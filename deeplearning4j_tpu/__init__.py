@@ -1,0 +1,21 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of the Eclipse
+Deeplearning4j ecosystem (reference surveyed in SURVEY.md):
+
+- ``ndarray``   — eager NDArray API (INDArray/Nd4j analog)
+- ``ops``       — registered op library (libnd4j declarable-op analog)
+- ``autodiff``  — define-then-run graph + jit/grad (SameDiff analog)
+- ``nn``        — layer-based NN API (DL4J MultiLayerNetwork/ComputationGraph)
+- ``datasets``  — DataSet/iterators (nd4j dataset + dl4j-datasets analog)
+- ``parallel``  — mesh/sharding/distributed training (ParallelWrapper/Spark/PS analog)
+- ``etl``       — record readers + transform DSL (DataVec analog)
+- ``models``    — model zoo (deeplearning4j-zoo analog)
+"""
+
+__version__ = "0.1.0"
+
+from .common.config import get_environment  # noqa: F401
+from .common.dtype import DataType  # noqa: F401
+from .ndarray import factory as nd  # noqa: F401
+from .ndarray.ndarray import NDArray  # noqa: F401

@@ -1,0 +1,3 @@
+"""Define-then-run autodiff graph layer (SameDiff analog)."""
+from .samediff import SameDiff, SDVariable, VariableType  # noqa: F401
+from .training import TrainingConfig, History  # noqa: F401

@@ -1,0 +1,114 @@
+"""Listener framework for SameDiff training.
+
+Reference: `org/nd4j/autodiff/listeners/` — Listener/BaseListener lifecycle
+with impls HistoryListener, ScoreListener, ProfilingListener (chrome trace),
+CheckpointListener, OpBenchmarkListener. Op-level hooks don't exist under
+XLA (ops fuse into one program), so the surface is iteration/epoch-level —
+the hooks the reference's production listeners actually use.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional
+
+
+class BaseListener:
+    def iteration_done(self, sd, iteration: int, epoch: int, loss: float):
+        pass
+
+    def epoch_done(self, sd, epoch: int):
+        pass
+
+
+class ScoreListener(BaseListener):
+    """Logs loss every N iterations (reference ScoreListener)."""
+
+    def __init__(self, frequency: int = 10, log_fn=print):
+        self.frequency = frequency
+        self.log_fn = log_fn
+
+    def iteration_done(self, sd, iteration, epoch, loss):
+        if iteration % self.frequency == 0:
+            self.log_fn(f"iter {iteration} epoch {epoch}: loss {loss:.6f}")
+
+
+class HistoryListener(BaseListener):
+    def __init__(self):
+        self.losses: List[float] = []
+
+    def iteration_done(self, sd, iteration, epoch, loss):
+        self.losses.append(loss)
+
+
+class CheckpointListener(BaseListener):
+    """Periodic model save with retention (reference CheckpointListener)."""
+
+    def __init__(self, directory: str, save_every_n_iterations: int = None,
+                 save_every_n_epochs: int = None, keep_last: int = 3):
+        self.directory = directory
+        self.every_iter = save_every_n_iterations
+        self.every_epoch = save_every_n_epochs
+        self.keep_last = keep_last
+        self._saved: List[str] = []
+        os.makedirs(directory, exist_ok=True)
+
+    def _save(self, sd, tag: str):
+        path = os.path.join(self.directory, f"checkpoint_{tag}.zip")
+        sd.save(path, save_updater_state=True)
+        self._saved.append(path)
+        while len(self._saved) > self.keep_last:
+            old = self._saved.pop(0)
+            if os.path.exists(old):
+                os.remove(old)
+
+    def iteration_done(self, sd, iteration, epoch, loss):
+        if self.every_iter and iteration > 0 and iteration % self.every_iter == 0:
+            self._save(sd, f"iter{iteration}")
+
+    def epoch_done(self, sd, epoch):
+        if self.every_epoch and (epoch + 1) % self.every_epoch == 0:
+            self._save(sd, f"epoch{epoch}")
+
+
+class ProfilingListener(BaseListener):
+    """Chrome-trace JSON writer (reference ProfilingListener:51).
+
+    Per-op events are folded into one "train_step" event per iteration (XLA
+    fuses the graph); deep per-op profiles come from jax.profiler, which this
+    listener can trigger for a window of iterations.
+    """
+
+    def __init__(self, output_path: str, warmup: int = 1,
+                 jax_trace_dir: Optional[str] = None,
+                 jax_trace_iters: int = 0):
+        self.output_path = output_path
+        self.warmup = warmup
+        self.events: List[dict] = []
+        self._last_ts = None
+        self.jax_trace_dir = jax_trace_dir
+        self.jax_trace_iters = jax_trace_iters
+        self._tracing = False
+
+    def iteration_done(self, sd, iteration, epoch, loss):
+        now = time.time() * 1e6  # chrome trace uses microseconds
+        if self._last_ts is not None and iteration >= self.warmup:
+            self.events.append({
+                "name": "train_step", "ph": "X", "pid": 0, "tid": 0,
+                "ts": self._last_ts, "dur": now - self._last_ts,
+                "args": {"iteration": iteration, "epoch": epoch, "loss": loss},
+            })
+        self._last_ts = now
+        if self.jax_trace_dir and self.jax_trace_iters:
+            import jax
+            if iteration == self.warmup and not self._tracing:
+                jax.profiler.start_trace(self.jax_trace_dir)
+                self._tracing = True
+            elif self._tracing and iteration >= self.warmup + self.jax_trace_iters:
+                jax.profiler.stop_trace()
+                self._tracing = False
+
+    def epoch_done(self, sd, epoch):
+        with open(self.output_path, "w") as f:
+            json.dump({"traceEvents": self.events}, f)

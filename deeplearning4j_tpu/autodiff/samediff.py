@@ -1,0 +1,554 @@
+"""SameDiff: define-then-run autodiff graph.
+
+Reference: `org/nd4j/autodiff/samediff/SameDiff.java` (6865 lines),
+`SDVariable.java`, and the session interpreters
+(`internal/AbstractSession.java:296-391`, `InferenceSession.java`).
+
+TPU-native redesign (SURVEY.md §3.2 note): the reference interprets the graph
+op-by-op with a dependency tracker, one JNI call per op. Here the graph is a
+lightweight recorded program; execution *traces* it once into a jittable
+callable, so XLA compiles the whole graph into a single TPU computation —
+`jit` replaces InferenceSession, `jax.grad` replaces per-op `doDiff`
+(`DifferentialFunction.diff` / `createGradFunction` at SameDiff.java:4663),
+and TF-style Enter/Exit/Merge control-flow frames disappear in favor of
+`lax.cond`/`lax.while_loop`/`lax.scan` wrappers.
+
+Variable types mirror the reference's `VariableType`:
+VARIABLE (trainable), CONSTANT, PLACEHOLDER, ARRAY (op output).
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.dtype import DataType
+from ..ndarray.ndarray import NDArray
+from ..ops.registry import OpRegistry
+
+
+class VariableType(enum.Enum):
+    VARIABLE = "VARIABLE"      # trainable parameter
+    CONSTANT = "CONSTANT"
+    PLACEHOLDER = "PLACEHOLDER"
+    ARRAY = "ARRAY"            # op output
+
+
+class SDVariable:
+    """Symbolic variable handle (reference SDVariable.java).
+
+    Arithmetic on SDVariables records ops into the owning SameDiff graph.
+    """
+
+    def __init__(self, sd: "SameDiff", name: str, var_type: VariableType,
+                 shape: Optional[Tuple[int, ...]] = None, dtype: str = "float32"):
+        self.sd = sd
+        self.name = name
+        self.var_type = var_type
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+
+    # -- graph-building arithmetic --------------------------------------
+    def _bin(self, other, op_name):
+        other = self.sd._as_var(other)
+        return self.sd._record(op_name, [self, other])
+
+    def __add__(self, o): return self._bin(o, "add")
+    def __radd__(self, o): return self.sd._as_var(o)._bin(self, "add")
+    def __sub__(self, o): return self._bin(o, "subtract")
+    def __rsub__(self, o): return self.sd._as_var(o)._bin(self, "subtract")
+    def __mul__(self, o): return self._bin(o, "multiply")
+    def __rmul__(self, o): return self.sd._as_var(o)._bin(self, "multiply")
+    def __truediv__(self, o): return self._bin(o, "divide")
+    def __rtruediv__(self, o): return self.sd._as_var(o)._bin(self, "divide")
+    def __pow__(self, o): return self._bin(o, "Pow")
+    def __neg__(self): return self.sd._record("neg", [self])
+    def __matmul__(self, o): return self._bin(o, "matmul")
+
+    def add(self, o): return self.__add__(o)
+    def sub(self, o): return self.__sub__(o)
+    def mul(self, o): return self.__mul__(o)
+    def div(self, o): return self.__truediv__(o)
+    def mmul(self, o): return self._bin(o, "matmul")
+    def dot(self, o): return self._bin(o, "dot")
+
+    def __getitem__(self, idx):
+        return self.sd._record_fn(lambda x: x[idx], [self], label="getitem")
+
+    # common methods routed through the op registry
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return self.sd._record("reshape", [self], shape=shape)
+
+    def transpose(self, *axes):
+        return self.sd._record("transpose", [self],
+                               axes=axes if axes else None)
+
+    def sum(self, *dims, keep_dims=False):
+        return self.sd._record("reduce_sum", [self], dims=dims or None,
+                               keep_dims=keep_dims)
+
+    def mean(self, *dims, keep_dims=False):
+        return self.sd._record("reduce_mean", [self], dims=dims or None,
+                               keep_dims=keep_dims)
+
+    def max(self, *dims, keep_dims=False):
+        return self.sd._record("reduce_max", [self], dims=dims or None,
+                               keep_dims=keep_dims)
+
+    def min(self, *dims, keep_dims=False):
+        return self.sd._record("reduce_min", [self], dims=dims or None,
+                               keep_dims=keep_dims)
+
+    def std(self, *dims, keep_dims=False):
+        return self.sd._record("reduce_stdev", [self], dims=dims or None,
+                               keep_dims=keep_dims)
+
+    def argmax(self, dim=None):
+        return self.sd._record("argmax", [self], dims=dim)
+
+    def norm2(self, *dims):
+        return self.sd._record("reduce_norm2", [self], dims=dims or None)
+
+    def cast(self, dtype):
+        return self.sd._record("cast", [self], dtype=dtype)
+
+    def rank(self):
+        return self.sd._record("rank", [self])
+
+    # -- evaluation ------------------------------------------------------
+    def eval(self, placeholders: Dict[str, Any] = None) -> NDArray:
+        """Execute the graph up to this variable (reference SDVariable.eval)."""
+        return self.sd.output(placeholders or {}, [self.name])[self.name]
+
+    def get_arr(self) -> Optional[NDArray]:
+        return self.sd.get_arr_for_var(self.name)
+
+    def set_array(self, value):
+        self.sd.set_array(self.name, value)
+
+    def rename(self, new_name: str) -> "SDVariable":
+        self.sd.rename_variable(self.name, new_name)
+        return self
+
+    def __repr__(self):
+        return (f"SDVariable(name={self.name!r}, type={self.var_type.value}, "
+                f"shape={self.shape}, dtype={self.dtype})")
+
+
+class SameDiffOp:
+    """A recorded graph node (reference internal/SameDiffOp.java)."""
+
+    __slots__ = ("name", "op_name", "fn", "inputs", "outputs", "kwargs",
+                 "n_outputs", "needs_key")
+
+    def __init__(self, name, op_name, fn, inputs, outputs, kwargs,
+                 needs_key=False):
+        self.name = name
+        self.op_name = op_name
+        self.fn = fn
+        self.inputs = inputs       # list[str] variable names
+        self.outputs = outputs     # list[str] variable names
+        self.kwargs = kwargs
+        self.needs_key = needs_key  # op consumes a jax PRNG key (dropout etc.)
+
+
+class SameDiff:
+    """The define-then-run graph container + compiler.
+
+    Usage mirrors the reference:
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 784))
+        w = sd.var("w", nd.randn(784, 10))
+        out = sd.nn.softmax(x.mmul(w))
+        result = out.eval({"x": batch})
+    """
+
+    def __init__(self):
+        self._vars: Dict[str, SDVariable] = {}
+        self._arrays: Dict[str, jax.Array] = {}   # VARIABLE/CONSTANT values
+        self._ops: Dict[str, SameDiffOp] = {}
+        self._op_order: List[str] = []
+        self._producer: Dict[str, Tuple[str, int]] = {}  # var -> (op, out_idx)
+        self._name_counter = 0
+        self._scope: List[str] = []
+        self._jit_cache: Dict[Any, Callable] = {}
+        self._loss_variables: List[str] = []
+        self.training_config = None
+        self._updater_state = None
+        self._listeners: List[Any] = []
+        self._rng_seed = 0
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def create() -> "SameDiff":
+        return SameDiff()
+
+    # -- naming ----------------------------------------------------------
+    def _unique_name(self, base: str) -> str:
+        name = "/".join(self._scope + [base]) if self._scope else base
+        if name not in self._vars and name not in self._ops:
+            return name
+        while True:
+            self._name_counter += 1
+            cand = f"{name}_{self._name_counter}"
+            if cand not in self._vars and cand not in self._ops:
+                return cand
+
+    def name_scope(self, name: str):
+        sd = self
+
+        class _Scope:
+            def __enter__(self):
+                sd._scope.append(name)
+                return sd
+
+            def __exit__(self, *a):
+                sd._scope.pop()
+
+        return _Scope()
+
+    # -- variable creation ----------------------------------------------
+    def var(self, name: str, value=None, shape=None, dtype="float32",
+            initializer=None) -> SDVariable:
+        """Trainable VARIABLE (reference SameDiff.var)."""
+        name = self._unique_name(name)
+        if value is not None:
+            arr = value.jax() if isinstance(value, NDArray) else jnp.asarray(value)
+            shape = arr.shape
+            dtype = str(arr.dtype)
+        elif initializer is not None:
+            arr = initializer(shape)
+            arr = arr.jax() if isinstance(arr, NDArray) else jnp.asarray(arr)
+        else:
+            arr = jnp.zeros(shape, DataType.from_any(dtype).jax)
+        v = SDVariable(self, name, VariableType.VARIABLE, tuple(arr.shape),
+                       str(arr.dtype))
+        self._vars[name] = v
+        self._arrays[name] = arr
+        return v
+
+    def constant(self, value, name: str = "const") -> SDVariable:
+        name = self._unique_name(name)
+        arr = value.jax() if isinstance(value, NDArray) else jnp.asarray(value)
+        v = SDVariable(self, name, VariableType.CONSTANT, tuple(arr.shape),
+                       str(arr.dtype))
+        self._vars[name] = v
+        self._arrays[name] = arr
+        return v
+
+    def placeholder(self, name: str, shape=None, dtype="float32") -> SDVariable:
+        name = self._unique_name(name)
+        v = SDVariable(self, name, VariableType.PLACEHOLDER,
+                       tuple(shape) if shape else None, dtype)
+        self._vars[name] = v
+        return v
+
+    # aliases matching the reference API
+    def variable(self, *a, **k):
+        return self.var(*a, **k)
+
+    def one(self, name, shape):
+        return self.constant(jnp.ones(shape), name)
+
+    def zero(self, name, shape):
+        return self.constant(jnp.zeros(shape), name)
+
+    # -- graph recording -------------------------------------------------
+    def _as_var(self, x) -> SDVariable:
+        if isinstance(x, SDVariable):
+            return x
+        return self.constant(x)
+
+    def _record(self, op_name: str, inputs: Sequence[SDVariable],
+                n_outputs: int = 1, out_name: str = None, **kwargs) -> Union[
+                    SDVariable, Tuple[SDVariable, ...]]:
+        """Record a registered op as a graph node."""
+        opdef = OpRegistry.get().lookup(op_name)
+        OpRegistry.get().mark_executed(opdef.name)
+        return self._record_fn(opdef.fn, inputs, label=op_name,
+                               n_outputs=n_outputs, out_name=out_name, **kwargs)
+
+    def _record_fn(self, fn: Callable, inputs: Sequence[SDVariable],
+                   label: str = "fn", n_outputs: int = 1, out_name: str = None,
+                   needs_key: bool = False, **kwargs):
+        node_name = self._unique_name(label)
+        out_names = []
+        outs = []
+        for i in range(n_outputs):
+            base = out_name if (out_name and n_outputs == 1) else \
+                (f"{out_name}_{i}" if out_name else
+                 (node_name if n_outputs == 1 else f"{node_name}:{i}"))
+            oname = self._unique_name(base) if base in self._vars else base
+            if oname in self._vars:
+                oname = self._unique_name(base)
+            v = SDVariable(self, oname, VariableType.ARRAY)
+            self._vars[oname] = v
+            self._producer[oname] = (node_name, i)
+            out_names.append(oname)
+            outs.append(v)
+        node = SameDiffOp(node_name, label, fn,
+                          [v.name if v is not None else None for v in inputs],
+                          out_names, kwargs, needs_key=needs_key)
+        self._ops[node_name] = node
+        self._op_order.append(node_name)
+        return outs[0] if n_outputs == 1 else tuple(outs)
+
+    # -- generic op invocation (sd.op("conv2d", x, w, ...)) --------------
+    def invoke(self, op_name: str, *inputs, n_outputs: int = 1, **kwargs):
+        # None positional inputs pass through as literals (e.g. optional
+        # weights arg of loss ops)
+        return self._record(op_name,
+                            [self._as_var(i) if i is not None else None
+                             for i in inputs],
+                            n_outputs=n_outputs, **kwargs)
+
+    # -- tracing / execution ---------------------------------------------
+    def _trace(self, var_values: Dict[str, Any],
+               placeholder_values: Dict[str, Any],
+               requested: Sequence[str], rng_key=None) -> List[Any]:
+        """Interpret the recorded graph with jax values.
+
+        Runs once under jit tracing; afterwards XLA owns execution. This is
+        the whole-graph compile that replaces AbstractSession's
+        dependency-tracked loop (AbstractSession.java:296-391).
+        """
+        env: Dict[str, Any] = {}
+        env.update(var_values)
+        env.update(placeholder_values)
+        needed = self._dependencies(requested, set(env))
+        key = rng_key
+        for op_name in self._op_order:
+            if op_name not in needed:
+                continue
+            node = self._ops[op_name]
+            args = [env[i] if i is not None else None for i in node.inputs]
+            kwargs = dict(node.kwargs)
+            if node.needs_key:
+                key, sub = jax.random.split(key)
+                kwargs["key"] = sub
+            result = node.fn(*args, **kwargs)
+            if len(node.outputs) == 1:
+                env[node.outputs[0]] = result
+            else:
+                for oname, r in zip(node.outputs, result):
+                    env[oname] = r
+        return [env[r] for r in requested]
+
+    def _dependencies(self, requested: Sequence[str],
+                      available: set) -> set:
+        """Ops needed (transitively) to produce `requested`."""
+        needed_ops = set()
+        stack = [r for r in requested if r not in available]
+        seen_vars = set()
+        while stack:
+            var = stack.pop()
+            if var in seen_vars:
+                continue
+            seen_vars.add(var)
+            prod = self._producer.get(var)
+            if prod is None:
+                if var not in available and var not in self._arrays:
+                    raise KeyError(
+                        f"variable {var!r} has no value and no producer; "
+                        f"missing placeholder?")
+                continue
+            op_name, _ = prod
+            needed_ops.add(op_name)
+            for i in self._ops[op_name].inputs:
+                if i is not None and i not in available:
+                    stack.append(i)
+        return needed_ops
+
+    def _graph_epoch(self):
+        """Cache key component: changes whenever the graph mutates."""
+        return (len(self._op_order), len(self._vars))
+
+    def make_function(self, outputs: Sequence[str],
+                      placeholders: Sequence[str],
+                      with_rng: bool = False) -> Callable:
+        """Compile graph → jitted fn(var_dict, placeholder_dict[, key]) -> list."""
+        outputs = tuple(outputs)
+        placeholders = tuple(placeholders)
+        cache_key = (outputs, placeholders, with_rng, self._graph_epoch())
+        fn = self._jit_cache.get(cache_key)
+        if fn is None:
+            if with_rng:
+                def raw(variables, ph, key):
+                    return self._trace(variables, ph, outputs, key)
+            else:
+                def raw(variables, ph):
+                    return self._trace(variables, ph, outputs)
+            fn = jax.jit(raw)
+            self._jit_cache[cache_key] = fn
+        return fn
+
+    def output(self, placeholders: Dict[str, Any],
+               outputs: Sequence[Union[str, SDVariable]]) -> Dict[str, NDArray]:
+        """Inference execution (reference SameDiff.output, SameDiff.java:2746)."""
+        out_names = [o.name if isinstance(o, SDVariable) else o for o in outputs]
+        ph = {k: (v.jax() if isinstance(v, NDArray) else jnp.asarray(v))
+              for k, v in (placeholders or {}).items()}
+        fn = self.make_function(out_names, tuple(sorted(ph)))
+        results = fn(self._arrays, ph)
+        return {n: NDArray(r) for n, r in zip(out_names, results)}
+
+    def batch_output(self, placeholders=None, outputs=None):
+        return self.output(placeholders or {}, outputs)
+
+    # -- array access ----------------------------------------------------
+    def get_arr_for_var(self, name: str) -> Optional[NDArray]:
+        arr = self._arrays.get(name)
+        return NDArray(arr) if arr is not None else None
+
+    def set_array(self, name: str, value):
+        arr = value.jax() if isinstance(value, NDArray) else jnp.asarray(value)
+        self._arrays[name] = arr
+
+    def get_variable(self, name: str) -> SDVariable:
+        return self._vars[name]
+
+    def has_variable(self, name: str) -> bool:
+        return name in self._vars
+
+    def variables(self) -> List[SDVariable]:
+        return list(self._vars.values())
+
+    def variable_names(self) -> List[str]:
+        return list(self._vars)
+
+    def trainable_variables(self) -> List[SDVariable]:
+        return [v for v in self._vars.values()
+                if v.var_type == VariableType.VARIABLE]
+
+    def rename_variable(self, old: str, new: str):
+        v = self._vars.pop(old)
+        v.name = new
+        self._vars[new] = v
+        if old in self._arrays:
+            self._arrays[new] = self._arrays.pop(old)
+        if old in self._producer:
+            self._producer[new] = self._producer.pop(old)
+        for node in self._ops.values():
+            node.inputs = [new if i == old else i for i in node.inputs]
+            node.outputs = [new if o == old else o for o in node.outputs]
+        self._jit_cache.clear()
+
+    # -- loss marking ----------------------------------------------------
+    def set_loss_variables(self, *names):
+        self._loss_variables = [n.name if isinstance(n, SDVariable) else n
+                                for n in names]
+
+    def loss_variables(self):
+        return list(self._loss_variables)
+
+    # -- gradients -------------------------------------------------------
+    def calculate_gradients(self, placeholders: Dict[str, Any],
+                            wrt: Sequence[Union[str, SDVariable]],
+                            loss: Union[str, SDVariable] = None
+                            ) -> Dict[str, NDArray]:
+        """Analytic gradients of the (summed) loss wrt given variables.
+
+        Replaces the reference's grad-graph construction
+        (SameDiff.createGradFunction, SameDiff.java:4663): jax.grad of the
+        traced forward *is* the grad graph.
+        """
+        wrt_names = [w.name if isinstance(w, SDVariable) else w for w in wrt]
+        loss_name = (loss.name if isinstance(loss, SDVariable) else loss) or \
+            (self._loss_variables[0] if self._loss_variables else None)
+        if loss_name is None:
+            raise ValueError("no loss variable set")
+        ph = {k: (v.jax() if isinstance(v, NDArray) else jnp.asarray(v))
+              for k, v in (placeholders or {}).items()}
+
+        def loss_fn(wrt_vals):
+            variables = dict(self._arrays)
+            variables.update(wrt_vals)
+            out = self._trace(variables, ph, [loss_name])[0]
+            return jnp.sum(out)
+
+        grads = jax.grad(loss_fn)({n: self._arrays[n] for n in wrt_names})
+        return {n: NDArray(g) for n, g in grads.items()}
+
+    # -- namespaces (populated in ops_namespaces.py) ---------------------
+    @property
+    def math(self):
+        from .ops_namespaces import SDMath
+        return SDMath(self)
+
+    @property
+    def nn(self):
+        from .ops_namespaces import SDNN
+        return SDNN(self)
+
+    @property
+    def cnn(self):
+        from .ops_namespaces import SDCNN
+        return SDCNN(self)
+
+    @property
+    def rnn(self):
+        from .ops_namespaces import SDRNN
+        return SDRNN(self)
+
+    @property
+    def loss(self):
+        from .ops_namespaces import SDLoss
+        return SDLoss(self)
+
+    @property
+    def image(self):
+        from .ops_namespaces import SDImage
+        return SDImage(self)
+
+    @property
+    def random(self):
+        from .ops_namespaces import SDRandom
+        return SDRandom(self)
+
+    @property
+    def linalg(self):
+        from .ops_namespaces import SDLinalg
+        return SDLinalg(self)
+
+    @property
+    def bitwise(self):
+        from .ops_namespaces import SDBitwise
+        return SDBitwise(self)
+
+    # -- training (TrainingSession analog) in training.py ----------------
+    def fit(self, *args, **kwargs):
+        from .training import fit as _fit
+        return _fit(self, *args, **kwargs)
+
+    def set_training_config(self, config):
+        self.training_config = config
+
+    def add_listener(self, listener):
+        self._listeners.append(listener)
+
+    # -- summary ---------------------------------------------------------
+    def summary(self) -> str:
+        lines = [f"SameDiff: {len(self._vars)} variables, {len(self._ops)} ops"]
+        for v in self._vars.values():
+            lines.append(f"  {v.var_type.value:<12} {v.name:<30} "
+                         f"{v.shape} {v.dtype}")
+        for name in self._op_order:
+            node = self._ops[name]
+            lines.append(f"  OP {node.op_name:<20} {node.inputs} -> "
+                         f"{node.outputs}")
+        return "\n".join(lines)
+
+    # -- serialization (serialization.py) --------------------------------
+    def save(self, path, save_updater_state: bool = False):
+        from .serialization import save as _save
+        _save(self, path, save_updater_state)
+
+    @staticmethod
+    def load(path) -> "SameDiff":
+        from .serialization import load as _load
+        return _load(path)

@@ -1,0 +1,60 @@
+"""Global environment/config singleton.
+
+Analog of the reference's layered config system (SURVEY.md §5):
+`ND4JEnvironmentVars`/`ND4JSystemProperties` env+props and the native
+`sd::Environment` (libnd4j include/system/Environment.h:41). One Python
+singleton reads env vars once; runtime-mutable knobs are plain attributes.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v is not None else default
+
+
+class Environment:
+    """Process-wide knobs. `Nd4j.getEnvironment()` analog."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        # Reference: DEBUG/VERBOSE in sd::Environment
+        self.debug = _env_bool("DL4J_TPU_DEBUG")
+        self.verbose = _env_bool("DL4J_TPU_VERBOSE")
+        # Reference: ND4J_DTYPE default dtype property
+        self.default_float_dtype = os.environ.get("DL4J_TPU_DTYPE", "float32")
+        # MXU-native compute dtype for matmul/conv accumulation inputs.
+        self.matmul_precision = os.environ.get("DL4J_TPU_MATMUL_PRECISION", "default")
+        # NAN/INF panic modes (reference OpExecutioner.ProfilingMode)
+        self.nan_panic = _env_bool("DL4J_TPU_NAN_PANIC")
+        self.inf_panic = _env_bool("DL4J_TPU_INF_PANIC")
+        # Profiling
+        self.profiling = _env_bool("DL4J_TPU_PROFILING")
+        # Max host threads for the ETL/data pipeline (native Threads analog)
+        self.max_threads = _env_int("DL4J_TPU_MAX_THREADS", os.cpu_count() or 1)
+        # Eager-op jit cache toggle
+        self.eager_jit = _env_bool("DL4J_TPU_EAGER_JIT", True)
+
+    @classmethod
+    def get(cls) -> "Environment":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = Environment()
+        return cls._instance
+
+
+def get_environment() -> Environment:
+    return Environment.get()

@@ -1,0 +1,115 @@
+"""Data-type system.
+
+TPU-native analog of the reference's DataType enum + type dispatch
+(`libnd4j/include/types/`, `org/nd4j/linalg/api/buffer/DataType.java`).
+On TPU there is no hand-rolled BUILD_SINGLE_SELECTOR dispatch: XLA handles
+per-dtype codegen. We keep the reference's *names* and conversion semantics so
+user code ports cleanly, and map them onto JAX dtypes (bfloat16 is first-class
+because it is the MXU-native format).
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    """Mirrors the reference's dtype enum (names kept for API parity)."""
+
+    DOUBLE = "float64"
+    FLOAT = "float32"
+    HALF = "float16"
+    BFLOAT16 = "bfloat16"
+    LONG = "int64"
+    INT = "int32"
+    SHORT = "int16"
+    BYTE = "int8"
+    UBYTE = "uint8"
+    UINT16 = "uint16"
+    UINT32 = "uint32"
+    UINT64 = "uint64"
+    BOOL = "bool"
+    # UTF8/COMPRESSED exist in the reference; strings are host-side only here.
+    UTF8 = "object"
+
+    # ------------------------------------------------------------------
+    @property
+    def jax(self):
+        if self is DataType.UTF8:
+            raise TypeError("UTF8 is a host-side dtype; no device representation")
+        return jnp.dtype(self.value)
+
+    @property
+    def np(self):
+        if self is DataType.UTF8:
+            return np.dtype(object)
+        return np.dtype(self.value) if self.value != "bfloat16" else jnp.bfloat16
+
+    # -- classification, mirroring DataType.java helpers ----------------
+    def is_fp(self) -> bool:
+        return self in _FP
+
+    def is_int(self) -> bool:
+        return self in _INT or self in _UINT
+
+    def is_signed(self) -> bool:
+        return self in _FP or self in _INT
+
+    def is_unsigned(self) -> bool:
+        return self in _UINT
+
+    def width(self) -> int:
+        return _WIDTH[self]
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_any(x) -> "DataType":
+        if isinstance(x, DataType):
+            return x
+        if isinstance(x, str):
+            alias = _ALIASES.get(x.lower())
+            if alias is not None:
+                return alias
+            raise ValueError(f"unknown dtype: {x!r}")
+        d = jnp.dtype(x)
+        for dt in DataType:
+            if dt is DataType.UTF8:
+                continue
+            if jnp.dtype(dt.value) == d:
+                return dt
+        raise ValueError(f"unknown dtype: {x!r}")
+
+
+_FP = {DataType.DOUBLE, DataType.FLOAT, DataType.HALF, DataType.BFLOAT16}
+_INT = {DataType.LONG, DataType.INT, DataType.SHORT, DataType.BYTE}
+_UINT = {DataType.UBYTE, DataType.UINT16, DataType.UINT32, DataType.UINT64}
+_WIDTH = {
+    DataType.DOUBLE: 64, DataType.FLOAT: 32, DataType.HALF: 16,
+    DataType.BFLOAT16: 16, DataType.LONG: 64, DataType.INT: 32,
+    DataType.SHORT: 16, DataType.BYTE: 8, DataType.UBYTE: 8,
+    DataType.UINT16: 16, DataType.UINT32: 32, DataType.UINT64: 64,
+    DataType.BOOL: 8, DataType.UTF8: 0,
+}
+
+_ALIASES = {
+    "double": DataType.DOUBLE, "float64": DataType.DOUBLE, "f64": DataType.DOUBLE,
+    "float": DataType.FLOAT, "float32": DataType.FLOAT, "f32": DataType.FLOAT,
+    "half": DataType.HALF, "float16": DataType.HALF, "f16": DataType.HALF,
+    "bfloat16": DataType.BFLOAT16, "bf16": DataType.BFLOAT16,
+    "long": DataType.LONG, "int64": DataType.LONG, "i64": DataType.LONG,
+    "int": DataType.INT, "int32": DataType.INT, "i32": DataType.INT,
+    "short": DataType.SHORT, "int16": DataType.SHORT,
+    "byte": DataType.BYTE, "int8": DataType.BYTE,
+    "ubyte": DataType.UBYTE, "uint8": DataType.UBYTE,
+    "uint16": DataType.UINT16, "uint32": DataType.UINT32, "uint64": DataType.UINT64,
+    "bool": DataType.BOOL, "utf8": DataType.UTF8, "string": DataType.UTF8,
+}
+
+# Type-promotion table follows JAX/numpy rules, which match the reference's
+# `DataTypeUtil` "max type" behavior for the common cases.
+
+
+def promote(a: DataType, b: DataType) -> DataType:
+    return DataType.from_any(jnp.promote_types(a.jax, b.jax))

@@ -1,0 +1,4 @@
+from .dataset import DataSet, MultiDataSet  # noqa: F401
+from .iterators import (ArrayDataSetIterator, AsyncDataSetIterator,  # noqa: F401
+                        BenchmarkDataSetIterator, DataSetIterator,
+                        ListDataSetIterator)

@@ -1,0 +1,97 @@
+"""DataSet / MultiDataSet containers.
+
+Reference: `org/nd4j/linalg/dataset/DataSet.java`, `MultiDataSet.java` —
+features+labels (+masks) bundles with split/shuffle/normalize helpers.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ndarray.ndarray import NDArray
+from ..ndarray import factory as nd
+
+
+def _wrap(x):
+    if x is None or isinstance(x, NDArray):
+        return x
+    return NDArray(x)
+
+
+class DataSet:
+    """features + labels (+ optional masks)."""
+
+    def __init__(self, features=None, labels=None, features_mask=None,
+                 labels_mask=None):
+        self.features = _wrap(features)
+        self.labels = _wrap(labels)
+        self.features_mask = _wrap(features_mask)
+        self.labels_mask = _wrap(labels_mask)
+
+    def num_examples(self) -> int:
+        return self.features.shape[0] if self.features is not None else 0
+
+    def get_features(self):
+        return self.features
+
+    def get_labels(self):
+        return self.labels
+
+    def shuffle(self, seed: Optional[int] = None):
+        if seed is not None:
+            nd.set_seed(seed)
+        perm = np.random.RandomState(seed).permutation(self.num_examples())
+        self.features = NDArray(self.features.jax()[perm])
+        if self.labels is not None:
+            self.labels = NDArray(self.labels.jax()[perm])
+        return self
+
+    def split_test_and_train(self, num_train: int):
+        train = DataSet(self.features[:num_train].dup(),
+                        self.labels[:num_train].dup() if self.labels is not None else None)
+        test = DataSet(self.features[num_train:].dup(),
+                       self.labels[num_train:].dup() if self.labels is not None else None)
+        return train, test
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        n = self.num_examples()
+        return [DataSet(self.features[i:i + batch_size].dup(),
+                        self.labels[i:i + batch_size].dup()
+                        if self.labels is not None else None)
+                for i in range(0, n, batch_size)]
+
+    def sample(self, num: int, seed: Optional[int] = None) -> "DataSet":
+        idx = np.random.RandomState(seed).choice(self.num_examples(), num,
+                                                 replace=False)
+        return DataSet(NDArray(self.features.jax()[idx]),
+                       NDArray(self.labels.jax()[idx])
+                       if self.labels is not None else None)
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        feats = nd.concat([d.features for d in datasets], axis=0)
+        labs = nd.concat([d.labels for d in datasets], axis=0) \
+            if datasets[0].labels is not None else None
+        return DataSet(feats, labs)
+
+    def __repr__(self):
+        return (f"DataSet(features={None if self.features is None else self.features.shape}, "
+                f"labels={None if self.labels is None else self.labels.shape})")
+
+
+class MultiDataSet:
+    """Multiple feature/label arrays (reference MultiDataSet)."""
+
+    def __init__(self, features: Sequence = (), labels: Sequence = (),
+                 features_masks: Sequence = None, labels_masks: Sequence = None):
+        self.features = [_wrap(f) for f in features]
+        self.labels = [_wrap(l) for l in labels]
+        self.features_masks = ([_wrap(m) for m in features_masks]
+                               if features_masks else None)
+        self.labels_masks = ([_wrap(m) for m in labels_masks]
+                             if labels_masks else None)
+
+    def num_examples(self) -> int:
+        return self.features[0].shape[0] if self.features else 0

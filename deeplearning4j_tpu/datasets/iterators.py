@@ -1,0 +1,205 @@
+"""DataSetIterator implementations.
+
+Reference: `org/nd4j/linalg/dataset/api/iterator/` — DataSetIterator API with
+ListDataSetIterator, ExistingDataSetIterator, AsyncDataSetIterator (prefetch),
+plus DL4J's BenchmarkDataSetIterator.
+
+TPU: AsyncDataSetIterator's double-buffered host→device prefetch is the key
+performance piece — it overlaps host ETL with device compute so the MXU never
+waits on input (`jax.device_put` on the prefetch thread).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..ndarray.ndarray import NDArray
+from .dataset import DataSet
+
+
+class DataSetIterator:
+    """Base iterator protocol (reference DataSetIterator interface)."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+    def batch(self) -> int:
+        return -1
+
+    def async_supported(self) -> bool:
+        return True
+
+
+class ListDataSetIterator(DataSetIterator):
+    def __init__(self, datasets: Sequence[DataSet], batch_size: int = None):
+        self._list = list(datasets)
+        self._i = 0
+        self._batch = batch_size or (self._list[0].num_examples()
+                                     if self._list else 0)
+
+    def has_next(self):
+        return self._i < len(self._list)
+
+    def next(self):
+        ds = self._list[self._i]
+        self._i += 1
+        return ds
+
+    def reset(self):
+        self._i = 0
+
+    def batch(self):
+        return self._batch
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Batches a single (features, labels) pair (TestDataSetIterator analog)."""
+
+    def __init__(self, features, labels, batch_size: int, shuffle: bool = False,
+                 seed: int = 123):
+        self.features = features.jax() if isinstance(features, NDArray) else features
+        self.labels = labels.jax() if isinstance(labels, NDArray) else labels
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self._epoch = 0
+        self._order = None
+        self._i = 0
+        self.reset()
+
+    def reset(self):
+        n = self.features.shape[0]
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self._epoch)
+            self._order = rng.permutation(n)
+            self._epoch += 1
+        else:
+            self._order = np.arange(n)
+        self._i = 0
+
+    def has_next(self):
+        return self._i < len(self._order)
+
+    def next(self):
+        # final batch may be partial (reference iterator behavior); the one
+        # extra XLA compile for the ragged shape is accepted
+        idx = self._order[self._i:self._i + self.batch_size]
+        self._i += len(idx)
+        return DataSet(NDArray(self.features[idx]), NDArray(self.labels[idx]))
+
+    def batch(self):
+        return self.batch_size
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background prefetch wrapper (reference AsyncDataSetIterator).
+
+    A worker thread pulls from the underlying iterator and device_puts into a
+    bounded queue; consumer overlaps compute with host-side prep + H2D DMA.
+    """
+
+    def __init__(self, underlying: DataSetIterator, queue_size: int = 2,
+                 device=None):
+        self.underlying = underlying
+        self.queue_size = queue_size
+        self.device = device or jax.devices()[0]
+        self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._thread: Optional[threading.Thread] = None
+        self._done = object()
+        self._start()
+
+    def _start(self):
+        def worker():
+            try:
+                self.underlying.reset()
+                while self.underlying.has_next():
+                    ds = self.underlying.next()
+                    feats = jax.device_put(ds.features.jax(), self.device)
+                    labs = (jax.device_put(ds.labels.jax(), self.device)
+                            if ds.labels is not None else None)
+                    self._queue.put(DataSet(NDArray(feats),
+                                            None if labs is None else NDArray(labs)))
+            finally:
+                self._queue.put(self._done)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+        self._head = None
+        self._exhausted = False
+        self._advance()
+
+    def _advance(self):
+        item = self._queue.get()
+        if item is self._done:
+            self._head = None
+            self._exhausted = True
+        else:
+            self._head = item
+
+    def has_next(self):
+        return not self._exhausted
+
+    def next(self):
+        ds = self._head
+        self._advance()
+        return ds
+
+    def reset(self):
+        if self._thread is not None and self._thread.is_alive():
+            # drain remaining items so the worker can exit
+            while not self._exhausted:
+                self._advance()
+            self._thread.join()
+        self._queue = queue.Queue(maxsize=self.queue_size)
+        self._start()
+
+    def batch(self):
+        return self.underlying.batch()
+
+
+class BenchmarkDataSetIterator(DataSetIterator):
+    """Synthetic fixed batch, zero host overhead (reference
+    `BenchmarkDataSetIterator.java` — isolates model throughput from ETL)."""
+
+    def __init__(self, feature_shape, num_classes: int, num_batches: int,
+                 dtype="float32", seed: int = 42):
+        from ..ndarray import factory as nd
+        nd.set_seed(seed)
+        self._features = nd.randn(*feature_shape, dtype=dtype)
+        labels_idx = np.random.RandomState(seed).randint(
+            0, num_classes, feature_shape[0])
+        self._labels = nd.one_hot(labels_idx, num_classes)
+        self.num_batches = num_batches
+        self._i = 0
+
+    def has_next(self):
+        return self._i < self.num_batches
+
+    def next(self):
+        self._i += 1
+        return DataSet(self._features, self._labels)
+
+    def reset(self):
+        self._i = 0
+
+    def batch(self):
+        return self._features.shape[0]

@@ -1,0 +1,270 @@
+"""Updater (optimizer) configurations.
+
+Reference: `org/nd4j/linalg/learning/config/` — IUpdater impls (Sgd, Adam,
+AdaMax, AdaBelief, AdaDelta, AdaGrad, AMSGrad, Nadam, Nesterovs, RmsProp,
+NoOp) each paired with a GradientUpdater applying native updater ops.
+
+TPU shape: each config builds `(init(params) -> state, apply(grad, state,
+iteration) -> (update, state'))` pure functions over pytrees, implemented on
+the registered updater ops so the graph/NN layers share one code path.
+Learning-rate schedules (ISchedule analog) are callables `f(iteration) -> lr`.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from .ops import updater_ops
+
+Schedule = Union[float, Callable[[Any], Any]]
+
+
+def _lr_at(lr: Schedule, iteration):
+    return lr(iteration) if callable(lr) else lr
+
+
+def _tree(fn, *trees):
+    return jax.tree_util.tree_map(fn, *trees)
+
+
+class IUpdater:
+    """Base updater config. Subclasses define state init and per-leaf apply."""
+
+    def init(self, params):
+        return None
+
+    def apply(self, grads, state, iteration):
+        raise NotImplementedError
+
+    # JSON-ish serde for ModelSerializer
+    def to_dict(self):
+        d = {"@class": type(self).__name__}
+        d.update(dataclasses.asdict(self))
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        cls = _UPDATERS[d.pop("@class")]
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class NoOp(IUpdater):
+    def apply(self, grads, state, iteration):
+        return _tree(jnp.zeros_like, grads), state
+
+
+@dataclasses.dataclass
+class Sgd(IUpdater):
+    learning_rate: Schedule = 1e-1
+
+    def apply(self, grads, state, iteration):
+        lr = _lr_at(self.learning_rate, iteration)
+        return _tree(lambda g: updater_ops.sgd_updater(g, lr), grads), state
+
+
+@dataclasses.dataclass
+class Nesterovs(IUpdater):
+    learning_rate: Schedule = 1e-1
+    momentum: float = 0.9
+
+    def init(self, params):
+        return {"v": _tree(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration):
+        lr = _lr_at(self.learning_rate, iteration)
+        pairs = _tree(lambda g, v: updater_ops.nesterovs_updater(
+            g, v, lr, self.momentum), grads, state["v"])
+        update = _tree(lambda p: p[0], pairs, is_leaf=lambda x: isinstance(x, tuple))
+        v = _tree(lambda p: p[1], pairs, is_leaf=lambda x: isinstance(x, tuple))
+        return update, {"v": v}
+
+
+def _stateful(op_fn, n_state, hyper_fn):
+    """Build apply() for updaters with n state tensors per param."""
+    def apply(grads, state, iteration, states):
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_states = [jax.tree_util.tree_flatten(s)[0] for s in states]
+        updates, new_states = [], [[] for _ in range(n_state)]
+        for i, g in enumerate(flat_g):
+            res = op_fn(g, *[fs[i] for fs in flat_states],
+                        **hyper_fn(iteration))
+            updates.append(res[0])
+            for j in range(n_state):
+                new_states[j].append(res[1 + j])
+        unflatten = treedef.unflatten
+        return (unflatten(updates),
+                [unflatten(ns) for ns in new_states])
+    return apply
+
+
+@dataclasses.dataclass
+class Adam(IUpdater):
+    learning_rate: Schedule = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        z = _tree(jnp.zeros_like, params)
+        return {"u": z, "m": _tree(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration):
+        hyper = dict(lr=_lr_at(self.learning_rate, iteration),
+                     beta1=self.beta1, beta2=self.beta2, eps=self.epsilon,
+                     iteration=iteration)
+        fn = _stateful(updater_ops.adam_updater, 2, lambda it: hyper)
+        update, (u, m) = fn(grads, state, iteration, [state["u"], state["m"]])
+        return update, {"u": u, "m": m}
+
+
+@dataclasses.dataclass
+class AdaMax(Adam):
+    def apply(self, grads, state, iteration):
+        hyper = dict(lr=_lr_at(self.learning_rate, iteration),
+                     beta1=self.beta1, beta2=self.beta2, eps=self.epsilon,
+                     iteration=iteration)
+        fn = _stateful(updater_ops.ada_max_updater, 2, lambda it: hyper)
+        update, (u, m) = fn(grads, state, iteration, [state["u"], state["m"]])
+        return update, {"u": u, "m": m}
+
+
+@dataclasses.dataclass
+class AdaBelief(Adam):
+    epsilon: float = 1e-14
+
+    def apply(self, grads, state, iteration):
+        hyper = dict(lr=_lr_at(self.learning_rate, iteration),
+                     beta1=self.beta1, beta2=self.beta2, eps=self.epsilon,
+                     iteration=iteration)
+        fn = _stateful(updater_ops.adabelief_updater, 2, lambda it: hyper)
+        update, (u, m) = fn(grads, state, iteration, [state["u"], state["m"]])
+        return update, {"u": u, "m": m}
+
+
+@dataclasses.dataclass
+class Nadam(Adam):
+    def apply(self, grads, state, iteration):
+        hyper = dict(lr=_lr_at(self.learning_rate, iteration),
+                     beta1=self.beta1, beta2=self.beta2, eps=self.epsilon,
+                     iteration=iteration)
+        fn = _stateful(updater_ops.nadam_updater, 2, lambda it: hyper)
+        update, (u, m) = fn(grads, state, iteration, [state["u"], state["m"]])
+        return update, {"u": u, "m": m}
+
+
+@dataclasses.dataclass
+class AMSGrad(Adam):
+    def init(self, params):
+        z = lambda: _tree(jnp.zeros_like, params)  # noqa: E731
+        return {"v": z(), "m": z(), "h": z()}
+
+    def apply(self, grads, state, iteration):
+        hyper = dict(lr=_lr_at(self.learning_rate, iteration),
+                     beta1=self.beta1, beta2=self.beta2, eps=self.epsilon,
+                     iteration=iteration)
+        fn = _stateful(updater_ops.ams_grad_updater, 3, lambda it: hyper)
+        update, (v, m, h) = fn(grads, state, iteration,
+                               [state["v"], state["m"], state["h"]])
+        return update, {"v": v, "m": m, "h": h}
+
+
+@dataclasses.dataclass
+class AdaGrad(IUpdater):
+    learning_rate: Schedule = 1e-1
+    epsilon: float = 1e-6
+
+    def init(self, params):
+        return {"h": _tree(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration):
+        hyper = dict(lr=_lr_at(self.learning_rate, iteration), eps=self.epsilon)
+        fn = _stateful(updater_ops.ada_grad_updater, 1, lambda it: hyper)
+        update, (h,) = fn(grads, state, iteration, [state["h"]])
+        return update, {"h": h}
+
+
+@dataclasses.dataclass
+class AdaDelta(IUpdater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init(self, params):
+        return {"msg": _tree(jnp.zeros_like, params),
+                "msdx": _tree(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration):
+        hyper = dict(rho=self.rho, eps=self.epsilon)
+        fn = _stateful(updater_ops.ada_delta_updater, 2, lambda it: hyper)
+        update, (msg, msdx) = fn(grads, state, iteration,
+                                 [state["msg"], state["msdx"]])
+        return update, {"msg": msg, "msdx": msdx}
+
+
+@dataclasses.dataclass
+class RmsProp(IUpdater):
+    learning_rate: Schedule = 1e-1
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"g": _tree(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration):
+        hyper = dict(lr=_lr_at(self.learning_rate, iteration),
+                     decay=self.rms_decay, eps=self.epsilon)
+        fn = _stateful(updater_ops.rms_prop_updater, 1, lambda it: hyper)
+        update, (g,) = fn(grads, state, iteration, [state["g"]])
+        return update, {"g": g}
+
+
+_UPDATERS = {c.__name__: c for c in
+             [NoOp, Sgd, Nesterovs, Adam, AdaMax, AdaBelief, Nadam, AMSGrad,
+              AdaGrad, AdaDelta, RmsProp]}
+
+
+# -- learning-rate schedules (ISchedule analog, linalg/schedule/) --------
+def step_schedule(initial: float, decay_rate: float, step: int):
+    def f(iteration):
+        return initial * (decay_rate ** (iteration // step))
+    return f
+
+
+def exponential_schedule(initial: float, gamma: float):
+    def f(iteration):
+        return initial * (gamma ** iteration)
+    return f
+
+
+def inverse_schedule(initial: float, gamma: float, power: float = 1.0):
+    def f(iteration):
+        return initial / (1 + gamma * iteration) ** power
+    return f
+
+
+def poly_schedule(initial: float, power: float, max_iter: int):
+    def f(iteration):
+        frac = jnp.minimum(iteration / max_iter, 1.0)
+        return initial * (1 - frac) ** power
+    return f
+
+
+def cosine_schedule(initial: float, max_iter: int, final: float = 0.0):
+    def f(iteration):
+        frac = jnp.minimum(iteration / max_iter, 1.0)
+        return final + 0.5 * (initial - final) * (1 + jnp.cos(jnp.pi * frac))
+    return f
+
+
+def warmup_linear_schedule(peak: float, warmup_iters: int, total_iters: int):
+    def f(iteration):
+        it = jnp.asarray(iteration, jnp.float32)
+        warm = peak * it / jnp.maximum(warmup_iters, 1)
+        decay = peak * jnp.maximum(
+            (total_iters - it) / jnp.maximum(total_iters - warmup_iters, 1), 0.0)
+        return jnp.where(it < warmup_iters, warm, decay)
+    return f

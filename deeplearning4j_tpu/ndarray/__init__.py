@@ -1,0 +1,2 @@
+from .ndarray import NDArray  # noqa: F401
+from . import factory  # noqa: F401

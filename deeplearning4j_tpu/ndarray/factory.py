@@ -1,0 +1,224 @@
+"""Array factory: the `Nd4j` static-factory analog.
+
+Reference: `org/nd4j/linalg/factory/Nd4j.java` (6564 lines). There the factory
+routes through a backend SPI to native buffers; here creation maps directly to
+jnp (device placement and layout are XLA's job). RNG mirrors the reference's
+stateful `Nd4j.getRandom()` on top of JAX's splittable keys: a process-global
+key is split per call, so eager creation is convenient *and* deterministic
+under `set_seed`, while graph-mode code uses explicit keys.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.dtype import DataType
+from .ndarray import NDArray, _unwrap
+
+
+class _GlobalRng:
+    """Stateful RNG facade over jax.random keys (NativeRandom analog)."""
+
+    def __init__(self, seed: int = 119):  # reference default seed
+        self._lock = threading.Lock()
+        self._key = jax.random.key(seed)
+        self._seed = seed
+
+    def set_seed(self, seed: int):
+        with self._lock:
+            self._key = jax.random.key(seed)
+            self._seed = seed
+
+    def get_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+
+_rng = _GlobalRng()
+
+
+def get_random() -> _GlobalRng:
+    return _rng
+
+
+def set_seed(seed: int):
+    _rng.set_seed(seed)
+
+
+def _dt(dtype) -> Optional[jnp.dtype]:
+    return DataType.from_any(dtype).jax if dtype is not None else None
+
+
+def _shape(args) -> tuple:
+    if len(args) == 1 and isinstance(args[0], (tuple, list)):
+        return tuple(int(s) for s in args[0])
+    return tuple(int(s) for s in args)
+
+
+# -- creation -----------------------------------------------------------
+
+def create(data, dtype=None) -> NDArray:
+    return NDArray(data, dtype=dtype)
+
+
+def zeros(*shape, dtype="float32") -> NDArray:
+    return NDArray(jnp.zeros(_shape(shape), dtype=_dt(dtype)))
+
+
+def ones(*shape, dtype="float32") -> NDArray:
+    return NDArray(jnp.ones(_shape(shape), dtype=_dt(dtype)))
+
+
+def full(shape, value, dtype="float32") -> NDArray:
+    return NDArray(jnp.full(_shape((shape,)), value, dtype=_dt(dtype)))
+
+
+def value_array_of(shape, value, dtype="float32") -> NDArray:
+    return full(shape, value, dtype)
+
+
+def zeros_like(a) -> NDArray:
+    return NDArray(jnp.zeros_like(_unwrap(a)))
+
+
+def ones_like(a) -> NDArray:
+    return NDArray(jnp.ones_like(_unwrap(a)))
+
+
+def eye(n, m=None, dtype="float32") -> NDArray:
+    return NDArray(jnp.eye(n, m, dtype=_dt(dtype)))
+
+
+def arange(*args, dtype=None) -> NDArray:
+    return NDArray(jnp.arange(*args, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype="float32") -> NDArray:
+    return NDArray(jnp.linspace(start, stop, num, dtype=_dt(dtype)))
+
+
+def scalar(value, dtype=None) -> NDArray:
+    return NDArray(jnp.asarray(value, dtype=_dt(dtype)))
+
+
+def empty(dtype="float32") -> NDArray:
+    """Zero-length array (reference empty-shape semantics, EmptyHandling.h)."""
+    return NDArray(jnp.zeros((0,), dtype=_dt(dtype)))
+
+
+def from_numpy(a: np.ndarray) -> NDArray:
+    return NDArray(jnp.asarray(a))
+
+
+# -- random -------------------------------------------------------------
+
+def rand(*shape, dtype="float32", key=None) -> NDArray:
+    key = key if key is not None else _rng.next_key()
+    return NDArray(jax.random.uniform(key, _shape(shape), dtype=_dt(dtype)))
+
+
+def randn(*shape, dtype="float32", key=None) -> NDArray:
+    key = key if key is not None else _rng.next_key()
+    return NDArray(jax.random.normal(key, _shape(shape), dtype=_dt(dtype)))
+
+
+def randint(low, high, shape, dtype="int32", key=None) -> NDArray:
+    key = key if key is not None else _rng.next_key()
+    return NDArray(jax.random.randint(key, _shape((shape,)), low, high,
+                                      dtype=_dt(dtype)))
+
+
+def bernoulli(p, shape, dtype="float32", key=None) -> NDArray:
+    key = key if key is not None else _rng.next_key()
+    return NDArray(jax.random.bernoulli(key, p, _shape((shape,))).astype(_dt(dtype)))
+
+
+def shuffle(a, key=None) -> NDArray:
+    key = key if key is not None else _rng.next_key()
+    return NDArray(jax.random.permutation(key, _unwrap(a), axis=0))
+
+
+# -- combining ----------------------------------------------------------
+
+def concat(arrays: Sequence, axis: int = 0) -> NDArray:
+    return NDArray(jnp.concatenate([_unwrap(a) for a in arrays], axis=axis))
+
+
+def hstack(arrays) -> NDArray:
+    return NDArray(jnp.hstack([_unwrap(a) for a in arrays]))
+
+
+def vstack(arrays) -> NDArray:
+    return NDArray(jnp.vstack([_unwrap(a) for a in arrays]))
+
+
+def stack(arrays, axis: int = 0) -> NDArray:
+    return NDArray(jnp.stack([_unwrap(a) for a in arrays], axis=axis))
+
+
+def pile(arrays) -> NDArray:
+    return stack(arrays, axis=0)
+
+
+def tear(a, axis: int = 0):
+    arr = _unwrap(a)
+    return [NDArray(x) for x in jnp.split(arr, arr.shape[axis], axis=axis)]
+
+
+def split(a, n_or_sections, axis: int = 0):
+    return [NDArray(x) for x in jnp.split(_unwrap(a), n_or_sections, axis=axis)]
+
+
+def where(cond, x=None, y=None):
+    if x is None:
+        return tuple(NDArray(i) for i in jnp.where(_unwrap(cond)))
+    return NDArray(jnp.where(_unwrap(cond), _unwrap(x), _unwrap(y)))
+
+
+def sort(a, axis: int = -1, descending: bool = False) -> NDArray:
+    r = jnp.sort(_unwrap(a), axis=axis)
+    if descending:
+        r = jnp.flip(r, axis=axis)
+    return NDArray(r)
+
+
+def argsort(a, axis: int = -1, descending: bool = False) -> NDArray:
+    r = jnp.argsort(_unwrap(a), axis=axis)
+    if descending:
+        r = jnp.flip(r, axis=axis)
+    return NDArray(r)
+
+
+def diag(a) -> NDArray:
+    return NDArray(jnp.diag(_unwrap(a)))
+
+
+def pad(a, pad_width, mode="constant", constant_values=0) -> NDArray:
+    if mode == "constant":
+        return NDArray(jnp.pad(_unwrap(a), pad_width, mode=mode,
+                               constant_values=constant_values))
+    return NDArray(jnp.pad(_unwrap(a), pad_width, mode=mode))
+
+
+def flip(a, *axes) -> NDArray:
+    return NDArray(jnp.flip(_unwrap(a), axis=tuple(axes) if axes else None))
+
+
+def roll(a, shift, axis=None) -> NDArray:
+    return NDArray(jnp.roll(_unwrap(a), shift, axis=axis))
+
+
+def gather(a, indices, axis: int = 0) -> NDArray:
+    return NDArray(jnp.take(_unwrap(a), _unwrap(indices), axis=axis))
+
+
+def one_hot(indices, depth: int, dtype="float32") -> NDArray:
+    return NDArray(jax.nn.one_hot(_unwrap(indices), depth, dtype=_dt(dtype)))

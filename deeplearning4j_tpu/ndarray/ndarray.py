@@ -1,0 +1,431 @@
+"""NDArray: the eager tensor type.
+
+TPU-native analog of the reference's INDArray/BaseNDArray
+(`org/nd4j/linalg/api/ndarray/INDArray.java`, `BaseNDArray.java`) and the
+native NDArray (`libnd4j/include/array/NDArray.h`).
+
+Design (SURVEY.md §7 "hard parts" #1): the reference exposes strided views
+with in-place writes over shared buffers. XLA arrays are immutable, so we
+emulate the *semantics* functionally:
+
+- An NDArray owns a ``jax.Array`` (immutable). "In-place" methods (``addi``,
+  ``assign``, ``put_scalar`` ...) swap the wrapped buffer for a new one.
+- A *view* records ``(parent, index)``. Reads slice lazily; writes rebuild the
+  parent's buffer via ``parent.at[index].set(...)`` and propagate up the view
+  chain. This is copy-on-write: no data is copied until a write happens, and
+  XLA's donation/aliasing keeps the update in-place on device where possible.
+
+This gives reference-compatible behavior (write-through views, flattened
+parameter views used by the updater machinery) without fighting XLA.
+Everything stays on device; there is no host round-trip on the hot path.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.dtype import DataType
+
+Index = Any
+
+
+def _unwrap(x):
+    return x.jax() if isinstance(x, NDArray) else x
+
+
+class NDArray:
+    """Dense tensor wrapping an immutable jax.Array with view write-through."""
+
+    __slots__ = ("_buf", "_parent", "_index", "__weakref__")
+
+    def __init__(self, data, dtype=None, *, _parent: "NDArray" = None,
+                 _index: Index = None):
+        if _parent is not None:
+            self._buf = None  # lazily sliced from parent
+            self._parent = _parent
+            self._index = _index
+        else:
+            if isinstance(data, NDArray):
+                data = data.jax()
+            if dtype is not None:
+                dtype = DataType.from_any(dtype).jax
+            if isinstance(data, jax.Array) and (dtype is None or data.dtype == dtype):
+                self._buf = data
+            else:
+                self._buf = jnp.asarray(data, dtype=dtype)
+            self._parent = None
+            self._index = None
+
+    # -- buffer access --------------------------------------------------
+    def jax(self) -> jax.Array:
+        """The current immutable device buffer (slicing views lazily)."""
+        if self._parent is not None:
+            return self._parent.jax()[self._index]
+        return self._buf
+
+    def _set_buf(self, new_buf: jax.Array) -> "NDArray":
+        """Write-through: replace this array's contents.
+
+        Views propagate into the parent buffer (BaseNDArray view-write
+        semantics); root arrays just swap the wrapped buffer.
+        """
+        if self._parent is not None:
+            self._parent._set_buf(self._parent.jax().at[self._index].set(new_buf))
+        else:
+            self._buf = new_buf
+        return self
+
+    # -- shape metadata (shapeInfo analog) -------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.jax().shape)
+
+    @property
+    def rank(self) -> int:
+        return self.jax().ndim
+
+    @property
+    def ndim(self) -> int:
+        return self.jax().ndim
+
+    def length(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def size(self) -> int:
+        return self.length()
+
+    @property
+    def dtype(self) -> DataType:
+        return DataType.from_any(self.jax().dtype)
+
+    def data_type(self) -> DataType:
+        return self.dtype
+
+    def is_view(self) -> bool:
+        return self._parent is not None
+
+    def is_scalar(self) -> bool:
+        return self.rank == 0 or self.length() == 1
+
+    def is_vector(self) -> bool:
+        return self.rank == 1 or (self.rank == 2 and 1 in self.shape)
+
+    def is_matrix(self) -> bool:
+        return self.rank == 2
+
+    def rows(self) -> int:
+        return self.shape[0]
+
+    def columns(self) -> int:
+        return self.shape[1]
+
+    def size_at(self, dim: int) -> int:
+        return self.shape[dim]
+
+    # -- conversion ------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.jax())
+
+    def to_list(self):
+        return self.numpy().tolist()
+
+    def item(self):
+        return self.jax().item()
+
+    def get_double(self, *indices) -> float:
+        return float(self.jax()[tuple(indices)] if indices else self.jax())
+
+    def get_int(self, *indices) -> int:
+        return int(self.jax()[tuple(indices)] if indices else self.jax())
+
+    def cast_to(self, dtype) -> "NDArray":
+        return NDArray(self.jax().astype(DataType.from_any(dtype).jax))
+
+    astype = cast_to
+
+    # -- copies / views --------------------------------------------------
+    def dup(self) -> "NDArray":
+        """Detached copy (reference `INDArray.dup()`)."""
+        return NDArray(self.jax())
+
+    def detach(self) -> "NDArray":
+        return self.dup()
+
+    def __getitem__(self, index) -> "NDArray":
+        """Strided view; writes through to this array."""
+        return NDArray(None, _parent=self, _index=index)
+
+    def __setitem__(self, index, value):
+        v = _unwrap(value)
+        self._set_buf(self.jax().at[index].set(v))
+
+    def get(self, *indices) -> "NDArray":
+        return self[tuple(indices)]
+
+    def put(self, index, value) -> "NDArray":
+        self[index] = value
+        return self
+
+    def put_scalar(self, indices, value) -> "NDArray":
+        if not isinstance(indices, (tuple, list)):
+            indices = (indices,)
+        self[tuple(indices)] = value
+        return self
+
+    putScalar = put_scalar
+
+    def assign(self, other) -> "NDArray":
+        """In-place overwrite (broadcasts), reference `INDArray.assign`."""
+        v = _unwrap(other)
+        return self._set_buf(jnp.broadcast_to(jnp.asarray(v, self.jax().dtype),
+                                              self.shape))
+
+    # -- shape ops -------------------------------------------------------
+    def reshape(self, *shape) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(self.jax().reshape(shape))
+
+    def ravel(self) -> "NDArray":
+        return NDArray(self.jax().ravel())
+
+    def flatten(self) -> "NDArray":
+        return self.ravel()
+
+    def transpose(self, *axes) -> "NDArray":
+        if not axes:
+            return NDArray(self.jax().T)
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return NDArray(jnp.transpose(self.jax(), axes))
+
+    def permute(self, *axes) -> "NDArray":
+        return self.transpose(*axes)
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    def swap_axes(self, a: int, b: int) -> "NDArray":
+        return NDArray(jnp.swapaxes(self.jax(), a, b))
+
+    def broadcast_to(self, shape) -> "NDArray":
+        return NDArray(jnp.broadcast_to(self.jax(), tuple(shape)))
+
+    def repeat(self, repeats, axis=None) -> "NDArray":
+        return NDArray(jnp.repeat(self.jax(), repeats, axis=axis))
+
+    def tile(self, reps) -> "NDArray":
+        return NDArray(jnp.tile(self.jax(), reps))
+
+    def squeeze(self, axis=None) -> "NDArray":
+        return NDArray(jnp.squeeze(self.jax(), axis=axis))
+
+    def expand_dims(self, axis: int) -> "NDArray":
+        return NDArray(jnp.expand_dims(self.jax(), axis))
+
+    # -- arithmetic (functional) ----------------------------------------
+    def _binary(self, other, fn) -> "NDArray":
+        return NDArray(fn(self.jax(), _unwrap(other)))
+
+    def __add__(self, o): return self._binary(o, jnp.add)
+    def __radd__(self, o): return self._binary(o, lambda a, b: jnp.add(b, a))
+    def __sub__(self, o): return self._binary(o, jnp.subtract)
+    def __rsub__(self, o): return self._binary(o, lambda a, b: jnp.subtract(b, a))
+    def __mul__(self, o): return self._binary(o, jnp.multiply)
+    def __rmul__(self, o): return self._binary(o, lambda a, b: jnp.multiply(b, a))
+    def __truediv__(self, o): return self._binary(o, jnp.divide)
+    def __rtruediv__(self, o): return self._binary(o, lambda a, b: jnp.divide(b, a))
+    def __pow__(self, o): return self._binary(o, jnp.power)
+    def __mod__(self, o): return self._binary(o, jnp.mod)
+    def __neg__(self): return NDArray(-self.jax())
+    def __abs__(self): return NDArray(jnp.abs(self.jax()))
+    def __matmul__(self, o): return self.mmul(o)
+
+    # reference-style names
+    def add(self, o): return self.__add__(o)
+    def sub(self, o): return self.__sub__(o)
+    def mul(self, o): return self.__mul__(o)
+    def div(self, o): return self.__truediv__(o)
+    def rsub(self, o): return self.__rsub__(o)
+    def rdiv(self, o): return self.__rtruediv__(o)
+    def neg(self): return self.__neg__()
+
+    # in-place variants (addi/subi/muli/divi/rsubi/rdivi/negi)
+    def addi(self, o): return self._set_buf(jnp.add(self.jax(), _unwrap(o)))
+    def subi(self, o): return self._set_buf(jnp.subtract(self.jax(), _unwrap(o)))
+    def muli(self, o): return self._set_buf(jnp.multiply(self.jax(), _unwrap(o)))
+    def divi(self, o): return self._set_buf(jnp.divide(self.jax(), _unwrap(o)))
+    def rsubi(self, o): return self._set_buf(jnp.subtract(_unwrap(o), self.jax()))
+    def rdivi(self, o): return self._set_buf(jnp.divide(_unwrap(o), self.jax()))
+    def negi(self): return self._set_buf(-self.jax())
+
+    # -- comparisons -----------------------------------------------------
+    def __lt__(self, o): return self._binary(o, jnp.less)
+    def __le__(self, o): return self._binary(o, jnp.less_equal)
+    def __gt__(self, o): return self._binary(o, jnp.greater)
+    def __ge__(self, o): return self._binary(o, jnp.greater_equal)
+
+    def eq(self, o): return self._binary(o, jnp.equal)
+    def neq(self, o): return self._binary(o, jnp.not_equal)
+    def lt(self, o): return self.__lt__(o)
+    def gt(self, o): return self.__gt__(o)
+    def lte(self, o): return self.__le__(o)
+    def gte(self, o): return self.__ge__(o)
+
+    def __eq__(self, o):  # noqa: D105 - numpy-style elementwise equality
+        if isinstance(o, (NDArray, jax.Array, np.ndarray, int, float, bool)):
+            return self.eq(o)
+        return NotImplemented
+
+    def __ne__(self, o):
+        if isinstance(o, (NDArray, jax.Array, np.ndarray, int, float, bool)):
+            return self.neq(o)
+        return NotImplemented
+
+    __hash__ = None  # mutable wrapper
+
+    def equals(self, o, eps: float = 1e-5) -> bool:
+        """Value equality with tolerance (reference `INDArray.equals`)."""
+        o = _unwrap(o)
+        if tuple(o.shape) != self.shape:
+            return False
+        a = self.jax()
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            return bool(jnp.all(jnp.abs(a - o.astype(a.dtype)) <= eps))
+        return bool(jnp.all(a == o))
+
+    # -- linalg ----------------------------------------------------------
+    def mmul(self, other) -> "NDArray":
+        return NDArray(jnp.matmul(self.jax(), _unwrap(other)))
+
+    def dot(self, other) -> "NDArray":
+        return NDArray(jnp.dot(self.jax(), _unwrap(other)))
+
+    def mmuli(self, other) -> "NDArray":
+        return self._set_buf(jnp.matmul(self.jax(), _unwrap(other)))
+
+    # -- reductions ------------------------------------------------------
+    def _reduce(self, fn, dims, keepdims=False) -> "NDArray":
+        axis = None
+        if dims:
+            axis = tuple(d if d >= 0 else d + self.rank for d in dims)
+        return NDArray(fn(self.jax(), axis=axis, keepdims=keepdims))
+
+    def sum(self, *dims, keepdims=False): return self._reduce(jnp.sum, dims, keepdims)
+    def mean(self, *dims, keepdims=False): return self._reduce(jnp.mean, dims, keepdims)
+    def max(self, *dims, keepdims=False): return self._reduce(jnp.max, dims, keepdims)
+    def min(self, *dims, keepdims=False): return self._reduce(jnp.min, dims, keepdims)
+    def prod(self, *dims, keepdims=False): return self._reduce(jnp.prod, dims, keepdims)
+
+    def std(self, *dims, bias_corrected: bool = True, keepdims=False):
+        ddof = 1 if bias_corrected else 0
+        axis = tuple(dims) if dims else None
+        return NDArray(jnp.std(self.jax(), axis=axis, ddof=ddof, keepdims=keepdims))
+
+    def var(self, *dims, bias_corrected: bool = True, keepdims=False):
+        ddof = 1 if bias_corrected else 0
+        axis = tuple(dims) if dims else None
+        return NDArray(jnp.var(self.jax(), axis=axis, ddof=ddof, keepdims=keepdims))
+
+    def argmax(self, *dims):
+        axis = dims[0] if dims else None
+        return NDArray(jnp.argmax(self.jax(), axis=axis))
+
+    def argmin(self, *dims):
+        axis = dims[0] if dims else None
+        return NDArray(jnp.argmin(self.jax(), axis=axis))
+
+    def cumsum(self, axis=None): return NDArray(jnp.cumsum(self.jax(), axis=axis))
+    def cumprod(self, axis=None): return NDArray(jnp.cumprod(self.jax(), axis=axis))
+
+    def norm1(self, *dims):
+        return self._reduce(lambda a, axis, keepdims: jnp.sum(jnp.abs(a), axis=axis,
+                                                              keepdims=keepdims), dims)
+
+    def norm2(self, *dims):
+        return self._reduce(lambda a, axis, keepdims: jnp.sqrt(
+            jnp.sum(a * a, axis=axis, keepdims=keepdims)), dims)
+
+    def norm_max(self, *dims):
+        return self._reduce(lambda a, axis, keepdims: jnp.max(jnp.abs(a), axis=axis,
+                                                              keepdims=keepdims), dims)
+
+    normmax = norm_max
+
+    def sum_number(self) -> float: return float(jnp.sum(self.jax()))
+    def mean_number(self) -> float: return float(jnp.mean(self.jax()))
+    def max_number(self) -> float: return float(jnp.max(self.jax()))
+    def min_number(self) -> float: return float(jnp.min(self.jax()))
+    def std_number(self, bias_corrected: bool = True) -> float:
+        return float(jnp.std(self.jax(), ddof=1 if bias_corrected else 0))
+    def norm2_number(self) -> float:
+        return float(jnp.sqrt(jnp.sum(self.jax() ** 2)))
+    def norm1_number(self) -> float:
+        return float(jnp.sum(jnp.abs(self.jax())))
+
+    # -- rows/cols (reference getRow/getColumn etc.) ---------------------
+    def get_row(self, i: int) -> "NDArray":
+        return self[i]
+
+    def get_column(self, i: int) -> "NDArray":
+        return self[:, i]
+
+    def get_rows(self, idx) -> "NDArray":
+        return NDArray(self.jax()[jnp.asarray(idx)])
+
+    def get_columns(self, idx) -> "NDArray":
+        return NDArray(self.jax()[:, jnp.asarray(idx)])
+
+    def put_row(self, i: int, row) -> "NDArray":
+        self[i] = row
+        return self
+
+    def put_column(self, i: int, col) -> "NDArray":
+        self[:, i] = col
+        return self
+
+    def add_row_vector(self, v): return self._binary(v, lambda a, b: a + b)
+    def add_column_vector(self, v):
+        return NDArray(self.jax() + _unwrap(v).reshape(-1, 1))
+    def mul_row_vector(self, v): return self._binary(v, lambda a, b: a * b)
+    def mul_column_vector(self, v):
+        return NDArray(self.jax() * _unwrap(v).reshape(-1, 1))
+    def sub_row_vector(self, v): return self._binary(v, lambda a, b: a - b)
+    def div_row_vector(self, v): return self._binary(v, lambda a, b: a / b)
+
+    # -- misc ------------------------------------------------------------
+    def is_nan(self) -> "NDArray": return NDArray(jnp.isnan(self.jax()))
+    def is_inf(self) -> "NDArray": return NDArray(jnp.isinf(self.jax()))
+
+    def any_nan(self) -> bool: return bool(jnp.any(jnp.isnan(self.jax())))
+    def any_inf(self) -> bool: return bool(jnp.any(jnp.isinf(self.jax())))
+
+    def __len__(self) -> int:
+        return self.shape[0] if self.shape else 1
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        if self.length() != 1:
+            raise ValueError("truth value of multi-element NDArray is ambiguous")
+        return bool(self.jax())
+
+    def __float__(self): return float(self.jax())
+    def __int__(self): return int(self.jax())
+
+    def __repr__(self):
+        return f"NDArray(shape={self.shape}, dtype={self.dtype.name.lower()})\n{self.numpy()}"
+
+    def __str__(self):
+        return str(self.numpy())
+
+    # JAX interop: NDArray registers as a pytree leaf-convertible value.
+    def __jax_array__(self):
+        return self.jax()

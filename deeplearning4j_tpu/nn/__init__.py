@@ -1,0 +1,6 @@
+"""Layer-based NN API (DL4J analog)."""
+from .conf.config import (InputType, MultiLayerConfiguration,  # noqa: F401
+                          NeuralNetConfiguration)
+from .conf import layers  # noqa: F401
+from .evaluation import Evaluation, RegressionEvaluation, ROC  # noqa: F401
+from .multilayer import MultiLayerNetwork  # noqa: F401

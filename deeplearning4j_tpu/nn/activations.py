@@ -1,0 +1,51 @@
+"""Activation functions for the layer API.
+
+Reference: `org/nd4j/linalg/activations/Activation.java` enum + IActivation
+impls (`linalg/activations/impl/`). Names match the reference enum so config
+serde is compatible.
+"""
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+_ACTIVATIONS = {
+    "identity": lambda x: x,
+    "linear": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "sigmoid": jax.nn.sigmoid,
+    "hardsigmoid": jax.nn.hard_sigmoid,
+    "tanh": jnp.tanh,
+    "hardtanh": jax.nn.hard_tanh,
+    "rationaltanh": lambda x: 1.7159 * (0.6666667 * x) / (1.0 + jnp.abs(0.6666667 * x)),
+    "rectifiedtanh": lambda x: jnp.maximum(jnp.tanh(x), 0.0),
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+    "logsoftmax": lambda x: jax.nn.log_softmax(x, axis=-1),
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "cube": lambda x: x ** 3,
+    "swish": jax.nn.silu,
+    "mish": jax.nn.mish,
+    "thresholdedrelu": lambda x: jnp.where(x > 1.0, x, 0.0),
+}
+
+
+def get_activation(act: Union[str, Callable]) -> Callable:
+    if callable(act):
+        return act
+    try:
+        return _ACTIVATIONS[act.lower()]
+    except KeyError:
+        raise ValueError(f"unknown activation {act!r}; "
+                         f"known: {sorted(_ACTIVATIONS)}") from None
+
+
+def activation_names():
+    return sorted(_ACTIVATIONS)

@@ -1,0 +1,2 @@
+from .config import (InputType, MultiLayerConfiguration,  # noqa: F401
+                     NeuralNetConfiguration)

@@ -1,0 +1,312 @@
+"""NeuralNetConfiguration builder DSL + MultiLayerConfiguration.
+
+Reference: `org/deeplearning4j/nn/conf/NeuralNetConfiguration.java` builder →
+`MultiLayerConfiguration` (JSON-serializable), with InputType-driven shape
+inference and automatic input preprocessors
+(`conf/preprocessor/CnnToFeedForwardPreProcessor` etc.).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+from ...learning import Adam, IUpdater, Sgd
+from . import layers as L
+
+
+class InputType:
+    """Shape inference tokens (reference conf/inputs/InputType.java).
+
+    Represented as plain tuples without batch dim:
+    FF: (n,), RNN: (features, timesteps), CNN: (channels, h, w).
+    """
+
+    @staticmethod
+    def feed_forward(n: int):
+        return (int(n),)
+
+    @staticmethod
+    def recurrent(features: int, timesteps: int = -1):
+        return (int(features), int(timesteps))
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int):
+        return (int(channels), int(height), int(width))
+
+
+# -- input preprocessors (auto-inserted reshapes) ------------------------
+@dataclasses.dataclass
+class CnnToFeedForwardPreProcessor:
+    def __call__(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def out_type(self, input_type):
+        c, h, w = input_type
+        return (c * h * w,)
+
+
+@dataclasses.dataclass
+class FeedForwardToCnnPreProcessor:
+    channels: int = 1
+    height: int = 1
+    width: int = 1
+
+    def __call__(self, x):
+        return x.reshape(x.shape[0], self.channels, self.height, self.width)
+
+    def out_type(self, input_type):
+        return (self.channels, self.height, self.width)
+
+
+@dataclasses.dataclass
+class RnnToFeedForwardPreProcessor:
+    """[B, F, T] → [B*T, F] (time-distributed dense)."""
+
+    def __call__(self, x):
+        return jnp.swapaxes(x, 1, 2).reshape(-1, x.shape[1])
+
+    def out_type(self, input_type):
+        return (input_type[0],)
+
+
+@dataclasses.dataclass
+class FeedForwardToRnnPreProcessor:
+    timesteps: int = -1
+
+    def __call__(self, x):
+        raise NotImplementedError("requires timestep context; use RnnOutputLayer")
+
+    def out_type(self, input_type):
+        return input_type
+
+
+@dataclasses.dataclass
+class CnnToRnnPreProcessor:
+    def __call__(self, x):
+        b, c, h, w = x.shape
+        return x.reshape(b, c * h, w)
+
+    def out_type(self, input_type):
+        c, h, w = input_type
+        return (c * h, w)
+
+
+def _is_cnn(t):
+    return t is not None and len(t) == 3
+
+
+def _is_rnn(t):
+    return t is not None and len(t) == 2
+
+
+def _is_ff(t):
+    return t is not None and len(t) == 1
+
+
+def infer_preprocessor(prev_type, layer):
+    """Auto-insert reshape preprocessors (reference
+    MultiLayerConfiguration.getPreProcessorForInputType)."""
+    needs_ff = isinstance(layer, (L.DenseLayer, L.OutputLayer))
+    needs_cnn = isinstance(layer, (L.ConvolutionLayer, L.SubsamplingLayer,
+                                   L.Upsampling2D, L.ZeroPaddingLayer,
+                                   L.LocalResponseNormalization))
+    needs_rnn = isinstance(layer, (L.LSTM, L.RnnOutputLayer,
+                                   L.SelfAttentionLayer, L.Bidirectional,
+                                   L.Convolution1DLayer))
+    if _is_cnn(prev_type) and needs_ff:
+        return CnnToFeedForwardPreProcessor()
+    if _is_cnn(prev_type) and needs_rnn:
+        return CnnToRnnPreProcessor()
+    if _is_rnn(prev_type) and needs_ff:
+        return RnnToFeedForwardPreProcessor()
+    return None
+
+
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    layers: List[L.Layer]
+    input_type: Optional[Tuple[int, ...]] = None
+    preprocessors: dict = dataclasses.field(default_factory=dict)
+    updater: IUpdater = dataclasses.field(default_factory=lambda: Sgd())
+    seed: int = 12345
+    l1: float = 0.0
+    l2: float = 0.0
+    weight_decay: float = 0.0
+    gradient_normalization: Optional[str] = None  # None|clip_l2|clip_value
+    gradient_clip: float = 1.0
+    dtype: str = "float32"
+
+    def layer_input_types(self):
+        """Per-layer input types after preprocessor application."""
+        types = []
+        cur = self.input_type
+        for i, layer in enumerate(self.layers):
+            pre = self.preprocessors.get(i)
+            if pre is not None:
+                cur = pre.out_type(cur)
+            if cur is None and getattr(layer, "n_in", 0):
+                # no explicit InputType: recover the chain from n_in
+                cur = (layer.n_in,)
+            types.append(cur)
+            cur = layer.output_type(cur) if cur is not None else None
+        return types
+
+    def to_json(self) -> str:
+        def layer_dict(layer):
+            d = {"@class": type(layer).__name__}
+            for f in dataclasses.fields(layer):
+                v = getattr(layer, f.name)
+                if isinstance(v, L.Layer):
+                    v = layer_dict(v)
+                elif callable(v) and not isinstance(v, str):
+                    v = getattr(v, "__name__", str(v))
+                d[f.name] = v
+            return d
+
+        return json.dumps({
+            "layers": [layer_dict(l) for l in self.layers],
+            "input_type": self.input_type,
+            "preprocessors": {str(k): {"@class": type(v).__name__,
+                                       **dataclasses.asdict(v)}
+                              for k, v in self.preprocessors.items()},
+            "updater": self.updater.to_dict(),
+            "seed": self.seed, "l1": self.l1, "l2": self.l2,
+            "weight_decay": self.weight_decay,
+            "gradient_normalization": self.gradient_normalization,
+            "gradient_clip": self.gradient_clip, "dtype": self.dtype,
+        }, indent=1, default=str)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        data = json.loads(s)
+
+        def mk_layer(d):
+            d = dict(d)
+            cls = getattr(L, d.pop("@class"))
+            for k, v in d.items():
+                if isinstance(v, dict) and "@class" in v:
+                    d[k] = mk_layer(v)
+                elif isinstance(v, list):
+                    d[k] = tuple(v)
+            return cls(**d)
+
+        pre_classes = {c.__name__: c for c in
+                       [CnnToFeedForwardPreProcessor, FeedForwardToCnnPreProcessor,
+                        RnnToFeedForwardPreProcessor, FeedForwardToRnnPreProcessor,
+                        CnnToRnnPreProcessor]}
+        pres = {}
+        for k, v in data.get("preprocessors", {}).items():
+            v = dict(v)
+            cls = pre_classes[v.pop("@class")]
+            pres[int(k)] = cls(**v)
+        return MultiLayerConfiguration(
+            layers=[mk_layer(d) for d in data["layers"]],
+            input_type=tuple(data["input_type"]) if data.get("input_type") else None,
+            preprocessors=pres,
+            updater=IUpdater.from_dict(data["updater"]),
+            seed=data.get("seed", 12345), l1=data.get("l1", 0.0),
+            l2=data.get("l2", 0.0), weight_decay=data.get("weight_decay", 0.0),
+            gradient_normalization=data.get("gradient_normalization"),
+            gradient_clip=data.get("gradient_clip", 1.0),
+            dtype=data.get("dtype", "float32"))
+
+
+class ListBuilder:
+    """`.list()` stage of the builder (reference NeuralNetConfiguration
+    .Builder.list())."""
+
+    def __init__(self, base: "NeuralNetConfigurationBuilder"):
+        self._base = base
+        self._layers: List[L.Layer] = []
+        self._input_type = None
+        self._preprocessors = {}
+
+    def layer(self, layer_or_idx, maybe_layer=None) -> "ListBuilder":
+        if maybe_layer is not None:
+            self._layers.append(maybe_layer)
+        else:
+            self._layers.append(layer_or_idx)
+        return self
+
+    def set_input_type(self, input_type) -> "ListBuilder":
+        self._input_type = tuple(input_type)
+        return self
+
+    def input_pre_processor(self, idx: int, pre) -> "ListBuilder":
+        self._preprocessors[idx] = pre
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        pres = dict(self._preprocessors)
+        if self._input_type is not None:
+            cur = self._input_type
+            for i, layer in enumerate(self._layers):
+                if i not in pres:
+                    auto = infer_preprocessor(cur, layer)
+                    if auto is not None:
+                        pres[i] = auto
+                if i in pres:
+                    cur = pres[i].out_type(cur)
+                cur = layer.output_type(cur)
+        b = self._base
+        return MultiLayerConfiguration(
+            layers=self._layers, input_type=self._input_type,
+            preprocessors=pres, updater=b._updater, seed=b._seed,
+            l1=b._l1, l2=b._l2, weight_decay=b._weight_decay,
+            gradient_normalization=b._grad_norm,
+            gradient_clip=b._grad_clip, dtype=b._dtype)
+
+
+class NeuralNetConfigurationBuilder:
+    """Reference NeuralNetConfiguration.Builder fluent DSL."""
+
+    def __init__(self):
+        self._seed = 12345
+        self._updater = Sgd()
+        self._l1 = 0.0
+        self._l2 = 0.0
+        self._weight_decay = 0.0
+        self._grad_norm = None
+        self._grad_clip = 1.0
+        self._dtype = "float32"
+
+    def seed(self, s: int):
+        self._seed = int(s)
+        return self
+
+    def updater(self, u: IUpdater):
+        self._updater = u
+        return self
+
+    def l1(self, v: float):
+        self._l1 = v
+        return self
+
+    def l2(self, v: float):
+        self._l2 = v
+        return self
+
+    def weight_decay(self, v: float):
+        self._weight_decay = v
+        return self
+
+    def data_type(self, dt: str):
+        self._dtype = dt
+        return self
+
+    def gradient_normalization(self, mode: str, clip: float = 1.0):
+        self._grad_norm = mode
+        self._grad_clip = clip
+        return self
+
+    def list(self) -> ListBuilder:
+        return ListBuilder(self)
+
+
+class NeuralNetConfiguration:
+    @staticmethod
+    def builder() -> NeuralNetConfigurationBuilder:
+        return NeuralNetConfigurationBuilder()

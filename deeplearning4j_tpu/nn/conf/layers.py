@@ -1,0 +1,638 @@
+"""Layer configuration classes.
+
+Reference: `deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/conf/layers/`
+(~75 configs) + the layer impls in `nn/layers/**` (activate/backpropGradient).
+
+TPU redesign: a layer is a *pure module* — `init_params(key, input_type)`
+returns a param dict, `forward(params, x, training, key)` is jax-traceable.
+Backprop is jax.grad over the whole network (no per-layer backpropGradient),
+parameters live in pytrees (the flattened-view semantics are provided at the
+MultiLayerNetwork level via `params()`/`set_params`).
+
+Input types mirror the reference's InputType shape-inference: tuples without
+the batch dim — FF: (n,), CNN: (c, h, w) NCHW, RNN: (features, timesteps).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import conv_ops, nn_ops, recurrent
+from ..activations import get_activation
+from ..losses import get_loss
+from ..weights import init_weights
+
+IntPair = Union[int, Tuple[int, int]]
+
+
+def _pair(v) -> Tuple[int, int]:
+    return tuple(v) if isinstance(v, (tuple, list)) else (int(v), int(v))
+
+
+@dataclasses.dataclass
+class Layer:
+    """Base layer config (reference nn/conf/layers/Layer.java)."""
+    name: Optional[str] = None
+
+    def init_params(self, key, input_type):
+        return {}
+
+    def forward(self, params, x, training=False, key=None):
+        raise NotImplementedError
+
+    def output_type(self, input_type):
+        return input_type
+
+    def has_params(self) -> bool:
+        return True
+
+    def needs_key(self) -> bool:
+        return False
+
+
+@dataclasses.dataclass
+class DenseLayer(Layer):
+    """Fully connected (reference conf/layers/DenseLayer.java)."""
+    n_in: int = 0
+    n_out: int = 0
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    has_bias: bool = True
+    dropout: float = 0.0
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        p = {"W": init_weights(key, (n_in, self.n_out), self.weight_init)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,))
+        return p
+
+    def forward(self, params, x, training=False, key=None):
+        out = jnp.matmul(x, params["W"])
+        if self.has_bias:
+            out = out + params["b"]
+        out = get_activation(self.activation)(out)
+        if self.dropout > 0 and training and key is not None:
+            out = nn_ops.dropout(out, self.dropout, key, training=True)
+        return out
+
+    def output_type(self, input_type):
+        return (self.n_out,)
+
+    def needs_key(self):
+        return self.dropout > 0
+
+
+@dataclasses.dataclass
+class OutputLayer(DenseLayer):
+    """Dense + loss head (reference conf/layers/OutputLayer.java)."""
+    loss: Union[str, Callable] = "mcxent"
+    activation: str = "softmax"
+
+    def compute_loss(self, labels, output, mask=None):
+        return get_loss(self.loss)(labels, output, mask)
+
+
+@dataclasses.dataclass
+class LossLayer(Layer):
+    """Loss without params (reference conf/layers/LossLayer.java)."""
+    loss: Union[str, Callable] = "mcxent"
+    activation: str = "identity"
+
+    def forward(self, params, x, training=False, key=None):
+        return get_activation(self.activation)(x)
+
+    def compute_loss(self, labels, output, mask=None):
+        return get_loss(self.loss)(labels, output, mask)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class ConvolutionLayer(Layer):
+    """2D convolution (reference conf/layers/ConvolutionLayer.java).
+
+    Input NCHW (c, h, w) like the reference; lax dimension numbers keep it
+    MXU-native without explicit transposes.
+    """
+    n_in: int = 0     # input channels (inferred if 0)
+    n_out: int = 0    # output channels
+    kernel_size: IntPair = (3, 3)
+    stride: IntPair = (1, 1)
+    padding: Union[str, IntPair] = (0, 0)
+    dilation: IntPair = (1, 1)
+    activation: str = "identity"
+    weight_init: str = "relu"
+    has_bias: bool = True
+    convolution_mode: str = "truncate"  # truncate|same (reference ConvolutionMode)
+
+    def _padding_arg(self):
+        if isinstance(self.padding, str):
+            return self.padding
+        if self.convolution_mode.lower() == "same":
+            return "SAME"
+        return _pair(self.padding)
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        kh, kw = _pair(self.kernel_size)
+        p = {"W": init_weights(key, (kh, kw, n_in, self.n_out), self.weight_init)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,))
+        return p
+
+    def forward(self, params, x, training=False, key=None):
+        out = conv_ops.conv2d(x, params["W"], params.get("b"),
+                              strides=_pair(self.stride),
+                              padding=self._padding_arg(),
+                              dilation=_pair(self.dilation),
+                              data_format="NCHW")
+        return get_activation(self.activation)(out)
+
+    def output_type(self, input_type):
+        c, h, w = input_type
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        dh, dw = _pair(self.dilation)
+        pad = self._padding_arg()
+        if pad == "SAME":
+            oh = -(-h // sh)
+            ow = -(-w // sw)
+        else:
+            ph, pw = (pad if isinstance(pad, tuple) else (0, 0))
+            if isinstance(pad, str):
+                ph = pw = 0
+            oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+            ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        return (self.n_out, oh, ow)
+
+
+@dataclasses.dataclass
+class Convolution1DLayer(Layer):
+    """1D conv over RNN-format input (features, time) (reference Conv1DLayer)."""
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    padding: Union[str, int] = "SAME"
+    activation: str = "identity"
+    weight_init: str = "relu"
+    has_bias: bool = True
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        p = {"W": init_weights(key, (self.kernel_size, n_in, self.n_out),
+                               self.weight_init)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,))
+        return p
+
+    def forward(self, params, x, training=False, key=None):
+        pad = self.padding if isinstance(self.padding, str) else int(self.padding)
+        return get_activation(self.activation)(
+            conv_ops.conv1d(x, params["W"], params.get("b"),
+                            strides=self.stride, padding=pad, data_format="NCW"))
+
+    def output_type(self, input_type):
+        c, t = input_type
+        if isinstance(self.padding, str) and self.padding.upper() == "SAME":
+            ot = -(-t // self.stride)
+        else:
+            p = self.padding if not isinstance(self.padding, str) else 0
+            ot = (t + 2 * p - self.kernel_size) // self.stride + 1
+        return (self.n_out, ot)
+
+
+@dataclasses.dataclass
+class SubsamplingLayer(Layer):
+    """Pooling (reference conf/layers/SubsamplingLayer.java)."""
+    pooling_type: str = "max"  # max|avg|pnorm
+    kernel_size: IntPair = (2, 2)
+    stride: IntPair = None
+    padding: Union[str, IntPair] = (0, 0)
+    pnorm: int = 2
+
+    def forward(self, params, x, training=False, key=None):
+        stride = self.stride if self.stride is not None else self.kernel_size
+        pad = self.padding if isinstance(self.padding, str) else _pair(self.padding)
+        if isinstance(pad, tuple) and pad != (0, 0):
+            pad = pad
+        elif isinstance(pad, tuple):
+            pad = "VALID"
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            return conv_ops.maxpool2d(x, _pair(self.kernel_size), _pair(stride),
+                                      pad, "NCHW")
+        if pt == "avg":
+            return conv_ops.avgpool2d(x, _pair(self.kernel_size), _pair(stride),
+                                      pad, "NCHW")
+        return conv_ops.pnormpool2d(x, _pair(self.kernel_size), _pair(stride),
+                                    pad, self.pnorm, "NCHW")
+
+    def output_type(self, input_type):
+        c, h, w = input_type
+        kh, kw = _pair(self.kernel_size)
+        stride = self.stride if self.stride is not None else self.kernel_size
+        sh, sw = _pair(stride)
+        if isinstance(self.padding, str) and self.padding.upper() == "SAME":
+            return (c, -(-h // sh), -(-w // sw))
+        ph, pw = _pair(self.padding)
+        return (c, (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class BatchNormalization(Layer):
+    """Batch norm (reference conf/layers/BatchNormalization.java).
+
+    Running stats are non-trainable state carried in params under keys
+    prefixed `state_` (excluded from gradient updates by the network).
+    """
+    n_out: int = 0  # inferred
+    decay: float = 0.9
+    eps: float = 1e-5
+    use_gamma_beta: bool = True
+
+    def _channels(self, input_type):
+        return self.n_out or input_type[0]
+
+    def init_params(self, key, input_type):
+        c = self._channels(input_type)
+        p = {"state_mean": jnp.zeros((c,)), "state_var": jnp.ones((c,))}
+        if self.use_gamma_beta:
+            p["gamma"] = jnp.ones((c,))
+            p["beta"] = jnp.zeros((c,))
+        return p
+
+    def forward(self, params, x, training=False, key=None):
+        axis = 1 if x.ndim >= 3 else -1  # NCHW channel axis; FF feature axis
+        reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+        if training:
+            mean = jnp.mean(x, axis=reduce_axes)
+            var = jnp.var(x, axis=reduce_axes)
+        else:
+            mean, var = params["state_mean"], params["state_var"]
+        return nn_ops.batchnorm(x, mean, var, params.get("gamma"),
+                                params.get("beta"), self.eps, axis)
+
+    def new_state(self, params, x):
+        """Updated running stats given a training batch (applied by the net)."""
+        axis = 1 if x.ndim >= 3 else -1
+        reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+        mean = jnp.mean(x, axis=reduce_axes)
+        var = jnp.var(x, axis=reduce_axes)
+        return {"state_mean": self.decay * params["state_mean"] + (1 - self.decay) * mean,
+                "state_var": self.decay * params["state_var"] + (1 - self.decay) * var}
+
+
+@dataclasses.dataclass
+class LocalResponseNormalization(Layer):
+    """LRN (reference conf/layers/LocalResponseNormalization.java)."""
+    n: int = 5
+    k: float = 2.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def forward(self, params, x, training=False, key=None):
+        xt = jnp.transpose(x, (0, 2, 3, 1))  # channel-last for the op
+        out = nn_ops.lrn(xt, self.n // 2, self.k, self.alpha, self.beta)
+        return jnp.transpose(out, (0, 3, 1, 2))
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class EmbeddingLayer(Layer):
+    """Index → vector lookup (reference conf/layers/EmbeddingLayer.java)."""
+    n_in: int = 0   # vocab
+    n_out: int = 0  # embedding dim
+    weight_init: str = "xavier"
+
+    def init_params(self, key, input_type):
+        return {"W": init_weights(key, (self.n_in, self.n_out), self.weight_init)}
+
+    def forward(self, params, x, training=False, key=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        return jnp.take(params["W"], idx, axis=0)
+
+    def output_type(self, input_type):
+        return (self.n_out,)
+
+
+@dataclasses.dataclass
+class EmbeddingSequenceLayer(EmbeddingLayer):
+    """Sequence of indices → RNN-format [B, n_out, T] (reference
+    EmbeddingSequenceLayer)."""
+
+    def forward(self, params, x, training=False, key=None):
+        idx = x.astype(jnp.int32)  # [B, T]
+        emb = jnp.take(params["W"], idx, axis=0)  # [B, T, E]
+        return jnp.swapaxes(emb, 1, 2)  # [B, E, T] RNN format
+
+    def output_type(self, input_type):
+        t = input_type[-1] if len(input_type) > 1 else input_type[0]
+        return (self.n_out, t)
+
+
+@dataclasses.dataclass
+class LSTM(Layer):
+    """LSTM over RNN-format input [B, features, T] (reference conf/layers/LSTM.java)."""
+    n_in: int = 0
+    n_out: int = 0
+    activation: str = "tanh"
+    weight_init: str = "xavier"
+    forget_gate_bias_init: float = 1.0
+    return_sequence: bool = True
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        k1, k2 = jax.random.split(key)
+        b = jnp.zeros((4 * self.n_out,))
+        b = b.at[self.n_out:2 * self.n_out].set(self.forget_gate_bias_init)
+        return {"Wx": init_weights(k1, (n_in, 4 * self.n_out), self.weight_init),
+                "Wh": init_weights(k2, (self.n_out, 4 * self.n_out),
+                                   self.weight_init),
+                "b": b}
+
+    def forward(self, params, x, training=False, key=None):
+        xt = jnp.swapaxes(x, 1, 2)  # [B, T, F]
+        h_seq, h_last, _ = recurrent.lstm_layer(xt, params["Wx"], params["Wh"],
+                                                params["b"])
+        if self.return_sequence:
+            return jnp.swapaxes(h_seq, 1, 2)  # back to [B, n_out, T]
+        return h_last
+
+    def output_type(self, input_type):
+        if self.return_sequence and len(input_type) == 2:
+            return (self.n_out, input_type[1])
+        return (self.n_out,)
+
+
+# GravesLSTM is API-compat alias (reference deprecated class)
+GravesLSTM = LSTM
+
+
+@dataclasses.dataclass
+class Bidirectional(Layer):
+    """Bidirectional wrapper (reference conf/layers/recurrent/Bidirectional.java)."""
+    fwd: Layer = None
+    mode: str = "concat"  # concat|add|mul|ave
+
+    def init_params(self, key, input_type):
+        k1, k2 = jax.random.split(key)
+        return {"fwd": self.fwd.init_params(k1, input_type),
+                "bwd": self.fwd.init_params(k2, input_type)}
+
+    def forward(self, params, x, training=False, key=None):
+        out_f = self.fwd.forward(params["fwd"], x, training, key)
+        x_rev = jnp.flip(x, axis=-1)
+        out_b = self.fwd.forward(params["bwd"], x_rev, training, key)
+        out_b = jnp.flip(out_b, axis=-1)
+        if self.mode == "concat":
+            return jnp.concatenate([out_f, out_b], axis=1)
+        if self.mode == "add":
+            return out_f + out_b
+        if self.mode == "mul":
+            return out_f * out_b
+        return (out_f + out_b) / 2
+
+    def output_type(self, input_type):
+        inner = self.fwd.output_type(input_type)
+        if self.mode == "concat":
+            return (inner[0] * 2,) + tuple(inner[1:])
+        return inner
+
+
+@dataclasses.dataclass
+class RnnOutputLayer(Layer):
+    """Per-timestep output head on [B, F, T] (reference RnnOutputLayer)."""
+    n_in: int = 0
+    n_out: int = 0
+    activation: str = "softmax"
+    loss: Union[str, Callable] = "mcxent"
+    weight_init: str = "xavier"
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        return {"W": init_weights(key, (n_in, self.n_out), self.weight_init),
+                "b": jnp.zeros((self.n_out,))}
+
+    def forward(self, params, x, training=False, key=None):
+        xt = jnp.swapaxes(x, 1, 2)  # [B, T, F]
+        out = jnp.matmul(xt, params["W"]) + params["b"]
+        out = get_activation(self.activation)(out)
+        return jnp.swapaxes(out, 1, 2)  # [B, n_out, T]
+
+    def compute_loss(self, labels, output, mask=None):
+        # labels/output [B, C, T] → move time into batch
+        lab = jnp.swapaxes(labels, 1, 2).reshape(-1, labels.shape[1])
+        out = jnp.swapaxes(output, 1, 2).reshape(-1, output.shape[1])
+        m = None
+        if mask is not None:
+            m = mask.reshape(-1)
+        return get_loss(self.loss)(lab, out, m)
+
+    def output_type(self, input_type):
+        return (self.n_out, input_type[1]) if len(input_type) == 2 else (self.n_out,)
+
+
+@dataclasses.dataclass
+class DropoutLayer(Layer):
+    rate: float = 0.5
+
+    def forward(self, params, x, training=False, key=None):
+        if training and key is not None and self.rate > 0:
+            return nn_ops.dropout(x, self.rate, key, training=True)
+        return x
+
+    def has_params(self):
+        return False
+
+    def needs_key(self):
+        return True
+
+
+@dataclasses.dataclass
+class ActivationLayer(Layer):
+    activation: str = "relu"
+
+    def forward(self, params, x, training=False, key=None):
+        return get_activation(self.activation)(x)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class GlobalPoolingLayer(Layer):
+    """Global pooling over spatial/time dims (reference GlobalPoolingLayer)."""
+    pooling_type: str = "max"  # max|avg|sum|pnorm
+    pnorm: int = 2
+
+    def forward(self, params, x, training=False, key=None):
+        axes = tuple(range(2, x.ndim))  # pool everything after [B, C]
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            return jnp.max(x, axis=axes)
+        if pt == "avg":
+            return jnp.mean(x, axis=axes)
+        if pt == "sum":
+            return jnp.sum(x, axis=axes)
+        return jnp.sum(jnp.abs(x) ** self.pnorm, axis=axes) ** (1.0 / self.pnorm)
+
+    def output_type(self, input_type):
+        return (input_type[0],)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class SelfAttentionLayer(Layer):
+    """Self attention over RNN-format input (reference SelfAttentionLayer)."""
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: int = None
+    weight_init: str = "xavier"
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        hs = self.head_size or (self.n_out // self.n_heads)
+        keys = jax.random.split(key, 4)
+        return {"Wq": init_weights(keys[0], (n_in, self.n_heads, hs), self.weight_init),
+                "Wk": init_weights(keys[1], (n_in, self.n_heads, hs), self.weight_init),
+                "Wv": init_weights(keys[2], (n_in, self.n_heads, hs), self.weight_init),
+                "Wo": init_weights(keys[3], (self.n_heads * hs, self.n_out),
+                                   self.weight_init)}
+
+    def forward(self, params, x, training=False, key=None):
+        xt = jnp.swapaxes(x, 1, 2)  # [B, T, F]
+        out = nn_ops.multi_head_dot_product_attention(
+            xt, xt, xt, params["Wq"], params["Wk"], params["Wv"], params["Wo"])
+        return jnp.swapaxes(out, 1, 2)
+
+    def output_type(self, input_type):
+        return (self.n_out, input_type[1])
+
+
+@dataclasses.dataclass
+class Upsampling2D(Layer):
+    size: IntPair = (2, 2)
+
+    def forward(self, params, x, training=False, key=None):
+        sh, sw = _pair(self.size)
+        return conv_ops.upsampling2d(x, sh, sw, "NCHW")
+
+    def output_type(self, input_type):
+        c, h, w = input_type
+        sh, sw = _pair(self.size)
+        return (c, h * sh, w * sw)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class ZeroPaddingLayer(Layer):
+    padding: Sequence[int] = (1, 1, 1, 1)  # top,bottom,left,right
+
+    def forward(self, params, x, training=False, key=None):
+        t, b, l, r = self.padding
+        return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r)))
+
+    def output_type(self, input_type):
+        c, h, w = input_type
+        t, b, l, r = self.padding
+        return (c, h + t + b, w + l + r)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class DeconvolutionLayer(ConvolutionLayer):
+    """Transposed conv (reference conf/layers/Deconvolution2D.java)."""
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        kh, kw = _pair(self.kernel_size)
+        p = {"W": init_weights(key, (kh, kw, self.n_out, n_in), self.weight_init)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,))
+        return p
+
+    def forward(self, params, x, training=False, key=None):
+        out = conv_ops.deconv2d(x, params["W"], params.get("b"),
+                                strides=_pair(self.stride),
+                                padding=self._padding_arg(),
+                                data_format="NCHW")
+        return get_activation(self.activation)(out)
+
+    def output_type(self, input_type):
+        c, h, w = input_type
+        sh, sw = _pair(self.stride)
+        kh, kw = _pair(self.kernel_size)
+        pad = self._padding_arg()
+        if pad == "SAME":
+            return (self.n_out, h * sh, w * sw)
+        ph, pw = pad if isinstance(pad, tuple) else (0, 0)
+        return (self.n_out, sh * (h - 1) + kh - 2 * ph, sw * (w - 1) + kw - 2 * pw)
+
+
+@dataclasses.dataclass
+class SeparableConvolution2D(ConvolutionLayer):
+    """Depthwise-separable conv (reference SeparableConvolution2D)."""
+    depth_multiplier: int = 1
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        kh, kw = _pair(self.kernel_size)
+        k1, k2 = jax.random.split(key)
+        p = {"Wd": init_weights(k1, (kh, kw, n_in, self.depth_multiplier),
+                                self.weight_init),
+             "Wp": init_weights(k2, (1, 1, n_in * self.depth_multiplier,
+                                     self.n_out), self.weight_init)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,))
+        return p
+
+    def forward(self, params, x, training=False, key=None):
+        out = conv_ops.sconv2d(x, params["Wd"], params["Wp"], params.get("b"),
+                               strides=_pair(self.stride),
+                               padding=self._padding_arg(), data_format="NCHW")
+        return get_activation(self.activation)(out)
+
+
+@dataclasses.dataclass
+class DepthwiseConvolution2D(ConvolutionLayer):
+    depth_multiplier: int = 1
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        kh, kw = _pair(self.kernel_size)
+        p = {"W": init_weights(key, (kh, kw, n_in, self.depth_multiplier),
+                               self.weight_init)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((n_in * self.depth_multiplier,))
+        return p
+
+    def forward(self, params, x, training=False, key=None):
+        out = conv_ops.depthwise_conv2d(x, params["W"], params.get("b"),
+                                        strides=_pair(self.stride),
+                                        padding=self._padding_arg(),
+                                        data_format="NCHW")
+        return get_activation(self.activation)(out)
+
+    def output_type(self, input_type):
+        base = super().output_type(input_type)
+        return (input_type[0] * self.depth_multiplier,) + base[1:]

@@ -1,0 +1,230 @@
+"""Evaluation metrics.
+
+Reference: `org/nd4j/evaluation/classification/Evaluation.java` (accuracy/
+precision/recall/F1 + confusion matrix), `EvaluationBinary`, `ROC`,
+`regression/RegressionEvaluation.java`. Accumulation happens on host in
+numpy (tiny data); the confusion matrix is built with one vectorized
+bincount per batch.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..ndarray.ndarray import NDArray
+
+
+def _np(x):
+    if isinstance(x, NDArray):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+class Evaluation:
+    """Multi-class classification metrics (reference Evaluation.java)."""
+
+    def __init__(self, num_classes: Optional[int] = None):
+        self.num_classes = num_classes
+        self.confusion: Optional[np.ndarray] = None
+
+    def eval(self, labels, predictions):
+        y = _np(labels)
+        p = _np(predictions)
+        if y.ndim > 1 and y.shape[-1] > 1:
+            y = np.argmax(y, axis=-1)
+        else:
+            y = y.astype(np.int64).reshape(y.shape[0], *y.shape[1:])
+            y = y.squeeze(-1) if y.ndim > 1 and y.shape[-1] == 1 else y
+        if p.ndim > 1 and p.shape[-1] > 1:
+            n = p.shape[-1]
+            p = np.argmax(p, axis=-1)
+        else:
+            p = p.squeeze(-1) if p.ndim > 1 else p
+            if np.issubdtype(p.dtype, np.floating):
+                # single sigmoid output: threshold at 0.5 (reference binary mode)
+                p = (p > 0.5).astype(np.int64)
+            else:
+                p = p.astype(np.int64)
+            n = self.num_classes or int(max(y.max(), p.max())) + 1
+        if self.num_classes is None:
+            self.num_classes = n
+        if self.confusion is None:
+            self.confusion = np.zeros((self.num_classes, self.num_classes),
+                                      np.int64)
+        y = y.ravel()
+        p = p.ravel()
+        cm = np.bincount(y * self.num_classes + p,
+                         minlength=self.num_classes ** 2)
+        self.confusion += cm.reshape(self.num_classes, self.num_classes)
+
+    # -- metrics ---------------------------------------------------------
+    def _tp(self):
+        return np.diag(self.confusion).astype(np.float64)
+
+    def accuracy(self) -> float:
+        total = self.confusion.sum()
+        return float(self._tp().sum() / total) if total else 0.0
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        col = self.confusion.sum(axis=0).astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.where(col > 0, self._tp() / col, 0.0)
+        return float(per[cls]) if cls is not None else float(np.mean(per))
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        row = self.confusion.sum(axis=1).astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.where(row > 0, self._tp() / row, 0.0)
+        return float(per[cls]) if cls is not None else float(np.mean(per))
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p = self.precision(cls)
+        r = self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+
+    def false_positive_rate(self, cls: int) -> float:
+        fp = self.confusion[:, cls].sum() - self.confusion[cls, cls]
+        tn = self.confusion.sum() - self.confusion[cls, :].sum() \
+            - self.confusion[:, cls].sum() + self.confusion[cls, cls]
+        return float(fp / (fp + tn)) if (fp + tn) > 0 else 0.0
+
+    def matthews_correlation(self, cls: int) -> float:
+        tp = self.confusion[cls, cls]
+        fp = self.confusion[:, cls].sum() - tp
+        fn = self.confusion[cls, :].sum() - tp
+        tn = self.confusion.sum() - tp - fp - fn
+        denom = np.sqrt(float((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)))
+        return float((tp * tn - fp * fn) / denom) if denom > 0 else 0.0
+
+    def stats(self) -> str:
+        lines = [
+            "========================Evaluation Metrics========================",
+            f" # of classes: {self.num_classes}",
+            f" Accuracy:  {self.accuracy():.4f}",
+            f" Precision: {self.precision():.4f}",
+            f" Recall:    {self.recall():.4f}",
+            f" F1 Score:  {self.f1():.4f}",
+            "=========================Confusion Matrix=========================",
+            str(self.confusion),
+        ]
+        return "\n".join(lines)
+
+
+class EvaluationBinary:
+    """Per-output binary metrics (reference EvaluationBinary.java)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+        self.tp = self.fp = self.tn = self.fn = None
+
+    def eval(self, labels, predictions):
+        y = _np(labels) > 0.5
+        p = _np(predictions) > self.threshold
+        if self.tp is None:
+            n = y.shape[-1]
+            self.tp = np.zeros(n, np.int64)
+            self.fp = np.zeros(n, np.int64)
+            self.tn = np.zeros(n, np.int64)
+            self.fn = np.zeros(n, np.int64)
+        flat_y = y.reshape(-1, y.shape[-1])
+        flat_p = p.reshape(-1, p.shape[-1])
+        self.tp += np.sum(flat_y & flat_p, axis=0)
+        self.fp += np.sum(~flat_y & flat_p, axis=0)
+        self.tn += np.sum(~flat_y & ~flat_p, axis=0)
+        self.fn += np.sum(flat_y & ~flat_p, axis=0)
+
+    def accuracy(self, i: int = 0) -> float:
+        total = self.tp[i] + self.fp[i] + self.tn[i] + self.fn[i]
+        return float((self.tp[i] + self.tn[i]) / total) if total else 0.0
+
+    def precision(self, i: int = 0) -> float:
+        d = self.tp[i] + self.fp[i]
+        return float(self.tp[i] / d) if d else 0.0
+
+    def recall(self, i: int = 0) -> float:
+        d = self.tp[i] + self.fn[i]
+        return float(self.tp[i] / d) if d else 0.0
+
+    def f1(self, i: int = 0) -> float:
+        p, r = self.precision(i), self.recall(i)
+        return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+
+
+class ROC:
+    """Binary ROC/AUC with exact thresholding (reference ROC.java with
+    thresholdSteps=0 exact mode)."""
+
+    def __init__(self):
+        self.scores = []
+        self.labels = []
+
+    def eval(self, labels, predictions):
+        y = _np(labels).ravel()
+        p = _np(predictions)
+        if p.ndim > 1 and p.shape[-1] == 2:
+            p = p[..., 1]
+        self.scores.append(p.ravel())
+        self.labels.append(y)
+
+    def calculate_auc(self) -> float:
+        y = np.concatenate(self.labels)
+        s = np.concatenate(self.scores)
+        order = np.argsort(-s)
+        y = y[order]
+        tps = np.cumsum(y)
+        fps = np.cumsum(1 - y)
+        tpr = tps / max(tps[-1], 1)
+        fpr = fps / max(fps[-1], 1)
+        return float(np.trapezoid(tpr, fpr))
+
+    def calculate_auprc(self) -> float:
+        y = np.concatenate(self.labels)
+        s = np.concatenate(self.scores)
+        order = np.argsort(-s)
+        y = y[order]
+        tps = np.cumsum(y)
+        precision = tps / np.arange(1, len(y) + 1)
+        recall = tps / max(tps[-1], 1)
+        return float(np.trapezoid(precision, recall))
+
+
+class RegressionEvaluation:
+    """MSE/MAE/RMSE/R² per column (reference RegressionEvaluation.java)."""
+
+    def __init__(self):
+        self._sum_sq = None
+        self._sum_abs = None
+        self._sum_y = None
+        self._sum_y2 = None
+        self._sum_pred_err2 = None
+        self._n = 0
+
+    def eval(self, labels, predictions):
+        y = _np(labels).reshape(-1, _np(labels).shape[-1])
+        p = _np(predictions).reshape(-1, _np(predictions).shape[-1])
+        err = y - p
+        if self._sum_sq is None:
+            c = y.shape[-1]
+            self._sum_sq = np.zeros(c)
+            self._sum_abs = np.zeros(c)
+            self._sum_y = np.zeros(c)
+            self._sum_y2 = np.zeros(c)
+        self._sum_sq += np.sum(err ** 2, axis=0)
+        self._sum_abs += np.sum(np.abs(err), axis=0)
+        self._sum_y += np.sum(y, axis=0)
+        self._sum_y2 += np.sum(y ** 2, axis=0)
+        self._n += y.shape[0]
+
+    def mean_squared_error(self, col: int = 0) -> float:
+        return float(self._sum_sq[col] / self._n)
+
+    def mean_absolute_error(self, col: int = 0) -> float:
+        return float(self._sum_abs[col] / self._n)
+
+    def root_mean_squared_error(self, col: int = 0) -> float:
+        return float(np.sqrt(self.mean_squared_error(col)))
+
+    def r_squared(self, col: int = 0) -> float:
+        ss_tot = self._sum_y2[col] - self._sum_y[col] ** 2 / self._n
+        return float(1.0 - self._sum_sq[col] / max(ss_tot, 1e-12))

@@ -1,0 +1,134 @@
+"""Loss functions for the layer API.
+
+Reference: `org/nd4j/linalg/lossfunctions/LossFunctions.java` enum + ILossFunction
+impls. Names match the reference (MCXENT, MSE, XENT, ...). Each loss is
+`f(labels, preactivation_output_after_activation, mask) -> scalar mean loss`.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def _masked_mean(per_example, mask):
+    if mask is None:
+        return jnp.mean(per_example)
+    while mask.ndim < per_example.ndim:
+        mask = mask[..., None]
+    mask = jnp.broadcast_to(mask, per_example.shape)
+    return jnp.sum(per_example * mask) / jnp.maximum(jnp.sum(mask), 1e-12)
+
+
+def mcxent(labels, output, mask=None, eps=1e-7):
+    """Multi-class cross entropy on softmax output (reference LossMCXENT)."""
+    per = -jnp.sum(labels * jnp.log(output + eps), axis=-1)
+    return _masked_mean(per, mask)
+
+
+def xent(labels, output, mask=None, eps=1e-7):
+    """Binary cross entropy on sigmoid output (reference LossBinaryXENT)."""
+    per = -(labels * jnp.log(output + eps) + (1 - labels) * jnp.log(1 - output + eps))
+    return _masked_mean(per, mask)
+
+
+def mse(labels, output, mask=None):
+    per = jnp.mean(jnp.square(labels - output), axis=-1)
+    return _masked_mean(per, mask)
+
+
+def l1(labels, output, mask=None):
+    per = jnp.mean(jnp.abs(labels - output), axis=-1)
+    return _masked_mean(per, mask)
+
+
+def l2(labels, output, mask=None):
+    per = jnp.sum(jnp.square(labels - output), axis=-1)
+    return _masked_mean(per, mask)
+
+
+def hinge(labels, output, mask=None):
+    signed = 2 * labels - 1
+    per = jnp.mean(jnp.maximum(0.0, 1.0 - signed * output), axis=-1)
+    return _masked_mean(per, mask)
+
+
+def squared_hinge(labels, output, mask=None):
+    signed = 2 * labels - 1
+    per = jnp.mean(jnp.square(jnp.maximum(0.0, 1.0 - signed * output)), axis=-1)
+    return _masked_mean(per, mask)
+
+
+def poisson(labels, output, mask=None, eps=1e-7):
+    per = jnp.mean(output - labels * jnp.log(output + eps), axis=-1)
+    return _masked_mean(per, mask)
+
+
+def cosine_proximity(labels, output, mask=None):
+    num = jnp.sum(labels * output, axis=-1)
+    den = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(output, axis=-1)
+    return _masked_mean(-num / jnp.maximum(den, 1e-12), mask)
+
+
+def kld(labels, output, mask=None, eps=1e-7):
+    per = jnp.sum(labels * (jnp.log(labels + eps) - jnp.log(output + eps)), axis=-1)
+    return _masked_mean(per, mask)
+
+
+def mean_absolute_percentage_error(labels, output, mask=None, eps=1e-7):
+    per = jnp.mean(jnp.abs((labels - output) / (jnp.abs(labels) + eps)), axis=-1) * 100
+    return _masked_mean(per, mask)
+
+
+def mean_squared_logarithmic_error(labels, output, mask=None):
+    per = jnp.mean(jnp.square(jnp.log1p(labels) - jnp.log1p(output)), axis=-1)
+    return _masked_mean(per, mask)
+
+
+def negative_log_likelihood(labels, output, mask=None, eps=1e-7):
+    return mcxent(labels, output, mask, eps)
+
+
+def wasserstein(labels, output, mask=None):
+    return _masked_mean(jnp.mean(labels * output, axis=-1), mask)
+
+
+def sparse_mcxent(labels, output, mask=None, eps=1e-7):
+    """labels are int class indices (reference LossSparseMCXENT)."""
+    lp = jnp.log(output + eps)
+    per = -jnp.take_along_axis(lp, labels[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]
+    return _masked_mean(per, mask)
+
+
+_LOSSES = {
+    "mcxent": mcxent,
+    "negativeloglikelihood": negative_log_likelihood,
+    "xent": xent,
+    "mse": mse,
+    "squared_loss": mse,
+    "l1": l1,
+    "mae": l1,
+    "l2": l2,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "kl_divergence": kld,
+    "reconstruction_crossentropy": xent,
+    "mean_absolute_percentage_error": mean_absolute_percentage_error,
+    "mean_squared_logarithmic_error": mean_squared_logarithmic_error,
+    "wasserstein": wasserstein,
+    "sparse_mcxent": sparse_mcxent,
+}
+
+
+def get_loss(loss: Union[str, Callable]) -> Callable:
+    if callable(loss):
+        return loss
+    try:
+        return _LOSSES[loss.lower()]
+    except KeyError:
+        raise ValueError(f"unknown loss {loss!r}; known: {sorted(_LOSSES)}") \
+            from None

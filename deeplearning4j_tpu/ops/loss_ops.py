@@ -1,0 +1,155 @@
+"""Loss ops.
+
+Reference: `libnd4j/include/ops/declarable/headers/loss.h` — 12 loss families,
+each with weights broadcasting and a `reduction` mode enum:
+0 = NONE, 1 = SUM, 2 = MEAN_BY_WEIGHT (sum/sumWeights), 3 = MEAN_BY_NONZERO_WEIGHT.
+Grad variants (`*_loss_grad`) come free via `jax.grad`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import op
+
+NONE, SUM, MEAN_BY_WEIGHT, MEAN_BY_NONZERO = 0, 1, 2, 3
+
+
+def _reduce(per_elem, weights, reduction):
+    if weights is None:
+        weights = jnp.ones((), per_elem.dtype)
+    weighted = per_elem * weights
+    if reduction == NONE:
+        return weighted
+    if reduction == SUM:
+        return jnp.sum(weighted)
+    if reduction == MEAN_BY_WEIGHT:
+        total_w = jnp.sum(jnp.broadcast_to(weights, per_elem.shape))
+        return jnp.sum(weighted) / jnp.maximum(total_w, 1e-12)
+    # MEAN_BY_NONZERO
+    nz = jnp.sum(jnp.broadcast_to(weights, per_elem.shape) != 0)
+    return jnp.sum(weighted) / jnp.maximum(nz.astype(weighted.dtype), 1.0)
+
+
+@op("mean_sqerr_loss", "loss")
+def mean_sqerr_loss(predictions, weights=None, labels=None, reduction=MEAN_BY_WEIGHT):
+    return _reduce(jnp.square(predictions - labels), weights, reduction)
+
+
+@op("absolute_difference_loss", "loss")
+def absolute_difference_loss(predictions, weights=None, labels=None,
+                             reduction=MEAN_BY_WEIGHT):
+    return _reduce(jnp.abs(predictions - labels), weights, reduction)
+
+
+@op("huber_loss", "loss")
+def huber_loss(predictions, weights=None, labels=None, delta=1.0,
+               reduction=MEAN_BY_WEIGHT):
+    err = jnp.abs(predictions - labels)
+    quad = jnp.minimum(err, delta)
+    per = 0.5 * quad * quad + delta * (err - quad)
+    return _reduce(per, weights, reduction)
+
+
+@op("log_loss", "loss")
+def log_loss(predictions, weights=None, labels=None, eps=1e-7,
+             reduction=MEAN_BY_WEIGHT):
+    per = -(labels * jnp.log(predictions + eps)
+            + (1 - labels) * jnp.log(1 - predictions + eps))
+    return _reduce(per, weights, reduction)
+
+
+@op("log_poisson_loss", "loss")
+def log_poisson_loss(log_predictions, weights=None, labels=None, full=False,
+                     reduction=MEAN_BY_WEIGHT):
+    per = jnp.exp(log_predictions) - labels * log_predictions
+    if full:
+        per = per + labels * jnp.log(jnp.maximum(labels, 1e-12)) - labels \
+            + 0.5 * jnp.log(2 * jnp.pi * jnp.maximum(labels, 1e-12))
+    return _reduce(per, weights, reduction)
+
+
+@op("hinge_loss", "loss")
+def hinge_loss(logits, weights=None, labels=None, reduction=MEAN_BY_WEIGHT):
+    signed = 2.0 * labels - 1.0
+    return _reduce(jnp.maximum(0.0, 1.0 - signed * logits), weights, reduction)
+
+
+@op("squared_hinge_loss", "loss")
+def squared_hinge_loss(logits, weights=None, labels=None, reduction=MEAN_BY_WEIGHT):
+    signed = 2.0 * labels - 1.0
+    return _reduce(jnp.square(jnp.maximum(0.0, 1.0 - signed * logits)), weights,
+                   reduction)
+
+
+@op("cosine_distance_loss", "loss")
+def cosine_distance_loss(predictions, weights=None, labels=None, axis=-1,
+                         reduction=MEAN_BY_WEIGHT):
+    per = 1.0 - jnp.sum(predictions * labels, axis=axis, keepdims=True)
+    return _reduce(per, weights, reduction)
+
+
+@op("mean_pairwssqerr_loss", "loss")
+def mean_pairwssqerr_loss(predictions, weights=None, labels=None,
+                          reduction=MEAN_BY_WEIGHT):
+    d = predictions - labels
+    n = d.shape[-1]
+    sum_sq = jnp.sum(d * d, axis=-1, keepdims=True)
+    sq_sum = jnp.square(jnp.sum(d, axis=-1, keepdims=True))
+    per = jnp.where(n > 1, 2.0 * (n * sum_sq - sq_sum) / jnp.maximum(n * (n - 1), 1),
+                    jnp.zeros_like(sum_sq))
+    return _reduce(per, weights, reduction)
+
+
+@op("sigm_cross_entropy_loss", "loss")
+def sigm_cross_entropy_loss(logits, weights=None, labels=None,
+                            label_smoothing=0.0, reduction=MEAN_BY_WEIGHT):
+    if label_smoothing > 0:
+        labels = labels * (1 - label_smoothing) + 0.5 * label_smoothing
+    per = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return _reduce(per, weights, reduction)
+
+
+@op("softmax_cross_entropy_loss", "loss")
+def softmax_cross_entropy_loss(logits, weights=None, labels=None,
+                               label_smoothing=0.0, reduction=MEAN_BY_WEIGHT):
+    if label_smoothing > 0:
+        n = labels.shape[-1]
+        labels = labels * (1 - label_smoothing) + label_smoothing / n
+    per = -jnp.sum(labels * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+    return _reduce(per, weights, reduction)
+
+
+@op("softmax_cross_entropy_loss_with_logits", "loss")
+def softmax_cross_entropy_loss_with_logits(logits, labels, axis=-1):
+    return -jnp.sum(labels * jax.nn.log_softmax(logits, axis=axis), axis=axis)
+
+
+@op("sparse_softmax_cross_entropy_loss_with_logits", "loss")
+def sparse_softmax_cross_entropy_loss_with_logits(labels, logits):
+    lsm = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(lsm, labels[..., None].astype(jnp.int32),
+                                axis=-1)[..., 0]
+
+
+@op("weighted_cross_entropy_with_logits", "loss")
+def weighted_cross_entropy_with_logits(targets, logits, pos_weight):
+    log_weight = 1 + (pos_weight - 1) * targets
+    return (1 - targets) * logits + log_weight * (
+        jnp.log1p(jnp.exp(-jnp.abs(logits))) + jnp.maximum(-logits, 0))
+
+
+@op("l2_loss", "loss")
+def l2_loss(x):
+    return jnp.sum(x * x) / 2
+
+
+@op("ctc_loss", "loss")
+def ctc_loss(labels, logits, label_lengths, logit_lengths, blank_index=0):
+    """CTC via optax (log-domain forward algorithm, scan-based — TPU-friendly)."""
+    import optax
+    B, T, C = logits.shape
+    logit_pad = 1.0 - (jnp.arange(T)[None, :] < logit_lengths[:, None]).astype(logits.dtype)
+    label_pad = 1.0 - (jnp.arange(labels.shape[1])[None, :] < label_lengths[:, None]).astype(logits.dtype)
+    return optax.ctc_loss(logits, logit_pad, labels, label_pad,
+                          blank_id=int(blank_index))

@@ -1,0 +1,176 @@
+"""Neural-network ops: softmax family, normalization, attention, dropout.
+
+Reference: `libnd4j/include/ops/declarable/headers/nn.h` (softmax, batchnorm,
+lrn, biasadd, layer_norm, xw_plus_b, relu_layer) and attention helpers
+(`libnd4j/include/helpers/AttentionHelper.h`,
+`generic/nn/multi_head_dot_product_attention.cpp` analogs).
+
+TPU notes: softmax/layernorm fuse into one XLA kernel; attention has a
+Pallas flash path in `deeplearning4j_tpu/kernels/flash_attention.py` that the
+graph layer swaps in for long sequences.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+op("softmax", "nn")(lambda x, axis=-1: jax.nn.softmax(x, axis=axis))
+op("log_softmax", "nn")(lambda x, axis=-1: jax.nn.log_softmax(x, axis=axis))
+
+
+@op("softmax_with_temperature", "nn")
+def softmax_with_temperature(x, temperature=1.0, axis=-1):
+    return jax.nn.softmax(x / temperature, axis=axis)
+
+
+@op("biasadd", "nn")
+def biasadd(x, bias, nchw=False):
+    if nchw:
+        return x + bias.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return x + bias
+
+
+@op("xw_plus_b", "nn")
+def xw_plus_b(x, w, b, transpose_w=False):
+    if transpose_w:
+        w = w.T
+    return jnp.matmul(x, w) + b
+
+
+@op("relu_layer", "nn")
+def relu_layer(x, w, b):
+    return jnp.maximum(jnp.matmul(x, w) + b, 0.0)
+
+
+@op("layer_norm", "nn")
+def layer_norm(x, gain, bias=None, axis=-1, eps=1e-5):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps) * gain
+    return y + bias if bias is not None else y
+
+
+@op("batchnorm", "nn")
+def batchnorm(x, mean, variance, gamma=None, beta=None, eps=1e-5, axis=-1):
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    mean = mean.reshape(shape)
+    variance = variance.reshape(shape)
+    y = (x - mean) * lax.rsqrt(variance + eps)
+    if gamma is not None:
+        y = y * gamma.reshape(shape)
+    if beta is not None:
+        y = y + beta.reshape(shape)
+    return y
+
+
+@op("fused_batch_norm", "nn")
+def fused_batch_norm(x, scale, offset, mean=None, variance=None, eps=1e-3,
+                     training=True, data_format="NHWC"):
+    axis = 1 if data_format == "NCHW" else -1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    if training or mean is None:
+        mean = jnp.mean(x, axis=reduce_axes)
+        variance = jnp.var(x, axis=reduce_axes)
+    return batchnorm(x, mean, variance, scale, offset, eps, axis), mean, variance
+
+
+@op("lrn", "nn")
+def lrn(x, depth_radius=5, bias=1.0, alpha=1.0, beta=0.5):
+    """Local response normalization over the channel (last) axis."""
+    sq = jnp.square(x)
+    c = x.shape[-1]
+    k = 2 * depth_radius + 1
+    padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(depth_radius, depth_radius)])
+    win = jnp.stack([padded[..., i:i + c] for i in range(k)], axis=0).sum(axis=0)
+    return x / jnp.power(bias + alpha * win, beta)
+
+
+@op("dropout", "nn")
+def dropout(x, rate, key, training=True):
+    """Inverted dropout. Explicit key (JAX-style) instead of stateful RNG."""
+    if not training or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+@op("alpha_dropout", "nn")
+def alpha_dropout(x, rate, key, training=True):
+    if not training or rate == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    return a * jnp.where(mask, x, alpha_p) + b
+
+
+@op("gaussian_dropout", "nn")
+def gaussian_dropout(x, rate, key, training=True):
+    if not training or rate == 0.0:
+        return x
+    stddev = jnp.sqrt(rate / (1.0 - rate))
+    return x * (1.0 + stddev * jax.random.normal(key, x.shape, x.dtype))
+
+
+@op("gaussian_noise", "nn")
+def gaussian_noise(x, stddev, key, training=True):
+    if not training:
+        return x
+    return x + stddev * jax.random.normal(key, x.shape, x.dtype)
+
+
+# -- attention ----------------------------------------------------------
+@op("dot_product_attention", "attention")
+def dot_product_attention(queries, keys, values, mask=None, scale=True,
+                          with_weights=False):
+    """Scaled dot-product attention.
+
+    Reference semantics: `generic/nn/dot_product_attention.cpp` — inputs
+    [batch, dim, timesteps] in DL4J layout; here we use [..., T, dim]
+    (TPU/MXU-friendly trailing contraction) and the layer API adapts.
+    """
+    d = queries.shape[-1]
+    logits = jnp.einsum("...qd,...kd->...qk", queries, keys)
+    if scale:
+        logits = logits / jnp.sqrt(jnp.asarray(d, logits.dtype))
+    if mask is not None:
+        big_neg = jnp.finfo(logits.dtype).min
+        logits = jnp.where(mask.astype(bool), logits, big_neg)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("...qk,...kd->...qd", weights, values)
+    if with_weights:
+        return out, weights
+    return out
+
+
+@op("multi_head_dot_product_attention", "attention")
+def multi_head_dot_product_attention(queries, keys, values, wq, wk, wv, wo,
+                                     mask=None, scale=True):
+    """MHA with projection weights, reference
+    `generic/nn/multi_head_dot_product_attention.cpp` semantics.
+
+    queries/keys/values: [B, T, E]; wq/wk/wv: [E, H, P]; wo: [H*P, E].
+    """
+    q = jnp.einsum("bte,ehp->bhtp", queries, wq)
+    k = jnp.einsum("bte,ehp->bhtp", keys, wk)
+    v = jnp.einsum("bte,ehp->bhtp", values, wv)
+    if mask is not None and mask.ndim == 2:
+        mask = mask[:, None, None, :]
+    attn = dot_product_attention(q, k, v, mask=mask, scale=scale)
+    b, h, t, p = attn.shape
+    out = attn.transpose(0, 2, 1, 3).reshape(b, t, h * p)
+    return jnp.matmul(out, wo)
+
+
+@op("l2_normalize", "nn")
+def l2_normalize(x, axis=-1, eps=1e-12):
+    return x * lax.rsqrt(jnp.maximum(jnp.sum(x * x, axis=axis, keepdims=True), eps))

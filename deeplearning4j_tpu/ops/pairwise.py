@@ -1,0 +1,94 @@
+"""Broadcastable pairwise ops.
+
+Reference: `libnd4j/include/ops/declarable/headers/broadcastable.h` and the
+legacy pairwise/broadcast loop families. XLA broadcasting subsumes the
+reference's TAD-based broadcast machinery entirely.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import op
+
+op("add", "pairwise")(jnp.add)
+op("subtract", "pairwise", aliases=("sub",))(jnp.subtract)
+op("multiply", "pairwise", aliases=("mul",))(jnp.multiply)
+op("divide", "pairwise", aliases=("div",))(jnp.divide)
+op("realdiv", "pairwise")(jnp.true_divide)
+op("truncatediv", "pairwise")(lambda x, y: jnp.trunc(x / y))
+op("floordiv", "pairwise")(jnp.floor_divide)
+op("mod", "pairwise")(jnp.mod)
+op("floormod", "pairwise")(jnp.mod)
+op("reversesubtract", "pairwise", aliases=("rsub",))(lambda x, y: y - x)
+op("reversedivide", "pairwise", aliases=("rdiv",))(lambda x, y: y / x)
+op("reversemod", "pairwise")(lambda x, y: jnp.mod(y, x))
+op("maximum", "pairwise")(jnp.maximum)
+op("minimum", "pairwise")(jnp.minimum)
+op("Pow", "pairwise", aliases=("pow",))(jnp.power)
+op("squaredsubtract", "pairwise")(lambda x, y: jnp.square(x - y))
+op("cross", "pairwise")(jnp.cross)
+
+
+@op("divide_no_nan", "pairwise")
+def divide_no_nan(x, y):
+    return jnp.where(y == 0, jnp.zeros_like(x), x / jnp.where(y == 0, 1, y))
+
+
+# -- comparison (bool output) ------------------------------------------
+op("equals", "pairwise", differentiable=False)(jnp.equal)
+op("not_equals", "pairwise", differentiable=False)(jnp.not_equal)
+op("greater", "pairwise", differentiable=False)(jnp.greater)
+op("greater_equal", "pairwise", differentiable=False)(jnp.greater_equal)
+op("less", "pairwise", differentiable=False)(jnp.less)
+op("less_equal", "pairwise", differentiable=False)(jnp.less_equal)
+
+# scalar comparison variants (reference *_scalar ops) — same kernels
+for _n, _f in [("eq_scalar", jnp.equal), ("neq_scalar", jnp.not_equal),
+               ("gt_scalar", jnp.greater), ("gte_scalar", jnp.greater_equal),
+               ("lt_scalar", jnp.less), ("lte_scalar", jnp.less_equal)]:
+    op(_n, "pairwise", differentiable=False)(_f)
+
+# -- boolean ------------------------------------------------------------
+op("boolean_and", "pairwise", differentiable=False)(jnp.logical_and)
+op("boolean_or", "pairwise", differentiable=False)(jnp.logical_or)
+op("boolean_xor", "pairwise", differentiable=False)(jnp.logical_xor)
+op("boolean_not", "pairwise", differentiable=False)(jnp.logical_not)
+
+
+@op("select", "pairwise")
+def select(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+@op("Where", "pairwise", differentiable=False, aliases=("where_np",))
+def where(cond, x=None, y=None):
+    if x is None:
+        return jnp.stack(jnp.where(cond), axis=-1)
+    return jnp.where(cond, x, y)
+
+
+# -- merge family (n-ary elementwise) ----------------------------------
+@op("mergeadd", "pairwise", aliases=("accumulate",))
+def mergeadd(*xs):
+    r = xs[0]
+    for x in xs[1:]:
+        r = r + x
+    return r
+
+
+@op("mergeavg", "pairwise")
+def mergeavg(*xs):
+    return mergeadd(*xs) / len(xs)
+
+
+@op("mergemax", "pairwise")
+def mergemax(*xs):
+    r = xs[0]
+    for x in xs[1:]:
+        r = jnp.maximum(r, x)
+    return r
+
+
+@op("mergemaxindex", "pairwise", differentiable=False)
+def mergemaxindex(*xs):
+    return jnp.argmax(jnp.stack(xs, axis=0), axis=0)

@@ -1,0 +1,85 @@
+"""Random ops.
+
+Reference: `libnd4j/include/ops/declarable/headers/random.h` backed by a
+stateful philox RNG (`include/helpers/RandomLauncher.h`). JAX keys are
+counter-based philox too, but *splittable and explicit* — the TPU-correct
+design (stateful RNG breaks SPMD determinism). Every op takes `key`; the
+eager facade supplies one from the global stream (factory._GlobalRng).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import op
+
+
+@op("randomuniform", "random", differentiable=False, aliases=("random_uniform",))
+def randomuniform(key, shape, minval=0.0, maxval=1.0, dtype=jnp.float32):
+    if jnp.issubdtype(dtype, jnp.integer):
+        return jax.random.randint(key, tuple(shape), int(minval), int(maxval), dtype)
+    return jax.random.uniform(key, tuple(shape), dtype, minval, maxval)
+
+
+@op("random_normal", "random", differentiable=False)
+def random_normal(key, shape, mean=0.0, stddev=1.0, dtype=jnp.float32):
+    return mean + stddev * jax.random.normal(key, tuple(shape), dtype)
+
+
+@op("random_bernoulli", "random", differentiable=False)
+def random_bernoulli(key, shape, p=0.5, dtype=jnp.float32):
+    return jax.random.bernoulli(key, p, tuple(shape)).astype(dtype)
+
+
+@op("random_exponential", "random", differentiable=False)
+def random_exponential(key, shape, lam=1.0, dtype=jnp.float32):
+    return jax.random.exponential(key, tuple(shape), dtype) / lam
+
+
+@op("random_gamma", "random", differentiable=False)
+def random_gamma(key, shape, alpha, beta=1.0, dtype=jnp.float32):
+    return jax.random.gamma(key, alpha, tuple(shape), dtype) / beta
+
+
+@op("random_poisson", "random", differentiable=False)
+def random_poisson(key, shape, lam, dtype=jnp.int32):
+    return jax.random.poisson(key, lam, tuple(shape), dtype)
+
+
+@op("random_multinomial", "random", differentiable=False)
+def random_multinomial(key, logits, num_samples, dtype=jnp.int32):
+    return jax.random.categorical(key, logits, axis=-1,
+                                  shape=(logits.shape[0], int(num_samples))).astype(dtype)
+
+
+@op("random_shuffle", "random", differentiable=False)
+def random_shuffle(key, x, axis=0):
+    return jax.random.permutation(key, x, axis=axis)
+
+
+@op("random_crop", "random", differentiable=False)
+def random_crop(key, x, size):
+    size = tuple(int(s) for s in size)
+    starts = [jax.random.randint(key_i, (), 0, d - s + 1)
+              for key_i, d, s in zip(jax.random.split(key, len(size)), x.shape, size)]
+    return jax.lax.dynamic_slice(x, starts, size)
+
+
+@op("dropout_inverted", "random", differentiable=False)
+def dropout_inverted(key, x, p):
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+@op("get_seed", "random", differentiable=False)
+def get_seed():
+    from ..ndarray import factory
+    return jnp.asarray(factory.get_random().get_seed())
+
+
+@op("set_seed", "random", differentiable=False)
+def set_seed(seed):
+    from ..ndarray import factory
+    factory.set_seed(int(seed))
+    return jnp.asarray(int(seed))

@@ -1,0 +1,172 @@
+"""Recurrent ops: LSTM/GRU/SRU cells and layers.
+
+Reference: `libnd4j/include/ops/declarable/headers/recurrent.h`
+(lstmLayer/lstmLayerCell, gru/gruCell, sru/sru_bi, static/dynamic rnn).
+
+TPU: time loops are `lax.scan` — one compiled program, weights resident in
+VMEM across steps, per-step matmuls batched onto the MXU. Gate math follows
+the reference (`ops/declarable/helpers/impl/lstmLayer.cpp` gate order
+i,f,o,c → here standard [i,f,g,o] blocks, documented per function).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+
+@op("lstmLayerCell", "recurrent", aliases=("lstmCell",))
+def lstm_cell(x, h_prev, c_prev, w_x, w_h, b=None, forget_bias=0.0):
+    """One LSTM step. Gate blocks ordered [i, f, g(cell), o] along axis -1.
+
+    x: [B, In]; h_prev/c_prev: [B, H]; w_x: [In, 4H]; w_h: [H, 4H]; b: [4H].
+    """
+    z = jnp.matmul(x, w_x) + jnp.matmul(h_prev, w_h)
+    if b is not None:
+        z = z + b
+    h_sz = h_prev.shape[-1]
+    i, f, g, o = (z[..., :h_sz], z[..., h_sz:2 * h_sz],
+                  z[..., 2 * h_sz:3 * h_sz], z[..., 3 * h_sz:])
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forget_bias)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c = f * c_prev + i * g
+    h = o * jnp.tanh(c)
+    return h, c
+
+
+@op("lstmLayer", "recurrent", aliases=("lstm",))
+def lstm_layer(x, w_x, w_h, b=None, h0=None, c0=None, forget_bias=0.0,
+               time_major=False, return_sequence=True):
+    """Full-sequence LSTM via lax.scan.
+
+    x: [B, T, In] (or [T, B, In] when time_major); returns (h_seq, h_T, c_T).
+    """
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)  # [T, B, In]
+    B = x.shape[1]
+    H = w_h.shape[0]
+    h0 = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
+    c0 = c0 if c0 is not None else jnp.zeros((B, H), x.dtype)
+
+    def step(carry, x_t):
+        h, c = carry
+        h, c = lstm_cell(x_t, h, c, w_x, w_h, b, forget_bias)
+        return (h, c), h
+
+    (h_last, c_last), h_seq = lax.scan(step, (h0, c0), x)
+    if not time_major:
+        h_seq = jnp.swapaxes(h_seq, 0, 1)
+    if return_sequence:
+        return h_seq, h_last, c_last
+    return h_last, c_last
+
+
+@op("lstmLayer_bidirectional", "recurrent")
+def lstm_layer_bidirectional(x, w_x_f, w_h_f, b_f, w_x_b, w_h_b, b_b,
+                             mode="concat"):
+    """Bidirectional LSTM, merge modes per reference Bidirectional.Mode:
+    concat | add | mul | ave."""
+    fwd, hf, cf = lstm_layer(x, w_x_f, w_h_f, b_f)
+    bwd, hb, cb = lstm_layer(jnp.flip(x, axis=1), w_x_b, w_h_b, b_b)
+    bwd = jnp.flip(bwd, axis=1)
+    if mode == "concat":
+        return jnp.concatenate([fwd, bwd], axis=-1), (hf, cf), (hb, cb)
+    if mode == "add":
+        return fwd + bwd, (hf, cf), (hb, cb)
+    if mode == "mul":
+        return fwd * bwd, (hf, cf), (hb, cb)
+    return (fwd + bwd) / 2, (hf, cf), (hb, cb)
+
+
+@op("gruCell", "recurrent")
+def gru_cell(x, h_prev, w_ru, w_c, b_ru=None, b_c=None):
+    """GRU step, reference gruCell gate layout: [r, u] fused then candidate.
+
+    x: [B, In]; h_prev: [B, H]; w_ru: [In+H, 2H]; w_c: [In+H, H].
+    """
+    xh = jnp.concatenate([x, h_prev], axis=-1)
+    ru = jnp.matmul(xh, w_ru)
+    if b_ru is not None:
+        ru = ru + b_ru
+    H = h_prev.shape[-1]
+    r = jax.nn.sigmoid(ru[..., :H])
+    u = jax.nn.sigmoid(ru[..., H:])
+    xrh = jnp.concatenate([x, r * h_prev], axis=-1)
+    c = jnp.matmul(xrh, w_c)
+    if b_c is not None:
+        c = c + b_c
+    c = jnp.tanh(c)
+    return u * h_prev + (1.0 - u) * c
+
+
+@op("gru", "recurrent")
+def gru(x, h0, w_ru, w_c, b_ru=None, b_c=None, time_major=False):
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+
+    def step(h, x_t):
+        h = gru_cell(x_t, h, w_ru, w_c, b_ru, b_c)
+        return h, h
+
+    h_last, h_seq = lax.scan(step, h0, x)
+    if not time_major:
+        h_seq = jnp.swapaxes(h_seq, 0, 1)
+    return h_seq, h_last
+
+
+@op("sruCell", "recurrent")
+def sru_cell(x_t, c_prev, w, b):
+    """Simple Recurrent Unit step (reference sru op family).
+
+    w: [In, 3H] producing [x_tilde, f, r]."""
+    z = jnp.matmul(x_t, w)
+    H = c_prev.shape[-1]
+    x_tilde, f_in, r_in = z[..., :H], z[..., H:2 * H], z[..., 2 * H:]
+    f = jax.nn.sigmoid(f_in + b[..., :H])
+    r = jax.nn.sigmoid(r_in + b[..., H:])
+    c = f * c_prev + (1 - f) * x_tilde
+    h = r * jnp.tanh(c) + (1 - r) * x_t[..., :H]
+    return h, c
+
+
+@op("sru", "recurrent")
+def sru(x, c0, w, b, time_major=False):
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+
+    def step(c, x_t):
+        h, c = sru_cell(x_t, c, w, b)
+        return c, h
+
+    c_last, h_seq = lax.scan(step, c0, x)
+    if not time_major:
+        h_seq = jnp.swapaxes(h_seq, 0, 1)
+    return h_seq, c_last
+
+
+@op("static_rnn", "recurrent", aliases=("dynamic_rnn",))
+def simple_rnn(x, w_x, w_h, b=None, h0=None, activation=jnp.tanh,
+               time_major=False):
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+    B = x.shape[1]
+    H = w_h.shape[0]
+    h0 = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
+
+    def step(h, x_t):
+        z = jnp.matmul(x_t, w_x) + jnp.matmul(h, w_h)
+        if b is not None:
+            z = z + b
+        h = activation(z)
+        return h, h
+
+    h_last, h_seq = lax.scan(step, h0, x)
+    if not time_major:
+        h_seq = jnp.swapaxes(h_seq, 0, 1)
+    return h_seq, h_last

@@ -1,0 +1,210 @@
+"""Reduction ops.
+
+Reference: reduce family in `libnd4j/include/ops/declarable/headers/parity_ops.h`
+(reduce_sum/mean/... at various lines) plus legacy reduce{Float,Same,Bool,Long},
+indexreduce, summarystats loop families. XLA reduce + the MXU-friendly layout
+replace the reference's TAD-dimension reduce kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import op
+
+
+def _axes(dims, keep_dims=False):
+    if dims is None or dims == () or dims == []:
+        return None
+    if isinstance(dims, int):
+        return (dims,)
+    return tuple(int(d) for d in dims)
+
+
+def _make_reduce(name, fn, differentiable=True):
+    @op(name, "reduce", differentiable=differentiable)
+    def _r(x, dims=None, keep_dims=False):
+        return fn(x, axis=_axes(dims), keepdims=bool(keep_dims))
+    return _r
+
+
+_make_reduce("reduce_sum", jnp.sum)
+_make_reduce("reduce_mean", jnp.mean)
+_make_reduce("reduce_max", jnp.max)
+_make_reduce("reduce_min", jnp.min)
+_make_reduce("reduce_prod", jnp.prod)
+_make_reduce("reduce_norm1", lambda x, axis, keepdims: jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims))
+_make_reduce("reduce_norm2", lambda x, axis, keepdims: jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdims)))
+_make_reduce("reduce_sqnorm", lambda x, axis, keepdims: jnp.sum(x * x, axis=axis, keepdims=keepdims))
+_make_reduce("reduce_norm_max", lambda x, axis, keepdims: jnp.max(jnp.abs(x), axis=axis, keepdims=keepdims))
+_make_reduce("reduce_logsumexp", lambda x, axis, keepdims: jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims))
+_make_reduce("amax", lambda x, axis, keepdims: jnp.max(jnp.abs(x), axis=axis, keepdims=keepdims))
+_make_reduce("amin", lambda x, axis, keepdims: jnp.min(jnp.abs(x), axis=axis, keepdims=keepdims))
+_make_reduce("amean", lambda x, axis, keepdims: jnp.mean(jnp.abs(x), axis=axis, keepdims=keepdims))
+_make_reduce("reduce_any", lambda x, axis, keepdims: jnp.any(x, axis=axis, keepdims=keepdims), differentiable=False)
+_make_reduce("reduce_all", lambda x, axis, keepdims: jnp.all(x, axis=axis, keepdims=keepdims), differentiable=False)
+_make_reduce("countNonZero", lambda x, axis, keepdims: jnp.sum((x != 0), axis=axis, keepdims=keepdims), differentiable=False)
+_make_reduce("countZero", lambda x, axis, keepdims: jnp.sum((x == 0), axis=axis, keepdims=keepdims), differentiable=False)
+
+
+@op("reduce_stdev", "reduce")
+def reduce_stdev(x, dims=None, keep_dims=False, bias_corrected=True):
+    return jnp.std(x, axis=_axes(dims), keepdims=bool(keep_dims),
+                   ddof=1 if bias_corrected else 0)
+
+
+@op("reduce_variance", "reduce")
+def reduce_variance(x, dims=None, keep_dims=False, bias_corrected=True):
+    return jnp.var(x, axis=_axes(dims), keepdims=bool(keep_dims),
+                   ddof=1 if bias_corrected else 0)
+
+
+@op("reduce_dot", "reduce")
+def reduce_dot(x, y, dims=None, keep_dims=False):
+    return jnp.sum(x * y, axis=_axes(dims), keepdims=bool(keep_dims))
+
+
+@op("moments", "reduce")
+def moments(x, dims=None, keep_dims=False):
+    axes = _axes(dims)
+    return (jnp.mean(x, axis=axes, keepdims=bool(keep_dims)),
+            jnp.var(x, axis=axes, keepdims=bool(keep_dims)))
+
+
+@op("normalize_moments", "reduce")
+def normalize_moments(count, mean_ss, var_ss, shift=0.0):
+    mean = mean_ss / count + shift
+    variance = var_ss / count - jnp.square(mean - shift)
+    return mean, variance
+
+
+@op("sufficient_statistics", "reduce")
+def sufficient_statistics(x, dims=None, shift=None):
+    axes = _axes(dims)
+    count = jnp.asarray(
+        jnp.prod(jnp.asarray([x.shape[a] for a in (axes or range(x.ndim))])),
+        x.dtype)
+    xs = x - shift if shift is not None else x
+    return count, jnp.sum(xs, axis=axes), jnp.sum(xs * xs, axis=axes)
+
+
+# -- index reductions ---------------------------------------------------
+@op("argmax", "indexreduce", differentiable=False, aliases=("argamax",))
+def argmax(x, dims=None, keep_dims=False):
+    axis = None if dims is None else (dims if isinstance(dims, int) else dims[0])
+    r = jnp.argmax(x, axis=axis)
+    if keep_dims and axis is not None:
+        r = jnp.expand_dims(r, axis)
+    return r
+
+
+@op("argmin", "indexreduce", differentiable=False, aliases=("argamin",))
+def argmin(x, dims=None, keep_dims=False):
+    axis = None if dims is None else (dims if isinstance(dims, int) else dims[0])
+    r = jnp.argmin(x, axis=axis)
+    if keep_dims and axis is not None:
+        r = jnp.expand_dims(r, axis)
+    return r
+
+
+@op("top_k", "indexreduce", differentiable=False)
+def top_k(x, k, sorted=True):
+    return jax.lax.top_k(x, k)
+
+
+@op("in_top_k", "indexreduce", differentiable=False)
+def in_top_k(predictions, targets, k):
+    _, idx = jax.lax.top_k(predictions, k)
+    return jnp.any(idx == targets[:, None], axis=-1)
+
+
+@op("nth_element", "indexreduce", differentiable=False)
+def nth_element(x, n, reverse=False):
+    s = jnp.sort(x, axis=-1)
+    if reverse:
+        s = jnp.flip(s, axis=-1)
+    return s[..., n]
+
+
+@op("percentile", "reduce", differentiable=False)
+def percentile(x, q, dims=None, interpolation="linear"):
+    return jnp.percentile(x, q, axis=_axes(dims), method=interpolation)
+
+
+@op("bincount", "reduce", differentiable=False)
+def bincount(x, weights=None, minlength=0, maxlength=None):
+    length = minlength if maxlength is None else maxlength
+    length = max(int(length), 1)
+    return jnp.bincount(x.ravel(), weights=None if weights is None else weights.ravel(),
+                        length=length)
+
+
+@op("histogram", "reduce", differentiable=False)
+def histogram(x, bins):
+    h, _ = jnp.histogram(x, bins=int(bins))
+    return h
+
+
+@op("histogram_fixed_width", "reduce", differentiable=False)
+def histogram_fixed_width(x, value_range, nbins=100):
+    h, _ = jnp.histogram(x, bins=int(nbins),
+                         range=(float(value_range[0]), float(value_range[1])))
+    return h
+
+
+# -- reduce3 (pairwise distance reductions) -----------------------------
+@op("cosine_similarity", "reduce3")
+def cosine_similarity(x, y, dims=None, keep_dims=False):
+    axes = _axes(dims)
+    num = jnp.sum(x * y, axis=axes, keepdims=bool(keep_dims))
+    nx = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=bool(keep_dims)))
+    ny = jnp.sqrt(jnp.sum(y * y, axis=axes, keepdims=bool(keep_dims)))
+    return num / jnp.maximum(nx * ny, 1e-12)
+
+
+@op("cosine_distance", "reduce3")
+def cosine_distance(x, y, dims=None, keep_dims=False):
+    return 1.0 - cosine_similarity(x, y, dims, keep_dims)
+
+
+@op("euclidean_distance", "reduce3")
+def euclidean_distance(x, y, dims=None, keep_dims=False):
+    return jnp.sqrt(jnp.sum((x - y) ** 2, axis=_axes(dims), keepdims=bool(keep_dims)))
+
+
+@op("manhattan_distance", "reduce3")
+def manhattan_distance(x, y, dims=None, keep_dims=False):
+    return jnp.sum(jnp.abs(x - y), axis=_axes(dims), keepdims=bool(keep_dims))
+
+
+@op("jaccard_distance", "reduce3")
+def jaccard_distance(x, y, dims=None, keep_dims=False):
+    axes = _axes(dims)
+    mins = jnp.sum(jnp.minimum(x, y), axis=axes, keepdims=bool(keep_dims))
+    maxs = jnp.sum(jnp.maximum(x, y), axis=axes, keepdims=bool(keep_dims))
+    return 1.0 - mins / jnp.maximum(maxs, 1e-12)
+
+
+@op("hamming_distance", "reduce3", differentiable=False)
+def hamming_distance(x, y, dims=None, keep_dims=False):
+    return jnp.sum((x != y), axis=_axes(dims), keepdims=bool(keep_dims))
+
+
+@op("dot", "reduce3")
+def dot(x, y, dims=None, keep_dims=False):
+    if dims is None:
+        return jnp.sum(x * y)
+    return jnp.sum(x * y, axis=_axes(dims), keepdims=bool(keep_dims))
+
+
+@op("matrix_band_part", "transforms")
+def matrix_band_part(x, num_lower, num_upper):
+    m, n = x.shape[-2], x.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = jnp.ones((m, n), bool)
+    if num_lower >= 0:
+        keep &= (i - j) <= num_lower
+    if num_upper >= 0:
+        keep &= (j - i) <= num_upper
+    return jnp.where(keep, x, jnp.zeros_like(x))

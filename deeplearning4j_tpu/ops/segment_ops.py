@@ -1,0 +1,56 @@
+"""Segment reduction ops.
+
+Reference: segment_*/unsorted_segment_* in
+`libnd4j/include/ops/declarable/headers/parity_ops.h`. jax.ops.segment_*
+lower to one-hot matmuls/scatters that XLA tiles efficiently; num_segments
+must be static (XLA static-shape rule) — callers pass it explicitly, the
+graph layer infers it from shapes at trace time.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import op
+
+
+def _num(segment_ids, num_segments):
+    if num_segments is not None:
+        return int(num_segments)
+    return int(jnp.max(segment_ids)) + 1  # eager-only fallback
+
+
+@op("segment_sum", "segment", aliases=("unsorted_segment_sum",))
+def segment_sum(data, segment_ids, num_segments=None):
+    return jax.ops.segment_sum(data, segment_ids, _num(segment_ids, num_segments))
+
+
+@op("segment_max", "segment", aliases=("unsorted_segment_max",))
+def segment_max(data, segment_ids, num_segments=None):
+    return jax.ops.segment_max(data, segment_ids, _num(segment_ids, num_segments))
+
+
+@op("segment_min", "segment", aliases=("unsorted_segment_min",))
+def segment_min(data, segment_ids, num_segments=None):
+    return jax.ops.segment_min(data, segment_ids, _num(segment_ids, num_segments))
+
+
+@op("segment_prod", "segment", aliases=("unsorted_segment_prod",))
+def segment_prod(data, segment_ids, num_segments=None):
+    return jax.ops.segment_prod(data, segment_ids, _num(segment_ids, num_segments))
+
+
+@op("segment_mean", "segment", aliases=("unsorted_segment_mean",))
+def segment_mean(data, segment_ids, num_segments=None):
+    n = _num(segment_ids, num_segments)
+    sums = jax.ops.segment_sum(data, segment_ids, n)
+    counts = jax.ops.segment_sum(jnp.ones_like(data, jnp.float32), segment_ids, n)
+    return sums / jnp.maximum(counts, 1.0)
+
+
+@op("unsorted_segment_sqrt_n", "segment")
+def unsorted_segment_sqrt_n(data, segment_ids, num_segments=None):
+    n = _num(segment_ids, num_segments)
+    sums = jax.ops.segment_sum(data, segment_ids, n)
+    counts = jax.ops.segment_sum(jnp.ones_like(data, jnp.float32), segment_ids, n)
+    return sums / jnp.sqrt(jnp.maximum(counts, 1.0))

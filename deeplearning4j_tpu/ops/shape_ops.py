@@ -1,0 +1,323 @@
+"""Shape / gather-scatter / layout ops.
+
+Reference: `libnd4j/include/ops/declarable/headers/shape.h`, `parity_ops.h`
+(gather/scatter/slice/stack families), `headers/list.h` TensorArray ops.
+Scatter ops map to jax `.at[]` ops which XLA lowers to efficient dynamic
+update slices; TensorArray-style list ops become `lax.scan` patterns at the
+graph layer and are represented eagerly as Python lists.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+op("reshape", "shape")(lambda x, shape: jnp.reshape(x, tuple(int(s) for s in shape)))
+op("reshapeas", "shape")(lambda x, y: jnp.reshape(x, y.shape))
+op("flatten", "shape")(lambda *xs: jnp.concatenate([x.ravel() for x in xs]))
+op("flatten_2d", "shape")(lambda x, axis=1: jnp.reshape(x, (int(jnp.prod(jnp.asarray(x.shape[:axis]))), -1)))
+op("transpose", "shape")(lambda x, axes=None: jnp.transpose(x, axes))
+op("permute", "shape")(lambda x, axes: jnp.transpose(x, axes))
+op("squeeze", "shape")(lambda x, axis=None: jnp.squeeze(x, axis=axis))
+op("expand_dims", "shape")(lambda x, axis: jnp.expand_dims(x, axis))
+op("broadcast_to", "shape")(lambda x, shape: jnp.broadcast_to(x, tuple(shape)))
+op("tile", "shape")(lambda x, reps: jnp.tile(x, reps))
+op("tile_to_shape", "shape")(lambda x, shape: jnp.broadcast_to(x, tuple(shape)))
+op("repeat", "shape")(lambda x, repeats, axis=None: jnp.repeat(x, repeats, axis=axis))
+op("concat", "shape")(lambda *xs, axis=0: jnp.concatenate(xs, axis=axis))
+op("stack", "shape", aliases=("parallel_stack",))(lambda *xs, axis=0: jnp.stack(xs, axis=axis))
+op("unstack", "shape")(lambda x, axis=0: [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)])
+op("split", "shape")(lambda x, num, axis=0: jnp.split(x, num, axis=axis))
+op("split_v", "shape")(lambda x, sizes, axis=0: jnp.split(x, jnp.cumsum(jnp.asarray(sizes))[:-1].tolist(), axis=axis))
+op("tear", "shape")(lambda x, axis=0: [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)])
+op("reverse", "shape")(lambda x, dims=None: jnp.flip(x, axis=tuple(dims) if dims is not None else None))
+op("roll", "shape")(lambda x, shift, axis=None: jnp.roll(x, shift, axis=axis))
+op("order", "shape", differentiable=False)(lambda x, order="c": x)  # layout is XLA's concern
+
+op("rank", "shape", differentiable=False)(lambda x: jnp.asarray(x.ndim))
+op("shape_of", "shape", differentiable=False, aliases=("shape",))(lambda x: jnp.asarray(x.shape, jnp.int64))
+op("shapes_of", "shape", differentiable=False)(lambda *xs: [jnp.asarray(x.shape, jnp.int64) for x in xs])
+op("size", "shape", differentiable=False)(lambda x: jnp.asarray(x.size))
+op("size_at", "shape", differentiable=False)(lambda x, dim: jnp.asarray(x.shape[dim]))
+op("set_shape", "shape", differentiable=False)(lambda x, shape: jnp.reshape(x, tuple(shape)))
+op("evaluate_reduction_shape", "shape", differentiable=False)(
+    lambda shape, dims, keep_dims=False: jnp.asarray(
+        [1 if i in dims else s for i, s in enumerate(shape.tolist())] if keep_dims
+        else [s for i, s in enumerate(shape.tolist()) if i not in dims], jnp.int64))
+
+
+@op("broadcast_dynamic_shape", "shape", differentiable=False)
+def broadcast_dynamic_shape(a, b):
+    return jnp.asarray(jnp.broadcast_shapes(tuple(a.tolist()), tuple(b.tolist())),
+                       jnp.int64)
+
+
+op("eye", "shape", differentiable=False)(
+    lambda rows, cols=None, batch_shape=None, dtype=jnp.float32:
+        jnp.broadcast_to(jnp.eye(rows, cols, dtype=dtype),
+                         tuple(batch_shape or ()) + (rows, cols or rows)))
+op("fill", "shape", differentiable=False)(lambda shape, value, dtype=None: jnp.full(tuple(shape), value, dtype=dtype))
+op("create", "shape", differentiable=False)(lambda shape, dtype=jnp.float32: jnp.zeros(tuple(shape), dtype))
+op("range", "shape", differentiable=False)(lambda start, limit=None, delta=1, dtype=None: jnp.arange(start, limit, delta, dtype=dtype))
+op("lin_space", "shape", differentiable=False)(lambda start, stop, num: jnp.linspace(start, stop, int(num)))
+op("meshgrid", "shape")(lambda *xs, indexing="xy": jnp.meshgrid(*xs, indexing=indexing))
+
+
+@op("onehot", "shape", differentiable=False)
+def onehot(indices, depth, on_value=1.0, off_value=0.0, axis=-1, dtype=jnp.float32):
+    oh = jax.nn.one_hot(indices, depth, axis=axis, dtype=dtype)
+    return oh * (on_value - off_value) + off_value
+
+
+@op("sequence_mask", "shape", differentiable=False)
+def sequence_mask(lengths, maxlen=None, dtype=jnp.bool_):
+    maxlen = int(maxlen) if maxlen is not None else int(jnp.max(lengths))
+    return (jnp.arange(maxlen)[None, :] < lengths[..., None]).astype(dtype)
+
+
+@op("reverse_sequence", "shape")
+def reverse_sequence(x, seq_lengths, seq_axis=1, batch_axis=0):
+    def rev_one(row, n):
+        idx = jnp.arange(row.shape[seq_axis - 1 if seq_axis > batch_axis else seq_axis])
+        src = jnp.where(idx < n, n - 1 - idx, idx)
+        return jnp.take(row, src, axis=seq_axis - 1 if seq_axis > batch_axis else seq_axis)
+    return jax.vmap(rev_one, in_axes=(batch_axis, 0), out_axes=batch_axis)(x, seq_lengths)
+
+
+# -- gather / scatter ---------------------------------------------------
+op("gather", "gather")(lambda x, indices, axis=0: jnp.take(x, indices, axis=axis))
+op("gather_nd", "gather")(lambda x, indices: x[tuple(jnp.moveaxis(indices, -1, 0))])
+op("embedding_lookup", "gather")(lambda params, ids, *a, **k: jnp.take(params, ids, axis=0))
+
+
+@op("invert_permutation", "gather", differentiable=False)
+def invert_permutation(p):
+    return jnp.zeros_like(p).at[p].set(jnp.arange(p.shape[0], dtype=p.dtype))
+
+
+def _scatter(method):
+    def f(ref, indices, updates):
+        return getattr(ref.at[indices], method)(updates)
+    return f
+
+
+op("scatter_add", "scatter")(_scatter("add"))
+op("scatter_sub", "scatter")(_scatter("subtract"))
+op("scatter_mul", "scatter")(_scatter("multiply"))
+op("scatter_div", "scatter")(_scatter("divide"))
+op("scatter_max", "scatter")(_scatter("max"))
+op("scatter_min", "scatter")(_scatter("min"))
+op("scatter_upd", "scatter", aliases=("scatter_update",))(_scatter("set"))
+
+
+def _scatter_nd(method):
+    def f(indices, updates, shape_or_ref):
+        if hasattr(shape_or_ref, "shape") and shape_or_ref.ndim > 0 and not isinstance(shape_or_ref, (list, tuple)):
+            ref = shape_or_ref if shape_or_ref.dtype == updates.dtype else jnp.zeros(tuple(shape_or_ref.tolist()), updates.dtype)
+        else:
+            ref = jnp.zeros(tuple(int(s) for s in shape_or_ref), updates.dtype)
+        idx = tuple(jnp.moveaxis(indices, -1, 0))
+        return getattr(ref.at[idx], method)(updates)
+    return f
+
+
+@op("scatter_nd", "scatter")
+def scatter_nd(indices, updates, shape):
+    ref = jnp.zeros(tuple(int(s) for s in (shape.tolist() if hasattr(shape, "tolist") else shape)), updates.dtype)
+    return ref.at[tuple(jnp.moveaxis(indices, -1, 0))].add(updates)
+
+
+@op("scatter_nd_add", "scatter")
+def scatter_nd_add(ref, indices, updates):
+    return ref.at[tuple(jnp.moveaxis(indices, -1, 0))].add(updates)
+
+
+@op("scatter_nd_sub", "scatter")
+def scatter_nd_sub(ref, indices, updates):
+    return ref.at[tuple(jnp.moveaxis(indices, -1, 0))].subtract(updates)
+
+
+@op("scatter_nd_update", "scatter")
+def scatter_nd_update(ref, indices, updates):
+    return ref.at[tuple(jnp.moveaxis(indices, -1, 0))].set(updates)
+
+
+# -- slicing ------------------------------------------------------------
+@op("slice", "shape")
+def slice_op(x, begin, size):
+    begin = [int(b) for b in begin]
+    size = [x.shape[i] - begin[i] if int(s) == -1 else int(s) for i, s in enumerate(size)]
+    return lax.slice(x, begin, [b + s for b, s in zip(begin, size)])
+
+
+@op("strided_slice", "shape")
+def strided_slice(x, begin, end, strides=None):
+    strides = strides or [1] * len(begin)
+    idx = tuple(slice(int(b), int(e), int(s)) for b, e, s in zip(begin, end, strides))
+    return x[idx]
+
+
+@op("dynamic_partition", "shape", differentiable=False)
+def dynamic_partition(x, partitions, num_partitions):
+    return [x[partitions == i] for i in range(num_partitions)]
+
+
+@op("dynamic_stitch", "shape")
+def dynamic_stitch(indices, data):
+    n = sum(int(i.size) for i in indices)
+    sample = data[0].reshape((indices[0].size,) + data[0].shape[indices[0].ndim:])
+    out = jnp.zeros((n,) + sample.shape[1:], sample.dtype)
+    for idx, d in zip(indices, data):
+        out = out.at[idx.ravel()].set(d.reshape((-1,) + sample.shape[1:]))
+    return out
+
+
+# -- space/depth layout -------------------------------------------------
+@op("space_to_depth", "shape")
+def space_to_depth(x, block_size, data_format="NHWC"):
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // block_size, block_size, w // block_size, block_size, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(b, h // block_size, w // block_size,
+                                                     c * block_size * block_size)
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    return x
+
+
+@op("depth_to_space", "shape")
+def depth_to_space(x, block_size, data_format="NHWC"):
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    b, h, w, c = x.shape
+    oc = c // (block_size * block_size)
+    x = x.reshape(b, h, w, block_size, block_size, oc)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(b, h * block_size, w * block_size, oc)
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    return x
+
+
+@op("batch_to_space", "shape", aliases=("batch_to_space_nd",))
+def batch_to_space(x, block_shape, crops):
+    if isinstance(block_shape, int):
+        block_shape = [block_shape] * 2
+    block_shape = [int(b) for b in (block_shape.tolist() if hasattr(block_shape, "tolist") else block_shape)]
+    crops = [[int(c) for c in row] for row in (crops.tolist() if hasattr(crops, "tolist") else crops)]
+    b = x.shape[0]
+    prod = 1
+    for s in block_shape:
+        prod *= s
+    nb = b // prod
+    spatial = list(x.shape[1:1 + len(block_shape)])
+    rem = list(x.shape[1 + len(block_shape):])
+    x = x.reshape(block_shape + [nb] + spatial + rem)
+    perm = [len(block_shape)]
+    for i in range(len(block_shape)):
+        perm += [len(block_shape) + 1 + i, i]
+    perm += list(range(2 * len(block_shape) + 1, x.ndim))
+    x = jnp.transpose(x, perm)
+    new_spatial = [spatial[i] * block_shape[i] for i in range(len(block_shape))]
+    x = x.reshape([nb] + new_spatial + rem)
+    idx = (slice(None),) + tuple(slice(c[0], x.shape[i + 1] - c[1]) for i, c in enumerate(crops))
+    return x[idx]
+
+
+@op("space_to_batch", "shape", aliases=("space_to_batch_nd",))
+def space_to_batch(x, block_shape, paddings):
+    if isinstance(block_shape, int):
+        block_shape = [block_shape] * 2
+    block_shape = [int(b) for b in (block_shape.tolist() if hasattr(block_shape, "tolist") else block_shape)]
+    paddings = [[int(c) for c in row] for row in (paddings.tolist() if hasattr(paddings, "tolist") else paddings)]
+    pad_width = [(0, 0)] + [tuple(p) for p in paddings] + [(0, 0)] * (x.ndim - 1 - len(paddings))
+    x = jnp.pad(x, pad_width)
+    b = x.shape[0]
+    spatial = list(x.shape[1:1 + len(block_shape)])
+    rem = list(x.shape[1 + len(block_shape):])
+    shape = [b]
+    for i, s in enumerate(spatial):
+        shape += [s // block_shape[i], block_shape[i]]
+    shape += rem
+    x = x.reshape(shape)
+    perm = []
+    for i in range(len(block_shape)):
+        perm.append(2 + 2 * i)
+    perm.append(0)
+    for i in range(len(block_shape)):
+        perm.append(1 + 2 * i)
+    perm += list(range(1 + 2 * len(block_shape), x.ndim))
+    x = jnp.transpose(x, perm)
+    prod = 1
+    for s in block_shape:
+        prod *= s
+    return x.reshape([b * prod] + [spatial[i] // block_shape[i] for i in range(len(block_shape))] + rem)
+
+
+@op("pad", "shape")
+def pad(x, paddings, mode="CONSTANT", constant_values=0):
+    paddings = [tuple(int(c) for c in row) for row in
+                (paddings.tolist() if hasattr(paddings, "tolist") else paddings)]
+    mode = mode.upper() if isinstance(mode, str) else {0: "CONSTANT", 1: "REFLECT", 2: "SYMMETRIC"}[mode]
+    if mode == "CONSTANT":
+        return jnp.pad(x, paddings, constant_values=constant_values)
+    return jnp.pad(x, paddings, mode=mode.lower())
+
+
+@op("mirror_pad", "shape")
+def mirror_pad(x, paddings, mode="REFLECT"):
+    return pad(x, paddings, mode=mode)
+
+
+@op("unique", "shape", differentiable=False)
+def unique(x):
+    vals, idx = jnp.unique(x, return_inverse=True, size=x.size)
+    return vals, idx.reshape(x.shape)
+
+
+@op("unique_with_counts", "shape", differentiable=False)
+def unique_with_counts(x):
+    vals, idx, counts = jnp.unique(x, return_inverse=True, return_counts=True, size=x.size)
+    return vals, idx.reshape(x.shape), counts
+
+
+@op("listdiff", "shape", differentiable=False)
+def listdiff(x, y):
+    mask = ~jnp.isin(x, y)
+    return x[mask], jnp.where(mask)[0]
+
+
+op("diag", "shape")(lambda x: jnp.diag(x) if x.ndim <= 1 else jnp.diagflat(x))
+op("diag_part", "shape")(lambda x: jnp.diagonal(x, axis1=-2, axis2=-1))
+op("matrix_diag", "shape")(lambda x: jnp.apply_along_axis(jnp.diag, -1, x) if x.ndim > 1 else jnp.diag(x))
+op("matrix_diag_part", "shape")(lambda x: jnp.diagonal(x, axis1=-2, axis2=-1))
+
+
+@op("matrix_set_diag", "shape")
+def matrix_set_diag(x, diagonal):
+    n = min(x.shape[-2], x.shape[-1])
+    i = jnp.arange(n)
+    return x.at[..., i, i].set(diagonal[..., :n])
+
+
+op("tri", "shape", differentiable=False)(lambda rows, cols=None, k=0, dtype=jnp.float32: jnp.tri(rows, cols, k, dtype=dtype))
+op("triu", "shape")(lambda x, k=0: jnp.triu(x, k))
+op("trace", "shape")(lambda x: jnp.trace(x, axis1=-2, axis2=-1))
+
+
+@op("bitcast", "shape", differentiable=False)
+def bitcast(x, dtype):
+    from ..common.dtype import DataType
+    return lax.bitcast_convert_type(x, DataType.from_any(dtype).jax)
+
+
+@op("assign", "shape")
+def assign(x, y):
+    return jnp.broadcast_to(y, x.shape).astype(x.dtype)
+
+
+@op("identity_n", "shape")
+def identity_n(*xs):
+    return list(xs)

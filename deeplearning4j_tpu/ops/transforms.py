@@ -1,0 +1,222 @@
+"""Elementwise transform ops.
+
+Reference: `libnd4j/include/ops/declarable/headers/transforms.h`, legacy
+transform families in `libnd4j/include/loops/legacy_ops.h`, activation ops in
+`headers/nn.h`. On TPU each of these is a single XLA HLO that fuses into
+surrounding computations — the hand-written template kernels of the reference
+(`loops/cpu/transform/*.hpp`) have no analog; jnp/lax *is* the kernel.
+
+All `_bp` (backprop) variants of the reference come free via `jax.grad`, so
+they are not separately registered.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+# -- basic unary math ---------------------------------------------------
+op("abs", "transforms")(jnp.abs)
+op("neg", "transforms")(jnp.negative)
+op("exp", "transforms")(jnp.exp)
+op("expm1", "transforms")(jnp.expm1)
+op("log", "transforms")(jnp.log)
+op("Log1p", "transforms", aliases=("log1p",))(jnp.log1p)
+op("log2", "transforms")(jnp.log2)
+op("sqrt", "transforms")(jnp.sqrt)
+op("rsqrt", "transforms")(lax.rsqrt)
+op("square", "transforms")(jnp.square)
+op("cube", "transforms")(lambda x: x * x * x)
+op("reciprocal", "transforms")(jnp.reciprocal)
+op("sign", "transforms")(jnp.sign)
+op("Floor", "transforms", aliases=("floor",))(jnp.floor)
+op("ceil", "transforms")(jnp.ceil)
+op("rint", "transforms")(jnp.rint)
+op("round", "transforms")(jnp.round)
+
+# -- trig ---------------------------------------------------------------
+op("sin", "transforms")(jnp.sin)
+op("cos", "transforms")(jnp.cos)
+op("tan", "transforms")(jnp.tan)
+op("asin", "transforms")(jnp.arcsin)
+op("acos", "transforms")(jnp.arccos)
+op("atan", "transforms")(jnp.arctan)
+op("sinh", "transforms")(jnp.sinh)
+op("cosh", "transforms")(jnp.cosh)
+op("tanh", "transforms")(jnp.tanh)
+op("asinh", "transforms")(jnp.arcsinh)
+op("acosh", "transforms")(jnp.arccosh)
+op("atanh", "transforms")(jnp.arctanh)
+op("tf_atan2", "transforms", aliases=("atan2",))(jnp.arctan2)
+
+# -- special ------------------------------------------------------------
+op("erf", "transforms")(jax.scipy.special.erf)
+op("erfc", "transforms")(jax.scipy.special.erfc)
+op("lgamma", "transforms")(jax.scipy.special.gammaln)
+op("digamma", "transforms")(jax.scipy.special.digamma)
+op("polygamma", "transforms")(jax.scipy.special.polygamma)
+op("zeta", "transforms")(jax.scipy.special.zeta)
+op("betainc", "transforms")(jax.scipy.special.betainc)
+op("igamma", "transforms")(jax.scipy.special.gammainc)
+op("igammac", "transforms")(jax.scipy.special.gammaincc)
+
+
+# -- activations (headers/nn.h) ----------------------------------------
+op("sigmoid", "activations")(jax.nn.sigmoid)
+op("relu", "activations")(lambda x, cutoff=0.0: jnp.maximum(x, cutoff))
+op("relu6", "activations")(jax.nn.relu6)
+op("lrelu", "activations", aliases=("leakyrelu",))(
+    lambda x, alpha=0.01: jax.nn.leaky_relu(x, alpha))
+op("elu", "activations")(lambda x, alpha=1.0: jax.nn.elu(x, alpha))
+op("selu", "activations")(jax.nn.selu)
+op("gelu", "activations")(jax.nn.gelu)
+op("softplus", "activations")(jax.nn.softplus)
+op("softsign", "activations")(jax.nn.soft_sign)
+op("hardsigmoid", "activations")(jax.nn.hard_sigmoid)
+op("hardtanh", "activations")(jax.nn.hard_tanh)
+op("swish", "activations")(jax.nn.silu)
+op("mish", "activations")(jax.nn.mish)
+op("hardswish", "activations")(jax.nn.hard_silu)
+
+
+@op("thresholdedrelu", "activations")
+def thresholdedrelu(x, theta=1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+@op("rationaltanh", "activations")
+def rationaltanh(x):
+    # reference: 1.7159 * tanh(2x/3) approximated rationally
+    a = 1.7159
+    x23 = 0.6666667 * x
+    return a * x23 / (1.0 + jnp.abs(x23))
+
+
+@op("rectifiedtanh", "activations")
+def rectifiedtanh(x):
+    return jnp.maximum(jnp.tanh(x), 0.0)
+
+
+@op("crelu", "activations")
+def crelu(x):
+    return jnp.concatenate([jnp.maximum(x, 0), jnp.maximum(-x, 0)], axis=-1)
+
+
+@op("prelu", "activations")
+def prelu(x, alpha):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@op("cast", "transforms")
+def cast(x, dtype):
+    from ..common.dtype import DataType
+    return x.astype(DataType.from_any(dtype).jax)
+
+
+for _name, _dt in [("to_double", "float64"), ("to_float32", "float32"),
+                   ("to_float16", "float16"), ("to_int32", "int32"),
+                   ("to_int64", "int64"), ("to_uint32", "uint32"),
+                   ("to_uint64", "uint64")]:
+    op(_name, "transforms")(lambda x, _d=_dt: x.astype(_d))
+
+op("identity", "transforms")(lambda x: x)
+op("ones_as", "transforms")(jnp.ones_like)
+op("zeros_as", "transforms")(jnp.zeros_like)
+op("fill_as", "transforms")(lambda x, v: jnp.full_like(x, v))
+op("stop_gradient", "transforms")(lax.stop_gradient)
+op("noop", "transforms")(lambda *a: a[0] if a else None)
+
+
+@op("clipbyvalue", "transforms", aliases=("clip_by_value",))
+def clipbyvalue(x, clip_min, clip_max):
+    return jnp.clip(x, clip_min, clip_max)
+
+
+@op("clipbynorm", "transforms")
+def clipbynorm(x, clip_norm, axis=None):
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=axis is not None))
+    return jnp.where(n > clip_norm, x * (clip_norm / jnp.maximum(n, 1e-12)), x)
+
+
+@op("clipbyavgnorm", "transforms")
+def clipbyavgnorm(x, clip_norm, axis=None):
+    n = jnp.sqrt(jnp.mean(x * x, axis=axis, keepdims=axis is not None))
+    return jnp.where(n > clip_norm, x * (clip_norm / jnp.maximum(n, 1e-12)), x)
+
+
+@op("clip_by_global_norm", "transforms")
+def clip_by_global_norm(xs, clip_norm):
+    leaves = jax.tree_util.tree_leaves(xs)
+    g = jnp.sqrt(sum(jnp.sum(x * x) for x in leaves))
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(g, 1e-12))
+    return jax.tree_util.tree_map(lambda x: x * scale, xs), g
+
+
+@op("standardize", "transforms")
+def standardize(x, axis=-1):
+    m = jnp.mean(x, axis=axis, keepdims=True)
+    s = jnp.std(x, axis=axis, keepdims=True)
+    return (x - m) / jnp.maximum(s, 1e-12)
+
+
+@op("cumsum", "transforms")
+def cumsum(x, axis=None, exclusive=False, reverse=False):
+    if reverse:
+        x = jnp.flip(x, axis=axis)
+    r = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        r = r - x
+    if reverse:
+        r = jnp.flip(r, axis=axis)
+    return r
+
+
+@op("cumprod", "transforms")
+def cumprod(x, axis=None, exclusive=False, reverse=False):
+    if reverse:
+        x = jnp.flip(x, axis=axis)
+    r = jnp.cumprod(x, axis=axis)
+    if exclusive:
+        r = r / x
+    if reverse:
+        r = jnp.flip(r, axis=axis)
+    return r
+
+
+op("is_numeric_tensor", "transforms", differentiable=False)(
+    lambda x: jnp.asarray(jnp.issubdtype(x.dtype, jnp.number)))
+op("is_non_decreasing", "transforms", differentiable=False)(
+    lambda x: jnp.all(jnp.diff(x.ravel()) >= 0))
+op("is_strictly_increasing", "transforms", differentiable=False)(
+    lambda x: jnp.all(jnp.diff(x.ravel()) > 0))
+
+
+@op("check_numerics", "transforms", differentiable=False)
+def check_numerics(x, message=""):
+    return x  # panic-mode checking happens in the executioner profiler
+
+
+@op("ismax", "transforms", differentiable=False)
+def ismax(x, axis=None):
+    if axis is None:
+        return (x == jnp.max(x)).astype(x.dtype)
+    return (x == jnp.max(x, axis=axis, keepdims=True)).astype(x.dtype)
+
+
+@op("zero_fraction", "transforms", differentiable=False)
+def zero_fraction(x):
+    return jnp.mean((x == 0).astype(jnp.float32))
+
+
+@op("axpy", "transforms")
+def axpy(x, y, alpha=1.0):
+    return alpha * x + y
+
+
+@op("choose", "transforms", differentiable=False)
+def choose(x, mode, scalar):
+    comps = {0: jnp.equal, 1: jnp.not_equal, 2: jnp.less, 3: jnp.less_equal,
+             4: jnp.greater, 5: jnp.greater_equal}
+    return x[comps[mode](x, scalar)]

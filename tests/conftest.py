@@ -1,0 +1,24 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the reference's DummyTransport
+in-JVM fake-cluster pattern, SURVEY.md §4 "distributed without a cluster"):
+sharding/collective code paths execute for real, just on host devices.
+Must run before jax is imported anywhere.
+"""
+import os
+
+# Force CPU: the session env pins JAX_PLATFORMS=axon (remote TPU tunnel);
+# unit tests must never touch it — they run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The axon sitecustomize hook calls jax.config.update("jax_platforms",
+# "axon,cpu") at interpreter boot, which overrides the env var — override it
+# back before any backend is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,274 @@
+"""MultiLayerNetwork tests: config DSL, init, fit, eval, serde — the layer-API
+slice of the reference's dl4jcore tests."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nd
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.nn import (InputType, MultiLayerConfiguration,
+                                   MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                               BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               DropoutLayer,
+                                               GlobalPoolingLayer, LSTM,
+                                               OutputLayer, RnnOutputLayer,
+                                               SubsamplingLayer)
+
+
+def _xor_data():
+    X = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
+    Y = np.array([[1, 0], [0, 1], [0, 1], [1, 0]], np.float32)
+    return nd.create(X), nd.create(Y)
+
+
+class TestConfigDSL:
+    def test_builder(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(42)
+                .updater(Adam(learning_rate=0.01))
+                .l2(1e-4)
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=3))
+                .build())
+        assert len(conf.layers) == 2
+        assert conf.seed == 42
+        assert conf.l2 == 1e-4
+
+    def test_json_roundtrip(self):
+        conf = (NeuralNetConfiguration.builder()
+                .updater(Adam(learning_rate=0.01))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=2))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        j = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(j)
+        assert len(conf2.layers) == 2
+        assert conf2.layers[0].activation == "tanh"
+        assert isinstance(conf2.updater, Adam)
+        assert conf2.updater.learning_rate == 0.01
+
+    def test_shape_inference_cnn(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(ConvolutionLayer(n_out=6, kernel_size=(5, 5)))
+                .layer(SubsamplingLayer(kernel_size=(2, 2)))
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=10))
+                .set_input_type(InputType.convolutional(28, 28, 1))
+                .build())
+        types = conf.layer_input_types()
+        assert types[0] == (1, 28, 28)
+        assert types[1] == (6, 24, 24)   # 28-5+1
+        assert types[2] == (6 * 12 * 12,)  # flattened by auto preprocessor
+        net = MultiLayerNetwork(conf).init()
+        assert net._params[2]["W"].shape == (864, 32)
+
+
+class TestTraining:
+    def test_xor(self):
+        X, Y = _xor_data()
+        conf = (NeuralNetConfiguration.builder()
+                .seed(7)
+                .updater(Adam(learning_rate=0.1))
+                .list()
+                .layer(DenseLayer(n_in=2, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        ds = DataSet(X, Y)
+        for _ in range(300):
+            net.fit(ds)
+        preds = net.predict(X).to_list()
+        assert preds == [0, 1, 1, 0]
+        assert net.score(ds) < 0.1
+
+    def test_fit_iterator_and_evaluate(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(200, 4).astype(np.float32)
+        Y_idx = (X.sum(axis=1) > 0).astype(np.int64)
+        Y = np.eye(2, dtype=np.float32)[Y_idx]
+        it = ArrayDataSetIterator(nd.create(X), nd.create(Y), batch_size=50)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1)
+                .updater(Adam(learning_rate=0.05))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+                .layer(OutputLayer(n_in=16, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, num_epochs=20)
+        e = net.evaluate(it)
+        assert e.accuracy() > 0.95
+        assert 0 <= e.f1() <= 1
+
+    def test_batchnorm_training(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 4).astype(np.float32) * 10 + 5
+        Y = np.eye(2, dtype=np.float32)[(X[:, 0] > 5).astype(np.int64)]
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3)
+                .updater(Adam(learning_rate=0.05))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="identity"))
+                .layer(BatchNormalization())
+                .layer(ActivationLayer(activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        ds = DataSet(nd.create(X), nd.create(Y))
+        for _ in range(30):
+            net.fit(ds)
+        # running stats should have moved off init values
+        assert float(np.abs(net._params[1]["state_mean"]).sum()) > 0.1
+        assert net.score(ds) < 0.5
+
+    def test_dropout_layer_runs(self):
+        X, Y = _xor_data()
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Sgd(learning_rate=0.1)).list()
+                .layer(DenseLayer(n_in=2, n_out=16, activation="relu"))
+                .layer(DropoutLayer(rate=0.5))
+                .layer(OutputLayer(n_in=16, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(DataSet(X, Y), num_epochs=3)
+        out = net.output(X)
+        assert out.shape == (4, 2)
+
+    def test_cnn_forward_and_fit(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(8, 1, 8, 8).astype(np.float32)
+        Y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)]
+        conf = (NeuralNetConfiguration.builder().seed(9)
+                .updater(Adam(learning_rate=0.01)).list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2)))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.convolutional(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        out = net.output(nd.create(X))
+        assert out.shape == (8, 2)
+        np.testing.assert_allclose(out.numpy().sum(axis=1), np.ones(8),
+                                   rtol=1e-5)
+        net.fit(DataSet(nd.create(X), nd.create(Y)), num_epochs=2)
+
+    def test_lstm_classification(self):
+        # simple sequence classification: mean of sequence sign
+        rng = np.random.RandomState(0)
+        X = rng.randn(16, 3, 5).astype(np.float32)  # [B, F, T]
+        Y = np.eye(2, dtype=np.float32)[(X.mean(axis=(1, 2)) > 0).astype(int)]
+        conf = (NeuralNetConfiguration.builder().seed(11)
+                .updater(Adam(learning_rate=0.02)).list()
+                .layer(LSTM(n_in=3, n_out=8))
+                .layer(GlobalPoolingLayer(pooling_type="avg"))
+                .layer(OutputLayer(n_in=8, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        out = net.output(nd.create(X))
+        assert out.shape == (16, 2)
+        net.fit(DataSet(nd.create(X), nd.create(Y)), num_epochs=3)
+
+    def test_rnn_output_layer(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(4, 3, 6).astype(np.float32)
+        Y = np.zeros((4, 2, 6), np.float32)
+        Y[:, 0, :] = 1.0
+        conf = (NeuralNetConfiguration.builder().seed(13)
+                .updater(Adam(learning_rate=0.05)).list()
+                .layer(LSTM(n_in=3, n_out=8))
+                .layer(RnnOutputLayer(n_in=8, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        out = net.output(nd.create(X))
+        assert out.shape == (4, 2, 6)
+        net.fit(DataSet(nd.create(X), nd.create(Y)), num_epochs=5)
+        assert net.score_value < 1.0
+
+
+class TestParams:
+    def test_flattened_params_roundtrip(self):
+        conf = (NeuralNetConfiguration.builder().seed(2).list()
+                .layer(DenseLayer(n_in=3, n_out=4))
+                .layer(OutputLayer(n_in=4, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        flat = net.params()
+        assert flat.length() == net.num_params() == (3 * 4 + 4) + (4 * 2 + 2)
+        doubled = flat * 2.0
+        net.set_params(doubled)
+        np.testing.assert_allclose(net.params().numpy(), doubled.numpy())
+
+    def test_clone_independent(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(DenseLayer(n_in=2, n_out=2))
+                .layer(OutputLayer(n_in=2, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        c = net.clone()
+        c.set_params(net.params() * 0.0)
+        assert float(net.params().norm2_number()) > 0
+
+
+class TestSerde:
+    def test_save_restore(self, tmp_path):
+        X, Y = _xor_data()
+        conf = (NeuralNetConfiguration.builder().seed(4)
+                .updater(Adam(learning_rate=0.1)).list()
+                .layer(DenseLayer(n_in=2, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(DataSet(X, Y), num_epochs=20)
+        path = str(tmp_path / "model.zip")
+        net.save(path, save_updater=True)
+        net2 = MultiLayerNetwork.load(path, load_updater=True)
+        np.testing.assert_allclose(net2.output(X).numpy(),
+                                   net.output(X).numpy(), rtol=1e-6)
+        # training continues from restored updater state without blowing up
+        net2.fit(DataSet(X, Y), num_epochs=1)
+
+
+class TestReviewRegressions:
+    def test_partial_final_batch_used(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(10, 2).astype(np.float32)
+        Y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 10)]
+        it = ArrayDataSetIterator(nd.create(X), nd.create(Y), batch_size=4)
+        seen = sum(ds.num_examples() for ds in it)
+        assert seen == 10  # partial final batch of 2 is yielded
+
+    def test_single_sigmoid_evaluation_thresholds(self):
+        from deeplearning4j_tpu.nn.evaluation import Evaluation
+        e = Evaluation(num_classes=2)
+        labels = nd.create([[1.0], [0.0], [1.0]])
+        preds = nd.create([[0.9], [0.2], [0.7]])
+        e.eval(labels, preds)
+        assert e.accuracy() == 1.0
+
+    def test_listener_can_touch_model_mid_fit(self):
+        # donation regression: listener calls output() during training
+        X, Y = _xor_data()
+        conf = (NeuralNetConfiguration.builder().seed(21)
+                .updater(Adam(learning_rate=0.1)).list()
+                .layer(DenseLayer(n_in=2, n_out=4, activation="tanh"))
+                .layer(OutputLayer(n_in=4, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+
+        outputs = []
+
+        class Touch:
+            def iteration_done(self, model, iteration, loss=None):
+                outputs.append(model.output(X).numpy())
+
+        net.set_listeners(Touch())
+        net.fit(DataSet(X, Y), num_epochs=3)
+        assert len(outputs) == 3
+        assert np.isfinite(outputs[-1]).all()

@@ -1,0 +1,165 @@
+"""NDArray core tests — INDArray semantics (view write-through, in-place ops,
+dup isolation), mirroring the reference's Nd4jTestsC basics."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import DataType, NDArray, nd
+
+
+class TestCreation:
+    def test_zeros_ones(self):
+        a = nd.zeros(2, 3)
+        assert a.shape == (2, 3)
+        assert a.sum_number() == 0.0
+        b = nd.ones(4)
+        assert b.sum_number() == 4.0
+
+    def test_create_from_list(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.shape == (2, 2)
+        assert a.get_double(1, 0) == 3.0
+
+    def test_dtypes(self):
+        a = nd.zeros(2, 2, dtype="bfloat16")
+        assert a.dtype == DataType.BFLOAT16
+        b = a.cast_to("float32")
+        assert b.dtype == DataType.FLOAT
+
+    def test_arange_linspace(self):
+        assert nd.arange(5).to_list() == [0, 1, 2, 3, 4]
+        ls = nd.linspace(0, 1, 5)
+        np.testing.assert_allclose(ls.numpy(), [0, 0.25, 0.5, 0.75, 1.0])
+
+    def test_rand_deterministic(self):
+        nd.set_seed(42)
+        a = nd.rand(3, 3)
+        nd.set_seed(42)
+        b = nd.rand(3, 3)
+        assert a.equals(b)
+
+    def test_eye_full(self):
+        assert nd.eye(3).get_double(1, 1) == 1.0
+        assert nd.full((2, 2), 7.0).get_double(0, 1) == 7.0
+
+
+class TestArithmetic:
+    def test_elementwise(self):
+        a = nd.create([1.0, 2.0, 3.0])
+        b = nd.create([4.0, 5.0, 6.0])
+        np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+        np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+        np.testing.assert_allclose((b - a).numpy(), [3, 3, 3])
+        np.testing.assert_allclose((a / 2).numpy(), [0.5, 1.0, 1.5])
+
+    def test_inplace_ops(self):
+        a = nd.create([1.0, 2.0])
+        a.addi(10)
+        np.testing.assert_allclose(a.numpy(), [11, 12])
+        a.muli(2)
+        np.testing.assert_allclose(a.numpy(), [22, 24])
+
+    def test_mmul(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        b = nd.eye(2)
+        assert a.mmul(b).equals(a)
+
+    def test_broadcasting(self):
+        a = nd.ones(2, 3)
+        row = nd.create([1.0, 2.0, 3.0])
+        np.testing.assert_allclose((a + row).numpy(),
+                                   [[2, 3, 4], [2, 3, 4]])
+
+
+class TestViews:
+    """The hard part: reference view write-through semantics (SURVEY §7)."""
+
+    def test_view_read(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        row = a.get_row(1)
+        np.testing.assert_allclose(row.numpy(), [3, 4])
+
+    def test_view_write_through(self):
+        a = nd.zeros(3, 3)
+        row = a.get_row(1)
+        row.assign(5.0)
+        np.testing.assert_allclose(a.numpy()[1], [5, 5, 5])
+        np.testing.assert_allclose(a.numpy()[0], [0, 0, 0])
+
+    def test_view_inplace_arithmetic(self):
+        a = nd.ones(2, 2)
+        col = a.get_column(0)
+        col.addi(10)
+        np.testing.assert_allclose(a.numpy(), [[11, 1], [11, 1]])
+
+    def test_nested_view(self):
+        a = nd.zeros(2, 2, 2)
+        v = a[0][1]
+        v.assign(3.0)
+        np.testing.assert_allclose(a.numpy()[0, 1], [3, 3])
+        assert a.numpy()[1].sum() == 0
+
+    def test_dup_detaches(self):
+        a = nd.ones(2, 2)
+        d = a.get_row(0).dup()
+        d.assign(99.0)
+        assert a.sum_number() == 4.0
+
+    def test_put_scalar(self):
+        a = nd.zeros(2, 2)
+        a.put_scalar((0, 1), 5.0)
+        assert a.get_double(0, 1) == 5.0
+        assert a.sum_number() == 5.0
+
+    def test_setitem_slice(self):
+        a = nd.zeros(4)
+        a[1:3] = 7.0
+        np.testing.assert_allclose(a.numpy(), [0, 7, 7, 0])
+
+
+class TestReductions:
+    def test_basic(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.sum_number() == 10.0
+        assert a.mean_number() == 2.5
+        assert a.max_number() == 4.0
+        np.testing.assert_allclose(a.sum(0).numpy(), [4, 6])
+        np.testing.assert_allclose(a.sum(1).numpy(), [3, 7])
+
+    def test_argmax(self):
+        a = nd.create([[1.0, 5.0], [3.0, 2.0]])
+        assert a.argmax(1).to_list() == [1, 0]
+
+    def test_norms(self):
+        a = nd.create([3.0, 4.0])
+        assert a.norm2_number() == pytest.approx(5.0)
+        assert a.norm1_number() == pytest.approx(7.0)
+
+    def test_std_bias_correction(self):
+        a = nd.create([1.0, 2.0, 3.0, 4.0])
+        # reference default: bias-corrected (ddof=1)
+        assert a.std_number() == pytest.approx(np.std([1, 2, 3, 4], ddof=1))
+
+
+class TestShape:
+    def test_reshape_transpose(self):
+        a = nd.arange(6).reshape(2, 3)
+        assert a.shape == (2, 3)
+        assert a.T.shape == (3, 2)
+        assert a.permute(1, 0).shape == (3, 2)
+
+    def test_concat_stack(self):
+        a, b = nd.ones(2, 2), nd.zeros(2, 2)
+        assert nd.concat([a, b], axis=0).shape == (4, 2)
+        assert nd.vstack([a, b]).shape == (4, 2)
+        assert nd.stack([a, b]).shape == (2, 2, 2)
+
+    def test_squeeze_expand(self):
+        a = nd.ones(1, 3, 1)
+        assert a.squeeze().shape == (3,)
+        assert a.expand_dims(0).shape == (1, 1, 3, 1)
+
+    def test_equals_tolerance(self):
+        a = nd.create([1.0, 2.0])
+        b = nd.create([1.0 + 1e-7, 2.0])
+        assert a.equals(b)
+        assert not a.equals(nd.create([1.1, 2.0]))

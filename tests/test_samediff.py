@@ -1,0 +1,150 @@
+"""SameDiff graph layer tests: define-then-run, eval, grad, fit, serde,
+gradient checks — mirroring the reference's SameDiffTests basics."""
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nd
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.autodiff.gradcheck import (check_gradients,
+                                                   check_samediff_gradients)
+from deeplearning4j_tpu.learning import Adam, Sgd
+
+
+class TestGraphBuilding:
+    def test_simple_arithmetic(self):
+        sd = SameDiff.create()
+        a = sd.constant(nd.create([1.0, 2.0]), "a")
+        b = sd.constant(nd.create([3.0, 4.0]), "b")
+        c = a + b
+        out = c.eval()
+        np.testing.assert_allclose(out.numpy(), [4, 6])
+
+    def test_placeholder_eval(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 2))
+        w = sd.var("w", nd.create([[1.0], [1.0]]))
+        y = x.mmul(w)
+        out = y.eval({"x": nd.create([[2.0, 3.0]])})
+        np.testing.assert_allclose(out.numpy(), [[5.0]])
+
+    def test_namespaces(self):
+        sd = SameDiff.create()
+        x = sd.constant(nd.create([[1.0, 1.0]]), "x")
+        s = sd.nn.softmax(x)
+        np.testing.assert_allclose(s.eval().numpy(), [[0.5, 0.5]])
+        m = sd.math.log(sd.constant(nd.create([jnp.e]), "e"))
+        assert float(m.eval().numpy()[0]) == pytest.approx(1.0)
+
+    def test_chained_ops(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (2, 2))
+        y = (x * 2.0 + 1.0).sum()
+        out = y.eval({"x": nd.ones(2, 2)})
+        assert float(out.numpy()) == 12.0
+
+    def test_reduce_methods(self):
+        sd = SameDiff.create()
+        x = sd.constant(nd.create([[1.0, 2.0], [3.0, 4.0]]), "x")
+        assert float(x.mean().eval().numpy()) == 2.5
+        np.testing.assert_allclose(x.sum(0).eval().numpy(), [4, 6])
+        assert x.argmax(1).eval().to_list() == [1, 1]
+
+    def test_name_scope(self):
+        sd = SameDiff.create()
+        with sd.name_scope("layer1"):
+            v = sd.var("w", nd.ones(2))
+        assert v.name == "layer1/w"
+
+    def test_multi_output_not_recomputed(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (2,))
+        y = x * 2.0
+        z = y + 1.0
+        outs = sd.output({"x": nd.ones(2)}, [y.name, z.name])
+        np.testing.assert_allclose(outs[y.name].numpy(), [2, 2])
+        np.testing.assert_allclose(outs[z.name].numpy(), [3, 3])
+
+
+class TestGradients:
+    def test_calculate_gradients(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (2,))
+        w = sd.var("w", nd.create([2.0, 3.0]))
+        loss = (x * w).sum()
+        sd.set_loss_variables(loss)
+        grads = sd.calculate_gradients({"x": nd.create([5.0, 7.0])}, ["w"])
+        np.testing.assert_allclose(grads["w"].numpy(), [5, 7])
+
+    def test_gradcheck_util(self):
+        check_gradients(lambda x: jnp.sum(jnp.tanh(x) ** 2),
+                        [jnp.array([0.3, -0.5, 1.2])])
+
+    def test_samediff_gradcheck(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (3,))
+        w = sd.var("w", nd.create([0.5, -0.3, 0.8]))
+        loss = sd.invoke("reduce_sum", sd.invoke("sigmoid", x * w))
+        sd.set_loss_variables(loss)
+        check_samediff_gradients(sd, {"x": nd.create([1.0, 2.0, 3.0])},
+                                 loss.name)
+
+
+class TestTraining:
+    def _make_regression_sd(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 3))
+        y = sd.placeholder("y", (None, 1))
+        w = sd.var("w", nd.zeros(3, 1))
+        b = sd.var("b", nd.zeros(1))
+        pred = x.mmul(w) + b
+        loss = sd.loss.mean_squared_error(pred, None, y)
+        sd.set_loss_variables(loss)
+        return sd
+
+    def test_fit_linear_regression(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+        nd.set_seed(0)
+        true_w = np.array([[1.0], [-2.0], [0.5]])
+        X = np.random.RandomState(0).randn(200, 3).astype(np.float32)
+        Y = X @ true_w
+
+        sd = self._make_regression_sd()
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(learning_rate=0.1),
+            data_set_feature_mapping=["x"], data_set_label_mapping=["y"]))
+        it = ListDataSetIterator(
+            [DataSet(nd.create(X[i:i + 50]), nd.create(Y[i:i + 50]))
+             for i in range(0, 200, 50)])
+        history = sd.fit(it, num_epochs=30)
+        assert history.final_loss() < 1e-2
+        w_trained = sd.get_arr_for_var("w").numpy()
+        np.testing.assert_allclose(w_trained, true_w, atol=0.1)
+
+
+class TestSerialization:
+    def test_save_load_roundtrip(self, tmp_path):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 2))
+        w = sd.var("w", nd.create([[1.0], [2.0]]))
+        out = sd.invoke("sigmoid", x.mmul(w))
+        path = str(tmp_path / "model.zip")
+        sd.save(path)
+
+        sd2 = SameDiff.load(path)
+        x_val = nd.create([[1.0, 1.0]])
+        r1 = out.eval({"x": x_val})
+        r2 = sd2.output({"x": x_val}, [out.name])[out.name]
+        np.testing.assert_allclose(r1.numpy(), r2.numpy())
+
+    def test_save_preserves_variables(self, tmp_path):
+        sd = SameDiff.create()
+        w = sd.var("w", nd.create([1.0, 2.0, 3.0]))
+        path = str(tmp_path / "vars.zip")
+        sd.save(path)
+        sd2 = SameDiff.load(path)
+        np.testing.assert_allclose(sd2.get_arr_for_var("w").numpy(), [1, 2, 3])
