@@ -1,0 +1,76 @@
+"""Headline benchmark: BERT-base MLM training throughput on one TPU chip.
+
+Matches BASELINE.md config 3 (SameDiff BERT-base, samples/sec/chip + MFU).
+The reference publishes no numbers ("published": {}), so vs_baseline reports
+progress against the north-star acceptance bar of 35% MFU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import bert
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+
+    if os.environ.get("BENCH_TINY"):  # CPU smoke-test of the bench harness
+        config = bert.BertConfig.tiny()
+        B, T = 8, 32
+    else:
+        config = bert.BertConfig.base()
+        B, T = 32, 128
+
+    params = bert.init_params(jax.random.key(0), config)
+    opt = bert.init_opt_state(params)
+    step = bert.make_train_step(config, mesh=None, learning_rate=1e-4)
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "input_ids": jnp.asarray(rng.randint(0, config.vocab_size, (B, T)),
+                                 jnp.int32),
+        "labels": jnp.asarray(
+            np.where(rng.rand(B, T) < 0.15,
+                     rng.randint(0, config.vocab_size, (B, T)), -100),
+            jnp.int32),
+        "attention_mask": jnp.ones((B, T), jnp.int32),
+    }
+
+    # warmup / compile
+    params, opt, loss = step(params, opt, batch, 0)
+    jax.block_until_ready(loss)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for i in range(1, iters + 1):
+        params, opt, loss = step(params, opt, batch, i)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = iters * B / dt
+    tokens_per_sec = samples_per_sec * T
+    model_flops = bert.flops_per_token(config) * tokens_per_sec
+    peak = {"tpu": 197e12, "axon": 197e12}.get(platform, 0)  # v5e bf16 peak
+    mfu = model_flops / peak if peak else 0.0
+
+    print(json.dumps({
+        "metric": "bert_base_mlm_train_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(mfu / 0.35, 4),  # north star: 35% MFU == 1.0
+        "mfu": round(mfu, 4),
+        "batch": B, "seq_len": T, "platform": platform,
+        "loss": round(float(loss), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -145,6 +145,7 @@ class AsyncDataSetIterator(DataSetIterator):
         self._thread.start()
         self._head = None
         self._exhausted = False
+        self._consumed = False
         self._advance()
 
     def _advance(self):
@@ -160,10 +161,13 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def next(self):
         ds = self._head
+        self._consumed = True
         self._advance()
         return ds
 
     def reset(self):
+        if not self._consumed and not self._exhausted:
+            return  # fresh prefetch pass, nothing consumed — keep it
         if self._thread is not None and self._thread.is_alive():
             # drain remaining items so the worker can exit
             while not self._exhausted:

@@ -1,0 +1,1 @@
+"""Model zoo (deeplearning4j-zoo analog)."""

@@ -1,0 +1,333 @@
+"""BERT: the flagship transformer, TPU-first.
+
+Reference context: the reference runs BERT only via TF-frozen-graph import
+(`samediff-import`, BASELINE.md config 3). Here BERT is a native model with
+first-class sharding — the component the reference never had (SURVEY.md §2.4:
+TP/SP/PP absent) and the north-star benchmark target (≥35% MFU).
+
+Design:
+- Pure-functional params pytree; bfloat16 activations/weights, f32 layernorm
+  and softmax accumulation (MXU-native mixed precision).
+- Megatron-style tensor parallelism via sharding annotations: attention
+  heads and MLP hidden sharded over `tensor`; XLA/GSPMD inserts the
+  all-reduces. No hand-written collectives in the model body.
+- Sequence parallelism: attention dispatches to ring attention (shard_map
+  over `seq`) when the mesh has a seq axis > 1.
+- One jitted train step: fwd + masked-LM loss + bwd + Adam, params donated.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import DATA, FSDP, SEQ, TENSOR
+from ..parallel.ring_attention import blockwise_attention, ring_attention
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def large() -> "BertConfig":
+        return BertConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                          intermediate_size=4096)
+
+    @staticmethod
+    def tiny() -> "BertConfig":
+        """For tests/dryruns."""
+        return BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                          num_heads=4, intermediate_size=128,
+                          max_position_embeddings=128)
+
+
+# -- parameter init -----------------------------------------------------
+
+def init_params(key, config: BertConfig) -> Dict:
+    c = config
+    dt = c.dtype
+    std = 0.02
+
+    def dense(key, shape):
+        return (std * jax.random.normal(key, shape, jnp.float32)).astype(dt)
+
+    keys = iter(jax.random.split(key, 8 + 8 * c.num_layers))
+    params = {
+        "embeddings": {
+            "word": dense(next(keys), (c.vocab_size, c.hidden_size)),
+            "position": dense(next(keys), (c.max_position_embeddings,
+                                           c.hidden_size)),
+            "token_type": dense(next(keys), (c.type_vocab_size, c.hidden_size)),
+            "ln_g": jnp.ones((c.hidden_size,), jnp.float32),
+            "ln_b": jnp.zeros((c.hidden_size,), jnp.float32),
+        },
+        "layers": [],
+        "mlm": {
+            "dense": dense(next(keys), (c.hidden_size, c.hidden_size)),
+            "dense_b": jnp.zeros((c.hidden_size,), dt),
+            "ln_g": jnp.ones((c.hidden_size,), jnp.float32),
+            "ln_b": jnp.zeros((c.hidden_size,), jnp.float32),
+            "bias": jnp.zeros((c.vocab_size,), jnp.float32),
+        },
+        "pooler": {
+            "w": dense(next(keys), (c.hidden_size, c.hidden_size)),
+            "b": jnp.zeros((c.hidden_size,), dt),
+        },
+    }
+    H, Dh, E, F = c.num_heads, c.head_dim, c.hidden_size, c.intermediate_size
+    for _ in range(c.num_layers):
+        params["layers"].append({
+            "attn": {
+                "wq": dense(next(keys), (E, H, Dh)),
+                "wk": dense(next(keys), (E, H, Dh)),
+                "wv": dense(next(keys), (E, H, Dh)),
+                "wo": dense(next(keys), (H, Dh, E)),
+                "bq": jnp.zeros((H, Dh), dt), "bk": jnp.zeros((H, Dh), dt),
+                "bv": jnp.zeros((H, Dh), dt), "bo": jnp.zeros((E,), dt),
+            },
+            "mlp": {
+                "w1": dense(next(keys), (E, F)), "b1": jnp.zeros((F,), dt),
+                "w2": dense(next(keys), (F, E)), "b2": jnp.zeros((E,), dt),
+            },
+            "ln1_g": jnp.ones((E,), jnp.float32),
+            "ln1_b": jnp.zeros((E,), jnp.float32),
+            "ln2_g": jnp.ones((E,), jnp.float32),
+            "ln2_b": jnp.zeros((E,), jnp.float32),
+        })
+    return params
+
+
+# -- sharding rules (Megatron TP + optional FSDP) ------------------------
+
+def param_specs(config: BertConfig) -> Dict:
+    """PartitionSpec tree matching init_params' structure."""
+    layer = {
+        "attn": {
+            "wq": P(FSDP, TENSOR, None), "wk": P(FSDP, TENSOR, None),
+            "wv": P(FSDP, TENSOR, None), "wo": P(TENSOR, None, FSDP),
+            "bq": P(TENSOR, None), "bk": P(TENSOR, None),
+            "bv": P(TENSOR, None), "bo": P(),
+        },
+        "mlp": {
+            "w1": P(FSDP, TENSOR), "b1": P(TENSOR),
+            "w2": P(TENSOR, FSDP), "b2": P(),
+        },
+        "ln1_g": P(), "ln1_b": P(), "ln2_g": P(), "ln2_b": P(),
+    }
+    return {
+        "embeddings": {"word": P(FSDP, None), "position": P(),
+                       "token_type": P(), "ln_g": P(), "ln_b": P()},
+        "layers": [layer] * config.num_layers,
+        "mlm": {"dense": P(FSDP, None), "dense_b": P(), "ln_g": P(),
+                "ln_b": P(), "bias": P()},
+        "pooler": {"w": P(FSDP, None), "b": P()},
+    }
+
+
+def _ln(x, g, b, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mean) * lax.rsqrt(var + eps) * g + b).astype(x.dtype)
+
+
+# -- forward ------------------------------------------------------------
+
+def _attention(layer_params, h, attention_mask, config: BertConfig,
+               mesh: Optional[Mesh], seq_parallel: bool):
+    a = layer_params["attn"]
+    q = jnp.einsum("bte,ehd->bthd", h, a["wq"]) + a["bq"]
+    k = jnp.einsum("bte,ehd->bthd", h, a["wk"]) + a["bk"]
+    v = jnp.einsum("bte,ehd->bthd", h, a["wv"]) + a["bv"]
+    if seq_parallel and mesh is not None:
+        ctx = ring_attention(q, k, v, mesh, mask=attention_mask, causal=False)
+    else:
+        scale = config.head_dim ** -0.5
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        if attention_mask is not None:
+            big_neg = jnp.finfo(jnp.float32).min
+            logits = jnp.where(attention_mask[:, None, None, :].astype(bool),
+                               logits, big_neg)
+        probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bqhd,hde->bqe", ctx, a["wo"]) + a["bo"]
+    return out
+
+
+def encode(params, input_ids, token_type_ids=None, attention_mask=None, *,
+           config: BertConfig, mesh: Optional[Mesh] = None,
+           seq_parallel: bool = False):
+    """Token ids [B, T] → contextual encodings [B, T, E]."""
+    c = config
+    e = params["embeddings"]
+    B, T = input_ids.shape
+    h = jnp.take(e["word"], input_ids, axis=0)
+    h = h + e["position"][None, :T]
+    if token_type_ids is not None:
+        h = h + jnp.take(e["token_type"], token_type_ids, axis=0)
+    else:
+        h = h + e["token_type"][0]
+    h = _ln(h, e["ln_g"], e["ln_b"], c.layer_norm_eps)
+    if mesh is not None:
+        h = lax.with_sharding_constraint(
+            h, NamedSharding(mesh, P((DATA, FSDP), SEQ if seq_parallel else None,
+                                     None)))
+
+    for layer in params["layers"]:
+        attn_out = _attention(layer, h, attention_mask, c, mesh, seq_parallel)
+        h = _ln(h + attn_out, layer["ln1_g"], layer["ln1_b"], c.layer_norm_eps)
+        mlp = layer["mlp"]
+        inter = jax.nn.gelu(jnp.einsum("bte,ef->btf", h, mlp["w1"]) + mlp["b1"])
+        if mesh is not None:
+            inter = lax.with_sharding_constraint(
+                inter, NamedSharding(
+                    mesh, P((DATA, FSDP), SEQ if seq_parallel else None,
+                            TENSOR)))
+        mlp_out = jnp.einsum("btf,fe->bte", inter, mlp["w2"]) + mlp["b2"]
+        h = _ln(h + mlp_out, layer["ln2_g"], layer["ln2_b"], c.layer_norm_eps)
+        if mesh is not None:
+            h = lax.with_sharding_constraint(
+                h, NamedSharding(mesh, P((DATA, FSDP),
+                                         SEQ if seq_parallel else None, None)))
+    return h
+
+
+def mlm_logits(params, encodings, config: BertConfig):
+    """Masked-LM head with tied decoder weights."""
+    m = params["mlm"]
+    h = jax.nn.gelu(jnp.einsum("bte,ef->btf", encodings, m["dense"])
+                    + m["dense_b"])
+    h = _ln(h, m["ln_g"], m["ln_b"], config.layer_norm_eps)
+    logits = jnp.einsum("bte,ve->btv", h, params["embeddings"]["word"])
+    return logits.astype(jnp.float32) + m["bias"]
+
+
+def pooled(params, encodings):
+    return jnp.tanh(jnp.einsum("be,eh->bh", encodings[:, 0],
+                               params["pooler"]["w"]) + params["pooler"]["b"])
+
+
+def mlm_loss(params, batch, config: BertConfig, mesh=None,
+             seq_parallel=False):
+    """Masked-LM cross entropy. batch: input_ids, labels (-100 = unmasked),
+    attention_mask."""
+    enc = encode(params, batch["input_ids"],
+                 batch.get("token_type_ids"), batch.get("attention_mask"),
+                 config=config, mesh=mesh, seq_parallel=seq_parallel)
+    logits = mlm_logits(params, enc, config)
+    labels = batch["labels"]
+    valid = labels >= 0
+    safe_labels = jnp.where(valid, labels, 0)
+    lsm = jax.nn.log_softmax(logits, axis=-1)
+    per_tok = -jnp.take_along_axis(lsm, safe_labels[..., None],
+                                   axis=-1)[..., 0]
+    per_tok = jnp.where(valid, per_tok, 0.0)
+    return jnp.sum(per_tok) / jnp.maximum(jnp.sum(valid), 1)
+
+
+# -- training step ------------------------------------------------------
+
+def make_train_step(config: BertConfig, mesh: Optional[Mesh] = None,
+                    learning_rate: float = 1e-4, seq_parallel: bool = False,
+                    remat: bool = True):
+    """Single jitted train step: fwd+bwd+Adam, donated params/state.
+
+    With a mesh: params placed per param_specs (TP/FSDP), batch sharded over
+    (data, fsdp), sequence over seq when seq_parallel — XLA emits all ICI
+    collectives (the entire reference PS stack, §2.5).
+    """
+    from ..ops import updater_ops
+
+    loss_fn = functools.partial(mlm_loss, config=config, mesh=mesh,
+                                seq_parallel=seq_parallel)
+    if remat:
+        # rematerialize the encoder to trade FLOPs for HBM (checkpointing)
+        loss_fn = jax.checkpoint(loss_fn)
+
+    def step(params, opt_state, batch, iteration):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_u, flat_m = opt_state
+        new_p, new_u, new_m = [], [], []
+        flat_p = jax.tree_util.tree_flatten(params)[0]
+        for p, g, u, m in zip(flat_p, flat_g, flat_u, flat_m):
+            upd, u2, m2 = updater_ops.adam_updater(
+                g.astype(jnp.float32), u, m, lr=learning_rate,
+                iteration=iteration)
+            new_p.append((p.astype(jnp.float32) - upd).astype(p.dtype))
+            new_u.append(u2)
+            new_m.append(m2)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                (new_u, new_m), loss)
+
+    donate = (0, 1)
+    if mesh is None:
+        return jax.jit(step, donate_argnums=donate)
+    specs = param_specs(config)
+    param_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    flat_specs = [NamedSharding(mesh, s) for s in
+                  jax.tree_util.tree_leaves(
+                      specs, is_leaf=lambda x: isinstance(x, P))]
+    opt_sh = (flat_specs, flat_specs)
+    batch_sh = NamedSharding(mesh, P((DATA, FSDP),
+                                     SEQ if seq_parallel else None))
+    # batch_sh is a pytree *prefix*: it applies to every entry of the batch
+    # dict, whatever keys the caller provides (token_type_ids included)
+    return jax.jit(
+        step, donate_argnums=donate,
+        in_shardings=(param_sh, opt_sh, batch_sh, None),
+        out_shardings=(param_sh, opt_sh, None))
+
+
+def init_opt_state(params):
+    flat = jax.tree_util.tree_leaves(params)
+    zeros = [jnp.zeros(p.shape, jnp.float32) for p in flat]
+    return (zeros, [jnp.zeros(p.shape, jnp.float32) for p in flat])
+
+
+def place_params(params, config: BertConfig, mesh: Mesh):
+    """Shard an (host/replicated) param tree onto the mesh per param_specs."""
+    specs = param_specs(config)
+    return jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params, specs,
+        is_leaf=lambda x: isinstance(x, P) or isinstance(x, jax.Array))
+
+
+def flops_per_token(config: BertConfig) -> float:
+    """Training FLOPs/token ≈ 6 * params_active + attention terms (for MFU)."""
+    c = config
+    E, F, L = c.hidden_size, c.intermediate_size, c.num_layers
+    per_layer = 4 * E * E + 2 * E * F  # qkv+o projections + mlp matmuls
+    embed_head = c.vocab_size * E      # tied mlm decoder matmul
+    matmul_params = L * per_layer + embed_head + E * E
+    return 6.0 * matmul_params
+
+
+def count_params(params) -> int:
+    return sum(int(p.size) for p in jax.tree_util.tree_leaves(params))

@@ -329,8 +329,13 @@ class MultiLayerNetwork:
     def clone(self) -> "MultiLayerNetwork":
         net = MultiLayerNetwork(self.conf)
         if self._initialized:
-            net.init(params=[dict(p) for p in self._params])
-            net._updater_state = self._updater_state
+            # deep-copy buffers: fit() donates its inputs to XLA, so shared
+            # arrays between clones would be deleted by the donor's next step
+            net.init(params=[{k: jnp.array(v, copy=True) for k, v in p.items()}
+                             for p in self._params])
+            net._updater_state = jax.tree_util.tree_map(
+                lambda v: jnp.array(v, copy=True), self._updater_state) \
+                if self._updater_state is not None else None
         return net
 
     # -- serde (serde.py) ------------------------------------------------

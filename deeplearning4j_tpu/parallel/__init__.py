@@ -1,0 +1,3 @@
+"""Distributed training: mesh, collectives, sequence parallelism, trainers."""
+from .mesh import (DATA, FSDP, PIPE, SEQ, TENSOR, MeshConfig,  # noqa: F401
+                   make_mesh, replicate, shard_batch)

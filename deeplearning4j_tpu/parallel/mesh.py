@@ -1,0 +1,102 @@
+"""Device mesh abstraction.
+
+Reference context (SURVEY.md §2.4/§2.5): the reference's distribution stack —
+ParallelWrapper replica threads, Spark parameter averaging, Aeron
+gradient-sharing mesh (`MeshOrganizer.java`) — is replaced wholesale by ONE
+concept: a `jax.sharding.Mesh` with named axes, over which whole training
+steps are jit-compiled and XLA inserts ICI collectives.
+
+Axes (the full 5D parallelism vocabulary, all first-class):
+  data   — batch sharding (subsumes all four reference DP flavors)
+  fsdp   — parameter sharding along data (ZeRO-3 style, optional)
+  tensor — tensor/model parallelism (absent in reference; required for BERT MFU)
+  seq    — sequence/context parallelism (ring attention)
+  pipe   — pipeline stages
+The reference's node-failure remapping (`MeshOrganizer.remapNode`) maps to
+JAX distributed-runtime coordination; in-process we expose elastic re-mesh
+by rebuilding the Mesh from the live device list.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA, FSDP, TENSOR, SEQ, PIPE = "data", "fsdp", "tensor", "seq", "pipe"
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Declarative mesh shape; -1 on `data` means "all remaining devices"."""
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+    pipe: int = 1
+
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int, int]:
+        fixed = self.fsdp * self.tensor * self.seq * self.pipe
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(f"{n_devices} devices not divisible by "
+                                 f"fsdp*tensor*seq*pipe={fixed}")
+            data = n_devices // fixed
+        if data * fixed != n_devices:
+            raise ValueError(f"mesh {data}x{fixed} != {n_devices} devices")
+        return (data, self.fsdp, self.tensor, self.seq, self.pipe)
+
+
+def make_mesh(config: MeshConfig = None, devices: Sequence = None) -> Mesh:
+    """Build a named Mesh.
+
+    Axis order puts `data` outermost (DCN-friendly) and `tensor`/`seq`
+    innermost (highest-bandwidth ICI neighbors) — the standard TPU layout
+    recipe: collectives that run every layer (TP allreduce, ring attention
+    ppermute) ride the fastest links.
+    """
+    config = config or MeshConfig()
+    devices = list(devices) if devices is not None else jax.devices()
+    shape = config.resolve(len(devices))
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, (DATA, FSDP, TENSOR, SEQ, PIPE))
+
+
+def data_parallel_mesh(devices=None) -> Mesh:
+    return make_mesh(MeshConfig(), devices)
+
+
+def batch_spec() -> P:
+    """Batch sharded over data(+fsdp); everything else replicated."""
+    return P((DATA, FSDP))
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def shard_batch(mesh: Mesh, batch_tree):
+    """Place host arrays sharded over the batch axis."""
+    sharding = NamedSharding(mesh, batch_spec())
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch_tree)
+
+
+def replicate(mesh: Mesh, tree):
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), tree)
+
+
+def num_devices(mesh: Optional[Mesh] = None) -> int:
+    return int(np.prod(mesh.devices.shape)) if mesh is not None \
+        else jax.device_count()
+
+
+def local_mesh_info(mesh: Mesh) -> str:
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return f"Mesh({shape}, {mesh.devices.size} devices)"

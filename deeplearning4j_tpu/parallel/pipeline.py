@@ -1,0 +1,99 @@
+"""Pipeline parallelism (GPipe-style microbatching over the `pipe` mesh axis).
+
+Reference gap (SURVEY.md §2.4): the reference has no pipeline parallelism.
+TPU design: stages are laid out along the `pipe` mesh axis; activations hop
+stage→stage with `ppermute` (nearest-neighbor ICI), microbatches flow through
+a `lax.scan` schedule of length n_micro + n_stages - 1 (the GPipe bubble).
+Everything is SPMD: every device runs the same program; stage identity comes
+from `axis_index`. `jax.grad` differentiates straight through the schedule
+(ppermute's transpose is the reverse permutation), so fwd+bwd pipelining
+needs no hand-written backward.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import DATA, FSDP, PIPE
+
+
+def _pipeline_local(stage_params, inputs, *, stage_fn: Callable, axis: str):
+    """Runs inside shard_map. stage_params: this stage's params (leading
+    stage axis already sharded away). inputs: [n_micro, mb, ...] (replicated).
+    Returns [n_micro, mb, ...] outputs (valid on every device via collective
+    broadcast from the last stage).
+    """
+    n_stages = lax.axis_size(axis)
+    stage = lax.axis_index(axis)
+    n_micro = inputs.shape[0]
+    mb_shape = inputs.shape[1:]
+    total_steps = n_micro + n_stages - 1
+
+    # state: the activation each device currently works on
+    init_carry = (jnp.zeros(mb_shape, inputs.dtype),
+                  jnp.zeros((n_micro,) + mb_shape, inputs.dtype))
+
+    def step_body(carry, t):
+        incoming, outputs = carry
+        # stage 0 ingests microbatch t (while in range); others take incoming
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        x = jnp.where(stage == 0, inputs[mb_idx], incoming)
+        y = stage_fn(stage_params, x)
+        # last stage writes its result for microbatch t-(n_stages-1)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        is_valid = (t >= n_stages - 1) & (stage == n_stages - 1)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(is_valid, y,
+                      lax.dynamic_index_in_dim(outputs, out_idx, 0,
+                                               keepdims=False)),
+            out_idx, 0)
+        # pass activation to the next stage
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        incoming = lax.ppermute(y, axis, perm)
+        return (incoming, outputs), None
+
+    (_, outputs), _ = lax.scan(step_body, init_carry, jnp.arange(total_steps))
+    # broadcast final outputs from the last stage to all stages so the loss
+    # can be computed SPMD (replicated out_spec)
+    mask = (stage == n_stages - 1).astype(outputs.dtype)
+    return lax.psum(outputs * mask, axis)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, inputs, mesh: Mesh,
+                   n_microbatches: int, axis: str = PIPE):
+    """Run a pipelined forward pass.
+
+    stage_fn(params_for_stage, x) -> y (same shape for all stages).
+    stacked_params: pytree whose leaves have leading dim n_stages.
+    inputs: [batch, ...]; internally split into n_microbatches.
+    """
+    B = inputs.shape[0]
+    assert B % n_microbatches == 0, "batch must divide into microbatches"
+    mb = B // n_microbatches
+    x = inputs.reshape((n_microbatches, mb) + inputs.shape[1:])
+
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+
+    def local(params, xin):
+        # shard_map delivers params with stage axis of size 1 — drop it
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        return _pipeline_local(params, xin, stage_fn=stage_fn, axis=axis)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(param_spec, P()),
+                   out_specs=P(), check_vma=False)
+    out = fn(stacked_params, x)
+    return out.reshape((B,) + out.shape[2:])
+
+
+def stack_stage_params(per_stage_params: Sequence):
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                  *per_stage_params)

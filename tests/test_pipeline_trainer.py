@@ -1,0 +1,135 @@
+"""Pipeline parallelism + ParallelWrapper + early stopping tests."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nd
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel.mesh import MeshConfig, make_mesh
+from deeplearning4j_tpu.parallel.pipeline import (pipeline_apply,
+                                                  stack_stage_params)
+from deeplearning4j_tpu.parallel.trainer import (ParallelInference,
+                                                 ParallelWrapper)
+
+
+class TestPipeline:
+    def test_pipeline_matches_sequential(self):
+        """4-stage pipelined MLP == running the stages sequentially."""
+        mesh = make_mesh(MeshConfig(data=1, pipe=4), devices=jax.devices()[:4])
+        D = 8
+        keys = jax.random.split(jax.random.key(0), 4)
+        per_stage = [{"w": jax.random.normal(k, (D, D)) * 0.3,
+                      "b": jnp.zeros(D)} for k in keys]
+        stacked = stack_stage_params(per_stage)
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        x = jax.random.normal(jax.random.key(9), (8, D))
+        out = pipeline_apply(stage_fn, stacked, x, mesh, n_microbatches=4)
+
+        ref = x
+        for p in per_stage:
+            ref = stage_fn(p, ref)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=1e-5)
+
+    def test_pipeline_differentiable(self):
+        mesh = make_mesh(MeshConfig(data=1, pipe=2), devices=jax.devices()[:2])
+        D = 4
+        per_stage = [{"w": jnp.eye(D) * 0.5} for _ in range(2)]
+        stacked = stack_stage_params(per_stage)
+
+        def stage_fn(p, x):
+            return x @ p["w"]
+
+        x = jnp.ones((4, D))
+
+        def loss(params):
+            return jnp.sum(pipeline_apply(stage_fn, params, x, mesh, 2) ** 2)
+
+        g = jax.grad(loss)(stacked)
+        assert np.isfinite(np.asarray(g["w"])).all()
+        assert float(jnp.abs(g["w"]).sum()) > 0
+
+
+class TestParallelWrapper:
+    def _net(self):
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater(Adam(learning_rate=0.05)).list()
+                .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+                .layer(OutputLayer(n_in=16, n_out=2))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def _data(self, n=256):
+        rng = np.random.RandomState(0)
+        X = rng.randn(n, 4).astype(np.float32)
+        Y = np.eye(2, dtype=np.float32)[(X.sum(axis=1) > 0).astype(np.int64)]
+        return X, Y
+
+    def test_dp_training_converges(self):
+        X, Y = self._data()
+        net = self._net()
+        wrapper = (ParallelWrapper.builder(net).workers(8)
+                   .averaging_frequency(1).build())
+        it = ArrayDataSetIterator(nd.create(X), nd.create(Y), batch_size=64)
+        wrapper.fit(it, num_epochs=15)
+        e = net.evaluate(it)
+        assert e.accuracy() > 0.9
+
+    def test_dp_matches_single_device_step(self):
+        """One DP step over the mesh == same step on one device (same math)."""
+        X, Y = self._data(64)
+        net1 = self._net()
+        net2 = net1.clone()
+        net1.fit(DataSet(nd.create(X), nd.create(Y)))
+        ParallelWrapper.builder(net2).workers(8).build().fit(
+            ArrayDataSetIterator(nd.create(X), nd.create(Y), batch_size=64))
+        np.testing.assert_allclose(net1.params().numpy(),
+                                   net2.params().numpy(), rtol=2e-3,
+                                   atol=2e-4)
+
+    def test_parallel_inference(self):
+        X, _ = self._data(50)  # deliberately not divisible by 8
+        net = self._net()
+        pi = ParallelInference(net)
+        out = pi.output(nd.create(X))
+        assert out.shape == (50, 2)
+        np.testing.assert_allclose(out.numpy(),
+                                   net.output(nd.create(X)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestEarlyStopping:
+    def test_early_stopping_patience(self):
+        from deeplearning4j_tpu.nn.earlystopping import (
+            DataSetLossCalculator, EarlyStoppingConfiguration,
+            EarlyStoppingTrainer, MaxEpochsTerminationCondition,
+            ScoreImprovementEpochTerminationCondition)
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 4).astype(np.float32)
+        Y = np.eye(2, dtype=np.float32)[(X.sum(1) > 0).astype(int)]
+        it = ArrayDataSetIterator(nd.create(X), nd.create(Y), batch_size=32)
+
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(learning_rate=0.05)).list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        esc = (EarlyStoppingConfiguration.builder()
+               .score_calculator(DataSetLossCalculator(it))
+               .epoch_termination_conditions(
+                   MaxEpochsTerminationCondition(30),
+                   ScoreImprovementEpochTerminationCondition(5))
+               .build())
+        result = EarlyStoppingTrainer(esc, net).fit(it)
+        assert result.total_epochs <= 30
+        assert result.best_model is not None
+        assert result.best_model_score < 1.0
