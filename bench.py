@@ -13,6 +13,23 @@ import time
 import numpy as np
 
 
+def _peak_flops(dev) -> float:
+    """Per-chip bf16 peak FLOP/s by TPU generation (device_kind), so MFU is
+    not inflated/deflated when the bench runs on a non-v5e chip."""
+    kind = getattr(dev, "device_kind", "").lower()
+    table = [
+        ("v6e", 918e12), ("trillium", 918e12),
+        ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
+        ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+    ]
+    for name, peak in table:
+        if name in kind:
+            return peak
+    if dev.platform in ("tpu", "axon"):
+        return 197e12  # unknown TPU: assume v5e
+    return 0.0
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -58,7 +75,7 @@ def main():
     samples_per_sec = iters * B / dt
     tokens_per_sec = samples_per_sec * T
     model_flops = bert.flops_per_token(config) * tokens_per_sec
-    peak = {"tpu": 197e12, "axon": 197e12}.get(platform, 0)  # v5e bf16 peak
+    peak = _peak_flops(dev)
     mfu = model_flops / peak if peak else 0.0
 
     print(json.dumps({

@@ -305,6 +305,11 @@ class NeuralNetConfigurationBuilder:
     def list(self) -> ListBuilder:
         return ListBuilder(self)
 
+    def graph_builder(self):
+        """DAG-network builder (reference .graphBuilder())."""
+        from ..graph.computation_graph import GraphBuilder
+        return GraphBuilder(self)
+
 
 class NeuralNetConfiguration:
     @staticmethod

@@ -111,12 +111,19 @@ class ClassificationScoreCalculator(ScoreCalculator):
 class InMemoryModelSaver:
     def __init__(self):
         self.best = None
+        self.latest = None
 
     def save_best_model(self, net, score):
         self.best = net.clone()
 
+    def save_latest_model(self, net, score):
+        self.latest = net.clone()
+
     def get_best_model(self):
         return self.best
+
+    def get_latest_model(self):
+        return self.latest
 
 
 class LocalFileModelSaver:
@@ -128,12 +135,23 @@ class LocalFileModelSaver:
     def _path(self):
         return os.path.join(self.directory, "bestModel.zip")
 
+    @property
+    def _latest_path(self):
+        return os.path.join(self.directory, "latestModel.zip")
+
     def save_best_model(self, net, score):
         net.save(self._path, save_updater=True)
+
+    def save_latest_model(self, net, score):
+        net.save(self._latest_path, save_updater=True)
 
     def get_best_model(self):
         from .multilayer import MultiLayerNetwork
         return MultiLayerNetwork.load(self._path, load_updater=True)
+
+    def get_latest_model(self):
+        from .multilayer import MultiLayerNetwork
+        return MultiLayerNetwork.load(self._latest_path, load_updater=True)
 
 
 # -- config + trainer ----------------------------------------------------
@@ -203,6 +221,12 @@ class EarlyStoppingTrainer:
 
     def fit(self, train_iterator) -> EarlyStoppingResult:
         cfg = self.config
+        if not cfg.epoch_termination_conditions and \
+                not cfg.iteration_termination_conditions:
+            raise ValueError(
+                "EarlyStoppingConfiguration needs at least one termination "
+                "condition (e.g. MaxEpochsTerminationCondition) — without "
+                "one, fit() would never stop")
         minimize = (cfg.score_calculator is None or
                     cfg.score_calculator.minimize_score)
         for c in cfg.iteration_termination_conditions:
@@ -229,10 +253,15 @@ class EarlyStoppingTrainer:
                     score = cfg.score_calculator.calculate_score(self.net)
                     last_score = score
                 else:
-                    score = last_score  # no fresh eval: keep last validation
+                    # no fresh eval this epoch: pass None so patience-style
+                    # conditions count *evaluations*, not epochs
+                    score = None
             else:
                 score = self.net.score_value
                 last_score = score
+            if cfg.save_last_model and \
+                    hasattr(cfg.model_saver, "save_latest_model"):
+                cfg.model_saver.save_latest_model(self.net, score)
             if score is not None:
                 better = score < best_score if minimize else score > best_score
                 if better:

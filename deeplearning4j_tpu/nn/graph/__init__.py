@@ -1,0 +1,7 @@
+from .computation_graph import (ComputationGraph,
+                                ComputationGraphConfiguration, GraphBuilder,
+                                LayerVertex)
+from .vertices import (AttentionVertex, ElementWiseVertex, GraphVertex,
+                       L2NormalizeVertex, L2Vertex, MergeVertex,
+                       PreprocessorVertex, ReshapeVertex, ScaleVertex,
+                       ShiftVertex, StackVertex, SubsetVertex, UnstackVertex)

@@ -1,0 +1,271 @@
+"""Graph vertices for ComputationGraph.
+
+Reference: `deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/conf/graph/`
+(MergeVertex, ElementWiseVertex, StackVertex, UnstackVertex, SubsetVertex,
+L2NormalizeVertex, L2Vertex, ScaleVertex, ShiftVertex, ReshapeVertex,
+PreprocessorVertex, AttentionVertex) and the runtime impls in
+`nn/graph/vertex/impl/`.
+
+TPU redesign: a vertex is a pure function over its input arrays — forward-only;
+backprop comes from jax.grad over the whole graph, so the reference's
+per-vertex `doBackward` disappears.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..weights import init_weights
+
+
+@dataclasses.dataclass
+class GraphVertex:
+    """Base vertex (reference conf/graph/GraphVertex.java)."""
+
+    def init_params(self, key, input_types):
+        return {}
+
+    def forward(self, params, inputs, training=False, key=None):
+        raise NotImplementedError
+
+    def output_type(self, input_types):
+        return input_types[0]
+
+    def has_params(self) -> bool:
+        return False
+
+    def needs_key(self) -> bool:
+        return False
+
+
+@dataclasses.dataclass
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature axis (reference conf/graph/MergeVertex.java)."""
+    axis: int = 1
+
+    def forward(self, params, inputs, training=False, key=None):
+        return jnp.concatenate(inputs, axis=self.axis)
+
+    def output_type(self, input_types):
+        t = list(input_types[0])
+        ax = self.axis - 1  # input_types exclude the batch dim
+        t[ax] = sum(it[ax] for it in input_types)
+        return tuple(t)
+
+
+@dataclasses.dataclass
+class ElementWiseVertex(GraphVertex):
+    """Pointwise combine (reference conf/graph/ElementWiseVertex.java).
+    op: add | subtract | product | average | max."""
+    op: str = "add"
+
+    def forward(self, params, inputs, training=False, key=None):
+        if self.op == "add":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if self.op == "subtract":
+            if len(inputs) != 2:
+                raise ValueError("subtract requires exactly 2 inputs")
+            return inputs[0] - inputs[1]
+        if self.op == "product":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if self.op == "average":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out / len(inputs)
+        if self.op == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        raise ValueError(f"unknown op {self.op}")
+
+
+@dataclasses.dataclass
+class StackVertex(GraphVertex):
+    """Stack minibatches along dim 0 (reference conf/graph/StackVertex.java)."""
+
+    def forward(self, params, inputs, training=False, key=None):
+        return jnp.concatenate(inputs, axis=0)
+
+
+@dataclasses.dataclass
+class UnstackVertex(GraphVertex):
+    """Take the `from_index`-th of `stack_size` equal slices along dim 0
+    (reference conf/graph/UnstackVertex.java)."""
+    from_index: int = 0
+    stack_size: int = 1
+
+    def forward(self, params, inputs, training=False, key=None):
+        x = inputs[0]
+        step = x.shape[0] // self.stack_size
+        return x[self.from_index * step:(self.from_index + 1) * step]
+
+
+@dataclasses.dataclass
+class SubsetVertex(GraphVertex):
+    """Feature range [from_idx, to_idx] inclusive (reference SubsetVertex.java)."""
+    from_idx: int = 0
+    to_idx: int = 0
+
+    def forward(self, params, inputs, training=False, key=None):
+        return inputs[0][:, self.from_idx:self.to_idx + 1]
+
+    def output_type(self, input_types):
+        t = list(input_types[0])
+        t[0] = self.to_idx - self.from_idx + 1
+        return tuple(t)
+
+
+@dataclasses.dataclass
+class L2NormalizeVertex(GraphVertex):
+    """Unit-L2-normalize per example (reference L2NormalizeVertex.java)."""
+    eps: float = 1e-8
+
+    def forward(self, params, inputs, training=False, key=None):
+        x = inputs[0]
+        axes = tuple(range(1, x.ndim))
+        n = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True))
+        return x / jnp.maximum(n, self.eps)
+
+
+@dataclasses.dataclass
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance of two inputs (reference L2Vertex.java)."""
+    eps: float = 1e-8
+
+    def forward(self, params, inputs, training=False, key=None):
+        a, b = inputs
+        d = a - b
+        axes = tuple(range(1, a.ndim))
+        return jnp.sqrt(jnp.sum(d * d, axis=axes) + self.eps)[:, None]
+
+    def output_type(self, input_types):
+        return (1,)
+
+
+@dataclasses.dataclass
+class ScaleVertex(GraphVertex):
+    """Multiply by a fixed scalar (reference ScaleVertex.java)."""
+    scale: float = 1.0
+
+    def forward(self, params, inputs, training=False, key=None):
+        return inputs[0] * self.scale
+
+
+@dataclasses.dataclass
+class ShiftVertex(GraphVertex):
+    """Add a fixed scalar (reference ShiftVertex.java)."""
+    shift: float = 0.0
+
+    def forward(self, params, inputs, training=False, key=None):
+        return inputs[0] + self.shift
+
+
+@dataclasses.dataclass
+class ReshapeVertex(GraphVertex):
+    """Reshape keeping batch dim (reference ReshapeVertex.java)."""
+    shape: Tuple[int, ...] = ()
+
+    def forward(self, params, inputs, training=False, key=None):
+        x = inputs[0]
+        return x.reshape((x.shape[0],) + tuple(self.shape))
+
+    def output_type(self, input_types):
+        return tuple(self.shape)
+
+
+@dataclasses.dataclass
+class PreprocessorVertex(GraphVertex):
+    """Wraps an InputPreProcessor as a vertex (reference PreprocessorVertex.java)."""
+    preprocessor: object = None
+
+    def forward(self, params, inputs, training=False, key=None):
+        return self.preprocessor(inputs[0])
+
+    def output_type(self, input_types):
+        return self.preprocessor.out_type(input_types[0])
+
+
+@dataclasses.dataclass
+class AttentionVertex(GraphVertex):
+    """Multi-head dot-product attention over RNN-format inputs
+    (reference conf/graph/AttentionVertex.java, built on the native
+    `multi_head_dot_product_attention` op — here one fused jnp.einsum chain
+    so XLA maps the batched matmuls straight onto the MXU).
+
+    Inputs: (queries, keys, values[, mask]) each [B, features, T] (reference
+    RNN format). With projectInput=True, learned per-head projections.
+    """
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: int = 0
+    project_input: bool = True
+    weight_init: str = "xavier"
+
+    def __post_init__(self):
+        if self.head_size == 0 and self.n_heads:
+            self.head_size = max(1, self.n_out // self.n_heads)
+
+    def has_params(self):
+        return self.project_input
+
+    def init_params(self, key, input_types):
+        if not self.project_input:
+            return {}
+        nq = self.n_heads * self.head_size
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        return {
+            "Wq": init_weights(kq, (self.n_in, nq), self.weight_init),
+            "Wk": init_weights(kk, (self.n_in, nq), self.weight_init),
+            "Wv": init_weights(kv, (self.n_in, nq), self.weight_init),
+            "Wo": init_weights(ko, (nq, self.n_out), self.weight_init),
+        }
+
+    def forward(self, params, inputs, training=False, key=None):
+        q, k, v = inputs[0], inputs[1], inputs[2]
+        mask = inputs[3] if len(inputs) > 3 else None
+        # [B, F, T] -> [B, T, F]
+        q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+        if self.project_input:
+            B, Tq, _ = q.shape
+            H, D = self.n_heads, self.head_size
+            qh = jnp.einsum("btf,fe->bte", q, params["Wq"]).reshape(B, Tq, H, D)
+            kh = jnp.einsum("btf,fe->bte", k, params["Wk"]).reshape(B, -1, H, D)
+            vh = jnp.einsum("btf,fe->bte", v, params["Wv"]).reshape(B, -1, H, D)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) / math.sqrt(D)
+            if mask is not None:
+                scores = jnp.where(mask[:, None, None, :].astype(bool),
+                                   scores, -1e9)
+            attn = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", attn, vh).reshape(B, Tq, H * D)
+            out = jnp.einsum("bte,eo->bto", out, params["Wo"])
+        else:
+            D = q.shape[-1]
+            scores = jnp.einsum("bqd,bkd->bqk", q, k) / math.sqrt(D)
+            if mask is not None:
+                scores = jnp.where(mask[:, None, :].astype(bool), scores, -1e9)
+            attn = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bqk,bkd->bqd", attn, v)
+        return jnp.swapaxes(out, 1, 2)  # back to [B, F, T]
+
+    def output_type(self, input_types):
+        f, t = input_types[0]
+        return (self.n_out if self.project_input else f, t)
+
+
+VERTEX_CLASSES = {c.__name__: c for c in [
+    MergeVertex, ElementWiseVertex, StackVertex, UnstackVertex, SubsetVertex,
+    L2NormalizeVertex, L2Vertex, ScaleVertex, ShiftVertex, ReshapeVertex,
+    PreprocessorVertex, AttentionVertex]}

@@ -79,3 +79,63 @@ def restore_multilayer(path, load_updater: bool = False):
 # ModelSerializer-compatible entry points
 write_model = save_multilayer
 restore_multi_layer_network = restore_multilayer
+
+
+def save_computation_graph(net, path, save_updater: bool = False):
+    """ComputationGraph zip serde (reference ModelSerializer.writeModel for
+    ComputationGraph — same zip layout, vertex-keyed params)."""
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("configuration.json", net.conf.to_json())
+        # npz keys are opaque indices; the manifest maps them back to
+        # (vertex, param) so vertex names can contain any characters
+        flat, manifest = {}, []
+        for name, p in net._params.items():
+            for k, v in p.items():
+                manifest.append([name, k])
+                flat[f"p{len(manifest) - 1}"] = np.asarray(v)
+        buf = io.BytesIO()
+        np.savez(buf, **flat)
+        z.writestr("coefficients.npz", buf.getvalue())
+        z.writestr("paramManifest.json", json.dumps(manifest))
+        z.writestr("meta.json", json.dumps(
+            {"iteration": net._iteration, "epoch": net._epoch,
+             "model_type": "ComputationGraph"}))
+        if save_updater and net._updater_state is not None:
+            leaves, _ = jax.tree_util.tree_flatten(net._updater_state)
+            buf2 = io.BytesIO()
+            np.savez(buf2, **{f"leaf{i}": np.asarray(l)
+                              for i, l in enumerate(leaves)})
+            z.writestr("updaterState.npz", buf2.getvalue())
+
+
+def restore_computation_graph(path, load_updater: bool = False):
+    from .graph.computation_graph import (ComputationGraph,
+                                          ComputationGraphConfiguration)
+
+    with zipfile.ZipFile(path) as z:
+        conf = ComputationGraphConfiguration.from_json(
+            z.read("configuration.json").decode())
+        manifest = json.loads(z.read("paramManifest.json"))
+        with z.open("coefficients.npz") as f:
+            npz = np.load(io.BytesIO(f.read()))
+            params = {}
+            for i, (name, pkey) in enumerate(manifest):
+                params.setdefault(name, {})[pkey] = jnp.asarray(npz[f"p{i}"])
+        meta = json.loads(z.read("meta.json"))
+        updater_leaves = None
+        if load_updater and "updaterState.npz" in z.namelist():
+            with z.open("updaterState.npz") as f:
+                npz2 = np.load(io.BytesIO(f.read()))
+                updater_leaves = [jnp.asarray(npz2[f"leaf{i}"])
+                                  for i in range(len(npz2.files))]
+
+    net = ComputationGraph(conf)
+    full = {n: params.get(n, {}) for n in net._order}
+    net.init(params=full)
+    net._iteration = meta.get("iteration", 0)
+    net._epoch = meta.get("epoch", 0)
+    if updater_leaves is not None and net._updater_state is not None:
+        _, treedef = jax.tree_util.tree_flatten(net._updater_state)
+        net._updater_state = jax.tree_util.tree_unflatten(treedef,
+                                                          updater_leaves)
+    return net

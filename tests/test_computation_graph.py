@@ -1,0 +1,220 @@
+"""ComputationGraph tests.
+
+Models the reference's ComputationGraph tests
+(platform-tests/.../dl4jcore/nn/graph/ComputationGraphTestRNN.java,
+TestComputationGraphNetwork.java): construction, topo order, multi-input/
+multi-output fit, vertices, serde round-trip.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.conf.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, LossLayer,
+                                               OutputLayer)
+from deeplearning4j_tpu.nn.graph import (AttentionVertex, ComputationGraph,
+                                         ComputationGraphConfiguration,
+                                         ElementWiseVertex, L2NormalizeVertex,
+                                         L2Vertex, MergeVertex, ReshapeVertex,
+                                         ScaleVertex, ShiftVertex, StackVertex,
+                                         SubsetVertex, UnstackVertex)
+
+
+def simple_graph():
+    return (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("d1", DenseLayer(n_in=4, n_out=8, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3), "d1")
+            .set_outputs("out")
+            .build())
+
+
+class TestGraphConstruction:
+    def test_topological_order(self):
+        conf = simple_graph()
+        order = conf.topological_order()
+        assert order.index("in") < order.index("d1") < order.index("out")
+
+    def test_cycle_detection(self):
+        conf = simple_graph()
+        conf.vertex_inputs["d1"] = ["out"]  # introduce a cycle
+        with pytest.raises(ValueError):
+            conf.topological_order()
+
+    def test_output_types(self):
+        conf = simple_graph()
+        types = conf.vertex_output_types()
+        assert types["d1"] == (8,)
+        assert types["out"] == (3,)
+
+    def test_num_params(self):
+        net = ComputationGraph(simple_graph()).init()
+        # d1: 4*8+8, out: 8*3+3
+        assert net.num_params() == 4 * 8 + 8 + 8 * 3 + 3
+
+
+class TestGraphFit:
+    def test_fit_reduces_loss(self):
+        net = ComputationGraph(simple_graph()).init()
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+        ds = DataSet(x, y)
+        before = net.score(ds)
+        net.fit(ds, num_epochs=30)
+        after = net.score(ds)
+        assert after < before * 0.7
+
+    def test_output_shape(self):
+        net = ComputationGraph(simple_graph()).init()
+        out = net.output(np.ones((5, 4), np.float32))
+        assert out[0].shape == (5, 3)
+        # softmax rows sum to 1
+        np.testing.assert_allclose(np.asarray(out[0].jax()).sum(-1),
+                                   np.ones(5), rtol=1e-5)
+
+    def test_multi_input_merge(self):
+        conf = (NeuralNetConfiguration.builder().updater(Adam(1e-2))
+                .graph_builder()
+                .add_inputs("a", "b")
+                .add_vertex("merge", MergeVertex(), "a", "b")
+                .add_layer("out", OutputLayer(n_in=6, n_out=2), "merge")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.RandomState(1)
+        mds = MultiDataSet(
+            features=[rng.randn(8, 2).astype(np.float32),
+                      rng.randn(8, 4).astype(np.float32)],
+            labels=[np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)]])
+        before = net.score(mds)
+        net.fit(mds, num_epochs=25)
+        assert net.score(mds) < before
+
+    def test_multi_output(self):
+        conf = (NeuralNetConfiguration.builder().updater(Adam(1e-2))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("shared", DenseLayer(n_in=4, n_out=8,
+                                                activation="tanh"), "in")
+                .add_layer("out1", OutputLayer(n_in=8, n_out=2), "shared")
+                .add_layer("out2", OutputLayer(n_in=8, n_out=3), "shared")
+                .set_outputs("out1", "out2").build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.RandomState(2)
+        x = rng.randn(16, 4).astype(np.float32)
+        mds = MultiDataSet(
+            features=[x],
+            labels=[np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)],
+                    np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]])
+        outs = net.output(x)
+        assert outs[0].shape == (16, 2) and outs[1].shape == (16, 3)
+        before = net.score(mds)
+        net.fit(mds, num_epochs=20)
+        assert net.score(mds) < before
+
+
+class TestVertices:
+    def _run(self, vertex, inputs, n_inputs=None):
+        return vertex.forward({}, [jnp.asarray(x) for x in inputs])
+
+    def test_elementwise(self):
+        a = np.array([[1., 2.]])
+        b = np.array([[3., 5.]])
+        assert np.allclose(self._run(ElementWiseVertex("add"), [a, b]),
+                           [[4., 7.]])
+        assert np.allclose(self._run(ElementWiseVertex("subtract"), [a, b]),
+                           [[-2., -3.]])
+        assert np.allclose(self._run(ElementWiseVertex("product"), [a, b]),
+                           [[3., 10.]])
+        assert np.allclose(self._run(ElementWiseVertex("average"), [a, b]),
+                           [[2., 3.5]])
+        assert np.allclose(self._run(ElementWiseVertex("max"), [a, b]),
+                           [[3., 5.]])
+
+    def test_stack_unstack(self):
+        a = np.ones((2, 3), np.float32)
+        b = 2 * np.ones((2, 3), np.float32)
+        stacked = self._run(StackVertex(), [a, b])
+        assert stacked.shape == (4, 3)
+        u1 = UnstackVertex(from_index=1, stack_size=2).forward(
+            {}, [stacked])
+        assert np.allclose(u1, b)
+
+    def test_subset(self):
+        x = np.arange(12, dtype=np.float32).reshape(2, 6)
+        out = self._run(SubsetVertex(from_idx=1, to_idx=3), [x])
+        assert out.shape == (2, 3)
+        assert np.allclose(out[0], [1, 2, 3])
+
+    def test_l2_normalize(self):
+        x = np.array([[3., 4.]])
+        out = self._run(L2NormalizeVertex(), [x])
+        assert np.allclose(out, [[0.6, 0.8]])
+
+    def test_l2_distance(self):
+        a = np.array([[0., 0.]])
+        b = np.array([[3., 4.]])
+        out = self._run(L2Vertex(), [a, b])
+        assert np.allclose(out, [[5.]], atol=1e-3)
+
+    def test_scale_shift_reshape(self):
+        x = np.ones((2, 4), np.float32)
+        assert np.allclose(self._run(ScaleVertex(scale=3.0), [x]), 3.0)
+        assert np.allclose(self._run(ShiftVertex(shift=1.5), [x]), 2.5)
+        out = self._run(ReshapeVertex(shape=(2, 2)), [x])
+        assert out.shape == (2, 2, 2)
+
+    def test_attention_vertex(self):
+        import jax
+        v = AttentionVertex(n_in=8, n_out=8, n_heads=2, head_size=4)
+        params = v.init_params(jax.random.key(0), [(8, 5)])
+        x = jnp.asarray(np.random.RandomState(0)
+                        .randn(3, 8, 5).astype(np.float32))
+        out = v.forward(params, [x, x, x])
+        assert out.shape == (3, 8, 5)
+        # masked positions get ~zero attention: compare masked vs unmasked
+        mask = jnp.asarray(np.array([[1, 1, 1, 0, 0]] * 3, np.float32))
+        out_m = v.forward(params, [x, x, x, mask])
+        assert out_m.shape == (3, 8, 5)
+        assert not np.allclose(out, out_m)
+
+
+class TestGraphSerde:
+    def test_json_round_trip(self):
+        conf = simple_graph()
+        conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert conf2.inputs == conf.inputs
+        assert conf2.outputs == conf.outputs
+        assert set(conf2.vertices) == set(conf.vertices)
+        assert conf2.vertex_inputs == conf.vertex_inputs
+
+    def test_save_load(self, tmp_path):
+        net = ComputationGraph(simple_graph()).init()
+        rng = np.random.RandomState(3)
+        x = rng.randn(4, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 4)]
+        net.fit(DataSet(x, y), num_epochs=2)
+        out_before = np.asarray(net.output(x)[0].jax())
+        p = tmp_path / "cg.zip"
+        net.save(str(p), save_updater=True)
+        net2 = ComputationGraph.load(str(p), load_updater=True)
+        out_after = np.asarray(net2.output(x)[0].jax())
+        np.testing.assert_allclose(out_before, out_after, rtol=1e-6)
+
+    def test_clone_independent(self):
+        net = ComputationGraph(simple_graph()).init()
+        clone = net.clone()
+        rng = np.random.RandomState(4)
+        x = rng.randn(4, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 4)]
+        net.fit(DataSet(x, y), num_epochs=3)
+        # clone unchanged by original's training
+        o1 = np.asarray(net.output(x)[0].jax())
+        o2 = np.asarray(clone.output(x)[0].jax())
+        assert not np.allclose(o1, o2)
