@@ -17,6 +17,7 @@ _ACTIVATIONS = {
     "relu": jax.nn.relu,
     "relu6": jax.nn.relu6,
     "leakyrelu": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "lrelu": lambda x: jax.nn.leaky_relu(x, 0.01),
     "elu": jax.nn.elu,
     "selu": jax.nn.selu,
     "gelu": jax.nn.gelu,

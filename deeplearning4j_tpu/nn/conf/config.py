@@ -109,13 +109,20 @@ def _is_ff(t):
 def infer_preprocessor(prev_type, layer):
     """Auto-insert reshape preprocessors (reference
     MultiLayerConfiguration.getPreProcessorForInputType)."""
-    needs_ff = isinstance(layer, (L.DenseLayer, L.OutputLayer))
+    needs_ff = isinstance(layer, (L.DenseLayer, L.OutputLayer,
+                                  L.VariationalAutoencoder,
+                                  L.ElementWiseMultiplicationLayer))
     needs_cnn = isinstance(layer, (L.ConvolutionLayer, L.SubsamplingLayer,
                                    L.Upsampling2D, L.ZeroPaddingLayer,
-                                   L.LocalResponseNormalization))
+                                   L.LocalResponseNormalization,
+                                   L.LocallyConnected2D, L.SpaceToDepthLayer,
+                                   L.DepthToSpaceLayer, L.Cropping2D))
     needs_rnn = isinstance(layer, (L.LSTM, L.RnnOutputLayer,
                                    L.SelfAttentionLayer, L.Bidirectional,
-                                   L.Convolution1DLayer))
+                                   L.Convolution1DLayer, L.SimpleRnn, L.GRU,
+                                   L.LearnedSelfAttentionLayer,
+                                   L.RecurrentAttentionLayer,
+                                   L.RnnLossLayer))
     if _is_cnn(prev_type) and needs_ff:
         return CnnToFeedForwardPreProcessor()
     if _is_cnn(prev_type) and needs_rnn:

@@ -281,7 +281,7 @@ class BatchNormalization(Layer):
         return nn_ops.batchnorm(x, mean, var, params.get("gamma"),
                                 params.get("beta"), self.eps, axis)
 
-    def new_state(self, params, x):
+    def new_state(self, params, x, labels=None):
         """Updated running stats given a training batch (applied by the net)."""
         axis = 1 if x.ndim >= 3 else -1
         reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
@@ -636,3 +636,18 @@ class DepthwiseConvolution2D(ConvolutionLayer):
     def output_type(self, input_type):
         base = super().output_type(input_type)
         return (input_type[0] * self.depth_multiplier,) + base[1:]
+
+
+# extended layer set lives in layers_extra; re-exported here so the whole
+# layer catalog (and JSON serde via getattr on this module) has one namespace
+from .layers_extra import (  # noqa: E402,F401
+    AlphaDropout, CapsuleLayer, CapsuleStrengthLayer, CenterLossOutputLayer,
+    Cnn3DLossLayer, CnnLossLayer, Convolution3D, Cropping1D, Cropping2D,
+    Cropping3D, DepthToSpaceLayer, ElementWiseMultiplicationLayer,
+    FrozenLayer, GRU, GaussianDropout, GaussianNoise, LastTimeStep,
+    LearnedSelfAttentionLayer, LocallyConnected1D, LocallyConnected2D,
+    MaskLayer, MaskZeroLayer, PReLULayer, PrimaryCapsules,
+    RecurrentAttentionLayer, RepeatVector, RnnLossLayer, SimpleRnn,
+    SpaceToDepthLayer, Subsampling1DLayer, Subsampling3DLayer,
+    TimeDistributed, Upsampling1D, Upsampling3D, VariationalAutoencoder,
+    Yolo2OutputLayer, ZeroPadding1DLayer, ZeroPadding3DLayer)

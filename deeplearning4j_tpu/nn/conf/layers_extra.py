@@ -1,0 +1,1053 @@
+"""Extended layer configurations (the reference's long tail).
+
+Reference: `deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/conf/layers/`
+— Convolution3D, Subsampling1D/3D, Upsampling1D/3D, Cropping1D/2D/3D,
+ZeroPadding1D/3D, SimpleRnn, LastTimeStep, TimeDistributed, MaskZeroLayer,
+LocallyConnected1D/2D, PReLULayer, SpaceToDepth/Batch, RepeatVector,
+ElementWiseMultiplicationLayer, MaskLayer, CnnLossLayer, RnnLossLayer,
+CenterLossOutputLayer, Yolo2OutputLayer (objdetect), LearnedSelfAttention,
+RecurrentAttention, FrozenLayer, variational/VariationalAutoencoder,
+CapsuleLayer/PrimaryCapsules/CapsuleStrengthLayer, dropout variants
+(conf/dropout/: GaussianDropout, GaussianNoise, AlphaDropout).
+
+All are pure modules like conf/layers.py; see that file's module docstring.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import conv_ops, nn_ops, recurrent
+from ..activations import get_activation
+from ..losses import get_loss
+from ..weights import init_weights
+from .layers import (Layer, ConvolutionLayer, DenseLayer, OutputLayer,
+                     _pair)
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (int(v),) * 3
+
+
+# -- 3D convolution family -----------------------------------------------
+@dataclasses.dataclass
+class Convolution3D(Layer):
+    """3D conv over NCDHW (reference conf/layers/Convolution3D.java)."""
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: Sequence[int] = (3, 3, 3)
+    stride: Sequence[int] = (1, 1, 1)
+    padding: Union[str, Sequence[int]] = "SAME"
+    activation: str = "identity"
+    weight_init: str = "relu"
+    has_bias: bool = True
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        kd, kh, kw = _triple(self.kernel_size)
+        p = {"W": init_weights(key, (kd, kh, kw, n_in, self.n_out),
+                               self.weight_init)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,))
+        return p
+
+    def forward(self, params, x, training=False, key=None):
+        pad = self.padding if isinstance(self.padding, str) \
+            else _triple(self.padding)
+        out = conv_ops.conv3d(x, params["W"], params.get("b"),
+                              strides=_triple(self.stride), padding=pad,
+                              data_format="NCDHW")
+        return get_activation(self.activation)(out)
+
+    def output_type(self, input_type):
+        c, d, h, w = input_type
+        kd, kh, kw = _triple(self.kernel_size)
+        sd, sh, sw = _triple(self.stride)
+        if isinstance(self.padding, str) and self.padding.upper() == "SAME":
+            return (self.n_out, -(-d // sd), -(-h // sh), -(-w // sw))
+        pd, ph, pw = _triple(self.padding) if not isinstance(self.padding, str) \
+            else (0, 0, 0)
+        return (self.n_out, (d + 2 * pd - kd) // sd + 1,
+                (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1)
+
+
+@dataclasses.dataclass
+class Subsampling1DLayer(Layer):
+    """1D pooling over [B, C, T] (reference Subsampling1DLayer.java)."""
+    pooling_type: str = "max"
+    kernel_size: int = 2
+    stride: int = None
+    padding: int = 0
+
+    def forward(self, params, x, training=False, key=None):
+        s = self.stride if self.stride is not None else self.kernel_size
+        x4 = x[:, :, :, None]  # [B, C, T, 1] — reuse the 2D pools
+        if self.pooling_type.lower() == "max":
+            out = conv_ops.maxpool2d(x4, (self.kernel_size, 1), (s, 1),
+                                     (self.padding, 0) if self.padding else "VALID",
+                                     "NCHW")
+        else:
+            out = conv_ops.avgpool2d(x4, (self.kernel_size, 1), (s, 1),
+                                     (self.padding, 0) if self.padding else "VALID",
+                                     "NCHW")
+        return out[:, :, :, 0]
+
+    def output_type(self, input_type):
+        c, t = input_type
+        s = self.stride if self.stride is not None else self.kernel_size
+        return (c, (t + 2 * self.padding - self.kernel_size) // s + 1)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class Subsampling3DLayer(Layer):
+    """3D pooling over NCDHW (reference Subsampling3DLayer.java)."""
+    pooling_type: str = "max"
+    kernel_size: Sequence[int] = (2, 2, 2)
+    stride: Sequence[int] = None
+    padding: Union[str, Sequence[int]] = "VALID"
+
+    def forward(self, params, x, training=False, key=None):
+        s = self.stride if self.stride is not None else self.kernel_size
+        pad = self.padding if isinstance(self.padding, str) \
+            else _triple(self.padding)
+        if self.pooling_type.lower() == "max":
+            return conv_ops.maxpool3d(x, _triple(self.kernel_size),
+                                      _triple(s), pad, "NCDHW")
+        return conv_ops.avgpool3d(x, _triple(self.kernel_size), _triple(s),
+                                  pad, "NCDHW")
+
+    def output_type(self, input_type):
+        c, d, h, w = input_type
+        kd, kh, kw = _triple(self.kernel_size)
+        s = self.stride if self.stride is not None else self.kernel_size
+        sd, sh, sw = _triple(s)
+        if isinstance(self.padding, str) and self.padding.upper() == "SAME":
+            return (c, -(-d // sd), -(-h // sh), -(-w // sw))
+        return (c, (d - kd) // sd + 1, (h - kh) // sh + 1, (w - kw) // sw + 1)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class Upsampling1D(Layer):
+    """Repeat along time (reference Upsampling1D.java)."""
+    size: int = 2
+
+    def forward(self, params, x, training=False, key=None):
+        return jnp.repeat(x, self.size, axis=2)
+
+    def output_type(self, input_type):
+        c, t = input_type
+        return (c, t * self.size)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class Upsampling3D(Layer):
+    size: Sequence[int] = (2, 2, 2)
+
+    def forward(self, params, x, training=False, key=None):
+        sd, sh, sw = _triple(self.size)
+        return conv_ops.upsampling3d(x, sd, sh, sw, "NCDHW")
+
+    def output_type(self, input_type):
+        c, d, h, w = input_type
+        sd, sh, sw = _triple(self.size)
+        return (c, d * sd, h * sh, w * sw)
+
+    def has_params(self):
+        return False
+
+
+# -- cropping / padding ---------------------------------------------------
+@dataclasses.dataclass
+class Cropping1D(Layer):
+    cropping: Sequence[int] = (1, 1)
+
+    def forward(self, params, x, training=False, key=None):
+        a, b = self.cropping
+        return x[:, :, a:x.shape[2] - b]
+
+    def output_type(self, input_type):
+        c, t = input_type
+        return (c, t - sum(self.cropping))
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class Cropping2D(Layer):
+    cropping: Sequence[int] = (1, 1, 1, 1)  # top,bottom,left,right
+
+    def forward(self, params, x, training=False, key=None):
+        t, b, l, r = self.cropping
+        return x[:, :, t:x.shape[2] - b, l:x.shape[3] - r]
+
+    def output_type(self, input_type):
+        c, h, w = input_type
+        t, b, l, r = self.cropping
+        return (c, h - t - b, w - l - r)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class Cropping3D(Layer):
+    cropping: Sequence[int] = (1, 1, 1, 1, 1, 1)
+
+    def forward(self, params, x, training=False, key=None):
+        d0, d1, h0, h1, w0, w1 = self.cropping
+        return x[:, :, d0:x.shape[2] - d1, h0:x.shape[3] - h1,
+                 w0:x.shape[4] - w1]
+
+    def output_type(self, input_type):
+        c, d, h, w = input_type
+        d0, d1, h0, h1, w0, w1 = self.cropping
+        return (c, d - d0 - d1, h - h0 - h1, w - w0 - w1)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class ZeroPadding1DLayer(Layer):
+    padding: Sequence[int] = (1, 1)
+
+    def forward(self, params, x, training=False, key=None):
+        a, b = self.padding
+        return jnp.pad(x, ((0, 0), (0, 0), (a, b)))
+
+    def output_type(self, input_type):
+        c, t = input_type
+        return (c, t + sum(self.padding))
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class ZeroPadding3DLayer(Layer):
+    padding: Sequence[int] = (1, 1, 1, 1, 1, 1)
+
+    def forward(self, params, x, training=False, key=None):
+        d0, d1, h0, h1, w0, w1 = self.padding
+        return jnp.pad(x, ((0, 0), (0, 0), (d0, d1), (h0, h1), (w0, w1)))
+
+    def output_type(self, input_type):
+        c, d, h, w = input_type
+        d0, d1, h0, h1, w0, w1 = self.padding
+        return (c, d + d0 + d1, h + h0 + h1, w + w0 + w1)
+
+    def has_params(self):
+        return False
+
+
+# -- recurrent ------------------------------------------------------------
+@dataclasses.dataclass
+class SimpleRnn(Layer):
+    """Elman RNN over [B, F, T] (reference conf/layers/recurrent/SimpleRnn.java)."""
+    n_in: int = 0
+    n_out: int = 0
+    activation: str = "tanh"
+    weight_init: str = "xavier"
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        k1, k2 = jax.random.split(key)
+        return {"Wx": init_weights(k1, (n_in, self.n_out), self.weight_init),
+                "Wh": init_weights(k2, (self.n_out, self.n_out),
+                                   self.weight_init),
+                "b": jnp.zeros((self.n_out,))}
+
+    def forward(self, params, x, training=False, key=None):
+        xt = jnp.swapaxes(x, 1, 2)
+        h_seq, _ = recurrent.simple_rnn(xt, params["Wx"], params["Wh"],
+                                        params["b"],
+                                        activation=get_activation(self.activation))
+        return jnp.swapaxes(h_seq, 1, 2)
+
+    def output_type(self, input_type):
+        return (self.n_out, input_type[1])
+
+
+@dataclasses.dataclass
+class GRU(Layer):
+    """GRU over [B, F, T] (libnd4j gruCell op; capability superset — the
+    reference layer API itself ships no GRU conf)."""
+    n_in: int = 0
+    n_out: int = 0
+    weight_init: str = "xavier"
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        k1, k2 = jax.random.split(key)
+        return {"Wru": init_weights(k1, (n_in + self.n_out, 2 * self.n_out),
+                                    self.weight_init),
+                "Wc": init_weights(k2, (n_in + self.n_out, self.n_out),
+                                   self.weight_init),
+                "bru": jnp.zeros((2 * self.n_out,)),
+                "bc": jnp.zeros((self.n_out,))}
+
+    def forward(self, params, x, training=False, key=None):
+        xt = jnp.swapaxes(x, 1, 2)
+        h0 = jnp.zeros((x.shape[0], self.n_out), x.dtype)
+        h_seq, _ = recurrent.gru(xt, h0, params["Wru"], params["Wc"],
+                                 params["bru"], params["bc"])
+        return jnp.swapaxes(h_seq, 1, 2)
+
+    def output_type(self, input_type):
+        return (self.n_out, input_type[1])
+
+
+@dataclasses.dataclass
+class LastTimeStep(Layer):
+    """Wrapper: last time step of an RNN layer's [B, F, T] output
+    (reference conf/layers/recurrent/LastTimeStep.java)."""
+    underlying: Layer = None
+
+    def init_params(self, key, input_type):
+        return self.underlying.init_params(key, input_type)
+
+    def forward(self, params, x, training=False, key=None):
+        out = self.underlying.forward(params, x, training, key)
+        return out[:, :, -1]
+
+    def output_type(self, input_type):
+        t = self.underlying.output_type(input_type)
+        return (t[0],)
+
+    def has_params(self):
+        return self.underlying.has_params()
+
+    def needs_key(self):
+        return self.underlying.needs_key()
+
+
+@dataclasses.dataclass
+class TimeDistributed(Layer):
+    """Apply an FF layer at every timestep of [B, F, T]
+    (reference conf/layers/recurrent/TimeDistributed.java)."""
+    underlying: Layer = None
+
+    def init_params(self, key, input_type):
+        return self.underlying.init_params(key, (input_type[0],))
+
+    def forward(self, params, x, training=False, key=None):
+        b, f, t = x.shape
+        flat = jnp.swapaxes(x, 1, 2).reshape(b * t, f)
+        out = self.underlying.forward(params, flat, training, key)
+        return jnp.swapaxes(out.reshape(b, t, -1), 1, 2)
+
+    def output_type(self, input_type):
+        inner = self.underlying.output_type((input_type[0],))
+        return (inner[0], input_type[1])
+
+    def has_params(self):
+        return self.underlying.has_params()
+
+    def needs_key(self):
+        return self.underlying.needs_key()
+
+
+@dataclasses.dataclass
+class MaskZeroLayer(Layer):
+    """Zero out all-zero (padding) timesteps after the wrapped RNN layer
+    (reference conf/layers/util/MaskZeroLayer.java)."""
+    underlying: Layer = None
+    mask_value: float = 0.0
+
+    def init_params(self, key, input_type):
+        return self.underlying.init_params(key, input_type)
+
+    def forward(self, params, x, training=False, key=None):
+        # timestep is masked where every feature equals mask_value
+        keep = jnp.any(x != self.mask_value, axis=1, keepdims=True)
+        out = self.underlying.forward(params, x, training, key)
+        return out * keep.astype(out.dtype)
+
+    def output_type(self, input_type):
+        return self.underlying.output_type(input_type)
+
+    def has_params(self):
+        return self.underlying.has_params()
+
+
+# -- locally connected ----------------------------------------------------
+@dataclasses.dataclass
+class LocallyConnected2D(Layer):
+    """Conv2D with unshared weights (reference conf/layers/LocallyConnected2D.java).
+
+    Patch extraction + one einsum — a single batched contraction on the MXU
+    instead of the reference's per-position loop.
+    """
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: Sequence[int] = (3, 3)
+    stride: Sequence[int] = (1, 1)
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    has_bias: bool = True
+    input_size: Sequence[int] = None  # (h, w), required if no InputType
+
+    def _out_hw(self, input_type):
+        h, w = (input_type[1], input_type[2]) if input_type is not None \
+            else self.input_size
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        return (h - kh) // sh + 1, (w - kw) // sw + 1
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        kh, kw = _pair(self.kernel_size)
+        oh, ow = self._out_hw(input_type)
+        p = {"W": init_weights(key, (oh * ow, n_in * kh * kw, self.n_out),
+                               self.weight_init)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((oh * ow, self.n_out))
+        return p
+
+    def forward(self, params, x, training=False, key=None):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), (sh, sw), "VALID")  # [B, C*kh*kw, oh, ow]
+        b, ck, oh, ow = patches.shape
+        flat = patches.reshape(b, ck, oh * ow).transpose(0, 2, 1)  # [B,P,CK]
+        out = jnp.einsum("bpc,pco->bpo", flat, params["W"])
+        if self.has_bias:
+            out = out + params["b"]
+        out = get_activation(self.activation)(out)
+        return out.transpose(0, 2, 1).reshape(b, self.n_out, oh, ow)
+
+    def output_type(self, input_type):
+        oh, ow = self._out_hw(input_type)
+        return (self.n_out, oh, ow)
+
+
+@dataclasses.dataclass
+class LocallyConnected1D(Layer):
+    """1D unshared conv over [B, C, T] (reference LocallyConnected1D.java)."""
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    has_bias: bool = True
+
+    def _out_t(self, input_type):
+        return (input_type[1] - self.kernel_size) // self.stride + 1
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        ot = self._out_t(input_type)
+        p = {"W": init_weights(key, (ot, n_in * self.kernel_size, self.n_out),
+                               self.weight_init)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((ot, self.n_out))
+        return p
+
+    def forward(self, params, x, training=False, key=None):
+        patches = jax.lax.conv_general_dilated_patches(
+            x[:, :, :, None], (self.kernel_size, 1), (self.stride, 1),
+            "VALID")[:, :, :, 0]  # [B, C*k, ot]
+        out = jnp.einsum("bct,tco->bto", patches, params["W"])
+        if self.has_bias:
+            out = out + params["b"]
+        out = get_activation(self.activation)(out)
+        return out.transpose(0, 2, 1)
+
+    def output_type(self, input_type):
+        return (self.n_out, self._out_t(input_type))
+
+
+# -- elementwise / shape utilities ----------------------------------------
+@dataclasses.dataclass
+class PReLULayer(Layer):
+    """Learned leaky-ReLU slope (reference conf/layers/PReLULayer.java)."""
+    n_in: int = 0  # number of features/channels (inferred)
+
+    def init_params(self, key, input_type):
+        n = self.n_in or input_type[0]
+        return {"alpha": jnp.zeros((n,)) + 0.25}
+
+    def forward(self, params, x, training=False, key=None):
+        a = params["alpha"]
+        shape = [1] * x.ndim
+        shape[1 if x.ndim >= 3 else -1] = a.shape[0]
+        a = a.reshape(shape)
+        return jnp.where(x >= 0, x, a * x)
+
+
+@dataclasses.dataclass
+class ElementWiseMultiplicationLayer(Layer):
+    """out = activation(x * w + b) (reference ElementWiseMultiplicationLayer)."""
+    n_in: int = 0
+    n_out: int = 0
+    activation: str = "identity"
+
+    def init_params(self, key, input_type):
+        n = self.n_in or input_type[0]
+        return {"w": jnp.ones((n,)), "b": jnp.zeros((n,))}
+
+    def forward(self, params, x, training=False, key=None):
+        return get_activation(self.activation)(x * params["w"] + params["b"])
+
+
+@dataclasses.dataclass
+class RepeatVector(Layer):
+    """[B, F] → [B, F, n] (reference conf/layers/misc/RepeatVector.java)."""
+    n: int = 1
+
+    def forward(self, params, x, training=False, key=None):
+        return jnp.repeat(x[:, :, None], self.n, axis=2)
+
+    def output_type(self, input_type):
+        return (input_type[0], self.n)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class MaskLayer(Layer):
+    """Pass-through that applies the feature mask (reference util/MaskLayer.java).
+    With masks threaded functionally, this is identity."""
+
+    def forward(self, params, x, training=False, key=None):
+        return x
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class SpaceToDepthLayer(Layer):
+    """(reference conf/layers/SpaceToDepthLayer.java)."""
+    block_size: int = 2
+
+    def forward(self, params, x, training=False, key=None):
+        b, c, h, w = x.shape
+        s = self.block_size
+        x = x.reshape(b, c, h // s, s, w // s, s)
+        x = x.transpose(0, 3, 5, 1, 2, 4)
+        return x.reshape(b, c * s * s, h // s, w // s)
+
+    def output_type(self, input_type):
+        c, h, w = input_type
+        s = self.block_size
+        return (c * s * s, h // s, w // s)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class DepthToSpaceLayer(Layer):
+    block_size: int = 2
+
+    def forward(self, params, x, training=False, key=None):
+        b, c, h, w = x.shape
+        s = self.block_size
+        x = x.reshape(b, s, s, c // (s * s), h, w)
+        x = x.transpose(0, 3, 4, 1, 5, 2)
+        return x.reshape(b, c // (s * s), h * s, w * s)
+
+    def output_type(self, input_type):
+        c, h, w = input_type
+        s = self.block_size
+        return (c // (s * s), h * s, w * s)
+
+    def has_params(self):
+        return False
+
+
+# -- dropout/noise variants (reference conf/dropout/) ---------------------
+@dataclasses.dataclass
+class GaussianDropout(Layer):
+    rate: float = 0.5
+
+    def forward(self, params, x, training=False, key=None):
+        if training and key is not None:
+            return nn_ops.gaussian_dropout(x, self.rate, key, training=True)
+        return x
+
+    def has_params(self):
+        return False
+
+    def needs_key(self):
+        return True
+
+
+@dataclasses.dataclass
+class GaussianNoise(Layer):
+    stddev: float = 0.1
+
+    def forward(self, params, x, training=False, key=None):
+        if training and key is not None:
+            return nn_ops.gaussian_noise(x, self.stddev, key, training=True)
+        return x
+
+    def has_params(self):
+        return False
+
+    def needs_key(self):
+        return True
+
+
+@dataclasses.dataclass
+class AlphaDropout(Layer):
+    rate: float = 0.5
+
+    def forward(self, params, x, training=False, key=None):
+        if training and key is not None:
+            return nn_ops.alpha_dropout(x, self.rate, key, training=True)
+        return x
+
+    def has_params(self):
+        return False
+
+    def needs_key(self):
+        return True
+
+
+# -- loss heads -----------------------------------------------------------
+@dataclasses.dataclass
+class CnnLossLayer(Layer):
+    """Per-pixel loss on [B, C, H, W] (reference CnnLossLayer.java)."""
+    loss: Union[str, Callable] = "mcxent"
+    activation: str = "softmax"
+
+    def forward(self, params, x, training=False, key=None):
+        # activations apply over the channel axis (axis 1 in NCHW)
+        xt = jnp.moveaxis(x, 1, -1)
+        return jnp.moveaxis(get_activation(self.activation)(xt), -1, 1)
+
+    def compute_loss(self, labels, output, mask=None):
+        c = output.shape[1]
+        lab = jnp.moveaxis(labels, 1, -1).reshape(-1, c)
+        out = jnp.moveaxis(output, 1, -1).reshape(-1, c)
+        m = mask.reshape(-1) if mask is not None else None
+        return get_loss(self.loss)(lab, out, m)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class RnnLossLayer(Layer):
+    """Per-timestep loss on [B, C, T] (reference RnnLossLayer.java)."""
+    loss: Union[str, Callable] = "mcxent"
+    activation: str = "softmax"
+
+    def forward(self, params, x, training=False, key=None):
+        xt = jnp.swapaxes(x, 1, 2)
+        return jnp.swapaxes(get_activation(self.activation)(xt), 1, 2)
+
+    def compute_loss(self, labels, output, mask=None):
+        c = output.shape[1]
+        lab = jnp.swapaxes(labels, 1, 2).reshape(-1, c)
+        out = jnp.swapaxes(output, 1, 2).reshape(-1, c)
+        m = mask.reshape(-1) if mask is not None else None
+        return get_loss(self.loss)(lab, out, m)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class CenterLossOutputLayer(OutputLayer):
+    """Softmax + center loss (reference CenterLossOutputLayer.java).
+
+    Keeps per-class feature centers as non-trainable state updated by EMA
+    (`alpha`), loss = mcxent + lambda/2 * ||f - c_y||^2."""
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+
+    def init_params(self, key, input_type):
+        p = super().init_params(key, input_type)
+        n_in = self.n_in or input_type[0]
+        p["state_centers"] = jnp.zeros((self.n_out, n_in))
+        return p
+
+    def forward(self, params, x, training=False, key=None):
+        # stashed for compute_loss, which runs inside the same trace
+        self._last_features = x
+        self._centers = params["state_centers"]
+        return super().forward(params, x, training, key)
+
+    def new_state(self, params, x, labels=None):
+        """EMA update of class centers toward the batch class means
+        (reference CenterLossOutputLayer center update with rate alpha)."""
+        centers = params["state_centers"]
+        if labels is None:
+            return {"state_centers": centers}
+        counts = jnp.sum(labels, axis=0)                      # [n_out]
+        sums = jnp.einsum("bc,bf->cf", labels, x)             # [n_out, n_in]
+        means = sums / jnp.maximum(counts[:, None], 1.0)
+        observed = (counts > 0)[:, None]
+        new = jnp.where(observed,
+                        centers - self.alpha * (centers - means), centers)
+        return {"state_centers": new}
+
+    def compute_loss(self, labels, output, mask=None):
+        base = get_loss(self.loss)(labels, output, mask)
+        feats = getattr(self, "_last_features", None)
+        centers = getattr(self, "_centers", None)
+        if centers is None or feats is None:
+            return base
+        cls_centers = jnp.matmul(labels, centers)  # [B, n_in]
+        center = jnp.mean(jnp.sum((feats - cls_centers) ** 2, axis=-1))
+        return base + 0.5 * self.lambda_ * center
+
+
+@dataclasses.dataclass
+class Yolo2OutputLayer(Layer):
+    """YOLOv2 detection loss (reference objdetect/Yolo2OutputLayer.java).
+
+    Input [B, A*(5+C), H, W]; labels [B, 4+C, H, W] (reference label format:
+    normalized box corners + one-hot class, zero where no object).
+    """
+    anchors: Sequence[Tuple[float, float]] = ((1.0, 1.0),)
+    lambda_coord: float = 5.0
+    lambda_noobj: float = 0.5
+
+    def forward(self, params, x, training=False, key=None):
+        return x
+
+    def has_params(self):
+        return False
+
+    def compute_loss(self, labels, output, mask=None):
+        B, _, H, W = output.shape
+        A = len(self.anchors)
+        C = labels.shape[1] - 4
+        pred = output.reshape(B, A, 5 + C, H, W)
+        tx, ty = jax.nn.sigmoid(pred[:, :, 0]), jax.nn.sigmoid(pred[:, :, 1])
+        tw, th = pred[:, :, 2], pred[:, :, 3]
+        conf = jax.nn.sigmoid(pred[:, :, 4])
+        cls = jax.nn.softmax(pred[:, :, 5:], axis=2)
+
+        obj = (jnp.sum(labels[:, :4], axis=1, keepdims=True) > 0)  # [B,1,H,W]
+        obj = obj.astype(output.dtype)
+        # label box center/size from corner format
+        x1, y1, x2, y2 = (labels[:, i] for i in range(4))
+        cx, cy = (x1 + x2) / 2 * W % 1.0, (y1 + y2) / 2 * H % 1.0
+        bw, bh = (x2 - x1) * W, (y2 - y1) * H
+
+        coord = 0.0
+        for a, (aw, ah) in enumerate(self.anchors):
+            coord = coord + jnp.sum(obj[:, 0] * (
+                (tx[:, a] - cx) ** 2 + (ty[:, a] - cy) ** 2
+                + (tw[:, a] - jnp.log(jnp.maximum(bw / aw, 1e-6))) ** 2
+                + (th[:, a] - jnp.log(jnp.maximum(bh / ah, 1e-6))) ** 2))
+        conf_loss = jnp.sum(obj * (conf - 1.0) ** 2) + \
+            self.lambda_noobj * jnp.sum((1 - obj) * conf ** 2)
+        cls_loss = jnp.sum(obj[:, :, None] *
+                           (cls - labels[:, None, 4:]) ** 2)
+        n = jnp.maximum(jnp.sum(obj), 1.0)
+        return (self.lambda_coord * coord + conf_loss + cls_loss) / n
+
+
+@dataclasses.dataclass
+class Cnn3DLossLayer(Layer):
+    """Per-voxel loss on [B, C, D, H, W] (reference Cnn3DLossLayer.java)."""
+    loss: Union[str, Callable] = "mcxent"
+    activation: str = "softmax"
+
+    def forward(self, params, x, training=False, key=None):
+        xt = jnp.moveaxis(x, 1, -1)
+        return jnp.moveaxis(get_activation(self.activation)(xt), -1, 1)
+
+    def compute_loss(self, labels, output, mask=None):
+        c = output.shape[1]
+        lab = jnp.moveaxis(labels, 1, -1).reshape(-1, c)
+        out = jnp.moveaxis(output, 1, -1).reshape(-1, c)
+        return get_loss(self.loss)(lab, out,
+                                   mask.reshape(-1) if mask is not None else None)
+
+    def has_params(self):
+        return False
+
+
+# -- attention ------------------------------------------------------------
+@dataclasses.dataclass
+class LearnedSelfAttentionLayer(Layer):
+    """Attention with learned queries → fixed n_queries output timesteps
+    (reference LearnedSelfAttentionLayer.java)."""
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    n_queries: int = 1
+    head_size: int = None
+    weight_init: str = "xavier"
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        hs = self.head_size or (self.n_out // self.n_heads)
+        ks = jax.random.split(key, 5)
+        return {"Q": init_weights(ks[0], (self.n_queries, n_in), self.weight_init),
+                "Wq": init_weights(ks[1], (n_in, self.n_heads, hs), self.weight_init),
+                "Wk": init_weights(ks[2], (n_in, self.n_heads, hs), self.weight_init),
+                "Wv": init_weights(ks[3], (n_in, self.n_heads, hs), self.weight_init),
+                "Wo": init_weights(ks[4], (self.n_heads * hs, self.n_out),
+                                   self.weight_init)}
+
+    def forward(self, params, x, training=False, key=None):
+        xt = jnp.swapaxes(x, 1, 2)  # [B, T, F]
+        q = jnp.broadcast_to(params["Q"],
+                             (x.shape[0],) + params["Q"].shape)  # [B, nq, F]
+        out = nn_ops.multi_head_dot_product_attention(
+            q, xt, xt, params["Wq"], params["Wk"], params["Wv"], params["Wo"])
+        return jnp.swapaxes(out, 1, 2)  # [B, n_out, n_queries]
+
+    def output_type(self, input_type):
+        return (self.n_out, self.n_queries)
+
+
+@dataclasses.dataclass
+class RecurrentAttentionLayer(Layer):
+    """Recurrent cell whose input is augmented with attention over the full
+    sequence (reference RecurrentAttentionLayer.java) — lax.scan over time,
+    attention via one batched matmul per step."""
+    n_in: int = 0
+    n_out: int = 0
+    activation: str = "tanh"
+    weight_init: str = "xavier"
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        k1, k2, k3 = jax.random.split(key, 3)
+        return {"Wx": init_weights(k1, (n_in, self.n_out), self.weight_init),
+                "Wh": init_weights(k2, (self.n_out, self.n_out), self.weight_init),
+                "Wa": init_weights(k3, (n_in, self.n_out), self.weight_init),
+                "b": jnp.zeros((self.n_out,))}
+
+    def forward(self, params, x, training=False, key=None):
+        xt = jnp.swapaxes(x, 1, 2)  # [B, T, F]
+        act = get_activation(self.activation)
+        proj = jnp.einsum("btf,fo->bto", xt, params["Wa"])  # attention values
+
+        def step(h, x_t):
+            scores = jnp.einsum("bo,bto->bt", h, proj) / math.sqrt(self.n_out)
+            attn = jax.nn.softmax(scores, axis=-1)
+            a_t = jnp.einsum("bt,bto->bo", attn, proj)
+            h_new = act(x_t @ params["Wx"] + h @ params["Wh"] + a_t
+                        + params["b"])
+            return h_new, h_new
+
+        h0 = jnp.zeros((x.shape[0], self.n_out), x.dtype)
+        _, h_seq = jax.lax.scan(step, h0, jnp.swapaxes(xt, 0, 1))
+        return jnp.transpose(h_seq, (1, 2, 0))  # [B, n_out, T]
+
+    def output_type(self, input_type):
+        return (self.n_out, input_type[1])
+
+
+# -- frozen (transfer learning) -------------------------------------------
+@dataclasses.dataclass
+class FrozenLayer(Layer):
+    """Wrapper excluding inner params from training (reference
+    layers/FrozenLayer.java). Inner params are stored under `state_` keys,
+    which every network treats as non-trainable."""
+    underlying: Layer = None
+
+    PREFIX = "state_frozen__"
+
+    def init_params(self, key, input_type):
+        inner = self.underlying.init_params(key, input_type)
+        return {self.PREFIX + k: v for k, v in inner.items()}
+
+    @classmethod
+    def wrap_params(cls, inner_params):
+        """Freeze an existing param dict (used by TransferLearning)."""
+        return {cls.PREFIX + k if not k.startswith(cls.PREFIX) else k: v
+                for k, v in inner_params.items()}
+
+    def forward(self, params, x, training=False, key=None):
+        inner = {k[len(self.PREFIX):]: v for k, v in params.items()
+                 if k.startswith(self.PREFIX)}
+        # frozen layers run in inference mode (reference FrozenLayer semantics)
+        return self.underlying.forward(inner, x, training=False, key=key)
+
+    def output_type(self, input_type):
+        return self.underlying.output_type(input_type)
+
+    def has_params(self):
+        return self.underlying.has_params()
+
+
+# -- variational autoencoder ----------------------------------------------
+@dataclasses.dataclass
+class VariationalAutoencoder(Layer):
+    """VAE pretrain layer (reference layers/variational/VariationalAutoencoder.java).
+
+    forward() yields the latent mean (the reference's supervised-path
+    behavior); elbo_loss() is the unsupervised pretrain objective with a
+    gaussian reconstruction distribution.
+    """
+    n_in: int = 0
+    n_out: int = 0                     # latent size
+    encoder_layer_sizes: Sequence[int] = (64,)
+    decoder_layer_sizes: Sequence[int] = (64,)
+    activation: str = "lrelu"
+    weight_init: str = "xavier"
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        p = {}
+        sizes = [n_in] + list(self.encoder_layer_sizes)
+        for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+            key, k = jax.random.split(key)
+            p[f"eW{i}"] = init_weights(k, (a, b), self.weight_init)
+            p[f"eb{i}"] = jnp.zeros((b,))
+        key, k1, k2 = jax.random.split(key, 3)
+        p["Wmu"] = init_weights(k1, (sizes[-1], self.n_out), self.weight_init)
+        p["bmu"] = jnp.zeros((self.n_out,))
+        p["Wlv"] = init_weights(k2, (sizes[-1], self.n_out), self.weight_init)
+        p["blv"] = jnp.zeros((self.n_out,))
+        dsizes = [self.n_out] + list(self.decoder_layer_sizes)
+        for i, (a, b) in enumerate(zip(dsizes[:-1], dsizes[1:])):
+            key, k = jax.random.split(key)
+            p[f"dW{i}"] = init_weights(k, (a, b), self.weight_init)
+            p[f"db{i}"] = jnp.zeros((b,))
+        key, k = jax.random.split(key)
+        p["Wout"] = init_weights(k, (dsizes[-1], n_in), self.weight_init)
+        p["bout"] = jnp.zeros((n_in,))
+        return p
+
+    def _encode(self, params, x):
+        act = get_activation(self.activation)
+        h = x
+        for i in range(len(self.encoder_layer_sizes)):
+            h = act(h @ params[f"eW{i}"] + params[f"eb{i}"])
+        mu = h @ params["Wmu"] + params["bmu"]
+        logvar = h @ params["Wlv"] + params["blv"]
+        return mu, logvar
+
+    def _decode(self, params, z):
+        act = get_activation(self.activation)
+        h = z
+        for i in range(len(self.decoder_layer_sizes)):
+            h = act(h @ params[f"dW{i}"] + params[f"db{i}"])
+        return h @ params["Wout"] + params["bout"]
+
+    def forward(self, params, x, training=False, key=None):
+        return self._encode(params, x)[0]
+
+    def reconstruct(self, params, x):
+        return self._decode(params, self._encode(params, x)[0])
+
+    def elbo_loss(self, params, x, key):
+        mu, logvar = self._encode(params, x)
+        eps = jax.random.normal(key, mu.shape, mu.dtype)
+        z = mu + jnp.exp(0.5 * logvar) * eps
+        recon = self._decode(params, z)
+        rec_loss = jnp.sum((recon - x) ** 2, axis=-1)
+        kl = -0.5 * jnp.sum(1 + logvar - mu ** 2 - jnp.exp(logvar), axis=-1)
+        return jnp.mean(rec_loss + kl)
+
+    def output_type(self, input_type):
+        return (self.n_out,)
+
+    def needs_key(self):
+        return False
+
+
+# -- capsules -------------------------------------------------------------
+def _squash(s, axis=-1, eps=1e-8):
+    n2 = jnp.sum(s * s, axis=axis, keepdims=True)
+    return (n2 / (1 + n2)) * s / jnp.sqrt(n2 + eps)
+
+
+@dataclasses.dataclass
+class PrimaryCapsules(Layer):
+    """Conv → capsule reshape + squash (reference conf/layers/PrimaryCapsules.java)."""
+    n_in: int = 0
+    capsules: int = 8          # capsules per spatial position
+    capsule_dimensions: int = 8
+    kernel_size: Sequence[int] = (9, 9)
+    stride: Sequence[int] = (2, 2)
+    weight_init: str = "relu"
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        kh, kw = _pair(self.kernel_size)
+        cout = self.capsules * self.capsule_dimensions
+        return {"W": init_weights(key, (kh, kw, n_in, cout), self.weight_init),
+                "b": jnp.zeros((cout,))}
+
+    def forward(self, params, x, training=False, key=None):
+        out = conv_ops.conv2d(x, params["W"], params["b"],
+                              strides=_pair(self.stride), padding="VALID",
+                              data_format="NCHW")
+        b = out.shape[0]
+        caps = out.reshape(b, self.capsule_dimensions, -1)
+        caps = jnp.swapaxes(caps, 1, 2)  # [B, n_caps_total, dim]
+        return _squash(caps)
+
+    def output_type(self, input_type):
+        c, h, w = input_type
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        return (self.capsules * oh * ow, self.capsule_dimensions)
+
+
+@dataclasses.dataclass
+class CapsuleLayer(Layer):
+    """Dynamic-routing capsule layer (reference conf/layers/CapsuleLayer.java)."""
+    input_capsules: int = 0
+    input_capsule_dimensions: int = 0
+    capsules: int = 10
+    capsule_dimensions: int = 16
+    routings: int = 3
+    weight_init: str = "xavier"
+
+    def init_params(self, key, input_type):
+        n_caps = self.input_capsules or input_type[0]
+        in_dim = self.input_capsule_dimensions or input_type[1]
+        return {"W": init_weights(
+            key, (n_caps, self.capsules, self.capsule_dimensions, in_dim),
+            self.weight_init)}
+
+    def forward(self, params, x, training=False, key=None):
+        # x: [B, in_caps, in_dim]; prediction vectors u_hat [B,in,out,out_dim]
+        u_hat = jnp.einsum("bid,iokd->biok", x, params["W"])
+        b_logits = jnp.zeros(u_hat.shape[:3], x.dtype)
+        # fixed small routing iteration count → unrolled, XLA-friendly
+        for _ in range(self.routings):
+            c = jax.nn.softmax(b_logits, axis=2)
+            s = jnp.einsum("bio,biok->bok", c, u_hat)
+            v = _squash(s)
+            b_logits = b_logits + jnp.einsum("biok,bok->bio", u_hat, v)
+        return v
+
+    def output_type(self, input_type):
+        return (self.capsules, self.capsule_dimensions)
+
+
+@dataclasses.dataclass
+class CapsuleStrengthLayer(Layer):
+    """Capsule length per class (reference CapsuleStrengthLayer.java)."""
+
+    def forward(self, params, x, training=False, key=None):
+        return jnp.sqrt(jnp.sum(x * x, axis=-1) + 1e-8)
+
+    def output_type(self, input_type):
+        return (input_type[0],)
+
+    def has_params(self):
+        return False

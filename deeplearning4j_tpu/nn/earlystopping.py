@@ -146,12 +146,12 @@ class LocalFileModelSaver:
         net.save(self._latest_path, save_updater=True)
 
     def get_best_model(self):
-        from .multilayer import MultiLayerNetwork
-        return MultiLayerNetwork.load(self._path, load_updater=True)
+        from .serde import restore_model
+        return restore_model(self._path, load_updater=True)
 
     def get_latest_model(self):
-        from .multilayer import MultiLayerNetwork
-        return MultiLayerNetwork.load(self._latest_path, load_updater=True)
+        from .serde import restore_model
+        return restore_model(self._latest_path, load_updater=True)
 
 
 # -- config + trainer ----------------------------------------------------

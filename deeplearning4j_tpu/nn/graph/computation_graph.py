@@ -113,6 +113,13 @@ class ComputationGraphConfiguration:
 
     # -- serde -----------------------------------------------------------
     def to_json(self) -> str:
+        def pre_dict(pre):
+            if pre is None:
+                return None
+            return {"@class": type(pre).__name__,
+                    **(dataclasses.asdict(pre)
+                       if dataclasses.is_dataclass(pre) else {})}
+
         def vert(v):
             if isinstance(v, LayerVertex):
                 ld = {"@class": type(v.layer).__name__}
@@ -127,13 +134,15 @@ class ComputationGraphConfiguration:
                         fv = getattr(fv, "__name__", str(fv))
                     ld[f.name] = fv
                 return {"type": "layer", "layer": ld,
-                        "preprocessor": type(v.preprocessor).__name__
-                        if v.preprocessor is not None else None}
+                        "preprocessor": pre_dict(v.preprocessor)}
             d = {"type": "vertex", "@class": type(v).__name__}
             for f in dataclasses.fields(v):
                 fv = getattr(v, f.name)
-                if not isinstance(fv, (int, float, str, bool, tuple, list,
-                                       type(None))):
+                if isinstance(v, PreprocessorVertex) and \
+                        f.name == "preprocessor":
+                    fv = pre_dict(fv)
+                elif not isinstance(fv, (int, float, str, bool, tuple, list,
+                                         type(None))):
                     fv = str(fv)
                 d[f.name] = fv
             return d
@@ -169,18 +178,31 @@ class ComputationGraphConfiguration:
             C.CnnToFeedForwardPreProcessor, C.FeedForwardToCnnPreProcessor,
             C.RnnToFeedForwardPreProcessor, C.FeedForwardToRnnPreProcessor,
             C.CnnToRnnPreProcessor]}
+
+        def mk_pre(pd):
+            if pd is None:
+                return None
+            pd = dict(pd)
+            name = pd.pop("@class")
+            if name not in pre_classes:
+                raise ValueError(
+                    f"unknown preprocessor {name!r} in saved config; "
+                    f"known: {sorted(pre_classes)}")
+            return pre_classes[name](**pd)
+
         verts = {}
         for n, d in data["vertices"].items():
             if d["type"] == "layer":
-                pre = pre_classes[d["preprocessor"]]() \
-                    if d.get("preprocessor") else None
-                verts[n] = LayerVertex(mk_layer(d["layer"]), pre)
+                verts[n] = LayerVertex(mk_layer(d["layer"]),
+                                       mk_pre(d.get("preprocessor")))
             else:
                 d = dict(d)
                 d.pop("type")
                 cls = VERTEX_CLASSES[d.pop("@class")]
                 for k, v in d.items():
-                    if isinstance(v, list):
+                    if k == "preprocessor" and isinstance(v, dict):
+                        d[k] = mk_pre(v)
+                    elif isinstance(v, list):
                         d[k] = tuple(v)
                 verts[n] = cls(**d)
         return ComputationGraphConfiguration(
@@ -364,8 +386,37 @@ class ComputationGraph:
             outs.append((o, layer))
         return outs
 
-    def _compute_loss(self, params, inputs, labels, key):
-        acts = self._forward(params, inputs, training=True, key=key)
+    def _stateful_vertices(self):
+        """Vertex names whose layer carries non-trainable state (batchnorm
+        running stats, center-loss centers) — mirrors MultiLayerNetwork."""
+        out = []
+        for name in self._order:
+            v = self.conf.vertices[name]
+            layer = v.layer if isinstance(v, LayerVertex) else v
+            if hasattr(layer, "new_state"):
+                out.append(name)
+        return out
+
+    def _forward_collect_state(self, params, inputs, key):
+        """Forward pass that also returns each stateful vertex's input so the
+        train step can refresh running state without a second pass."""
+        acts: Dict[str, jax.Array] = dict(inputs)
+        state_inputs: Dict[str, jax.Array] = {}
+        stateful = set(self._stateful_vertices())
+        for name in self._order:
+            v = self.conf.vertices[name]
+            ins = [acts[i] for i in self.conf.vertex_inputs[name]]
+            if name in stateful:
+                state_inputs[name] = ins[0]
+            vkey = None
+            if key is not None and v.needs_key():
+                key, vkey = jax.random.split(key)
+            acts[name] = v.forward(params[name], ins, training=True, key=vkey)
+        return acts, state_inputs
+
+    def _compute_loss(self, params, inputs, labels, key, acts=None):
+        if acts is None:
+            acts = self._forward(params, inputs, training=True, key=key)
         loss = 0.0
         for (name, layer), y in zip(self._output_layers(), labels):
             loss = loss + layer.compute_loss(y, acts[name])
@@ -401,13 +452,28 @@ class ComputationGraph:
         grad_clip = self.conf.gradient_clip
         wd = self.conf.weight_decay
 
+        output_label_idx = {o: i for i, o in enumerate(self.conf.outputs)}
+
         def step(trainable, states, updater_state, iteration, inputs, labels,
                  key):
             def loss_fn(tr):
                 params = self._merge_states(tr, states)
-                return self._compute_loss(params, inputs, labels, key)
+                acts, state_inputs = self._forward_collect_state(params,
+                                                                 inputs, key)
+                loss = self._compute_loss(params, inputs, labels, key,
+                                          acts=acts)
+                return loss, state_inputs
 
-            loss, grads = jax.value_and_grad(loss_fn)(trainable)
+            (loss, state_inputs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(trainable)
+            new_states = dict(states)
+            for name, sx in state_inputs.items():
+                v = self.conf.vertices[name]
+                layer = v.layer if isinstance(v, LayerVertex) else v
+                y = labels[output_label_idx[name]] \
+                    if name in output_label_idx else None
+                new_states[name] = layer.new_state(states[name], sx, labels=y)
+            states = new_states
             if grad_norm == "clip_l2":
                 gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in
                                      jax.tree_util.tree_leaves(grads)))
@@ -420,7 +486,7 @@ class ComputationGraph:
                                                   iteration)
             new_trainable = jax.tree_util.tree_map(
                 lambda p, u: p - u.astype(p.dtype) - wd * p, trainable, update)
-            return new_trainable, updater_state, loss
+            return new_trainable, states, updater_state, loss
 
         return jax.jit(step, donate_argnums=(0, 2))
 
@@ -445,7 +511,7 @@ class ComputationGraph:
             for ds in data:
                 inputs, labs = self._split_dataset(ds)
                 self._rng_key, step_key = jax.random.split(self._rng_key)
-                trainable, ustate, loss = self._train_step(
+                trainable, states, ustate, loss = self._train_step(
                     trainable, states, ustate, self._iteration, inputs, labs,
                     step_key)
                 self._params = self._merge_states(trainable, states)

@@ -183,12 +183,13 @@ class MultiLayerNetwork:
             update, updater_state = updater.apply(grads, updater_state, iteration)
             new_trainable = jax.tree_util.tree_map(
                 lambda p, u: p - u.astype(p.dtype) - wd * p, trainable, update)
-            # batchnorm running stats from BN inputs collected in the fwd pass
+            # stateful layers (batchnorm running stats, center-loss centers)
+            # refresh from inputs collected during the fwd pass
             new_states = []
             for i, layer in enumerate(self.layers):
-                if isinstance(layer, BatchNormalization) and i in bn_inputs:
+                if hasattr(layer, "new_state") and i in bn_inputs:
                     new_states.append(layer.new_state(states[i],
-                                                      bn_inputs[i]))
+                                                      bn_inputs[i], labels=y))
                 else:
                     new_states.append(states[i])
             return new_trainable, new_states, updater_state, loss
@@ -208,7 +209,7 @@ class MultiLayerNetwork:
             pre = self.conf.preprocessors.get(i)
             if pre is not None:
                 h = pre(h)
-            if isinstance(layer, BatchNormalization):
+            if hasattr(layer, "new_state"):
                 bn_inputs[i] = h
             layer_key = None
             if key is not None and layer.needs_key():

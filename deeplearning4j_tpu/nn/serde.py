@@ -139,3 +139,13 @@ def restore_computation_graph(path, load_updater: bool = False):
         net._updater_state = jax.tree_util.tree_unflatten(treedef,
                                                           updater_leaves)
     return net
+
+
+def restore_model(path, load_updater: bool = False):
+    """Type-dispatching loader (reference ModelSerializer.restore* family):
+    reads meta.json's model_type and returns the right network class."""
+    with zipfile.ZipFile(path) as z:
+        meta = json.loads(z.read("meta.json"))
+    if meta.get("model_type") == "ComputationGraph":
+        return restore_computation_graph(path, load_updater)
+    return restore_multilayer(path, load_updater)

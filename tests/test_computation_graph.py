@@ -13,8 +13,8 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.learning import Adam
 from deeplearning4j_tpu.nn.conf.config import InputType, NeuralNetConfiguration
-from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, LossLayer,
-                                               OutputLayer)
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
+                                               LossLayer, OutputLayer)
 from deeplearning4j_tpu.nn.graph import (AttentionVertex, ComputationGraph,
                                          ComputationGraphConfiguration,
                                          ElementWiseVertex, L2NormalizeVertex,
@@ -218,3 +218,79 @@ class TestGraphSerde:
         o1 = np.asarray(net.output(x)[0].jax())
         o2 = np.asarray(clone.output(x)[0].jax())
         assert not np.allclose(o1, o2)
+
+
+class TestReviewRegressions:
+    def test_cg_batchnorm_state_updates(self):
+        """CG fit must refresh BatchNormalization running stats (review
+        finding: states were frozen at init)."""
+        from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
+        conf = (NeuralNetConfiguration.builder().updater(Adam(1e-2))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_in=4, n_out=6,
+                                           activation="identity"), "in")
+                .add_layer("bn", BatchNormalization(n_out=6), "d")
+                .add_layer("out", OutputLayer(n_in=6, n_out=2), "bn")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.RandomState(5)
+        x = (rng.randn(32, 4) * 5 + 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 32)]
+        net.fit(DataSet(x, y), num_epochs=10)
+        mean = np.asarray(net._params["bn"]["state_mean"])
+        var = np.asarray(net._params["bn"]["state_var"])
+        assert not np.allclose(mean, 0.0)
+        assert not np.allclose(var, 1.0)
+
+    def test_preprocessor_serde_keeps_args(self):
+        """Parameterized preprocessors round-trip with their fields (review
+        finding: args were dropped)."""
+        from deeplearning4j_tpu.nn.conf.config import \
+            FeedForwardToCnnPreProcessor
+        conf = (NeuralNetConfiguration.builder().graph_builder()
+                .add_inputs("in")
+                .add_layer("c", ConvolutionLayer(n_in=3, n_out=4,
+                                                 kernel_size=(3, 3)),
+                           "in",
+                           preprocessor=FeedForwardToCnnPreProcessor(3, 4, 4))
+                .add_layer("out", OutputLayer(n_in=4 * 2 * 2, n_out=2), "c",
+                           preprocessor=None)
+                .set_outputs("out").build())
+        conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        pre = conf2.vertices["c"].preprocessor
+        assert pre.channels == 3 and pre.height == 4 and pre.width == 4
+
+    def test_preprocessor_vertex_serde(self):
+        from deeplearning4j_tpu.nn.conf.config import \
+            CnnToFeedForwardPreProcessor
+        from deeplearning4j_tpu.nn.graph import PreprocessorVertex
+        conf = (NeuralNetConfiguration.builder().graph_builder()
+                .add_inputs("in")
+                .add_vertex("flat", PreprocessorVertex(
+                    preprocessor=CnnToFeedForwardPreProcessor()), "in")
+                .add_layer("out", OutputLayer(n_in=12, n_out=2), "flat")
+                .set_outputs("out").build())
+        conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        v = conf2.vertices["flat"]
+        x = jnp.ones((2, 3, 2, 2))
+        assert v.forward({}, [x]).shape == (2, 12)
+
+    def test_early_stopping_with_cg(self, tmp_path):
+        """LocalFileModelSaver round-trips a ComputationGraph (review
+        finding: loader was hardcoded to MultiLayerNetwork)."""
+        from deeplearning4j_tpu.nn.earlystopping import (
+            EarlyStoppingConfiguration, EarlyStoppingTrainer,
+            LocalFileModelSaver, MaxEpochsTerminationCondition)
+        net = ComputationGraph(simple_graph()).init()
+        rng = np.random.RandomState(6)
+        x = rng.randn(16, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+        ds = DataSet(x, y)
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+            model_saver=LocalFileModelSaver(str(tmp_path)))
+        result = EarlyStoppingTrainer(cfg, net).fit([ds])
+        best = result.get_best_model()
+        assert isinstance(best, ComputationGraph)
+        assert best.output(x)[0].shape == (16, 3)

@@ -1,0 +1,318 @@
+"""Extended layer catalog tests.
+
+Models the reference's per-layer tests in
+platform-tests/.../dl4jcore/nn/layers/ (shape inference + forward shape
+agreement, plus train-ability for parameterized layers).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.conf.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+
+RNG = np.random.RandomState(0)
+
+
+def check_layer(layer, input_type, batch=2, training=False):
+    """init → forward → assert output shape matches output_type inference."""
+    key = jax.random.key(0)
+    params = layer.init_params(key, input_type) if layer.has_params() else {}
+    x = jnp.asarray(RNG.randn(batch, *input_type).astype(np.float32))
+    out = layer.forward(params, x, training=training,
+                        key=key if layer.needs_key() else None)
+    expect = layer.output_type(input_type)
+    assert out.shape == (batch,) + tuple(expect), \
+        f"{type(layer).__name__}: {out.shape} != {(batch,) + tuple(expect)}"
+    assert bool(jnp.all(jnp.isfinite(out)))
+    return params, x, out
+
+
+class TestConv3DFamily:
+    def test_conv3d(self):
+        check_layer(L.Convolution3D(n_in=2, n_out=4, kernel_size=(3, 3, 3),
+                                    padding="SAME"), (2, 6, 6, 6))
+
+    def test_conv3d_valid(self):
+        check_layer(L.Convolution3D(n_in=2, n_out=4, kernel_size=(3, 3, 3),
+                                    padding=(0, 0, 0)), (2, 6, 6, 6))
+
+    def test_subsampling3d(self):
+        check_layer(L.Subsampling3DLayer(kernel_size=(2, 2, 2)), (3, 4, 4, 4))
+        check_layer(L.Subsampling3DLayer(pooling_type="avg"), (3, 4, 4, 4))
+
+    def test_upsampling3d(self):
+        check_layer(L.Upsampling3D(size=(2, 2, 2)), (3, 2, 2, 2))
+
+    def test_cropping_padding_3d(self):
+        check_layer(L.Cropping3D(cropping=(1, 1, 1, 1, 1, 1)), (2, 4, 4, 4))
+        check_layer(L.ZeroPadding3DLayer(padding=(1, 1, 1, 1, 1, 1)),
+                    (2, 4, 4, 4))
+
+
+class TestConv1DFamily:
+    def test_subsampling1d(self):
+        check_layer(L.Subsampling1DLayer(kernel_size=2), (3, 8))
+
+    def test_upsampling1d(self):
+        check_layer(L.Upsampling1D(size=3), (3, 4))
+
+    def test_cropping1d(self):
+        check_layer(L.Cropping1D(cropping=(1, 2)), (3, 8))
+
+    def test_zeropadding1d(self):
+        check_layer(L.ZeroPadding1DLayer(padding=(2, 1)), (3, 8))
+
+    def test_cropping2d(self):
+        check_layer(L.Cropping2D(cropping=(1, 1, 2, 0)), (2, 6, 6))
+
+
+class TestRecurrent:
+    def test_simple_rnn(self):
+        check_layer(L.SimpleRnn(n_in=4, n_out=6), (4, 7))
+
+    def test_gru(self):
+        check_layer(L.GRU(n_in=4, n_out=6), (4, 7))
+
+    def test_last_time_step(self):
+        check_layer(L.LastTimeStep(underlying=L.LSTM(n_in=4, n_out=6)), (4, 7))
+
+    def test_time_distributed(self):
+        check_layer(L.TimeDistributed(
+            underlying=L.DenseLayer(n_in=4, n_out=6, activation="relu")),
+            (4, 7))
+
+    def test_mask_zero(self):
+        layer = L.MaskZeroLayer(underlying=L.SimpleRnn(n_in=3, n_out=5))
+        key = jax.random.key(0)
+        params = layer.init_params(key, (3, 6))
+        x = np.ones((2, 3, 6), np.float32)
+        x[:, :, 4:] = 0.0  # padding timesteps
+        out = layer.forward(params, jnp.asarray(x))
+        assert np.allclose(np.asarray(out)[:, :, 4:], 0.0)
+        assert not np.allclose(np.asarray(out)[:, :, :4], 0.0)
+
+
+class TestLocallyConnected:
+    def test_lc2d(self):
+        check_layer(L.LocallyConnected2D(n_in=2, n_out=4, kernel_size=(3, 3)),
+                    (2, 6, 6))
+
+    def test_lc1d(self):
+        check_layer(L.LocallyConnected1D(n_in=3, n_out=5, kernel_size=3),
+                    (3, 8))
+
+    def test_lc2d_vs_conv_param_count(self):
+        # unshared weights: param count = positions * shared-conv params
+        lc = L.LocallyConnected2D(n_in=2, n_out=4, kernel_size=(3, 3),
+                                  has_bias=False)
+        p = lc.init_params(jax.random.key(0), (2, 6, 6))
+        assert p["W"].shape == (16, 2 * 9, 4)
+
+
+class TestElementwiseShape:
+    def test_prelu(self):
+        layer = L.PReLULayer(n_in=4)
+        p, x, out = check_layer(layer, (4,))
+        neg = jnp.asarray(-np.ones((2, 4), np.float32))
+        assert np.allclose(layer.forward(p, neg), -0.25)
+
+    def test_elementwise_mult(self):
+        check_layer(L.ElementWiseMultiplicationLayer(n_in=5), (5,))
+
+    def test_repeat_vector(self):
+        check_layer(L.RepeatVector(n=4), (3,))
+
+    def test_space_depth_roundtrip(self):
+        s2d = L.SpaceToDepthLayer(block_size=2)
+        d2s = L.DepthToSpaceLayer(block_size=2)
+        x = jnp.asarray(RNG.randn(2, 3, 4, 4).astype(np.float32))
+        y = s2d.forward({}, x)
+        assert y.shape == (2, 12, 2, 2)
+        z = d2s.forward({}, y)
+        assert np.allclose(z, x, atol=1e-6)
+
+    def test_mask_layer(self):
+        check_layer(L.MaskLayer(), (4,))
+
+
+class TestDropoutVariants:
+    def test_gaussian_dropout(self):
+        check_layer(L.GaussianDropout(rate=0.5), (8,), training=True)
+
+    def test_gaussian_noise(self):
+        layer = L.GaussianNoise(stddev=0.1)
+        x = jnp.ones((2, 8))
+        out_train = layer.forward({}, x, training=True, key=jax.random.key(1))
+        out_infer = layer.forward({}, x, training=False)
+        assert not np.allclose(out_train, x)
+        assert np.allclose(out_infer, x)
+
+    def test_alpha_dropout(self):
+        check_layer(L.AlphaDropout(rate=0.3), (8,), training=True)
+
+
+class TestLossHeads:
+    def test_cnn_loss_layer(self):
+        layer = L.CnnLossLayer()
+        x = jnp.asarray(RNG.randn(2, 3, 4, 4).astype(np.float32))
+        out = layer.forward({}, x)
+        # softmax over channels
+        assert np.allclose(np.asarray(out).sum(1), 1.0, atol=1e-5)
+        labels = jax.nn.one_hot(jnp.zeros((2, 4, 4), jnp.int32), 3, axis=1)
+        loss = layer.compute_loss(labels, out)
+        assert float(loss) > 0
+
+    def test_rnn_loss_layer(self):
+        layer = L.RnnLossLayer()
+        x = jnp.asarray(RNG.randn(2, 3, 5).astype(np.float32))
+        out = layer.forward({}, x)
+        labels = jax.nn.one_hot(jnp.zeros((2, 5), jnp.int32), 3, axis=1)
+        assert float(layer.compute_loss(labels, out)) > 0
+
+    def test_cnn3d_loss_layer(self):
+        layer = L.Cnn3DLossLayer()
+        x = jnp.asarray(RNG.randn(2, 3, 2, 4, 4).astype(np.float32))
+        out = layer.forward({}, x)
+        labels = jax.nn.one_hot(jnp.zeros((2, 2, 4, 4), jnp.int32), 3, axis=1)
+        assert float(layer.compute_loss(labels, out)) > 0
+
+    def test_yolo2_loss(self):
+        layer = L.Yolo2OutputLayer(anchors=((1.0, 1.0), (2.0, 2.0)))
+        B, H, W, C = 2, 4, 4, 3
+        x = jnp.asarray(RNG.randn(B, 2 * (5 + C), H, W).astype(np.float32))
+        labels = np.zeros((B, 4 + C, H, W), np.float32)
+        labels[0, :4, 1, 1] = [0.1, 0.1, 0.3, 0.3]  # one box
+        labels[0, 4, 1, 1] = 1.0                     # class 0
+        loss = layer.compute_loss(jnp.asarray(labels), layer.forward({}, x))
+        assert np.isfinite(float(loss)) and float(loss) > 0
+
+    def test_center_loss_trains(self):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder().updater(Adam(1e-2)).list()
+                .layer(L.DenseLayer(n_in=4, n_out=8, activation="relu"))
+                .layer(L.CenterLossOutputLayer(n_in=8, n_out=3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = RNG.randn(16, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[RNG.randint(0, 3, 16)]
+        before = net.score(DataSet(x, y))
+        net.fit(DataSet(x, y), num_epochs=20)
+        assert net.score(DataSet(x, y)) < before
+        # centers moved away from zero init
+        centers = net._params[1]["state_centers"]
+        assert float(jnp.abs(centers).sum()) > 0
+
+
+class TestAttentionLayers:
+    def test_learned_self_attention(self):
+        check_layer(L.LearnedSelfAttentionLayer(n_in=6, n_out=8, n_heads=2,
+                                                n_queries=3), (6, 10))
+
+    def test_recurrent_attention(self):
+        check_layer(L.RecurrentAttentionLayer(n_in=6, n_out=8), (6, 10))
+
+
+class TestFrozen:
+    def test_frozen_params_not_trained(self):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        inner = L.DenseLayer(n_in=4, n_out=8, activation="relu")
+        conf = (NeuralNetConfiguration.builder().updater(Adam(1e-2)).list()
+                .layer(L.FrozenLayer(underlying=inner))
+                .layer(L.OutputLayer(n_in=8, n_out=3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        w_before = np.asarray(net._params[0][L.FrozenLayer.PREFIX + "W"])
+        x = RNG.randn(8, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[RNG.randint(0, 3, 8)]
+        net.fit(DataSet(x, y), num_epochs=5)
+        w_after = np.asarray(net._params[0][L.FrozenLayer.PREFIX + "W"])
+        np.testing.assert_array_equal(w_before, w_after)
+        # but the output layer did train
+        assert net.score(DataSet(x, y)) < 2.0
+
+
+class TestVAE:
+    def test_vae_shapes(self):
+        check_layer(L.VariationalAutoencoder(
+            n_in=10, n_out=4, encoder_layer_sizes=(16,),
+            decoder_layer_sizes=(16,)), (10,))
+
+    def test_vae_elbo_decreases(self):
+        vae = L.VariationalAutoencoder(n_in=10, n_out=3,
+                                       encoder_layer_sizes=(16,),
+                                       decoder_layer_sizes=(16,))
+        params = vae.init_params(jax.random.key(0), (10,))
+        x = jnp.asarray(RNG.randn(32, 10).astype(np.float32))
+        opt = Adam(1e-2)
+        state = opt.init(params)
+
+        @jax.jit
+        def step(params, state, i, key):
+            loss, g = jax.value_and_grad(
+                lambda p: vae.elbo_loss(p, x, key))(params)
+            upd, state = opt.apply(g, state, i)
+            params = jax.tree_util.tree_map(lambda p, u: p - u, params, upd)
+            return params, state, loss
+
+        key = jax.random.key(1)
+        first = None
+        for i in range(60):
+            key, k = jax.random.split(key)
+            params, state, loss = step(params, state, i, k)
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first * 0.8
+
+    def test_vae_reconstruct(self):
+        vae = L.VariationalAutoencoder(n_in=6, n_out=2)
+        params = vae.init_params(jax.random.key(0), (6,))
+        x = jnp.ones((3, 6))
+        assert vae.reconstruct(params, x).shape == (3, 6)
+
+
+class TestCapsules:
+    def test_primary_capsules(self):
+        check_layer(L.PrimaryCapsules(n_in=2, capsules=4,
+                                      capsule_dimensions=8,
+                                      kernel_size=(3, 3), stride=(2, 2)),
+                    (2, 12, 12))
+
+    def test_capsule_layer_routing(self):
+        check_layer(L.CapsuleLayer(input_capsules=6, input_capsule_dimensions=4,
+                                   capsules=3, capsule_dimensions=8,
+                                   routings=2), (6, 4))
+
+    def test_capsule_strength(self):
+        layer = L.CapsuleStrengthLayer()
+        x = jnp.asarray(RNG.randn(2, 5, 8).astype(np.float32))
+        out = layer.forward({}, x)
+        assert out.shape == (2, 5)
+        # lengths are in [0, inf); squashed capsules give < 1
+        assert bool(jnp.all(out >= 0))
+
+    def test_capsnet_end_to_end(self):
+        """Mini CapsNet (reference CapsNet zoo-style construction)."""
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder().updater(Adam(1e-2)).list()
+                .layer(L.ConvolutionLayer(n_in=1, n_out=4, kernel_size=(3, 3),
+                                          activation="relu"))
+                .layer(L.PrimaryCapsules(n_in=4, capsules=2,
+                                         capsule_dimensions=4,
+                                         kernel_size=(3, 3), stride=(2, 2)))
+                .layer(L.CapsuleLayer(capsules=2, capsule_dimensions=4,
+                                      routings=2))
+                .layer(L.CapsuleStrengthLayer())
+                .layer(L.LossLayer(loss="mse", activation="softmax"))
+                .set_input_type(InputType.convolutional(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = RNG.randn(4, 1, 8, 8).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[RNG.randint(0, 2, 4)]
+        net.fit(DataSet(x, y), num_epochs=3)
+        out = net.output(x)
+        assert out.shape == (4, 2)
