@@ -128,9 +128,14 @@ class Subsampling3DLayer(Layer):
         kd, kh, kw = _triple(self.kernel_size)
         s = self.stride if self.stride is not None else self.kernel_size
         sd, sh, sw = _triple(s)
-        if isinstance(self.padding, str) and self.padding.upper() == "SAME":
-            return (c, -(-d // sd), -(-h // sh), -(-w // sw))
-        return (c, (d - kd) // sd + 1, (h - kh) // sh + 1, (w - kw) // sw + 1)
+        if isinstance(self.padding, str):
+            if self.padding.upper() == "SAME":
+                return (c, -(-d // sd), -(-h // sh), -(-w // sw))
+            pd = ph = pw = 0
+        else:
+            pd, ph, pw = _triple(self.padding)
+        return (c, (d + 2 * pd - kd) // sd + 1, (h + 2 * ph - kh) // sh + 1,
+                (w + 2 * pw - kw) // sw + 1)
 
     def has_params(self):
         return False
@@ -683,9 +688,6 @@ class CenterLossOutputLayer(OutputLayer):
         return p
 
     def forward(self, params, x, training=False, key=None):
-        # stashed for compute_loss, which runs inside the same trace
-        self._last_features = x
-        self._centers = params["state_centers"]
         return super().forward(params, x, training, key)
 
     def new_state(self, params, x, labels=None):
@@ -703,13 +705,18 @@ class CenterLossOutputLayer(OutputLayer):
         return {"state_centers": new}
 
     def compute_loss(self, labels, output, mask=None):
+        # without features only the softmax term is computable; the full loss
+        # goes through compute_loss_ext (called by MLN/CG, which thread the
+        # layer's input features through the trace — no hidden state)
+        return get_loss(self.loss)(labels, output, mask)
+
+    def compute_loss_ext(self, params, labels, output, features, mask=None):
+        """Full center loss: mcxent + lambda/2 * mean ||f - c_y||^2."""
         base = get_loss(self.loss)(labels, output, mask)
-        feats = getattr(self, "_last_features", None)
-        centers = getattr(self, "_centers", None)
-        if centers is None or feats is None:
+        if features is None:
             return base
-        cls_centers = jnp.matmul(labels, centers)  # [B, n_in]
-        center = jnp.mean(jnp.sum((feats - cls_centers) ** 2, axis=-1))
+        cls_centers = jnp.matmul(labels, params["state_centers"])  # [B, n_in]
+        center = jnp.mean(jnp.sum((features - cls_centers) ** 2, axis=-1))
         return base + 0.5 * self.lambda_ * center
 
 

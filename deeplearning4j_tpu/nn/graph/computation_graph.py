@@ -120,20 +120,22 @@ class ComputationGraphConfiguration:
                     **(dataclasses.asdict(pre)
                        if dataclasses.is_dataclass(pre) else {})}
 
+        def layer_dict(layer):
+            # same recursive scheme as MultiLayerConfiguration.to_json, so
+            # wrapper layers (MaskZero(LastTimeStep(LSTM)) etc.) round-trip
+            d = {"@class": type(layer).__name__}
+            for f in dataclasses.fields(layer):
+                fv = getattr(layer, f.name)
+                if isinstance(fv, L.Layer):
+                    fv = layer_dict(fv)
+                elif callable(fv) and not isinstance(fv, str):
+                    fv = getattr(fv, "__name__", str(fv))
+                d[f.name] = fv
+            return d
+
         def vert(v):
             if isinstance(v, LayerVertex):
-                ld = {"@class": type(v.layer).__name__}
-                for f in dataclasses.fields(v.layer):
-                    fv = getattr(v.layer, f.name)
-                    if isinstance(fv, L.Layer):
-                        fv2 = {"@class": type(fv).__name__}
-                        for g in dataclasses.fields(fv):
-                            fv2[g.name] = getattr(fv, g.name)
-                        fv = fv2
-                    elif callable(fv) and not isinstance(fv, str):
-                        fv = getattr(fv, "__name__", str(fv))
-                    ld[f.name] = fv
-                return {"type": "layer", "layer": ld,
+                return {"type": "layer", "layer": layer_dict(v.layer),
                         "preprocessor": pre_dict(v.preprocessor)}
             d = {"type": "vertex", "@class": type(v).__name__}
             for f in dataclasses.fields(v):
@@ -337,15 +339,29 @@ class ComputationGraph:
         return {n: {**trainable[n], **states[n]} for n in trainable}
 
     # -- forward ---------------------------------------------------------
-    def _forward(self, params, inputs: Dict[str, jax.Array], training, key=None):
+    def _forward(self, params, inputs: Dict[str, jax.Array], training,
+                 key=None, collect_state=False):
+        """Topological forward. With collect_state, also returns each stateful
+        vertex's actual layer input (post-preprocessor) so the train step can
+        refresh running state (batchnorm etc.) without a second pass."""
         acts: Dict[str, jax.Array] = dict(inputs)
+        state_inputs: Dict[str, jax.Array] = {}
+        stateful = set(self._stateful_vertices()) if collect_state else ()
         for name in self._order:
             v = self.conf.vertices[name]
             ins = [acts[i] for i in self.conf.vertex_inputs[name]]
+            if name in stateful:
+                si = ins[0]
+                pre = getattr(v, "preprocessor", None)
+                if pre is not None:
+                    si = pre(si)
+                state_inputs[name] = si
             vkey = None
             if training and key is not None and v.needs_key():
                 key, vkey = jax.random.split(key)
             acts[name] = v.forward(params[name], ins, training=training, key=vkey)
+        if collect_state:
+            return acts, state_inputs
         return acts
 
     def _inputs_dict(self, inputs) -> Dict[str, jax.Array]:
@@ -398,28 +414,26 @@ class ComputationGraph:
         return out
 
     def _forward_collect_state(self, params, inputs, key):
-        """Forward pass that also returns each stateful vertex's input so the
-        train step can refresh running state without a second pass."""
-        acts: Dict[str, jax.Array] = dict(inputs)
-        state_inputs: Dict[str, jax.Array] = {}
-        stateful = set(self._stateful_vertices())
-        for name in self._order:
-            v = self.conf.vertices[name]
-            ins = [acts[i] for i in self.conf.vertex_inputs[name]]
-            if name in stateful:
-                state_inputs[name] = ins[0]
-            vkey = None
-            if key is not None and v.needs_key():
-                key, vkey = jax.random.split(key)
-            acts[name] = v.forward(params[name], ins, training=True, key=vkey)
-        return acts, state_inputs
+        return self._forward(params, inputs, training=True, key=key,
+                             collect_state=True)
 
-    def _compute_loss(self, params, inputs, labels, key, acts=None):
+    def _compute_loss(self, params, inputs, labels, key, acts=None,
+                      state_inputs=None):
         if acts is None:
-            acts = self._forward(params, inputs, training=True, key=key)
+            if any(hasattr(l, "compute_loss_ext")
+                   for _, l in self._output_layers()):
+                acts, state_inputs = self._forward(params, inputs,
+                                                   training=True, key=key,
+                                                   collect_state=True)
+            else:
+                acts = self._forward(params, inputs, training=True, key=key)
         loss = 0.0
         for (name, layer), y in zip(self._output_layers(), labels):
-            loss = loss + layer.compute_loss(y, acts[name])
+            if hasattr(layer, "compute_loss_ext") and state_inputs is not None:
+                loss = loss + layer.compute_loss_ext(
+                    params[name], y, acts[name], state_inputs.get(name))
+            else:
+                loss = loss + layer.compute_loss(y, acts[name])
         if self.conf.l2 > 0 or self.conf.l1 > 0:
             for p in self._trainable(params).values():
                 for v in p.values():
@@ -461,7 +475,8 @@ class ComputationGraph:
                 acts, state_inputs = self._forward_collect_state(params,
                                                                  inputs, key)
                 loss = self._compute_loss(params, inputs, labels, key,
-                                          acts=acts)
+                                          acts=acts,
+                                          state_inputs=state_inputs)
                 return loss, state_inputs
 
             (loss, state_inputs), grads = jax.value_and_grad(

@@ -127,8 +127,14 @@ class MultiLayerNetwork:
 
     def _compute_loss(self, trainable, x, y, key, mask=None):
         params = self._merge(self._params, trainable)
-        out = self._forward(params, x, training=True, key=key)
-        loss = self._loss_layer().compute_loss(y, out, mask)
+        ll = self._loss_layer()
+        li = len(self.layers) - 1
+        if hasattr(ll, "compute_loss_ext"):
+            out, coll = self._forward_collect_bn(params, x, key)
+            loss = ll.compute_loss_ext(params[li], y, out, coll.get(li), mask)
+        else:
+            out = self._forward(params, x, training=True, key=key)
+            loss = ll.compute_loss(y, out, mask)
         # L1/L2/weight-decay regularization (reference BaseLayer.calcRegularizationScore)
         if self.conf.l2 > 0 or self.conf.l1 > 0:
             for p in trainable:
@@ -159,7 +165,13 @@ class MultiLayerNetwork:
             def loss_fn(tr):
                 params = self._merge_states(tr, states)
                 out, bn_inputs = self._forward_collect_bn(params, x, key)
-                loss = self._loss_layer().compute_loss(y, out)
+                ll = self._loss_layer()
+                li = len(self.layers) - 1
+                if hasattr(ll, "compute_loss_ext"):
+                    loss = ll.compute_loss_ext(params[li], y, out,
+                                               bn_inputs.get(li))
+                else:
+                    loss = ll.compute_loss(y, out)
                 if self.conf.l2 > 0 or self.conf.l1 > 0:
                     for p in tr:
                         for v in p.values():

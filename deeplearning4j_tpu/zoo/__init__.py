@@ -1,0 +1,15 @@
+"""Model zoo — the 16 reference architectures
+(reference `deeplearning4j-zoo/src/main/java/org/deeplearning4j/zoo/model/`).
+"""
+from .base import PretrainedType, ZooModel, set_weights_fetcher
+from .models import (AlexNet, Darknet19, LeNet, SimpleCNN, TextGenerationLSTM,
+                     TinyYOLO, VGG16, VGG19)
+from .models_graph import (FaceNetNN4Small2, InceptionResNetV1, NASNet,
+                           ResNet50, SqueezeNet, UNet, Xception, YOLO2)
+
+__all__ = [
+    "ZooModel", "PretrainedType", "set_weights_fetcher",
+    "AlexNet", "Darknet19", "FaceNetNN4Small2", "InceptionResNetV1", "LeNet",
+    "NASNet", "ResNet50", "SimpleCNN", "SqueezeNet", "TextGenerationLSTM",
+    "TinyYOLO", "UNet", "VGG16", "VGG19", "Xception", "YOLO2",
+]

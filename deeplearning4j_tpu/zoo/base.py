@@ -1,0 +1,89 @@
+"""Zoo base: instantiable named architectures with optional pretrained weights.
+
+Reference: `deeplearning4j-zoo/src/main/java/org/deeplearning4j/zoo/ZooModel.java`
+(download + checksum + restore flow) and `zoo/ModelMetaData.java`.
+
+TPU redesign: models are plain config builders over the NN config DSL; the
+whole net lowers to one jitted XLA program, so there is no per-model native
+helper selection. Pretrained weights load from a local file (zip produced by
+our ModelSerializer) — remote fetch is pluggable via `weights_fetcher`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+from typing import Callable, Optional, Tuple
+
+
+class PretrainedType:
+    IMAGENET = "imagenet"
+    MNIST = "mnist"
+    CIFAR10 = "cifar10"
+    VGGFACE = "vggface"
+    SEGMENT = "segment"
+
+
+#: Optional hook: (model_name, pretrained_type) -> local file path.
+#: The reference downloads from azure blob storage + md5-checks
+#: (ZooModel.java `initPretrained`); here the fetch transport is injectable
+#: so air-gapped installs can point at a mirror. Set via set_weights_fetcher.
+weights_fetcher: Optional[Callable[[str, str], str]] = None
+
+
+def set_weights_fetcher(fn: Optional[Callable[[str, str], str]]) -> None:
+    """Register the pretrained-weights fetch hook (read by init_pretrained)."""
+    global weights_fetcher
+    weights_fetcher = fn
+
+
+def _md5(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class ZooModel:
+    """Base class for zoo architectures (reference zoo/ZooModel.java)."""
+    num_classes: int = 1000
+    seed: int = 123
+    input_shape: Tuple[int, int, int] = (3, 224, 224)  # (C, H, W)
+
+    #: md5 of the pretrained artifact, when one is published
+    pretrained_checksums: dict = dataclasses.field(default_factory=dict)
+
+    def init_model(self):
+        """Build + init the network (MultiLayerNetwork or ComputationGraph)."""
+        raise NotImplementedError
+
+    def pretrained_available(self, ptype: str = PretrainedType.IMAGENET) -> bool:
+        return ptype in self.pretrained_checksums
+
+    def init_pretrained(self, ptype: str = PretrainedType.IMAGENET,
+                        path: Optional[str] = None):
+        """Load pretrained weights (reference ZooModel.initPretrained).
+
+        `path` points at a locally available artifact; otherwise the module
+        `weights_fetcher` hook is consulted. Checksum-verified when the model
+        publishes one.
+        """
+        name = type(self).__name__
+        if path is None:
+            if weights_fetcher is None:
+                raise RuntimeError(
+                    f"No pretrained weights path given for {name} and no "
+                    "weights_fetcher registered (offline environment); pass "
+                    "path= to a locally downloaded artifact")
+            path = weights_fetcher(name, ptype)
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        want = self.pretrained_checksums.get(ptype)
+        if want is not None and _md5(path) != want:
+            raise ValueError(f"checksum mismatch for {name}:{ptype}")
+        from ..nn import serde
+        # the artifact carries config + ALL params incl. state_* running
+        # stats (BN means/vars), which set_params(loaded.params()) would drop
+        return serde.restore_model(path)

@@ -1,0 +1,95 @@
+"""Regression tests for review findings (post-hoc fixes).
+
+Covers: stateful-vertex input collected post-preprocessor in CG fit;
+Subsampling3D shape inference with numeric padding; CenterLossOutputLayer
+purity (no tracer leaks); CG JSON round-trip of doubly-wrapped layers.
+"""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.conf.config import (CnnToFeedForwardPreProcessor,
+                                               InputType,
+                                               NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               LSTM, OutputLayer)
+from deeplearning4j_tpu.nn.conf.layers_extra import (CenterLossOutputLayer,
+                                                     LastTimeStep,
+                                                     MaskZeroLayer,
+                                                     Subsampling3DLayer)
+from deeplearning4j_tpu.nn.graph.computation_graph import (
+    ComputationGraph, ComputationGraphConfiguration)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def test_cg_fit_with_bn_behind_preprocessor():
+    """Stateful vertex (BN) behind a preprocessor: new_state must see the
+    post-preprocessor (flattened) input, not the raw NCHW tensor."""
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.convolutional(4, 4, 2))
+            .add_layer("conv", ConvolutionLayer(n_out=2, kernel_size=(1, 1)),
+                       "in")
+            .add_layer("bn", BatchNormalization(n_out=32), "conv",
+                       preprocessor=CnnToFeedForwardPreProcessor())
+            .add_layer("out", OutputLayer(n_in=32, n_out=3), "bn")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    x = np.random.RandomState(0).randn(6, 2, 4, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.arange(6) % 3]
+    net.fit(DataSet(x, y), num_epochs=2)  # raised TypeError before the fix
+    assert net.output(x)[0].shape == (6, 3)
+
+
+def test_subsampling3d_output_type_with_padding():
+    layer = Subsampling3DLayer(kernel_size=(2, 2, 2), padding=(1, 1, 1))
+    inferred = layer.output_type((3, 4, 4, 4))
+    x = np.zeros((1, 3, 4, 4, 4), np.float32)
+    real = layer.forward({}, x).shape[1:]
+    assert inferred == tuple(real) == (3, 3, 3, 3)
+
+
+def test_center_loss_pure_and_trains():
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(CenterLossOutputLayer(n_in=8, n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(12, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 12)]
+    out = net.output(x)                      # jitted forward first
+    # compute_loss after a jitted output() must not leak tracers
+    loss = net.layers[-1].compute_loss(y, out.jax())
+    assert np.isfinite(float(loss))
+    before = net.score(DataSet(x, y))
+    net.fit(DataSet(x, y), num_epochs=20)
+    after = net.score(DataSet(x, y))
+    assert after < before
+    # centers were actually updated from their zero init
+    centers = np.asarray(net._params[-1]["state_centers"])
+    assert np.abs(centers).sum() > 0
+
+
+def test_cg_json_roundtrip_nested_wrappers():
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.recurrent(4, 7))
+            .add_layer("l", LastTimeStep(
+                underlying=MaskZeroLayer(underlying=LSTM(n_in=4, n_out=6))),
+                "in")
+            .add_layer("out", OutputLayer(n_in=6, n_out=2), "l")
+            .set_outputs("out")
+            .build())
+    conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+    inner = conf2.vertices["l"].layer.underlying.underlying
+    assert isinstance(inner, LSTM)
+    assert inner.n_out == 6
+    net = ComputationGraph(conf2).init()
+    out = net.output(np.zeros((2, 4, 7), np.float32))
+    assert out[0].shape == (2, 2)

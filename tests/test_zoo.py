@@ -1,0 +1,123 @@
+"""Zoo architecture tests.
+
+Models the reference's `platform-tests/.../zoo/TestInstantiation.java`:
+every architecture instantiates and runs a forward pass. Tiny input shapes /
+reduced block counts keep CPU compile time sane; the full default configs are
+construction-checked (graph build + shape inference, no forward).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import zoo
+
+
+def _fwd(net, shape):
+    x = np.random.RandomState(0).randn(*shape).astype(np.float32) * 0.1
+    out = net.output(x)
+    return out[0] if isinstance(out, list) else out
+
+
+class TestSequentialZoo:
+    def test_lenet_forward_and_fit(self):
+        net = zoo.LeNet(num_classes=10).init_model()
+        out = _fwd(net, (2, 1, 28, 28))
+        assert out.shape == (2, 10)
+        np.testing.assert_allclose(np.asarray(out.jax()).sum(-1), np.ones(2),
+                                   rtol=1e-5)
+
+    def test_simple_cnn(self):
+        net = zoo.SimpleCNN(num_classes=5, input_shape=(3, 32, 32)).init_model()
+        assert _fwd(net, (2, 3, 32, 32)).shape == (2, 5)
+
+    def test_alexnet(self):
+        net = zoo.AlexNet(num_classes=10, input_shape=(3, 96, 96)).init_model()
+        assert _fwd(net, (1, 3, 96, 96)).shape == (1, 10)
+
+    def test_vgg16(self):
+        net = zoo.VGG16(num_classes=10, input_shape=(3, 32, 32)).init_model()
+        assert _fwd(net, (1, 3, 32, 32)).shape == (1, 10)
+
+    def test_vgg19_constructs(self):
+        conf = zoo.VGG19(num_classes=10, input_shape=(3, 32, 32)).conf()
+        assert len(conf.layers) > 20
+
+    def test_darknet19(self):
+        net = zoo.Darknet19(num_classes=10,
+                            input_shape=(3, 64, 64)).init_model()
+        assert _fwd(net, (1, 3, 64, 64)).shape == (1, 10)
+
+    def test_tiny_yolo(self):
+        net = zoo.TinyYOLO(num_classes=4, input_shape=(3, 64, 64)).init_model()
+        out = _fwd(net, (1, 3, 64, 64))
+        # 5 anchors * (5 + 4 classes) channels on a /32 grid
+        assert out.shape == (1, 5 * 9, 2, 2)
+
+    def test_text_generation_lstm(self):
+        net = zoo.TextGenerationLSTM(num_classes=20,
+                                     input_shape=(20, 16)).init_model()
+        out = _fwd(net, (2, 20, 16))
+        assert out.shape == (2, 20, 16)
+
+
+class TestGraphZoo:
+    def test_resnet50_tiny_forward(self):
+        net = zoo.ResNet50(num_classes=7, stages=(1, 1, 1, 1),
+                           input_shape=(3, 64, 64)).init_model()
+        assert _fwd(net, (1, 3, 64, 64)).shape == (1, 7)
+
+    def test_resnet50_full_construction(self):
+        conf = zoo.ResNet50().conf()
+        types = conf.vertex_output_types()
+        assert types["avgpool"] == (2048,)
+        assert types["output"] == (1000,)
+
+    def test_squeezenet(self):
+        net = zoo.SqueezeNet(num_classes=6,
+                             input_shape=(3, 48, 48)).init_model()
+        assert _fwd(net, (1, 3, 48, 48)).shape == (1, 6)
+
+    def test_unet(self):
+        net = zoo.UNet(input_shape=(3, 32, 32), base_filters=4).init_model()
+        out = _fwd(net, (1, 3, 32, 32))
+        assert out.shape == (1, 1, 32, 32)
+        v = np.asarray(out.jax())
+        assert (v >= 0).all() and (v <= 1).all()  # sigmoid output
+
+    def test_xception_tiny(self):
+        net = zoo.Xception(num_classes=5, middle_blocks=1,
+                           input_shape=(3, 64, 64)).init_model()
+        assert _fwd(net, (1, 3, 64, 64)).shape == (1, 5)
+
+    def test_inception_resnet_v1_tiny(self):
+        net = zoo.InceptionResNetV1(num_classes=5, blocks=(1, 1, 1),
+                                    input_shape=(3, 96, 96)).init_model()
+        assert _fwd(net, (1, 3, 96, 96)).shape == (1, 5)
+
+    def test_facenet_nn4_small2(self):
+        net = zoo.FaceNetNN4Small2(num_classes=5,
+                                   input_shape=(3, 64, 64)).init_model()
+        assert _fwd(net, (1, 3, 64, 64)).shape == (1, 5)
+
+    def test_nasnet_tiny(self):
+        net = zoo.NASNet(num_classes=5, num_blocks=1, penultimate_filters=48,
+                         input_shape=(3, 32, 32)).init_model()
+        assert _fwd(net, (1, 3, 32, 32)).shape == (1, 5)
+
+    def test_yolo2_tiny(self):
+        net = zoo.YOLO2(num_classes=4, input_shape=(3, 64, 64)).init_model()
+        out = _fwd(net, (1, 3, 64, 64))
+        assert out.shape == (1, 5 * 9, 2, 2)
+
+
+class TestZooInfra:
+    def test_pretrained_requires_path_offline(self):
+        with pytest.raises(RuntimeError):
+            zoo.LeNet().init_pretrained()
+
+    def test_pretrained_roundtrip(self, tmp_path):
+        net = zoo.LeNet(num_classes=10).init_model()
+        p = str(tmp_path / "lenet.zip")
+        net.save(p)
+        net2 = zoo.LeNet(num_classes=10).init_pretrained(path=p)
+        np.testing.assert_allclose(np.asarray(net.params().jax()),
+                                   np.asarray(net2.params().jax()))
