@@ -1,0 +1,451 @@
+"""Word2Vec + ParagraphVectors + fastText.
+
+Reference: `deeplearning4j-nlp/.../models/word2vec/Word2Vec.java` (717;
+builder API), `models/paragraphvectors/ParagraphVectors.java` (1524;
+PV-DM/PV-DBOW, inferVector), `models/fasttext/FastText.java` (JNI wrapper
+around facebook fastText — here implemented natively with hashed subword
+n-gram buckets), `models/embeddings/loader/WordVectorSerializer.java`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import zipfile
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .sequence_vectors import SGNSConfig, SequenceVectors, _sgns_loss
+from .tokenization import DefaultTokenizerFactory, TokenizerFactory
+from .vocab import VocabCache, build_vocab
+
+
+class Word2Vec:
+    """Skip-gram / CBOW word embeddings (reference Word2Vec.java builder)."""
+
+    class Builder:
+        def __init__(self):
+            self._cfg = SGNSConfig()
+            self._min_word_frequency = 5
+            self._iterate: Optional[Iterable[str]] = None
+            self._tokenizer: TokenizerFactory = DefaultTokenizerFactory()
+            self._limit = None
+
+        def min_word_frequency(self, v):
+            self._min_word_frequency = v; return self
+
+        def layer_size(self, v):
+            self._cfg.layer_size = v; return self
+
+        def window_size(self, v):
+            self._cfg.window = v; return self
+
+        def negative_sample(self, v):
+            self._cfg.negative = int(v); return self
+
+        def learning_rate(self, v):
+            self._cfg.learning_rate = v; return self
+
+        def min_learning_rate(self, v):
+            self._cfg.min_learning_rate = v; return self
+
+        def epochs(self, v):
+            self._cfg.epochs = v; return self
+
+        def iterations(self, v):  # reference alias: in-loop iterations
+            return self
+
+        def batch_size(self, v):
+            self._cfg.batch_size = v; return self
+
+        def sampling(self, v):
+            self._cfg.subsample = v; return self
+
+        def seed(self, v):
+            self._cfg.seed = int(v); return self
+
+        def elements_learning_algorithm(self, name: str):
+            self._cfg.cbow = "cbow" in str(name).lower(); return self
+
+        def use_cbow(self, v: bool = True):
+            self._cfg.cbow = v; return self
+
+        def limit_vocabulary_size(self, v):
+            self._limit = v; return self
+
+        def iterate(self, sentences: Iterable[str]):
+            self._iterate = sentences; return self
+
+        def tokenizer_factory(self, tf: TokenizerFactory):
+            self._tokenizer = tf; return self
+
+        def build(self) -> "Word2Vec":
+            return Word2Vec(self._cfg, self._min_word_frequency,
+                            self._iterate, self._tokenizer, self._limit)
+
+    @staticmethod
+    def builder() -> "Word2Vec.Builder":
+        return Word2Vec.Builder()
+
+    def __init__(self, cfg: SGNSConfig, min_word_frequency, sentences,
+                 tokenizer: TokenizerFactory, limit=None):
+        self.config = cfg
+        self.min_word_frequency = min_word_frequency
+        self._sentences = sentences
+        self._tokenizer = tokenizer
+        self._limit = limit
+        self.vocab: Optional[VocabCache] = None
+        self._sv: Optional[SequenceVectors] = None
+
+    def _token_streams(self) -> List[List[str]]:
+        return [self._tokenizer.create(s).get_tokens()
+                for s in self._sentences]
+
+    def fit(self, listeners: Sequence[Callable] = ()) -> float:
+        streams = self._token_streams()
+        self.vocab = build_vocab(streams, self.min_word_frequency, self._limit)
+        if len(self.vocab) == 0:
+            raise ValueError("empty vocabulary after min_word_frequency filter")
+        self._sv = SequenceVectors(self.config, self.vocab)
+        idx_streams = [
+            np.array([self.vocab.index_of(t) for t in s
+                      if self.vocab.index_of(t) >= 0], np.int64)
+            for s in streams]
+        return self._sv.fit_sequences(lambda: idx_streams, listeners)
+
+    # -- WordVectors surface --------------------------------------------
+    def _check(self):
+        if self._sv is None:
+            raise RuntimeError("call fit() first")
+
+    def get_word_vector(self, word):
+        self._check(); return self._sv.get_word_vector(word)
+
+    def get_word_vector_matrix(self) -> np.ndarray:
+        self._check(); return self._sv.syn0
+
+    def has_word(self, word):
+        self._check(); return self._sv.has_word(word)
+
+    def similarity(self, w1, w2):
+        self._check(); return self._sv.similarity(w1, w2)
+
+    def words_nearest(self, word, n=10):
+        self._check(); return self._sv.words_nearest(word, n)
+
+    def words_nearest_sum(self, positive: List[str], negative: List[str],
+                          n: int = 10) -> List[str]:
+        """king - man + woman style analogy (reference wordsNearestSum)."""
+        self._check()
+        v = np.zeros(self.config.layer_size, np.float32)
+        for w in positive:
+            v += self._sv.get_word_vector(w)
+        for w in negative:
+            v -= self._sv.get_word_vector(w)
+        m = self._sv.syn0
+        sims = (m @ v) / (np.linalg.norm(m, axis=1) * np.linalg.norm(v) + 1e-12)
+        order = np.argsort(-sims)
+        skip = {self.vocab.index_of(w) for w in positive + negative}
+        return [self.vocab.word_at(i) for i in order if i not in skip][:n]
+
+
+class ParagraphVectors(Word2Vec):
+    """PV-DBOW document embeddings (reference ParagraphVectors.java).
+
+    Doc vectors are extra rows appended after the word vocab; each document
+    id predicts its words with negative sampling (DBOW). infer_vector runs
+    the same jitted loss with frozen word tables.
+    """
+
+    class Builder(Word2Vec.Builder):
+        def iterate_labeled(self, docs: Sequence):
+            """docs: list of (label, text)."""
+            self._docs = list(docs); return self
+
+        def build(self) -> "ParagraphVectors":
+            pv = ParagraphVectors(self._cfg, self._min_word_frequency,
+                                  None, self._tokenizer, self._limit)
+            pv._docs = getattr(self, "_docs", [])
+            return pv
+
+    @staticmethod
+    def builder() -> "ParagraphVectors.Builder":
+        return ParagraphVectors.Builder()
+
+    def fit(self, listeners: Sequence[Callable] = ()) -> float:
+        streams = [self._tokenizer.create(t).get_tokens()
+                   for _, t in self._docs]
+        self.vocab = build_vocab(streams, self.min_word_frequency, self._limit)
+        if len(self.vocab) == 0:
+            raise ValueError("empty vocabulary")
+        self.labels = [lbl for lbl, _ in self._docs]
+        nwords, ndocs = len(self.vocab), len(self._docs)
+        cfg = self.config
+        self._sv = SequenceVectors(cfg, self.vocab)
+        # widen tables with one row per document
+        rng = np.random.RandomState(cfg.seed + 1)
+        doc_rows = (rng.rand(ndocs, cfg.layer_size).astype(np.float32)
+                    - 0.5) / cfg.layer_size
+        self._sv._w_in = jnp.concatenate(
+            [self._sv._w_in, jnp.asarray(doc_rows)], axis=0)
+        self._sv._w_out = jnp.concatenate(
+            [self._sv._w_out, jnp.zeros((ndocs, cfg.layer_size))], axis=0)
+        # DBOW "sequences": doc id followed by its words; pairs are
+        # (doc, word) — emulate by yielding [doc, w1, doc, w2, ...]? No:
+        # generate explicit pairs through a custom sequence of (center=doc).
+        idx_streams = []
+        for d, s in enumerate(streams):
+            ids = [self.vocab.index_of(t) for t in s]
+            ids = [i for i in ids if i >= 0]
+            idx_streams.append((nwords + d, np.array(ids, np.int64)))
+
+        total = self._fit_dbow(idx_streams, listeners)
+        self._nwords = nwords
+        return total
+
+    def _fit_dbow(self, doc_streams, listeners):
+        cfg = self.config
+        sv = self._sv
+        rng = np.random.RandomState(cfg.seed)
+        if sv._sg_step is None:
+            sv._sg_step = sv._build_sg()
+        total_loss, steps = 0.0, 0
+        for epoch in range(cfg.epochs):
+            lr = max(cfg.learning_rate * (1 - epoch / max(cfg.epochs, 1)),
+                     cfg.min_learning_rate)
+            buf_c, buf_x = [], []
+            for doc_id, words in doc_streams:
+                for wid in words:
+                    buf_c.append(doc_id)
+                    buf_x.append(wid)
+                    if len(buf_c) >= cfg.batch_size:
+                        total_loss, steps = self._dbow_flush(
+                            buf_c, buf_x, rng, lr, total_loss, steps)
+            if buf_c:
+                total_loss, steps = self._dbow_flush(buf_c, buf_x, rng, lr,
+                                                     total_loss, steps)
+            for cb in listeners:
+                cb(epoch, total_loss / max(steps, 1))
+        return total_loss / max(steps, 1)
+
+    def _dbow_flush(self, buf_c, buf_x, rng, lr, total_loss, steps):
+        cfg = self.config
+        sv = self._sv
+        B = cfg.batch_size
+        c = np.array(buf_c[:B], np.int64)
+        x = np.array(buf_x[:B], np.int64)
+        if len(c) < B:
+            reps = -(-B // len(c))
+            c, x = np.tile(c, reps)[:B], np.tile(x, reps)[:B]
+        negs = sv._negatives((B, cfg.negative), rng)
+        sv._w_in, sv._w_out, loss = sv._sg_step(sv._w_in, sv._w_out, c, x,
+                                                negs, lr)
+        del buf_c[:], buf_x[:]
+        return total_loss + float(loss), steps + 1
+
+    def get_paragraph_vector(self, label) -> np.ndarray:
+        d = self.labels.index(label)
+        return np.asarray(self._sv._w_in[self._nwords + d])
+
+    def infer_vector(self, text: str, steps: int = 50,
+                     lr: float = 0.05) -> np.ndarray:
+        """Gradient-fit a fresh doc vector against frozen tables
+        (reference ParagraphVectors.inferVector)."""
+        toks = self._tokenizer.create(text).get_tokens()
+        ids = np.array([self.vocab.index_of(t) for t in toks
+                        if self.vocab.index_of(t) >= 0], np.int64)
+        if len(ids) == 0:
+            return np.zeros(self.config.layer_size, np.float32)
+        rng = np.random.RandomState(0)
+        v = jnp.asarray((rng.rand(self.config.layer_size).astype(np.float32)
+                         - 0.5) / self.config.layer_size)
+        w_out = self._sv._w_out
+
+        def loss_fn(vec, negs):
+            u_pos = w_out[ids]
+            pos = u_pos @ vec
+            neg = w_out[negs] @ vec                     # [N, K]
+            neg_mask = (negs != ids[:, None]).astype(neg.dtype)
+            return -(jnp.sum(jax.nn.log_sigmoid(pos))
+                     + jnp.sum(jax.nn.log_sigmoid(-neg) * neg_mask)) / len(ids)
+
+        grad = jax.jit(jax.grad(loss_fn))
+        for _ in range(steps):
+            negs = self._sv._negatives((len(ids), self.config.negative), rng)
+            v = v - lr * grad(v, negs)
+        return np.asarray(v)
+
+    def similarity_to_label(self, text: str, label: str) -> float:
+        a = self.infer_vector(text)
+        b = self.get_paragraph_vector(label)
+        return float(a @ b / ((np.linalg.norm(a) * np.linalg.norm(b)) or 1e-12))
+
+
+class FastText:
+    """Subword-enriched embeddings (reference models/fasttext/FastText.java —
+    there a JNI wrapper; here native: hashed char n-gram buckets summed into
+    word vectors, trained with a batched SGNS step whose input vector is
+    word row + its subword rows, so OOV words get vectors from subwords)."""
+
+    def __init__(self, layer_size=100, window=5, negative=5, epochs=1,
+                 min_word_frequency=1, min_n=3, max_n=6, buckets=200_000,
+                 learning_rate=0.05, seed=0, batch_size=2048,
+                 max_grams_per_word=24):
+        self.cfg = SGNSConfig(layer_size=layer_size, window=window,
+                              negative=negative, epochs=epochs,
+                              learning_rate=learning_rate, seed=seed,
+                              batch_size=batch_size)
+        self.min_word_frequency = min_word_frequency
+        self.min_n, self.max_n, self.buckets = min_n, max_n, buckets
+        self.max_grams = max_grams_per_word
+        self._tokenizer = DefaultTokenizerFactory()
+
+    def _ngrams(self, word: str) -> List[int]:
+        w = f"<{word}>"
+        out = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(w) - n + 1):
+                # stable fnv-1a so vectors are reproducible across runs
+                h = 2166136261
+                for ch in w[i:i + n].encode():
+                    h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+                out.append(h % self.buckets)
+        return out[: self.max_grams]
+
+    def fit(self, sentences: Iterable[str]) -> float:
+        cfg = self.cfg
+        streams = [self._tokenizer.create(s).get_tokens() for s in sentences]
+        self.vocab = build_vocab(streams, self.min_word_frequency)
+        V, D, G = len(self.vocab), cfg.layer_size, self.max_grams
+        rng = np.random.RandomState(cfg.seed)
+        self._w_in = jnp.asarray((rng.rand(V + self.buckets, D)
+                                  .astype(np.float32) - 0.5) / D)
+        self._w_out = jnp.zeros((V, D), jnp.float32)
+        # padded per-word gram ids [V, G] (offset by V) + mask
+        gram_mat = np.zeros((V, G), np.int64)
+        gram_mask = np.zeros((V, G), np.float32)
+        for i, w in enumerate(self.vocab.words()):
+            gs = self._ngrams(w)
+            gram_mat[i, :len(gs)] = [V + g for g in gs]
+            gram_mask[i, :len(gs)] = 1.0
+        self._gram_mat = jnp.asarray(gram_mat)
+        self._gram_mask = jnp.asarray(gram_mask)
+        from .sequence_vectors import SequenceVectors as _SV
+        from .vocab import unigram_table
+        self._table = unigram_table(self.vocab)
+
+        def loss_fn(w_in, w_out, centers, contexts, negatives):
+            denom = 1.0 + self._gram_mask[centers].sum(-1, keepdims=True)
+            v = (w_in[centers]
+                 + jnp.sum(w_in[self._gram_mat[centers]]
+                           * self._gram_mask[centers][..., None], axis=1))
+            v = v / denom
+            pos = jnp.einsum("bd,bd->b", v, w_out[contexts])
+            neg = jnp.einsum("bd,bkd->bk", v, w_out[negatives])
+            neg_mask = (negatives != contexts[:, None]).astype(neg.dtype)
+            return -(jnp.sum(jax.nn.log_sigmoid(pos))
+                     + jnp.sum(jax.nn.log_sigmoid(-neg) * neg_mask))
+
+        S = 64  # micro-batch scan, see SequenceVectors step notes
+
+        @jax.jit
+        def step(w_in, w_out, c, x, negs, lr):
+            C = c.shape[0] // S
+            chunks = (c[:C * S].reshape(C, S), x[:C * S].reshape(C, S),
+                      negs[:C * S].reshape(C, S, -1))
+
+            def body(carry, inp):
+                wi, wo = carry
+                cc, xx, nn = inp
+                loss, (gi, go) = jax.value_and_grad(loss_fn, (0, 1))(
+                    wi, wo, cc, xx, nn)
+                return (wi - lr * gi, wo - lr * go), loss
+
+            (w_in, w_out), losses = jax.lax.scan(body, (w_in, w_out), chunks)
+            return w_in, w_out, jnp.sum(losses) / (C * S)
+
+        idx_streams = [np.array([self.vocab.index_of(t) for t in s
+                                 if self.vocab.index_of(t) >= 0], np.int64)
+                       for s in streams]
+        total_loss, steps = 0.0, 0
+        pair_rng = np.random.RandomState(cfg.seed)
+        sv_helper = _SV(cfg, self.vocab)  # reuse its pair generator
+        for epoch in range(cfg.epochs):
+            lr = max(cfg.learning_rate * (1 - epoch / max(cfg.epochs, 1)),
+                     cfg.min_learning_rate)
+            buf_c, buf_x = [], []
+            for c, x in sv_helper._pairs(idx_streams, pair_rng):
+                buf_c.append(c)
+                buf_x.append(x)
+                if len(buf_c) >= cfg.batch_size:
+                    total_loss, steps = self._flush(step, buf_c, buf_x,
+                                                    pair_rng, lr,
+                                                    total_loss, steps)
+            if buf_c:
+                total_loss, steps = self._flush(step, buf_c, buf_x, pair_rng,
+                                                lr, total_loss, steps)
+        return total_loss / max(steps, 1)
+
+    def _flush(self, step, buf_c, buf_x, rng, lr, total_loss, steps):
+        B = self.cfg.batch_size
+        c = np.array(buf_c[:B], np.int64)
+        x = np.array(buf_x[:B], np.int64)
+        if len(c) < B:
+            reps = -(-B // len(c))
+            c, x = np.tile(c, reps)[:B], np.tile(x, reps)[:B]
+        negs = rng.choice(len(self._table), size=(B, self.cfg.negative),
+                          p=self._table).astype(np.int64)
+        self._w_in, self._w_out, loss = step(self._w_in, self._w_out, c, x,
+                                             negs, lr)
+        del buf_c[:], buf_x[:]
+        return total_loss + float(loss), steps + 1
+
+    def get_word_vector(self, word: str) -> np.ndarray:
+        """Word row + its n-gram rows, averaged; OOV words get a vector from
+        subwords alone (the fastText selling point)."""
+        w_in = np.asarray(self._w_in)
+        V = len(self.vocab)
+        i = self.vocab.index_of(word)
+        vecs = [w_in[i]] if i >= 0 else []
+        vecs.extend(w_in[V + g] for g in self._ngrams(word))
+        return np.mean(vecs, axis=0)
+
+    def similarity(self, w1, w2) -> float:
+        a, b = self.get_word_vector(w1), self.get_word_vector(w2)
+        return float(a @ b / ((np.linalg.norm(a) * np.linalg.norm(b)) or 1e-12))
+
+
+# -- serialization (reference WordVectorSerializer) -----------------------
+def write_word_vectors(model: Word2Vec, path: str):
+    """Zip of vocab json + float32 tables (reference writeWord2VecModel)."""
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        meta = {"words": model.vocab.words(),
+                "counts": [model.vocab.word_frequency(w)
+                           for w in model.vocab.words()],
+                "config": dataclasses.asdict(model.config)}
+        z.writestr("vocab.json", json.dumps(meta))
+        buf = io.BytesIO()
+        np.savez(buf, syn0=np.asarray(model._sv._w_in),
+                 syn1neg=np.asarray(model._sv._w_out))
+        z.writestr("tables.npz", buf.getvalue())
+
+
+def read_word_vectors(path: str) -> Word2Vec:
+    from .vocab import VocabWord
+    with zipfile.ZipFile(path) as z:
+        meta = json.loads(z.read("vocab.json"))
+        tables = np.load(io.BytesIO(z.read("tables.npz")))
+    cfg = SGNSConfig(**meta["config"])
+    vocab = VocabCache()
+    for w, c in zip(meta["words"], meta["counts"]):
+        vocab.add(VocabWord(w, c))
+    m = Word2Vec(cfg, 1, [], DefaultTokenizerFactory())
+    m.vocab = vocab
+    m._sv = SequenceVectors(cfg, vocab)
+    m._sv._w_in = jnp.asarray(tables["syn0"])
+    m._sv._w_out = jnp.asarray(tables["syn1neg"])
+    return m

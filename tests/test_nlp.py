@@ -1,0 +1,180 @@
+"""NLP tests: tokenizers, vocab/huffman, Word2Vec, ParagraphVectors,
+FastText, DeepWalk — models the reference's
+`platform-tests/.../nlp/` Word2VecTests / ParagraphVectorsTest and
+`deeplearning4j-graph` DeepWalk tests, on small synthetic corpora.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nlp
+
+
+def synthetic_corpus(n=300, seed=0):
+    """Two topic clusters: (cat, dog, pet) and (car, road, drive) — words
+    inside a topic co-occur, across topics never."""
+    rng = np.random.RandomState(seed)
+    animals = ["cat", "dog", "pet", "fur", "paw"]
+    cars = ["car", "road", "drive", "wheel", "fuel"]
+    out = []
+    for _ in range(n):
+        topic = animals if rng.rand() < 0.5 else cars
+        out.append(" ".join(rng.choice(topic, size=8)))
+    return out
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        tf = nlp.DefaultTokenizerFactory()
+        assert tf.create("Hello world foo").get_tokens() == \
+            ["Hello", "world", "foo"]
+
+    def test_common_preprocessor(self):
+        tf = nlp.DefaultTokenizerFactory()
+        tf.set_token_pre_processor(nlp.CommonPreprocessor())
+        assert tf.create("Hello, World!").get_tokens() == ["hello", "world!"] \
+            or tf.create("Hello, World.").get_tokens() == ["hello", "world"]
+
+    def test_ngram_tokenizer(self):
+        tf = nlp.NGramTokenizerFactory(1, 2)
+        toks = tf.create("a b c").get_tokens()
+        assert "a" in toks and "a b" in toks and "b c" in toks
+
+
+class TestVocab:
+    def test_build_and_frequency_order(self):
+        streams = [["a", "a", "b"], ["a", "b", "c"]]
+        v = nlp.build_vocab(streams, min_word_frequency=1)
+        assert v.word_at(0) == "a" and v.word_frequency("a") == 3
+        assert v.index_of("zzz") == -1
+
+    def test_min_frequency_filter(self):
+        v = nlp.build_vocab([["a", "a", "b"]], min_word_frequency=2)
+        assert "b" not in v and "a" in v
+
+    def test_huffman_codes(self):
+        v = nlp.build_vocab([["a"] * 8 + ["b"] * 4 + ["c"] * 2 + ["d"]],
+                            min_word_frequency=1)
+        nlp.assign_huffman_codes(v)
+        # most frequent word gets the shortest code
+        assert len(v.word_for("a").codes) <= len(v.word_for("d").codes)
+        codes, points, mask = nlp.huffman_arrays(v)
+        assert codes.shape == points.shape == mask.shape
+        assert mask[v.index_of("a")].sum() == len(v.word_for("a").codes)
+
+    def test_unigram_table(self):
+        v = nlp.build_vocab([["a", "a", "a", "b"]], min_word_frequency=1)
+        p = nlp.unigram_table(v)
+        assert p.sum() == pytest.approx(1.0)
+        assert p[v.index_of("a")] > p[v.index_of("b")]
+
+
+class TestWord2Vec:
+    @pytest.fixture(scope="class")
+    def model(self):
+        m = (nlp.Word2Vec.builder()
+             .min_word_frequency(1).layer_size(32).window_size(3)
+             .negative_sample(5).epochs(3).batch_size(512).seed(42)
+             .iterate(synthetic_corpus())
+             .tokenizer_factory(nlp.DefaultTokenizerFactory())
+             .build())
+        m.fit()
+        return m
+
+    def test_topics_cluster(self, model):
+        within = model.similarity("cat", "dog")
+        across = model.similarity("cat", "road")
+        assert within > across
+
+    def test_words_nearest(self, model):
+        near = model.words_nearest("car", 3)
+        assert set(near) <= {"road", "drive", "wheel", "fuel"}
+
+    def test_vector_shape(self, model):
+        assert model.get_word_vector("cat").shape == (32,)
+        assert model.get_word_vector("notaword") is None
+
+    def test_serialization_roundtrip(self, model, tmp_path):
+        p = str(tmp_path / "w2v.zip")
+        nlp.write_word_vectors(model, p)
+        m2 = nlp.read_word_vectors(p)
+        np.testing.assert_allclose(m2.get_word_vector("cat"),
+                                   model.get_word_vector("cat"))
+        assert m2.similarity("cat", "dog") == \
+            pytest.approx(model.similarity("cat", "dog"))
+
+    def test_cbow(self):
+        m = (nlp.Word2Vec.builder()
+             .min_word_frequency(1).layer_size(16).window_size(3)
+             .use_cbow(True).epochs(2).batch_size(256).seed(1)
+             .iterate(synthetic_corpus(150))
+             .build())
+        m.fit()
+        assert m.similarity("cat", "pet") > m.similarity("cat", "fuel")
+
+
+class TestParagraphVectors:
+    def test_doc_clusters(self):
+        rng = np.random.RandomState(3)
+        docs = []
+        for i in range(40):
+            topic = ["cat", "dog", "pet"] if i % 2 == 0 else \
+                ["car", "road", "drive"]
+            docs.append((f"doc{i}", " ".join(rng.choice(topic, size=10))))
+        pv = (nlp.ParagraphVectors.builder()
+              .min_word_frequency(1).layer_size(24).epochs(5)
+              .batch_size(256).seed(5).iterate_labeled(docs).build())
+        pv.fit()
+        a, b = pv.get_paragraph_vector("doc0"), pv.get_paragraph_vector("doc2")
+        c = pv.get_paragraph_vector("doc1")
+        cos = lambda x, y: float(x @ y / (np.linalg.norm(x) *
+                                          np.linalg.norm(y) + 1e-12))
+        assert cos(a, b) > cos(a, c)
+
+    def test_infer_vector(self):
+        docs = [("animals", "cat dog pet cat dog pet cat dog"),
+                ("vehicles", "car road drive car road drive car road")] * 10
+        docs = [(f"{l}{i}", t) for i, (l, t) in enumerate(docs)]
+        pv = (nlp.ParagraphVectors.builder()
+              .min_word_frequency(1).layer_size(16).epochs(8)
+              .batch_size(128).seed(7).iterate_labeled(docs).build())
+        pv.fit()
+        v = pv.infer_vector("cat dog pet")
+        assert v.shape == (16,)
+        sim_animal = pv.similarity_to_label("cat dog pet", "animals0")
+        sim_vehicle = pv.similarity_to_label("cat dog pet", "vehicles1")
+        assert sim_animal > sim_vehicle
+
+
+class TestFastText:
+    def test_oov_from_subwords(self):
+        ft = nlp.FastText(layer_size=16, epochs=2, min_n=3, max_n=4,
+                          buckets=1000, batch_size=256)
+        ft.fit(synthetic_corpus(100))
+        # OOV word shares subwords with an in-vocab word
+        v = ft.get_word_vector("catt")
+        assert v.shape == (16,)
+        assert ft.similarity("cat", "catt") > ft.similarity("cat", "fuel")
+
+
+class TestDeepWalk:
+    def test_two_cliques(self):
+        # two 6-cliques joined by one bridge edge
+        g = nlp.Graph(12)
+        for base in (0, 6):
+            for i in range(base, base + 6):
+                for j in range(i + 1, base + 6):
+                    g.add_edge(i, j)
+        g.add_edge(5, 6)
+        dw = (nlp.DeepWalk.builder().vector_size(16).window_size(3)
+              .epochs(5).seed(0).build())
+        it = nlp.RandomWalkIterator(g, walk_length=12, seed=0)
+        dw.fit(it)
+        assert dw.similarity(0, 1) > dw.similarity(0, 11)
+
+    def test_weighted_walks(self):
+        g = nlp.Graph(3)
+        g.add_edge(0, 1, weight=100.0)
+        g.add_edge(0, 2, weight=0.001)
+        it = nlp.RandomWalkIterator(g, walk_length=2, seed=0, weighted=True)
+        nxt = [w[1] for w in it.walks() if w[0] == 0]
+        assert nxt == [1]
