@@ -99,8 +99,14 @@ class SequenceVectors:
     # granularity, one compilation, device-resident tables.
     MICRO = 64
 
+    def _micro(self) -> int:
+        # batch_size below MICRO would give zero scan chunks (C = B//S = 0)
+        # and a 0/0 loss; padding guarantees batches of exactly batch_size,
+        # so clamping S to it keeps C >= 1 for any user batch_size.
+        return min(self.MICRO, self.config.batch_size)
+
     def _build_sg(self):
-        S = self.MICRO
+        S = self._micro()
 
         @jax.jit
         def step(w_in, w_out, centers, contexts, negatives, lr):
@@ -121,7 +127,7 @@ class SequenceVectors:
         return step
 
     def _build_cbow(self):
-        S = self.MICRO
+        S = self._micro()
 
         @jax.jit
         def step(w_in, w_out, ctx_mat, ctx_mask, targets, negatives, lr):
@@ -301,7 +307,9 @@ class SequenceVectors:
         v = self.get_word_vector(word)
         if v is None:
             return []
-        m = self.syn0
+        # slice to vocab rows: ParagraphVectors widens syn0 with doc rows
+        # whose indices have no VocabWord behind them
+        m = self.syn0[:len(self.vocab)]
         sims = (m @ v) / (np.linalg.norm(m, axis=1) * np.linalg.norm(v) + 1e-12)
         order = np.argsort(-sims)
         me = self.vocab.index_of(word)

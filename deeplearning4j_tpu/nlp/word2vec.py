@@ -143,10 +143,17 @@ class Word2Vec:
         self._check()
         v = np.zeros(self.config.layer_size, np.float32)
         for w in positive:
-            v += self._sv.get_word_vector(w)
+            vec = self._sv.get_word_vector(w)
+            if vec is not None:
+                v += vec
         for w in negative:
-            v -= self._sv.get_word_vector(w)
-        m = self._sv.syn0
+            vec = self._sv.get_word_vector(w)
+            if vec is not None:
+                v -= vec
+        if not np.any(v):
+            return []
+        # vocab rows only: ParagraphVectors appends doc rows past the vocab
+        m = self._sv.syn0[:len(self.vocab)]
         sims = (m @ v) / (np.linalg.norm(m, axis=1) * np.linalg.norm(v) + 1e-12)
         order = np.argsort(-sims)
         skip = {self.vocab.index_of(w) for w in positive + negative}
@@ -350,7 +357,9 @@ class FastText:
             return -(jnp.sum(jax.nn.log_sigmoid(pos))
                      + jnp.sum(jax.nn.log_sigmoid(-neg) * neg_mask))
 
-        S = 64  # micro-batch scan, see SequenceVectors step notes
+        # micro-batch scan, see SequenceVectors step notes; clamp so that
+        # small batch_size still yields >= 1 chunk
+        S = min(SequenceVectors.MICRO, cfg.batch_size)
 
         @jax.jit
         def step(w_in, w_out, c, x, negs, lr):
@@ -412,6 +421,8 @@ class FastText:
         i = self.vocab.index_of(word)
         vecs = [w_in[i]] if i >= 0 else []
         vecs.extend(w_in[V + g] for g in self._ngrams(word))
+        if not vecs:  # OOV too short for any n-gram: no rows to average
+            return np.zeros(self.cfg.layer_size, np.float32)
         return np.mean(vecs, axis=0)
 
     def similarity(self, w1, w2) -> float:
@@ -421,12 +432,17 @@ class FastText:
 
 # -- serialization (reference WordVectorSerializer) -----------------------
 def write_word_vectors(model: Word2Vec, path: str):
-    """Zip of vocab json + float32 tables (reference writeWord2VecModel)."""
+    """Zip of vocab json + float32 tables (reference writeWord2VecModel).
+
+    ParagraphVectors tables carry extra doc rows past the vocab; persist
+    the labels so the reader can reconstruct (or strip) them."""
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         meta = {"words": model.vocab.words(),
                 "counts": [model.vocab.word_frequency(w)
                            for w in model.vocab.words()],
                 "config": dataclasses.asdict(model.config)}
+        if isinstance(model, ParagraphVectors):
+            meta["labels"] = list(model.labels)
         z.writestr("vocab.json", json.dumps(meta))
         buf = io.BytesIO()
         np.savez(buf, syn0=np.asarray(model._sv._w_in),
@@ -443,7 +459,13 @@ def read_word_vectors(path: str) -> Word2Vec:
     vocab = VocabCache()
     for w, c in zip(meta["words"], meta["counts"]):
         vocab.add(VocabWord(w, c))
-    m = Word2Vec(cfg, 1, [], DefaultTokenizerFactory())
+    labels = meta.get("labels")
+    if labels is not None:
+        m = ParagraphVectors(cfg, 1, [], DefaultTokenizerFactory())
+        m.labels = list(labels)
+        m._nwords = len(vocab)
+    else:
+        m = Word2Vec(cfg, 1, [], DefaultTokenizerFactory())
     m.vocab = vocab
     m._sv = SequenceVectors(cfg, vocab)
     m._sv._w_in = jnp.asarray(tables["syn0"])

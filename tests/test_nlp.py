@@ -178,3 +178,61 @@ class TestDeepWalk:
         it = nlp.RandomWalkIterator(g, walk_length=2, seed=0, weighted=True)
         nxt = [w[1] for w in it.walks() if w[0] == 0]
         assert nxt == [1]
+
+
+class TestAdvisorRegressions:
+    """Regressions for the round-1 advisor findings (ADVICE.md)."""
+
+    def _small_pv(self, tmp_path=None):
+        docs = [("animals", "cat dog pet cat dog pet cat dog"),
+                ("vehicles", "car road drive car road drive car road")] * 5
+        docs = [(f"{l}{i}", t) for i, (l, t) in enumerate(docs)]
+        pv = (nlp.ParagraphVectors.builder()
+              .min_word_frequency(1).layer_size(8).epochs(2)
+              .batch_size(64).seed(7).iterate_labeled(docs).build())
+        pv.fit()
+        return pv
+
+    def test_pv_words_nearest_excludes_doc_rows(self):
+        pv = self._small_pv()
+        near = pv.words_nearest("cat", n=5)
+        assert near  # used to raise IndexError via doc-row indices
+        assert all(pv.has_word(w) for w in near)
+        near_sum = pv.words_nearest_sum(["cat"], [], n=3)
+        assert all(pv.has_word(w) for w in near_sum)
+
+    def test_small_batch_size_trains(self):
+        # batch_size < MICRO(64) used to ZeroDivisionError in the scan step
+        m = (nlp.Word2Vec.builder()
+             .min_word_frequency(1).layer_size(8).epochs(1).batch_size(16)
+             .seed(3).iterate(synthetic_corpus(30)).build())
+        loss = m.fit()
+        assert np.isfinite(loss)
+        c = (nlp.Word2Vec.builder()
+             .min_word_frequency(1).layer_size(8).epochs(1).batch_size(16)
+             .use_cbow(True).seed(3).iterate(synthetic_corpus(30)).build())
+        assert np.isfinite(c.fit())
+        ft = nlp.FastText(layer_size=8, epochs=1, batch_size=16, seed=3)
+        assert np.isfinite(ft.fit(synthetic_corpus(30)))
+
+    def test_fasttext_oov_no_ngrams_returns_zeros(self):
+        ft = nlp.FastText(layer_size=8, epochs=1, batch_size=64,
+                          min_n=5, max_n=6, seed=0)
+        ft.fit(synthetic_corpus(30))
+        v = ft.get_word_vector("ab")  # too short for any 5-gram of "<ab>"
+        assert v.shape == (8,)
+        assert not np.any(np.isnan(v))
+        assert np.isfinite(ft.similarity("ab", "cat"))
+
+    def test_pv_serde_roundtrip(self, tmp_path):
+        pv = self._small_pv()
+        p = str(tmp_path / "pv.zip")
+        nlp.write_word_vectors(pv, p)
+        m2 = nlp.read_word_vectors(p)
+        assert isinstance(m2, nlp.ParagraphVectors)
+        assert m2.labels == pv.labels
+        np.testing.assert_allclose(m2.get_paragraph_vector("animals0"),
+                                   pv.get_paragraph_vector("animals0"))
+        np.testing.assert_allclose(m2.get_word_vector("cat"),
+                                   pv.get_word_vector("cat"))
+        assert all(m2.has_word(w) for w in m2.words_nearest("cat", n=3))
