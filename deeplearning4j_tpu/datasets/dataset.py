@@ -20,6 +20,14 @@ def _wrap(x):
     return NDArray(x)
 
 
+def one_hot_labels(idx: np.ndarray, n: int) -> np.ndarray:
+    """Integer class ids → one-hot float32 matrix."""
+    idx = np.asarray(idx).astype(np.int64).reshape(-1)
+    out = np.zeros((len(idx), n), dtype=np.float32)
+    out[np.arange(len(idx)), idx] = 1.0
+    return out
+
+
 class DataSet:
     """features + labels (+ optional masks)."""
 

@@ -60,6 +60,15 @@ def _sgns_loss(w_in, w_out, centers, contexts, negatives):
              + jnp.sum(jax.nn.log_sigmoid(-neg) * neg_mask))
 
 
+def micro_chunk(batch_size: int, micro: int = 64) -> int:
+    """Largest divisor of batch_size that is <= micro — the scan chunk size
+    must divide the (padded, exact) batch or remainder pairs are dropped."""
+    for s in range(min(micro, batch_size), 0, -1):
+        if batch_size % s == 0:
+            return s
+    return 1
+
+
 def _cbow_loss(w_in, w_out, contexts_mat, ctx_mask, targets, negatives):
     """Batched CBOW-NS: mean of window vectors predicts the target."""
     ctx = w_in[contexts_mat]                # [B, W, D]
@@ -99,11 +108,17 @@ class SequenceVectors:
     # granularity, one compilation, device-resident tables.
     MICRO = 64
 
+    @staticmethod
+    def micro_chunk(batch_size: int, micro: int = 64) -> int:
+        """Largest divisor of batch_size that is <= micro."""
+        return micro_chunk(batch_size, micro)
+
     def _micro(self) -> int:
-        # batch_size below MICRO would give zero scan chunks (C = B//S = 0)
-        # and a 0/0 loss; padding guarantees batches of exactly batch_size,
-        # so clamping S to it keeps C >= 1 for any user batch_size.
-        return min(self.MICRO, self.config.batch_size)
+        # Padding guarantees batches of exactly batch_size, and the scan
+        # consumes C = B // S chunks — S must DIVIDE batch_size or the
+        # remainder pairs are silently dropped. Use the largest divisor of
+        # batch_size that is <= MICRO (worst case 1, sequential scan).
+        return micro_chunk(self.config.batch_size, self.MICRO)
 
     def _build_sg(self):
         S = self._micro()

@@ -357,9 +357,9 @@ class FastText:
             return -(jnp.sum(jax.nn.log_sigmoid(pos))
                      + jnp.sum(jax.nn.log_sigmoid(-neg) * neg_mask))
 
-        # micro-batch scan, see SequenceVectors step notes; clamp so that
-        # small batch_size still yields >= 1 chunk
-        S = min(SequenceVectors.MICRO, cfg.batch_size)
+        # micro-batch scan, see SequenceVectors step notes; S must divide
+        # the exact (padded) batch or remainder pairs are dropped
+        S = SequenceVectors.micro_chunk(cfg.batch_size)
 
         @jax.jit
         def step(w_in, w_out, c, x, negs, lr):

@@ -1,0 +1,199 @@
+"""Dataset-layer tests: normalizers, RecordReader→DataSet bridge, fetchers,
+and the LeNet end-to-end training slice (SURVEY §7 build-plan step 4 /
+BASELINE config 1 — digits stands in for MNIST in the no-egress test env)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    ArrayDataSetIterator, DataSet, DigitsDataSetIterator, IrisDataSetIterator,
+    ImagePreProcessingScaler, ListDataSetIterator, NormalizerMinMaxScaler,
+    NormalizerSerializer, NormalizerStandardize,
+    RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator,
+    parse_idx)
+from deeplearning4j_tpu.etl import (CollectionRecordReader, CSVRecordReader,
+                                    CSVSequenceRecordReader, FileSplit,
+                                    StringSplit)
+from deeplearning4j_tpu.ndarray import factory as nd
+from deeplearning4j_tpu.ndarray.ndarray import NDArray
+
+
+class TestNormalizers:
+    def _ds(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(200, 5).astype(np.float32) * np.array(
+            [1, 2, 3, 4, 5], np.float32) + np.array(
+            [10, -5, 0, 2, 100], np.float32)
+        return DataSet(NDArray(x), NDArray(np.zeros((200, 2), np.float32)))
+
+    def test_standardize(self):
+        ds = self._ds()
+        norm = NormalizerStandardize().fit(ds)
+        out = norm.transform(DataSet(ds.features.dup(), None))
+        arr = np.asarray(out.features.jax())
+        np.testing.assert_allclose(arr.mean(0), 0, atol=1e-4)
+        np.testing.assert_allclose(arr.std(0), 1, atol=1e-3)
+        rev = norm.revert_array(arr)
+        np.testing.assert_allclose(rev, np.asarray(ds.features.jax()),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_standardize_streaming_matches_full(self):
+        """Iterator (streaming Chan-merge) fit == single-DataSet fit."""
+        ds = self._ds()
+        full = NormalizerStandardize().fit(ds)
+        batches = ds.batch_by(32)
+        stream = NormalizerStandardize().fit(ListDataSetIterator(batches))
+        np.testing.assert_allclose(full.mean, stream.mean, rtol=1e-5)
+        np.testing.assert_allclose(full.std, stream.std, rtol=1e-4)
+
+    def test_standardize_sequence_axes(self):
+        x = np.random.RandomState(1).randn(8, 3, 7).astype(np.float32)
+        norm = NormalizerStandardize().fit(DataSet(NDArray(x), None))
+        assert norm.mean.shape == (3,)
+        out = norm.transform_array(x)
+        np.testing.assert_allclose(out.mean(axis=(0, 2)), 0, atol=1e-4)
+
+    def test_minmax(self):
+        ds = self._ds()
+        norm = NormalizerMinMaxScaler(0, 1).fit(ds)
+        arr = norm.transform_array(np.asarray(ds.features.jax()))
+        assert arr.min() >= -1e-6 and arr.max() <= 1 + 1e-6
+        rev = norm.revert_array(arr)
+        np.testing.assert_allclose(rev, np.asarray(ds.features.jax()),
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_image_scaler(self):
+        x = np.array([[0.0, 127.5, 255.0]], np.float32)
+        s = ImagePreProcessingScaler(0, 1)
+        np.testing.assert_allclose(s.transform_array(x),
+                                   [[0, 0.5, 1]], atol=1e-3)
+
+    def test_serializer_roundtrip(self, tmp_path):
+        ds = self._ds()
+        norm = NormalizerStandardize().fit(ds)
+        p = str(tmp_path / "norm.zip")
+        NormalizerSerializer.write(norm, p)
+        norm2 = NormalizerSerializer.restore(p)
+        assert isinstance(norm2, NormalizerStandardize)
+        np.testing.assert_allclose(norm.mean, norm2.mean)
+        np.testing.assert_allclose(norm.std, norm2.std)
+
+
+class TestRecordReaderIterator:
+    def test_classification_from_csv(self):
+        csv = "1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,2\n7.0,8.0,1\n"
+        rr = CSVRecordReader().initialize(StringSplit(csv))
+        it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=2,
+                                         num_classes=3)
+        b1 = it.next()
+        assert b1.features.shape == (2, 2)
+        assert b1.labels.shape == (2, 3)
+        np.testing.assert_allclose(np.asarray(b1.labels.jax()),
+                                   [[1, 0, 0], [0, 1, 0]])
+        assert it.has_next()
+        it.next()
+        assert not it.has_next()
+        it.reset()
+        assert it.has_next()
+
+    def test_regression(self):
+        rr = CollectionRecordReader([[1.0, 2.0, 10.0], [3.0, 4.0, 20.0]])
+        rr.initialize()
+        it = RecordReaderDataSetIterator(rr, 2, label_index=2,
+                                         regression=True)
+        b = it.next()
+        np.testing.assert_allclose(np.asarray(b.labels.jax()),
+                                   [[10.0], [20.0]])
+
+    def test_multi_output_regression(self):
+        rr = CollectionRecordReader([[1.0, 5.0, 6.0], [2.0, 7.0, 8.0]])
+        rr.initialize()
+        it = RecordReaderDataSetIterator(rr, 2, label_index=1,
+                                         label_index_to=2, regression=True)
+        b = it.next()
+        assert b.features.shape == (2, 1)
+        assert b.labels.shape == (2, 2)
+
+    def test_sequence_iterator(self, tmp_path):
+        (tmp_path / "s0.csv").write_text("1,2,0\n3,4,1\n5,6,0\n")
+        (tmp_path / "s1.csv").write_text("7,8,1\n9,10,0\n")
+        rr = CSVSequenceRecordReader().initialize(
+            FileSplit(str(tmp_path), allowed_extensions=["csv"]))
+        it = SequenceRecordReaderDataSetIterator(rr, 2, label_index=2,
+                                                 num_classes=2)
+        b = it.next()
+        assert b.features.shape == (2, 2, 3)   # [batch, feat, time]
+        assert b.labels.shape == (2, 2, 3)
+        mask = np.asarray(b.features_mask.jax())
+        np.testing.assert_allclose(mask, [[1, 1, 1], [1, 1, 0]])
+
+
+class TestFetchers:
+    def test_parse_idx_roundtrip(self, tmp_path):
+        import struct
+        arr = np.arange(24, dtype=np.uint8).reshape(2, 3, 4)
+        p = tmp_path / "test-idx3-ubyte"
+        with open(p, "wb") as f:
+            f.write(struct.pack(">I", 0x00000803))
+            f.write(struct.pack(">III", 2, 3, 4))
+            f.write(arr.tobytes())
+        out = parse_idx(str(p))
+        np.testing.assert_array_equal(out, arr)
+
+    def test_mnist_missing_gives_clear_error(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_DATA", str(tmp_path))
+        from deeplearning4j_tpu.datasets import MnistDataSetIterator
+        with pytest.raises(FileNotFoundError, match="no network egress"):
+            MnistDataSetIterator(32)
+
+    def test_iris(self):
+        it = IrisDataSetIterator(150)
+        ds = it.next()
+        assert ds.features.shape == (150, 4)
+        assert ds.labels.shape == (150, 3)
+
+    def test_digits(self):
+        tr = DigitsDataSetIterator(64, train=True, as_image=True)
+        te = DigitsDataSetIterator(64, train=False, as_image=True)
+        assert tr.next().features.shape == (64, 1, 8, 8)
+        assert tr.features.shape[0] + te.features.shape[0] == 1797
+
+
+class TestLeNetEndToEnd:
+    """SURVEY build-plan step 4: the 'one model running' milestone.
+    LeNet-style CNN trained from the raw-record path (fetcher → normalizer →
+    iterator → MultiLayerNetwork.fit) to high test accuracy on a real
+    dataset (bundled 8x8 digits; MNIST itself needs network egress)."""
+
+    def test_lenet_digits(self):
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                                       DenseLayer,
+                                                       OutputLayer,
+                                                       SubsamplingLayer)
+
+        conf = (NeuralNetConfiguration.builder()
+                .seed(12345)
+                .updater(Adam(learning_rate=1e-3))
+                .list()
+                .layer(ConvolutionLayer(n_out=16, kernel_size=(3, 3),
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=32, kernel_size=(3, 3),
+                                        activation="relu"))
+                .layer(DenseLayer(n_out=64, activation="relu"))
+                .layer(OutputLayer(n_out=10))
+                .set_input_type(InputType.convolutional(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+
+        train = DigitsDataSetIterator(128, train=True, as_image=True,
+                                      seed=7)
+        test = DigitsDataSetIterator(256, train=False, as_image=True,
+                                     shuffle=False)
+        net.fit(train, num_epochs=40)
+        ev = net.evaluate(test)
+        assert ev.accuracy() >= 0.95, f"accuracy {ev.accuracy():.3f}"
