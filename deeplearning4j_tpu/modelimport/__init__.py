@@ -1,0 +1,27 @@
+"""Model import: foreign-framework graphs/models -> SameDiff / DL4J nets.
+
+Reference: `nd4j/samediff-import/` (Kotlin IR + declarative mapping rules,
+`ImportGraph.kt:68,218`), `deeplearning4j/deeplearning4j-modelimport/`
+(Keras h5, `KerasModel.java:639`), and the legacy `org/nd4j/imports/`
+`TFGraphMapper` (901 lines).
+
+TPU-native redesign: the reference maps foreign ops onto its own op
+descriptors via protobuf IR (`org/nd4j/ir`). Here every foreign node maps
+onto a registered op in `ops.registry` (a pure jax function), so an
+imported graph *is* a SameDiff graph and compiles whole-program under jit
+like any native graph. Parsing uses a self-contained protobuf wire-format
+decoder (`protoio.py`) — no tensorflow/onnx runtime dependency.
+"""
+from .ir import IRGraph, IRNode, ImportContext, ImportException
+from .tf.importer import TFGraphImporter, import_tf_graph
+from .onnx.importer import OnnxImporter, import_onnx_model
+from .keras.importer import (KerasModelImport, import_keras_model_and_weights,
+                             import_keras_sequential_model_and_weights)
+
+__all__ = [
+    "IRGraph", "IRNode", "ImportContext", "ImportException",
+    "TFGraphImporter", "import_tf_graph",
+    "OnnxImporter", "import_onnx_model",
+    "KerasModelImport", "import_keras_model_and_weights",
+    "import_keras_sequential_model_and_weights",
+]

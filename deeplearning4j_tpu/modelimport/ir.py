@@ -1,0 +1,215 @@
+"""Framework-agnostic import IR + mapping-rule registry.
+
+Reference: `nd4j/samediff-import/samediff-import-api/src/main/kotlin/org/nd4j/
+samediff/frameworkimport/ImportGraph.kt:68` (importGraph walks IRGraph nodes,
+resolving each through a mapping-rule registry into SameDiff ops) and the
+per-framework `IRGraph/IRNode/IROpDef` abstractions (ADRs 0003/0004/0005).
+
+TPU-native redesign: mapping rules emit *registered ops* (pure jax fns) into
+a SameDiff graph, so the imported model whole-graph-compiles under XLA like
+a natively built one. Shape-ish constant inputs (reshape targets, axes,
+perms) are folded into static kwargs at import time — XLA wants static
+shapes, so the importer is where TF/ONNX dynamism dies.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..autodiff.samediff import SameDiff, SDVariable
+from ..ops.registry import OpRegistry
+
+
+class ImportException(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class IRNode:
+    """One foreign-graph node in framework-neutral form."""
+    name: str
+    op_type: str
+    inputs: List[str]            # producer tensor names (foreign naming)
+    outputs: List[str]           # tensor names this node produces
+    attrs: Dict[str, Any]
+    control_inputs: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class IRGraph:
+    """Parsed foreign graph, before mapping."""
+    framework: str
+    nodes: List[IRNode]
+    initializers: Dict[str, np.ndarray]          # weights/consts by tensor name
+    inputs: Dict[str, Any]                       # name -> (shape, dtype)
+    outputs: List[str]
+
+    def node_map(self) -> Dict[str, IRNode]:
+        m = {}
+        for n in self.nodes:
+            for o in n.outputs:
+                m[o] = n
+        return m
+
+
+# --------------------------------------------------------------- registry
+# framework -> op_type -> mapper(node, ctx) (ImportGraph's OpMappingRegistry)
+_MAPPERS: Dict[str, Dict[str, Callable]] = {}
+
+
+def mapper(framework: str, *op_types: str):
+    def deco(fn):
+        reg = _MAPPERS.setdefault(framework, {})
+        for t in op_types:
+            reg[t] = fn
+        return fn
+    return deco
+
+
+def get_mapper(framework: str, op_type: str) -> Optional[Callable]:
+    return _MAPPERS.get(framework, {}).get(op_type)
+
+
+def supported_ops(framework: str) -> List[str]:
+    return sorted(_MAPPERS.get(framework, {}))
+
+
+class ImportContext:
+    """Carries the target SameDiff graph during a mapping pass.
+
+    Mapping rules call `ctx.emit(...)` (registered-op node), `ctx.bind(...)`
+    (alias a foreign tensor name to an SDVariable) and `ctx.const_value(...)`
+    (static fold of a constant input).
+    """
+
+    def __init__(self, graph: IRGraph, sd: Optional[SameDiff] = None,
+                 import_weights_as_variables: bool = False):
+        self.graph = graph
+        self.sd = sd or SameDiff.create()
+        self.vars: Dict[str, SDVariable] = {}      # foreign tensor name -> var
+        self.const_np: Dict[str, np.ndarray] = dict(graph.initializers)
+        self._as_variables = import_weights_as_variables
+        self._node_map = graph.node_map()
+        # static shape/dtype propagation (jax.eval_shape as we emit) — lets
+        # Shape/Size/Rank fold to constants, which kills TF graphs' dynamic
+        # reshape chains (XLA requires static shapes anyway)
+        self._var_aval: Dict[str, jax.ShapeDtypeStruct] = {}
+
+    # -- variable plumbing ------------------------------------------------
+    def bind(self, tensor_name: str, var: SDVariable,
+             aval: Optional[jax.ShapeDtypeStruct] = None):
+        self.vars[tensor_name] = var
+        if aval is not None:
+            self._var_aval[var.name] = aval
+        elif var.shape is not None and var.name not in self._var_aval:
+            self._var_aval[var.name] = jax.ShapeDtypeStruct(
+                var.shape, np.dtype(var.dtype))
+
+    def aval(self, tensor_name: str) -> Optional[jax.ShapeDtypeStruct]:
+        """Static shape/dtype of a foreign tensor, if known."""
+        if tensor_name in self.const_np:
+            a = np.asarray(self.const_np[tensor_name])
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+        v = self.vars.get(tensor_name)
+        return self._var_aval.get(v.name) if v is not None else None
+
+    def has(self, tensor_name: str) -> bool:
+        return tensor_name in self.vars or tensor_name in self.const_np
+
+    def get(self, tensor_name: str) -> SDVariable:
+        """SDVariable for a foreign tensor, materializing consts on demand."""
+        if tensor_name in self.vars:
+            return self.vars[tensor_name]
+        if tensor_name in self.const_np:
+            arr = self.const_np[tensor_name]
+            safe = tensor_name.replace(":", "_")
+            if self._as_variables and np.issubdtype(arr.dtype, np.floating) \
+                    and arr.ndim >= 1:
+                v = self.sd.var(safe, arr)
+            else:
+                v = self.sd.constant(arr, safe)
+            self.bind(tensor_name, v)
+            return v
+        raise ImportException(f"tensor {tensor_name!r} not yet produced — "
+                              f"graph not topologically ordered?")
+
+    def const_value(self, tensor_name: str) -> np.ndarray:
+        """Static value of a constant input (for shapes/axes/perms)."""
+        if tensor_name in self.const_np:
+            return self.const_np[tensor_name]
+        raise ImportException(
+            f"input {tensor_name!r} must be a graph constant (static shape/"
+            f"axis data) for TPU import, but is computed at runtime")
+
+    def maybe_const(self, tensor_name: str) -> Optional[np.ndarray]:
+        return self.const_np.get(tensor_name)
+
+    def producer(self, tensor_name: str) -> Optional[IRNode]:
+        return self._node_map.get(tensor_name)
+
+    # -- emission ---------------------------------------------------------
+    def _infer_avals(self, op_name, inputs, n_outputs, kwargs):
+        """Propagate static shapes through the emitted op via jax.eval_shape."""
+        in_avals = []
+        for v in inputs:
+            if v is None:
+                in_avals.append(None)
+                continue
+            a = self._var_aval.get(v.name)
+            if a is None:
+                return None
+            in_avals.append(a)
+        try:
+            fn = functools.partial(OpRegistry.get().lookup(op_name).fn, **kwargs)
+            out = jax.eval_shape(fn, *in_avals)
+        except Exception:
+            return None
+        if n_outputs == 1:
+            return [out]
+        return list(out)
+
+    def emit(self, op_name: str, inputs: Sequence[SDVariable],
+             out_tensor: str, n_outputs: int = 1, **kwargs):
+        """Record a registered op; bind its output(s) to foreign name(s)."""
+        safe = out_tensor.replace(":", "_")
+        out = self.sd._record(op_name, list(inputs), n_outputs=n_outputs,
+                              out_name=safe, **kwargs)
+        avals = self._infer_avals(op_name, inputs, n_outputs, kwargs)
+        if n_outputs == 1:
+            self.bind(out_tensor, out,
+                      aval=avals[0] if avals else None)
+        return out
+
+    def emit_multi(self, op_name: str, inputs: Sequence[SDVariable],
+                   out_tensors: Sequence[str], **kwargs):
+        outs = self.sd._record(op_name, list(inputs),
+                               n_outputs=len(out_tensors), **kwargs)
+        if len(out_tensors) == 1:
+            outs = (outs,)
+        avals = self._infer_avals(op_name, inputs, len(out_tensors), kwargs)
+        for i, (t, v) in enumerate(zip(out_tensors, outs)):
+            self.bind(t, v, aval=avals[i] if avals else None)
+        return outs
+
+
+def run_import(graph: IRGraph, sd: Optional[SameDiff] = None,
+               import_weights_as_variables: bool = False) -> ImportContext:
+    """The ImportGraph.importGraph analog: walk nodes, apply mapping rules."""
+    ctx = ImportContext(graph, sd, import_weights_as_variables)
+    for name, spec in graph.inputs.items():
+        shape, dtype = spec
+        ctx.bind(name, ctx.sd.placeholder(name.replace(":", "_"),
+                                          shape=shape, dtype=dtype))
+    unmapped = sorted({n.op_type for n in graph.nodes
+                       if get_mapper(graph.framework, n.op_type) is None})
+    if unmapped:
+        raise ImportException(
+            f"no {graph.framework} mapping rule for op type(s): {unmapped}")
+    for node in graph.nodes:
+        fn = get_mapper(graph.framework, node.op_type)
+        fn(node, ctx)
+    return ctx

@@ -1,0 +1,5 @@
+from .importer import (KerasModelImport, import_keras_model_and_weights,
+                       import_keras_sequential_model_and_weights)
+
+__all__ = ["KerasModelImport", "import_keras_model_and_weights",
+           "import_keras_sequential_model_and_weights"]

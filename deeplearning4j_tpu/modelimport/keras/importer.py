@@ -1,0 +1,562 @@
+"""Keras HDF5 model import -> MultiLayerNetwork / ComputationGraph.
+
+Reference: `deeplearning4j/deeplearning4j-modelimport/src/main/java/org/
+deeplearning4j/nn/modelimport/keras/KerasModelImport.java:45-151` (entry
+points), `KerasModel.java:639` (getComputationGraph),
+`KerasSequentialModel.java` (-> MultiLayerNetwork), and the 62 layer
+adapters under `keras/layers/**`.
+
+Handles both Keras 2 and Keras 3 legacy-h5 flavors (model_config JSON +
+model_weights groups). Data-format note: Keras is channels-last (NHWC);
+this framework's conv stack is NCHW like the reference DL4J — the importer
+converts kernels (HWIO is shared) and reorders Flatten->Dense kernels from
+(h,w,c) to (c,h,w) row order, the same fixup the reference applies via
+KerasFlatten's preprocessor.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...nn.conf import layers as L
+from ...nn.conf.config import (InputType, MultiLayerConfiguration,
+                               NeuralNetConfiguration)
+from ...nn.graph.computation_graph import ComputationGraph
+from ...nn.graph.vertices import ElementWiseVertex, MergeVertex
+from ...nn.multilayer import MultiLayerNetwork
+from ..ir import ImportException
+
+_ACTIVATIONS = {
+    "linear": "identity", "relu": "relu", "relu6": "relu6",
+    "softmax": "softmax", "sigmoid": "sigmoid", "tanh": "tanh",
+    "elu": "elu", "selu": "selu", "softplus": "softplus",
+    "softsign": "softsign", "hard_sigmoid": "hardsigmoid",
+    "swish": "swish", "silu": "swish", "gelu": "gelu", "mish": "mish",
+    "exponential": "exp", "leaky_relu": "leakyrelu",
+}
+
+
+def _act(name) -> str:
+    if name is None:
+        return "identity"
+    if isinstance(name, dict):  # serialized Activation object
+        name = name.get("config", {}).get("name", "linear")
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise ImportException(f"unsupported Keras activation {name!r}")
+
+
+def _pair(v):
+    return tuple(int(x) for x in v) if isinstance(v, (list, tuple)) \
+        else (int(v), int(v))
+
+
+def _keras_shape_to_input_type(shape) -> Optional[Tuple[int, ...]]:
+    """Keras shape (no batch) -> InputType tuple. NHWC -> (C,H,W);
+    [T, F] -> (F, T); [F] -> (F,)."""
+    if shape is None:
+        return None
+    dims = [d for d in shape]
+    if len(dims) == 3:
+        h, w, c = dims
+        return InputType.convolutional(h, w, c)
+    if len(dims) == 2:
+        t, f = dims
+        return InputType.recurrent(f, t if t is not None else -1)
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0]) if dims[0] else None
+    return None
+
+
+class _Adapted:
+    """One imported layer: our config + a weight-mapping function."""
+
+    def __init__(self, layer: Optional[L.Layer],
+                 set_weights: Optional[Callable] = None):
+        self.layer = layer
+        self.set_weights = set_weights  # (weights, in_type) -> params dict
+
+
+def _dense_adapter(cfg, keras_in_shape):
+    units = int(cfg["units"])
+    use_bias = bool(cfg.get("use_bias", True))
+    layer = L.DenseLayer(n_out=units, activation=_act(cfg.get("activation")),
+                         has_bias=use_bias, name=cfg.get("name"))
+
+    def set_weights(weights, in_type):
+        kernel = np.asarray(weights[0])
+        # Flatten-after-conv fixup: Keras flattens (h,w,c), ours (c,h,w)
+        if keras_in_shape is not None and len(keras_in_shape) == 3 and \
+                kernel.shape[0] == int(np.prod(keras_in_shape)):
+            h, w, c = keras_in_shape
+            kernel = kernel.reshape(h, w, c, units).transpose(2, 0, 1, 3) \
+                .reshape(c * h * w, units)
+        p = {"W": jnp.asarray(kernel)}
+        if use_bias:
+            p["b"] = jnp.asarray(np.asarray(weights[1]))
+        return p
+
+    return _Adapted(layer, set_weights)
+
+
+def _conv2d_adapter(cfg, depthwise=False):
+    strides = _pair(cfg.get("strides", (1, 1)))
+    dilation = _pair(cfg.get("dilation_rate", (1, 1)))
+    padding = "SAME" if cfg.get("padding", "valid") == "same" else "VALID"
+    use_bias = bool(cfg.get("use_bias", True))
+    act = _act(cfg.get("activation"))
+    if depthwise:
+        mult = int(cfg.get("depth_multiplier", 1))
+        layer = L.DepthwiseConvolution2D(
+            n_out=0, depth_multiplier=mult,
+            kernel_size=_pair(cfg["kernel_size"]), stride=strides,
+            padding=padding, dilation=dilation, activation=act,
+            has_bias=use_bias, name=cfg.get("name"))
+    else:
+        layer = L.ConvolutionLayer(
+            n_out=int(cfg["filters"]), kernel_size=_pair(cfg["kernel_size"]),
+            stride=strides, padding=padding, dilation=dilation,
+            activation=act, has_bias=use_bias, name=cfg.get("name"))
+
+    def set_weights(weights, in_type):
+        p = {"W": jnp.asarray(np.asarray(weights[0]))}  # HWIO both sides
+        if use_bias:
+            p["b"] = jnp.asarray(np.asarray(weights[1]))
+        return p
+
+    return _Adapted(layer, set_weights)
+
+
+def _pool2d_adapter(cfg, pool_type):
+    pool = _pair(cfg.get("pool_size", (2, 2)))
+    strides = _pair(cfg.get("strides") or cfg.get("pool_size", (2, 2)))
+    padding = "SAME" if cfg.get("padding", "valid") == "same" else "VALID"
+    return _Adapted(L.SubsamplingLayer(
+        pooling_type=pool_type, kernel_size=pool, stride=strides,
+        padding=padding, name=cfg.get("name")))
+
+
+def _bn_adapter(cfg):
+    scale = bool(cfg.get("scale", True))
+    center = bool(cfg.get("center", True))
+    layer = L.BatchNormalization(eps=float(cfg.get("epsilon", 1e-3)),
+                                 decay=float(cfg.get("momentum", 0.99)),
+                                 use_gamma_beta=True, name=cfg.get("name"))
+
+    def set_weights(weights, in_type):
+        w = [np.asarray(a) for a in weights]
+        i = 0
+        gamma = w[i] if scale else None
+        i += 1 if scale else 0
+        beta = w[i] if center else None
+        i += 1 if center else 0
+        mean, var = w[i], w[i + 1]
+        c = mean.shape[0]
+        return {"gamma": jnp.asarray(gamma if gamma is not None
+                                     else np.ones(c, np.float32)),
+                "beta": jnp.asarray(beta if beta is not None
+                                    else np.zeros(c, np.float32)),
+                "state_mean": jnp.asarray(mean),
+                "state_var": jnp.asarray(var)}
+
+    return _Adapted(layer, set_weights)
+
+
+def _embedding_adapter(cfg):
+    layer = L.EmbeddingSequenceLayer(n_in=int(cfg["input_dim"]),
+                                     n_out=int(cfg["output_dim"]),
+                                     name=cfg.get("name"))
+
+    def set_weights(weights, in_type):
+        return {"W": jnp.asarray(np.asarray(weights[0]))}
+
+    return _Adapted(layer, set_weights)
+
+
+def _lstm_adapter(cfg):
+    units = int(cfg["units"])
+    layer = L.LSTM(n_out=units, activation=_act(cfg.get("activation", "tanh")),
+                   return_sequence=bool(cfg.get("return_sequences", False)),
+                   name=cfg.get("name"))
+
+    def set_weights(weights, in_type):
+        kernel, rec, bias = [np.asarray(a) for a in weights[:3]]
+        # Keras gate order [i, f, c, o] == ours — direct copy
+        return {"Wx": jnp.asarray(kernel), "Wh": jnp.asarray(rec),
+                "b": jnp.asarray(bias)}
+
+    return _Adapted(layer, set_weights)
+
+
+def _simple_rnn_adapter(cfg):
+    units = int(cfg["units"])
+    layer = L.SimpleRnn(n_out=units,
+                        activation=_act(cfg.get("activation", "tanh")),
+                        name=cfg.get("name"))
+
+    def set_weights(weights, in_type):
+        kernel, rec, bias = [np.asarray(a) for a in weights[:3]]
+        return {"Wx": jnp.asarray(kernel), "Wh": jnp.asarray(rec),
+                "b": jnp.asarray(bias)}
+
+    return _Adapted(layer, set_weights)
+
+
+def _adapt_layer(class_name: str, cfg: Dict[str, Any],
+                 keras_in_shape) -> Optional[_Adapted]:
+    """One Keras layer -> framework layer + weight mapper.
+
+    Returns None for layers that vanish (InputLayer, Flatten — handled by
+    automatic preprocessors like the reference's KerasFlatten)."""
+    if class_name in ("InputLayer", "Flatten"):
+        return None
+    if class_name == "Dense":
+        return _dense_adapter(cfg, keras_in_shape)
+    if class_name == "Conv2D":
+        return _conv2d_adapter(cfg)
+    if class_name == "DepthwiseConv2D":
+        return _conv2d_adapter(cfg, depthwise=True)
+    if class_name == "MaxPooling2D":
+        return _pool2d_adapter(cfg, "max")
+    if class_name == "AveragePooling2D":
+        return _pool2d_adapter(cfg, "avg")
+    if class_name == "GlobalAveragePooling2D":
+        return _Adapted(L.GlobalPoolingLayer(pooling_type="avg",
+                                             name=cfg.get("name")))
+    if class_name == "GlobalMaxPooling2D":
+        return _Adapted(L.GlobalPoolingLayer(pooling_type="max",
+                                             name=cfg.get("name")))
+    if class_name == "BatchNormalization":
+        return _bn_adapter(cfg)
+    if class_name == "Dropout":
+        return _Adapted(L.DropoutLayer(rate=float(cfg.get("rate", 0.5)),
+                                       name=cfg.get("name")))
+    if class_name == "Activation":
+        return _Adapted(L.ActivationLayer(
+            activation=_act(cfg.get("activation")), name=cfg.get("name")))
+    if class_name == "LeakyReLU":
+        return _Adapted(L.ActivationLayer(activation="leakyrelu",
+                                          name=cfg.get("name")))
+    if class_name == "ReLU":
+        return _Adapted(L.ActivationLayer(activation="relu",
+                                          name=cfg.get("name")))
+    if class_name == "ELU":
+        return _Adapted(L.ActivationLayer(activation="elu",
+                                          name=cfg.get("name")))
+    if class_name == "Embedding":
+        return _embedding_adapter(cfg)
+    if class_name == "LSTM":
+        return _lstm_adapter(cfg)
+    if class_name == "SimpleRNN":
+        return _simple_rnn_adapter(cfg)
+    if class_name == "ZeroPadding2D":
+        pad = cfg.get("padding", (1, 1))
+        if isinstance(pad, (list, tuple)) and pad and \
+                isinstance(pad[0], (list, tuple)):
+            padding = (int(pad[0][0]), int(pad[0][1]),
+                       int(pad[1][0]), int(pad[1][1]))
+        else:
+            ph, pw = _pair(pad)
+            padding = (ph, ph, pw, pw)
+        return _Adapted(L.ZeroPaddingLayer(padding=padding,
+                                           name=cfg.get("name")))
+    raise ImportException(f"unsupported Keras layer type {class_name!r}")
+
+
+# ---------------------------------------------------------------- h5 I/O
+def _read_h5(path):
+    import h5py
+    with h5py.File(path, "r") as f:
+        raw = f.attrs.get("model_config")
+        if raw is None:
+            raise ImportException(
+                "h5 file has no model_config attr (weights-only file?); "
+                "use import with a separate config JSON")
+        if isinstance(raw, bytes):
+            raw = raw.decode("utf-8")
+        config = json.loads(raw)
+        weights: Dict[str, List[np.ndarray]] = {}
+        mw = f["model_weights"] if "model_weights" in f else f
+        layer_names = [n.decode() if isinstance(n, bytes) else n
+                       for n in mw.attrs.get("layer_names", list(mw.keys()))]
+        for lname in layer_names:
+            if lname not in mw:
+                continue
+            grp = mw[lname]
+            wnames = [n.decode() if isinstance(n, bytes) else n
+                      for n in grp.attrs.get("weight_names", [])]
+            ws = []
+            if wnames:
+                for wn in wnames:
+                    ws.append(np.array(grp[wn]))
+            else:
+                def visit(name, obj):
+                    import h5py as _h
+                    if isinstance(obj, _h.Dataset):
+                        ws.append(np.array(obj))
+                grp.visititems(visit)
+            if ws:
+                weights[lname] = ws
+    return config, weights
+
+
+def _layer_entries(model_cfg: Dict) -> List[Dict]:
+    cfg = model_cfg.get("config", model_cfg)
+    return cfg["layers"]
+
+
+def _keras_out_shape(class_name, cfg, in_shape):
+    """Track Keras-side (channels-last, batchless) shapes for weight fixups."""
+    if in_shape is None:
+        return None
+    if class_name == "Dense":
+        return (int(cfg["units"]),)
+    if class_name == "Conv2D":
+        h, w, c = in_shape
+        sh, sw = _pair(cfg.get("strides", (1, 1)))
+        kh, kw = _pair(cfg["kernel_size"])
+        if cfg.get("padding", "valid") == "same":
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:
+            oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        return (oh, ow, int(cfg["filters"]))
+    if class_name in ("MaxPooling2D", "AveragePooling2D"):
+        h, w, c = in_shape
+        ph, pw = _pair(cfg.get("pool_size", (2, 2)))
+        st = cfg.get("strides") or (ph, pw)
+        sh, sw = _pair(st)
+        if cfg.get("padding", "valid") == "same":
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:
+            oh, ow = (h - ph) // sh + 1, (w - pw) // sw + 1
+        return (oh, ow, c)
+    if class_name in ("GlobalAveragePooling2D", "GlobalMaxPooling2D"):
+        return (in_shape[-1],)
+    if class_name == "Flatten":
+        return (int(np.prod(in_shape)),)
+    if class_name == "Embedding":
+        return tuple(in_shape) + (int(cfg["output_dim"]),)
+    if class_name == "LSTM":
+        units = int(cfg["units"])
+        return (in_shape[0], units) if cfg.get("return_sequences") \
+            else (units,)
+    if class_name == "ZeroPadding2D":
+        h, w, c = in_shape
+        pad = cfg.get("padding", (1, 1))
+        if isinstance(pad, (list, tuple)) and pad and \
+                isinstance(pad[0], (list, tuple)):
+            return (h + pad[0][0] + pad[0][1], w + pad[1][0] + pad[1][1], c)
+        ph, pw = _pair(pad)
+        return (h + 2 * ph, w + 2 * pw, c)
+    return in_shape  # shape-preserving (BN, Dropout, Activation...)
+
+
+def _input_shape_of(entries) -> Optional[Tuple]:
+    for e in entries:
+        cfg = e.get("config", {})
+        if e["class_name"] == "InputLayer":
+            shape = cfg.get("batch_shape") or cfg.get("batch_input_shape")
+            if shape:
+                return tuple(shape[1:])
+        bis = cfg.get("batch_input_shape") or cfg.get("batch_shape")
+        if bis:
+            return tuple(bis[1:])
+    return None
+
+
+class KerasModelImport:
+    """Entry points mirroring the reference KerasModelImport API."""
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(
+            path, input_shape: Optional[Tuple] = None) -> MultiLayerNetwork:
+        config, weights = _read_h5(path)
+        if config["class_name"] not in ("Sequential",):
+            raise ImportException(
+                f"not a Sequential model ({config['class_name']}); use "
+                f"import_keras_model_and_weights")
+        entries = _layer_entries(config)
+        keras_shape = input_shape or _input_shape_of(entries)
+        if keras_shape is None:  # keras 3 Sequential: build_input_shape
+            bis = config.get("config", {}).get("build_input_shape")
+            if bis:
+                keras_shape = tuple(bis[1:])
+        if keras_shape is None:
+            raise ImportException("could not determine input shape; pass "
+                                  "input_shape=")
+
+        lb = NeuralNetConfiguration.builder().list()
+        in_type = _keras_shape_to_input_type(keras_shape)
+        lb.set_input_type(in_type)
+        adapted: List[Tuple[int, _Adapted, Tuple]] = []
+        cur = tuple(keras_shape)
+        conv_src = None  # pre-Flatten conv shape for Dense-kernel reordering
+        idx = 0
+        for e in entries:
+            cls, cfg = e["class_name"], e.get("config", {})
+            if cls == "Flatten" and cur is not None and len(cur) == 3:
+                conv_src = cur
+            shape_for_adapter = conv_src if (cls == "Dense" and conv_src) \
+                else cur
+            a = _adapt_layer(cls, cfg, shape_for_adapter)
+            if cls == "Dense":
+                conv_src = None
+            if a is not None:
+                lb.layer(a.layer)
+                adapted.append((idx, a, shape_for_adapter))
+                idx += 1
+            cur = _keras_out_shape(cls, cfg, cur)
+
+        conf = lb.build()
+        net = MultiLayerNetwork(conf)
+        net.init()
+        # overwrite initialized params with the imported weights
+        for i, a, in_shape in adapted:
+            if a.set_weights is None:
+                continue
+            name = a.layer.name
+            if name not in weights:
+                raise ImportException(f"no weights for layer {name!r} in h5")
+            net._params[i] = a.set_weights(weights[name], in_shape)
+        net._updater_state = conf.updater.init(net._trainable(net._params))
+        return net
+
+    @staticmethod
+    def import_keras_model_and_weights(path,
+                                       input_shape: Optional[Tuple] = None
+                                       ) -> ComputationGraph:
+        config, weights = _read_h5(path)
+        cls_name = config["class_name"]
+        if cls_name == "Sequential":
+            raise ImportException("Sequential model; use "
+                                  "import_keras_sequential_model_and_weights")
+        entries = _layer_entries(config)
+        gcfg = config.get("config", {})
+
+        def _ref_names(spec):
+            """input/output_layers spec -> layer names (keras 2 and 3).
+
+            Single-ref specs may be flat ['name', 0, 0]; multi-ref are
+            [['a',0,0], ['b',0,0]] (or plain name lists)."""
+            if not spec:
+                return []
+            if isinstance(spec, (list, tuple)) and len(spec) == 3 and \
+                    isinstance(spec[0], str) and \
+                    all(isinstance(s, int) for s in spec[1:]):
+                return [spec[0]]
+            out = []
+            for item in spec:
+                out.append(item[0] if isinstance(item, (list, tuple))
+                           else item)
+            return out
+
+        builder = NeuralNetConfiguration.builder().graph_builder()
+        keras_shapes: Dict[str, Tuple] = {}
+        adapted: Dict[str, Tuple[_Adapted, Tuple]] = {}
+        alias: Dict[str, str] = {}  # keras layer name -> vertex name used
+        unflattened: Dict[str, Tuple] = {}  # Flatten name -> conv shape
+
+        input_names = _ref_names(gcfg.get("input_layers", []))
+        builder.add_inputs(*input_names)
+
+        for e in entries:
+            cls, cfg = e["class_name"], e.get("config", {})
+            name = cfg.get("name") or e.get("name")
+            inbound = _parse_inbound(e.get("inbound_nodes", []))
+            if cls == "InputLayer":
+                shape = cfg.get("batch_shape") or cfg.get("batch_input_shape")
+                keras_shapes[name] = tuple(shape[1:]) if shape else None
+                continue
+            in_names = [alias.get(n, n) for n in inbound]
+            in_shape = keras_shapes.get(inbound[0]) if inbound else None
+            if cls == "Flatten":
+                alias[name] = in_names[0]  # vanishes; preprocessor handles
+                if in_shape is not None and len(in_shape) == 3:
+                    unflattened[name] = in_shape
+                keras_shapes[name] = _keras_out_shape(cls, cfg, in_shape)
+                continue
+            if cls == "Dense" and inbound and inbound[0] in unflattened:
+                in_shape = unflattened[inbound[0]]
+            if cls in ("Add", "Subtract", "Multiply", "Average", "Maximum",
+                       "Minimum"):
+                op = {"Add": "add", "Subtract": "sub", "Multiply": "mul",
+                      "Average": "ave", "Maximum": "max",
+                      "Minimum": "min"}[cls]
+                builder.add_vertex(name, ElementWiseVertex(op=op), *in_names)
+                keras_shapes[name] = in_shape
+                continue
+            if cls == "Concatenate":
+                builder.add_vertex(name, MergeVertex(), *in_names)
+                shapes = [keras_shapes.get(n) for n in inbound]
+                if in_shape is not None and all(s is not None
+                                                for s in shapes):
+                    merged = list(in_shape)
+                    merged[-1] = sum(s[-1] for s in shapes)
+                    keras_shapes[name] = tuple(merged)
+                continue
+            a = _adapt_layer(cls, cfg, in_shape)
+            if a is None:
+                alias[name] = in_names[0] if in_names else name
+                keras_shapes[name] = _keras_out_shape(cls, cfg, in_shape)
+                continue
+            builder.add_layer(name, a.layer, *in_names)
+            adapted[name] = (a, in_shape)
+            keras_shapes[name] = _keras_out_shape(cls, cfg, in_shape)
+
+        out_names = [alias.get(n, n)
+                     for n in _ref_names(gcfg.get("output_layers", []))]
+        builder.set_outputs(*out_names)
+        in_types = [_keras_shape_to_input_type(keras_shapes.get(n) or
+                                               (input_shape if input_shape
+                                                else None))
+                    for n in input_names]
+        if all(t is not None for t in in_types):
+            builder.set_input_types(*in_types)
+        conf = builder.build()
+        net = ComputationGraph(conf)
+        net.init()
+        for name, (a, in_shape) in adapted.items():
+            if a.set_weights is None:
+                continue
+            if name not in weights:
+                raise ImportException(f"no weights for layer {name!r} in h5")
+            net._params[name] = a.set_weights(weights[name], in_shape)
+        net._updater_state = conf.updater.init(net._trainable(net._params))
+        return net
+
+
+def _parse_inbound(inbound_nodes) -> List[str]:
+    """Inbound layer names across Keras 2/3 serialization formats."""
+    names: List[str] = []
+    if not inbound_nodes:
+        return names
+    node = inbound_nodes[0]
+    if isinstance(node, dict):  # keras 3: {"args": [...], "kwargs": {}}
+        def find_hist(obj):
+            if isinstance(obj, dict):
+                if "keras_history" in obj.get("config", {}):
+                    names.append(obj["config"]["keras_history"][0])
+                else:
+                    for v in obj.values():
+                        find_hist(v)
+            elif isinstance(obj, (list, tuple)):
+                for v in obj:
+                    find_hist(v)
+        find_hist(node.get("args", []))
+    else:  # keras 2: [["layer", node_idx, tensor_idx, {}], ...]
+        for item in node:
+            names.append(item[0])
+    return names
+
+
+def import_keras_sequential_model_and_weights(path, input_shape=None):
+    return KerasModelImport.import_keras_sequential_model_and_weights(
+        path, input_shape)
+
+
+def import_keras_model_and_weights(path, input_shape=None):
+    return KerasModelImport.import_keras_model_and_weights(path, input_shape)

@@ -238,9 +238,12 @@ class SubsamplingLayer(Layer):
         kh, kw = _pair(self.kernel_size)
         stride = self.stride if self.stride is not None else self.kernel_size
         sh, sw = _pair(stride)
-        if isinstance(self.padding, str) and self.padding.upper() == "SAME":
-            return (c, -(-h // sh), -(-w // sw))
-        ph, pw = _pair(self.padding)
+        if isinstance(self.padding, str):
+            if self.padding.upper() == "SAME":
+                return (c, -(-h // sh), -(-w // sw))
+            ph = pw = 0  # "VALID"
+        else:
+            ph, pw = _pair(self.padding)
         return (c, (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1)
 
     def has_params(self):

@@ -110,3 +110,9 @@ def cross_batched(a, b):
 def knn_mindistance(point, lowest, highest):
     closest = jnp.clip(point, lowest, highest)
     return jnp.sqrt(jnp.sum(jnp.square(point - closest), axis=-1))
+
+
+@op("einsum", "blas")
+def einsum(*operands, equation):
+    """General contraction (TF/ONNX Einsum import target) — MXU-native."""
+    return jnp.einsum(equation, *operands)

@@ -321,3 +321,26 @@ def assign(x, y):
 @op("identity_n", "shape")
 def identity_n(*xs):
     return list(xs)
+
+
+@op("tf_strided_slice", "shape")
+def tf_strided_slice(x, spec):
+    """Strided slice with full TF mask semantics, pre-resolved to a static
+    index spec at import time (`modelimport/tf/slicing.py`).
+
+    spec: sequence of ("slice", b, e, s) | ("int", i) | ("newaxis",) |
+    ("all",) entries — serializable, unlike a recorded lambda.
+    """
+    idx = []
+    for entry in spec:
+        kind = entry[0]
+        if kind == "slice":
+            b, e, s = entry[1:]
+            idx.append(slice(b, e, s))
+        elif kind == "int":
+            idx.append(int(entry[1]))
+        elif kind == "newaxis":
+            idx.append(None)
+        else:
+            idx.append(slice(None))
+    return x[tuple(idx)]

@@ -1,0 +1,441 @@
+"""Model-import conformance tests (TF .pb, ONNX, Keras h5).
+
+Mirrors the reference's `platform-tests/src/test/java/org/eclipse/deeplearning4j/
+frameworkimport/**` strategy: execute imported models and compare against the
+originating framework's outputs (golden comparison), plus wire-format checks
+against real fixture files from the reference test corpus.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport import (ImportException, import_tf_graph,
+                                            import_onnx_model)
+from deeplearning4j_tpu.modelimport import protoio as pio
+
+tf = pytest.importorskip("tensorflow")
+tf1 = tf.compat.v1
+
+REF = "/root/reference"
+
+
+def _freeze_and_golden(graph, feeds, fetches):
+    pb = graph.as_graph_def().SerializeToString()
+    with tf1.Session(graph=graph) as s:
+        golden = s.run(fetches, feeds)
+    return pb, golden
+
+
+# TF1-style graphs are built inside explicit `tf.Graph().as_default()`
+# contexts, which suspends eager mode per-graph — keras tests keep eager.
+
+
+# ---------------------------------------------------------------- TF
+class TestTFImport:
+    def test_mlp_golden(self):
+        rs = np.random.RandomState(0)
+        g = tf.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [4, 8], name="x")
+            w1 = tf.constant(rs.randn(8, 16).astype(np.float32))
+            b1 = tf.constant(rs.randn(16).astype(np.float32))
+            h = tf.nn.relu(tf.nn.bias_add(tf.matmul(x, w1), b1))
+            w2 = tf.constant(rs.randn(16, 3).astype(np.float32))
+            out = tf.nn.softmax(tf.matmul(h, w2), name="out")
+        xs = rs.randn(4, 8).astype(np.float32)
+        pb, golden = _freeze_and_golden(g, {"x:0": xs}, "out:0")
+        imp = import_tf_graph(pb, input_shapes={"x": (4, 8)},
+                              outputs=["out"])
+        res = imp.output({"x": xs}, ["out"])["out"].numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-6)
+
+    def test_shape_chain_constant_folding(self):
+        """tf.shape-driven dynamic reshape folds to static under import."""
+        rs = np.random.RandomState(1)
+        g = tf.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [2, 3, 4], name="x")
+            b = tf.shape(x)[0]
+            y = tf.reshape(x, tf.stack([b, 12]))
+            out = tf.reduce_sum(y, axis=1, name="out")
+        xs = rs.randn(2, 3, 4).astype(np.float32)
+        pb, golden = _freeze_and_golden(g, {"x:0": xs}, "out:0")
+        imp = import_tf_graph(pb, input_shapes={"x": (2, 3, 4)},
+                              outputs=["out"])
+        res = imp.output({"x": xs}, ["out"])["out"].numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-6)
+
+    def test_conv_pool_golden(self):
+        rs = np.random.RandomState(2)
+        g = tf.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [2, 8, 8, 3], name="x")
+            k = tf.constant(rs.randn(3, 3, 3, 5).astype(np.float32))
+            c = tf.nn.conv2d(x, k, strides=[1, 1, 1, 1], padding="SAME")
+            p = tf.nn.max_pool2d(c, 2, 2, "VALID")
+            out = tf.identity(tf.nn.relu(p), name="out")
+        xs = rs.randn(2, 8, 8, 3).astype(np.float32)
+        pb, golden = _freeze_and_golden(g, {"x:0": xs}, "out:0")
+        imp = import_tf_graph(pb, input_shapes={"x": (2, 8, 8, 3)},
+                              outputs=["out"])
+        res = imp.output({"x": xs}, ["out"])["out"].numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    def test_strided_slice_masks(self):
+        rs = np.random.RandomState(3)
+        g = tf.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [4, 6, 8], name="x")
+            a = x[:, 0]            # shrink axis
+            b = x[1:3, ::2, -1:]   # strides + negative
+            c = x[:, tf.newaxis, 2:5]  # new axis
+            out = tf.identity(tf.reduce_sum(a) + tf.reduce_sum(b) +
+                              tf.reduce_sum(c), name="out")
+        xs = rs.randn(4, 6, 8).astype(np.float32)
+        pb, golden = _freeze_and_golden(g, {"x:0": xs}, "out:0")
+        imp = import_tf_graph(pb, input_shapes={"x": (4, 6, 8)},
+                              outputs=["out"])
+        res = imp.output({"x": xs}, ["out"])["out"].numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    def test_reference_lenet_frozen_pb(self):
+        """The reference's own frozen-LeNet import fixture runs identically."""
+        path = f"{REF}/platform-tests/src/test/resources/lenet_frozen.pb"
+        if not os.path.exists(path):
+            pytest.skip("reference fixture not present")
+        with open(path, "rb") as f:
+            data = f.read()
+        imp = import_tf_graph(data, input_shapes={"input": (2, 784)},
+                              outputs=["output"])
+        x = np.random.RandomState(0).rand(2, 784).astype(np.float32)
+        res = imp.output({"input": x}, ["output"])["output"].numpy()
+        gd = tf1.GraphDef()
+        gd.ParseFromString(data)
+        g = tf.Graph()
+        with g.as_default():
+            tf.import_graph_def(gd, name="")
+        with tf1.Session(graph=g) as s:
+            golden = s.run("output:0", {"input:0": x})
+        assert np.array_equal(res, golden)
+
+    def test_unmapped_op_reports_clearly(self):
+        g = tf.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [2], name="x")
+            tf1.py_func(lambda v: v, [x], tf.float32, name="weird")
+        pb = g.as_graph_def().SerializeToString()
+        with pytest.raises(ImportException, match="PyFunc"):
+            import_tf_graph(pb, input_shapes={"x": (2,)})
+
+
+# ---------------------------------------------------------------- BERT
+def build_tf1_bert(batch, seq, hidden=64, n_layers=2, heads=4, vocab=99,
+                   intermediate=128, type_vocab=2, max_pos=64, seed=0):
+    """Hand-built TF1 BERT encoder matching google-research/bert's frozen
+    inference graphs op-for-op (gather embeddings, decomposed layernorm,
+    erf-gelu, batched attention matmuls, tf.shape-driven reshapes)."""
+    rs = np.random.RandomState(seed)
+
+    def cst(*shape):
+        return tf.constant((rs.randn(*shape) * 0.02).astype(np.float32))
+
+    hd = hidden // heads
+    g = tf.Graph()
+    with g.as_default():
+        input_ids = tf1.placeholder(tf.int32, [None, seq], name="input_ids")
+        input_mask = tf1.placeholder(tf.int32, [None, seq], name="input_mask")
+        token_type = tf1.placeholder(tf.int32, [None, seq],
+                                     name="token_type_ids")
+        B = tf.shape(input_ids)[0]
+
+        def layer_norm(x, name):
+            with tf1.variable_scope(name):
+                gamma = tf.constant(np.ones(hidden, np.float32))
+                beta = tf.constant(np.zeros(hidden, np.float32))
+                mean = tf.reduce_mean(x, axis=-1, keepdims=True)
+                var = tf.reduce_mean(tf.math.squared_difference(x, mean),
+                                     axis=-1, keepdims=True)
+                return (x - mean) * tf.math.rsqrt(var + 1e-12) * gamma + beta
+
+        def gelu(x):
+            return x * 0.5 * (1.0 + tf.math.erf(x / np.sqrt(2.0).astype(
+                np.float32)))
+
+        word_emb = cst(vocab, hidden)
+        emb = tf.gather(word_emb, input_ids)
+        type_table = cst(type_vocab, hidden)
+        one_hot_ids = tf.one_hot(tf.reshape(token_type, [-1]),
+                                 depth=type_vocab)
+        type_emb = tf.reshape(tf.matmul(one_hot_ids, type_table),
+                              tf.stack([B, seq, hidden]))
+        pos_table = cst(max_pos, hidden)
+        pos_emb = tf.slice(pos_table, [0, 0], [seq, -1])
+        x = layer_norm(emb + type_emb + tf.expand_dims(pos_emb, 0), "emb_ln")
+
+        adder = (1.0 - tf.cast(tf.reshape(input_mask,
+                                          tf.stack([B, 1, 1, seq])),
+                               tf.float32)) * -10000.0
+
+        for i in range(n_layers):
+            with tf1.variable_scope(f"layer_{i}"):
+                def dense(t, win, wout, name, act=None):
+                    w_ = cst(win, wout)
+                    b_ = cst(wout)
+                    t2 = tf.reshape(t, [-1, win])
+                    o = tf.nn.bias_add(tf.matmul(t2, w_), b_)
+                    if act is not None:
+                        o = act(o)
+                    return o
+
+                q = dense(x, hidden, hidden, "q")
+                k = dense(x, hidden, hidden, "k")
+                v = dense(x, hidden, hidden, "v")
+
+                def split_heads(t):
+                    t = tf.reshape(t, tf.stack([B, seq, heads, hd]))
+                    return tf.transpose(t, [0, 2, 1, 3])
+
+                qh, kh, vh = split_heads(q), split_heads(k), split_heads(v)
+                scores = tf.matmul(qh, kh, transpose_b=True)
+                scores = scores * (1.0 / np.sqrt(hd).astype(np.float32))
+                probs = tf.nn.softmax(scores + adder)
+                ctxt = tf.matmul(probs, vh)
+                ctxt = tf.transpose(ctxt, [0, 2, 1, 3])
+                ctxt = tf.reshape(ctxt, tf.stack([B, seq, hidden]))
+                att_out = tf.reshape(dense(ctxt, hidden, hidden, "att_o"),
+                                     tf.stack([B, seq, hidden]))
+                x = layer_norm(att_out + x, "att_ln")
+                ffn = dense(x, hidden, intermediate, "ffn_in", act=gelu)
+                ffn_out = tf.reshape(
+                    tf.nn.bias_add(tf.matmul(ffn, cst(intermediate, hidden)),
+                                   cst(hidden)),
+                    tf.stack([B, seq, hidden]))
+                x = layer_norm(ffn_out + x, "ffn_ln")
+
+        seq_out = tf.identity(x, name="sequence_output")
+        first = tf.squeeze(x[:, 0:1, :], axis=1)
+        pooled = tf.tanh(tf.nn.bias_add(tf.matmul(first, cst(hidden, hidden)),
+                                        cst(hidden)), name="pooled_output")
+    return g, ("sequence_output", "pooled_output")
+
+
+class TestBertImport:
+    """BASELINE config 3 as specified: SameDiff BERT from a TF .pb."""
+
+    def test_bert_golden(self):
+        B, S = 2, 16
+        g, (seq_name, pooled_name) = build_tf1_bert(B, S)
+        pb = g.as_graph_def().SerializeToString()
+        rs = np.random.RandomState(7)
+        ids = rs.randint(0, 99, (B, S)).astype(np.int32)
+        mask = np.ones((B, S), np.int32)
+        mask[:, 12:] = 0
+        types = np.zeros((B, S), np.int32)
+        with tf1.Session(graph=g) as s:
+            golden_seq, golden_pooled = s.run(
+                [seq_name + ":0", pooled_name + ":0"],
+                {"input_ids:0": ids, "input_mask:0": mask,
+                 "token_type_ids:0": types})
+        imp = import_tf_graph(
+            pb, input_shapes={"input_ids": (B, S), "input_mask": (B, S),
+                              "token_type_ids": (B, S)},
+            outputs=[seq_name, pooled_name])
+        res = imp.output({"input_ids": ids, "input_mask": mask,
+                          "token_type_ids": types},
+                         [seq_name, pooled_name])
+        np.testing.assert_allclose(res[seq_name].numpy(), golden_seq,
+                                   atol=2e-5)
+        np.testing.assert_allclose(res[pooled_name].numpy(), golden_pooled,
+                                   atol=2e-5)
+
+    def test_bert_graph_is_one_xla_program(self):
+        """The imported graph jit-compiles whole-program (no interpreter)."""
+        B, S = 2, 8
+        g, (seq_name, _) = build_tf1_bert(B, S, hidden=32, n_layers=1,
+                                          heads=2, intermediate=64)
+        pb = g.as_graph_def().SerializeToString()
+        imp = import_tf_graph(
+            pb, input_shapes={"input_ids": (B, S), "input_mask": (B, S),
+                              "token_type_ids": (B, S)},
+            outputs=[seq_name])
+        fn = imp.sd.make_function([imp.outputs[seq_name + ":0"]],
+                                  tuple(sorted(imp.inputs.values())))
+        assert callable(fn)
+
+
+# ---------------------------------------------------------------- ONNX
+def _onnx_tensor(name, arr):
+    w = pio.Writer()
+    for d in arr.shape:
+        w.int_(1, d)
+    w.int_(2, 1)  # FLOAT
+    w.str_(8, name)
+    w.bytes_(9, arr.astype("<f4").tobytes())
+    return w
+
+
+def _onnx_vi(name, shape):
+    dimw = pio.Writer()
+    for d in shape:
+        dimw.msg(1, pio.Writer().int_(1, d))
+    tens = pio.Writer().int_(1, 1).msg(2, dimw)
+    typ = pio.Writer().msg(1, tens)
+    return pio.Writer().str_(1, name).msg(2, typ)
+
+
+def _onnx_node(op_type, inputs, outputs, **attrs):
+    w = pio.Writer()
+    for i in inputs:
+        w.str_(1, i)
+    for o in outputs:
+        w.str_(2, o)
+    w.str_(4, op_type)
+    for k, v in attrs.items():
+        aw = pio.Writer().str_(1, k)
+        if isinstance(v, float):
+            aw.int_(20, 1).float_(2, v)
+        elif isinstance(v, int):
+            aw.int_(20, 2).int_(3, v)
+        elif isinstance(v, (list, tuple)):
+            aw.int_(20, 7)
+            for x in v:
+                aw.int_(8, x)
+        w.msg(5, aw)
+    return w
+
+
+def build_onnx_mlp(rs):
+    w1 = rs.randn(8, 16).astype(np.float32)
+    b1 = rs.randn(16).astype(np.float32)
+    w2 = rs.randn(16, 3).astype(np.float32)
+    gw = pio.Writer()
+    gw.msg(1, _onnx_node("MatMul", ["x", "w1"], ["h0"]))
+    gw.msg(1, _onnx_node("Add", ["h0", "b1"], ["h1"]))
+    gw.msg(1, _onnx_node("Relu", ["h1"], ["h2"]))
+    gw.msg(1, _onnx_node("MatMul", ["h2", "w2"], ["h3"]))
+    gw.msg(1, _onnx_node("Softmax", ["h3"], ["y"], axis=-1))
+    gw.str_(2, "mlp")
+    gw.msg(5, _onnx_tensor("w1", w1))
+    gw.msg(5, _onnx_tensor("b1", b1))
+    gw.msg(5, _onnx_tensor("w2", w2))
+    gw.msg(11, _onnx_vi("x", (4, 8)))
+    gw.msg(12, _onnx_vi("y", (4, 3)))
+    model = pio.Writer().int_(1, 8).msg(7, gw)
+    model.msg(8, pio.Writer().str_(1, "").int_(2, 17))
+    return model.build(), (w1, b1, w2)
+
+
+class TestOnnxImport:
+    def test_mlp(self):
+        rs = np.random.RandomState(0)
+        data, (w1, b1, w2) = build_onnx_mlp(rs)
+        imp = import_onnx_model(data)
+        x = rs.randn(4, 8).astype(np.float32)
+        res = imp.output({"x": x}, ["y"])["y"].numpy()
+        h = np.maximum(x @ w1 + b1, 0) @ w2
+        e = np.exp(h - h.max(-1, keepdims=True))
+        expected = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(res, expected, atol=1e-5)
+
+    def test_reference_add_onnx_fixture(self):
+        """Real onnx file from the reference corpus validates wire parsing."""
+        path = f"{REF}/nd4j/nd4j-onnxruntime/src/test/resources/add.onnx"
+        if not os.path.exists(path):
+            pytest.skip("reference fixture not present")
+        imp = import_onnx_model(path)
+        x = np.asarray([[1.5]], np.float32)
+        y = np.asarray([[2.25]], np.float32)
+        res = imp.output({"x": x, "y": y}, ["z"])["z"].numpy()
+        np.testing.assert_allclose(res, x + y)
+
+    def test_gemm_and_reduce(self):
+        rs = np.random.RandomState(1)
+        w = rs.randn(3, 4).astype(np.float32)
+        c = rs.randn(3).astype(np.float32)
+        gw = pio.Writer()
+        gw.msg(1, _onnx_node("Gemm", ["x", "w", "c"], ["g"], transB=1,
+                             alpha=1.0, beta=1.0))
+        gw.msg(1, _onnx_node("ReduceMean", ["g"], ["y"], axes=[1],
+                             keepdims=0))
+        gw.str_(2, "gemm")
+        gw.msg(5, _onnx_tensor("w", w))
+        gw.msg(5, _onnx_tensor("c", c))
+        gw.msg(11, _onnx_vi("x", (5, 4)))
+        gw.msg(12, _onnx_vi("y", (5,)))
+        data = pio.Writer().int_(1, 8).msg(7, gw).build()
+        imp = import_onnx_model(data)
+        x = np.random.RandomState(2).randn(5, 4).astype(np.float32)
+        res = imp.output({"x": x}, ["y"])["y"].numpy()
+        expected = (x @ w.T + c).mean(axis=1)
+        np.testing.assert_allclose(res, expected, atol=1e-5)
+
+
+# ---------------------------------------------------------------- Keras
+keras = pytest.importorskip("keras")
+
+
+class TestKerasImport:
+    def test_sequential_cnn(self, tmp_path):
+        from keras import layers
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_sequential_model_and_weights
+        rs = np.random.RandomState(0)
+        m = keras.Sequential([
+            keras.Input((8, 8, 3)),
+            layers.Conv2D(4, 3, activation="relu", padding="same",
+                          name="c1"),
+            layers.MaxPooling2D(2, name="p1"),
+            layers.BatchNormalization(name="bn1"),
+            layers.Flatten(name="f"),
+            layers.Dense(10, activation="softmax", name="d1"),
+        ])
+        x = rs.randn(2, 8, 8, 3).astype(np.float32)
+        golden = m.predict(x, verbose=0)
+        path = str(tmp_path / "cnn.h5")
+        m.save(path)
+        net = import_keras_sequential_model_and_weights(path)
+        res = net.output(x.transpose(0, 3, 1, 2)).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    def test_sequential_lstm(self, tmp_path):
+        from keras import layers
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_sequential_model_and_weights
+        rs = np.random.RandomState(1)
+        m = keras.Sequential([
+            keras.Input((5,)),
+            layers.Embedding(20, 8, name="e1"),
+            layers.LSTM(6, name="l1"),
+            layers.Dense(3, activation="softmax", name="d2"),
+        ])
+        ix = rs.randint(0, 20, (4, 5))
+        golden = m.predict(ix, verbose=0)
+        path = str(tmp_path / "lstm.h5")
+        m.save(path)
+        net = import_keras_sequential_model_and_weights(path)
+        res = net.output(ix).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    def test_functional_multi_output(self, tmp_path):
+        from keras import layers
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_model_and_weights
+        rs = np.random.RandomState(2)
+        inp = keras.Input((16,), name="in1")
+        a = layers.Dense(8, activation="relu", name="fa")(inp)
+        b = layers.Dense(8, activation="tanh", name="fb")(inp)
+        merged = layers.Concatenate(name="cat")([a, b])
+        added = layers.Add(name="addv")([a, b])
+        out1 = layers.Dense(4, activation="softmax", name="out1")(merged)
+        out2 = layers.Dense(2, name="out2")(added)
+        m = keras.Model(inputs=inp, outputs=[out1, out2])
+        x = rs.randn(3, 16).astype(np.float32)
+        g1, g2 = m.predict(x, verbose=0)
+        path = str(tmp_path / "func.h5")
+        m.save(path)
+        net = import_keras_model_and_weights(path)
+        r1, r2 = [o.numpy() for o in net.output(x)]
+        np.testing.assert_allclose(r1, g1, atol=1e-5)
+        np.testing.assert_allclose(r2, g2, atol=1e-5)
