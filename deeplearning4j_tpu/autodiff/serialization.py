@@ -25,6 +25,9 @@ FORMAT_VERSION = 1
 
 
 def _json_safe(v: Any):
+    from .subgraph import SubGraph
+    if isinstance(v, SubGraph):
+        return {"__subgraph__": v.to_dict()}
     if isinstance(v, (jnp.dtype, np.dtype)):
         return {"__dtype__": str(v)}
     if isinstance(v, type) and hasattr(jnp, getattr(v, "__name__", "")):
@@ -45,6 +48,9 @@ def _json_safe(v: Any):
 
 def _json_restore(v: Any):
     if isinstance(v, dict):
+        if "__subgraph__" in v:
+            from .subgraph import SubGraph
+            return SubGraph.from_dict(v["__subgraph__"])
         if "__dtype__" in v:
             return jnp.dtype(v["__dtype__"])
         if "__array__" in v:

@@ -1,0 +1,138 @@
+"""Serializable sub-graphs for control-flow op bodies.
+
+Reference: the reference executes If/While with sub-graph bodies inside the
+session interpreter (`InferenceSession.java:828`, `ADRs/0020 - New Control
+flow.md` — bodies are named sub-scopes of the flat graph). TPU-native
+redesign: a body is recorded once into a standalone `SubGraph` (registered
+ops only, so it serializes), and the parent graph holds it as a static
+kwarg of a `cond`/`while_loop`/`scan` node. At execution the sub-graph is
+traced *inside* `lax.cond`/`lax.while_loop`/`lax.scan`, so XLA compiles
+native control flow — no Enter/Exit/Merge frames, no interpreter.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.registry import OpRegistry
+
+
+class SubGraph:
+    """A recorded, registry-only graph fragment: callable + serializable.
+
+    `captured` lists parent-graph variable names the body closed over;
+    their values are appended after the explicit args at call time (they
+    become implicit constants of the XLA control-flow region, exactly how
+    lax handles closure capture)."""
+
+    def __init__(self, placeholders: List[str], outputs: List[str],
+                 nodes: List[dict], constants: Dict[str, Any],
+                 captured: List[str] = None):
+        self.placeholders = placeholders
+        self.outputs = outputs
+        self.nodes = nodes          # {name, op, inputs, outputs, kwargs}
+        self.constants = constants  # name -> jnp array
+        self.captured = captured or []
+
+    # -- recording --------------------------------------------------------
+    @staticmethod
+    def record(fn: Callable, n_args: int, arg_prefix: str = "arg"
+               ) -> Tuple["SubGraph", int]:
+        """Trace `fn` over fresh placeholders into a SubGraph.
+
+        Returns (subgraph, n_outputs). The body must use only registered
+        ops (same rule serialization enforces on the main graph). Parent
+        variables referenced by closure are detected and recorded in
+        `.captured` — the parent passes their values as extra operands."""
+        from .samediff import SameDiff
+
+        sub = SameDiff.create()
+        phs = [sub.placeholder(f"{arg_prefix}{i}") for i in range(n_args)]
+        out = fn(sub, *phs) if _wants_sd(fn) else fn(*phs)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        reg = OpRegistry.get()
+        nodes = []
+        internal = {p.name for p in phs} | set(sub._arrays)
+        captured: List[str] = []
+        for name in sub._op_order:
+            node = sub._ops[name]
+            if not reg.has(node.op_name):
+                raise ValueError(
+                    f"control-flow body op {node.name!r} ({node.op_name}) is "
+                    f"not a registered op and cannot be serialized")
+            if node.needs_key:
+                raise ValueError("stochastic ops (dropout etc.) are not "
+                                 "supported inside control-flow bodies")
+            for i in node.inputs:
+                if i is not None and i not in internal and i not in captured:
+                    captured.append(i)
+            internal.update(node.outputs)
+            nodes.append({"name": node.name, "op": node.op_name,
+                          "inputs": node.inputs, "outputs": node.outputs,
+                          "kwargs": node.kwargs})
+        constants = {n: a for n, a in sub._arrays.items()}
+        sg = SubGraph([p.name for p in phs], [o.name for o in outs],
+                      nodes, constants, captured)
+        return sg, len(outs)
+
+    # -- execution --------------------------------------------------------
+    def __call__(self, *args):
+        reg = OpRegistry.get()
+        env: Dict[str, Any] = dict(self.constants)
+        for name, val in zip(self.placeholders + self.captured, args):
+            env[name] = val
+        for nd in self.nodes:
+            fn = reg.lookup(nd["op"]).fn
+            ins = [env[i] if i is not None else None for i in nd["inputs"]]
+            res = fn(*ins, **nd["kwargs"])
+            if len(nd["outputs"]) == 1:
+                env[nd["outputs"][0]] = res
+            else:
+                for o, r in zip(nd["outputs"], res):
+                    env[o] = r
+        outs = tuple(env[o] for o in self.outputs)
+        return outs[0] if len(outs) == 1 else outs
+
+    def call_tuple(self, *args) -> Tuple:
+        out = self(*args)
+        return out if isinstance(out, tuple) else (out,)
+
+    # -- serde ------------------------------------------------------------
+    def to_dict(self) -> dict:
+        from .serialization import _json_safe
+        return {
+            "placeholders": self.placeholders,
+            "outputs": self.outputs,
+            "captured": self.captured,
+            "nodes": [{**n, "kwargs": _json_safe(n["kwargs"])}
+                      for n in self.nodes],
+            "constants": {k: {"data": np.asarray(v).tolist(),
+                              "dtype": str(np.asarray(v).dtype)}
+                          for k, v in self.constants.items()},
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "SubGraph":
+        from .serialization import _json_restore
+        return SubGraph(
+            placeholders=list(d["placeholders"]),
+            outputs=list(d["outputs"]),
+            nodes=[{**n, "kwargs": _json_restore(n["kwargs"])}
+                   for n in d["nodes"]],
+            constants={k: jnp.asarray(v["data"], dtype=v["dtype"])
+                       for k, v in d["constants"].items()},
+            captured=list(d.get("captured", [])))
+
+
+def _wants_sd(fn) -> bool:
+    """Body fns may optionally take the sub-SameDiff as first arg
+    (`lambda sd, x: sd.math.sin(x)` style, matching reference bodies that
+    receive the SameDiff instance)."""
+    import inspect
+    try:
+        params = list(inspect.signature(fn).parameters)
+    except (TypeError, ValueError):
+        return False
+    return bool(params) and params[0] in ("sd", "samediff")

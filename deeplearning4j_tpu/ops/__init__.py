@@ -1,6 +1,6 @@
 """Op library: named, registered pure functions over jax.Arrays.
 
-Importing this package registers every op family (the DECLARE_OP macro-走
+Importing this package registers every op family (the DECLARE_OP macro
 auto-registration analog, `libnd4j/include/ops/declarable/OpRegistrator.h`).
 """
 from .registry import OpRegistry, exec_op, op  # noqa: F401
@@ -8,6 +8,7 @@ from .registry import OpRegistry, exec_op, op  # noqa: F401
 from . import (  # noqa: F401  (import for registration side effects)
     bitwise_ops,
     compression,
+    controlflow,
     conv_ops,
     linalg_ops,
     loss_ops,

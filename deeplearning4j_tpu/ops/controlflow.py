@@ -1,0 +1,124 @@
+"""Control-flow ops: cond / while_loop / scan with sub-graph bodies.
+
+Reference: TF-style control flow executed by the session interpreter —
+Enter/Exit/Switch/Merge/NextIteration + If/While sub-graph invocation
+(`nd4j/.../internal/InferenceSession.java:828`, `ADRs/0020 - New Control
+flow.md`, native `libnd4j/include/graph/` control-flow nodes).
+
+TPU-native redesign: bodies are `SubGraph`s (static kwargs) and execution
+lowers straight to `lax.cond`/`lax.while_loop`/`lax.scan`, which XLA
+compiles as native HLO control flow — traced once, no per-iteration
+dispatch. Frame/iteration bookkeeping (FrameIter) disappears entirely.
+Parent variables a body closes over arrive as trailing operands
+(`cap_names`) and are threaded to each sub-graph by name — they are loop
+invariants, not carries.
+
+Differentiability matches XLA semantics: `cond` and `scan` are reverse-mode
+differentiable; `while_loop` is forward-mode only (use `scan` with a static
+trip count for trainable loops).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+
+def _as_bool(r):
+    r = jnp.asarray(r)
+    return jnp.all(r) if r.ndim > 0 else r
+
+
+def _caps_for(graph, cap_env):
+    return [cap_env[n] for n in graph.captured]
+
+
+@op("cond", "controlflow", aliases=("If",))
+def cond(pred, *args, true_graph, false_graph, n_base, cap_names=()):
+    """lax.cond over SubGraph branches (reference If op)."""
+    base = args[:n_base]
+    cap_env = dict(zip(cap_names, args[n_base:]))
+    res = lax.cond(_as_bool(pred),
+                   lambda ops: true_graph.call_tuple(
+                       *ops, *_caps_for(true_graph, cap_env)),
+                   lambda ops: false_graph.call_tuple(
+                       *ops, *_caps_for(false_graph, cap_env)),
+                   tuple(base))
+    return res[0] if len(res) == 1 else res
+
+
+@op("while_loop", "controlflow", aliases=("While",))
+def while_loop(*args, cond_graph, body_graph, n_loop_vars, cap_names=()):
+    """lax.while_loop over SubGraph cond/body (reference While op)."""
+    init = tuple(args[:n_loop_vars])
+    cap_env = dict(zip(cap_names, args[n_loop_vars:]))
+
+    def c(carry):
+        return _as_bool(cond_graph(*carry, *_caps_for(cond_graph, cap_env)))
+
+    def b(carry):
+        return body_graph.call_tuple(*carry,
+                                     *_caps_for(body_graph, cap_env))
+
+    res = lax.while_loop(c, b, init)
+    return res[0] if len(res) == 1 else res
+
+
+@op("scan", "controlflow")
+def scan(*args, body_graph, n_carry, n_scan, cap_names=(), length=None,
+         reverse=False):
+    """lax.scan with a SubGraph body.
+
+    args = (*carry_init, *xs, *captured). Body receives
+    (*carry, *x_slices, *captured) and returns (*new_carry, *ys). Output =
+    (*final_carry, *stacked_ys)."""
+    carry_init = tuple(args[:n_carry])
+    xs = tuple(args[n_carry:n_carry + n_scan])
+    cap_env = dict(zip(cap_names, args[n_carry + n_scan:]))
+    caps = _caps_for(body_graph, cap_env)
+
+    def step(carry, x):
+        x_slices = x if isinstance(x, tuple) else (x,)
+        res = body_graph.call_tuple(*carry, *x_slices, *caps)
+        return tuple(res[:n_carry]), tuple(res[n_carry:])
+
+    final, ys = lax.scan(step, carry_init,
+                         (xs if len(xs) != 1 else xs[0]) if xs else None,
+                         length=length, reverse=reverse)
+    res = tuple(final) + tuple(ys)
+    return res[0] if len(res) == 1 else res
+
+
+@op("enter", "controlflow", aliases=("Enter",))
+def enter(x, frame_name=None):
+    """Frame ops are identity on TPU (XLA has no frames); kept for parity
+    with imported TF1 graphs."""
+    return x
+
+
+@op("exit", "controlflow", aliases=("Exit",))
+def exit_(x, frame_name=None):
+    return x
+
+
+@op("next_iteration", "controlflow", aliases=("NextIteration",))
+def next_iteration(x):
+    return x
+
+
+@op("switch", "controlflow", aliases=("Switch",))
+def switch(x, pred):
+    """Reference Switch: route to one of two outputs. Functionally: both
+    outputs exist; consumers select (XLA computes both sides of a cond
+    anyway). Returns (false_out, true_out) with the non-taken side zeroed."""
+    p = _as_bool(pred)
+    z = jnp.zeros_like(x)
+    return jnp.where(p, z, x), jnp.where(p, x, z)
+
+
+@op("merge", "controlflow", aliases=("Merge",))
+def merge(a, b):
+    """Reference Merge: first-available input. Functional analog: sum of
+    the (mutually exclusive) switch outputs."""
+    return a + b

@@ -326,10 +326,12 @@ def identity_n(*xs):
 @op("tf_strided_slice", "shape")
 def tf_strided_slice(x, spec):
     """Strided slice with full TF mask semantics, pre-resolved to a static
-    index spec at import time (`modelimport/tf/slicing.py`).
+    index spec at import time (`modelimport/tf/slicing.py`) — also the
+    lowering target of SDVariable.__getitem__ (serializable, unlike a
+    recorded lambda).
 
     spec: sequence of ("slice", b, e, s) | ("int", i) | ("newaxis",) |
-    ("all",) entries — serializable, unlike a recorded lambda.
+    ("ellipsis",) | ("all",) entries.
     """
     idx = []
     for entry in spec:
@@ -341,6 +343,8 @@ def tf_strided_slice(x, spec):
             idx.append(int(entry[1]))
         elif kind == "newaxis":
             idx.append(None)
+        elif kind == "ellipsis":
+            idx.append(Ellipsis)
         else:
             idx.append(slice(None))
     return x[tuple(idx)]

@@ -1,0 +1,170 @@
+"""SameDiff control flow: cond / while_loop / scan / TensorArray +
+serializable strided-slice.
+
+Reference behavior: If/While/TensorArray execution in
+`nd4j/.../internal/InferenceSession.java:828` and `ADRs/0020 - New Control
+flow.md`; here they lower to lax.cond/while_loop/scan (SURVEY §7 table).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.ndarray import factory as nd
+
+
+class TestCond:
+    def test_forward_both_branches(self):
+        for pred, expected in [(True, 6.0), (False, -3.0)]:
+            sd = SameDiff.create()
+            x = sd.placeholder("x", (3,))
+            p = sd.constant(np.asarray(pred))
+            out = sd.cond(p,
+                          lambda a: a * 2.0,
+                          lambda a: a - 2.0,
+                          x)
+            res = out.eval({"x": np.ones(3, np.float32)})
+            assert res.numpy().sum() == pytest.approx(expected)
+
+    def test_multi_output_and_grad(self):
+        sd = SameDiff.create()
+        w = sd.var("w", np.asarray([2.0, 3.0], np.float32))
+        p = sd.constant(np.asarray(True))
+        a, b = sd.cond(p,
+                       lambda v: (v * v, v + 1.0),
+                       lambda v: (v, v),
+                       w)
+        loss = (a + b).sum()
+        sd.set_loss_variables(loss)
+        g = sd.calculate_gradients({}, ["w"])["w"].numpy()
+        # d/dw (w^2 + w + 1) = 2w + 1
+        np.testing.assert_allclose(g, [5.0, 7.0])
+
+    def test_serialization_roundtrip(self, tmp_path):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (2,))
+        p = sd.constant(np.asarray(False))
+        out = sd.cond(p, lambda a: a * 10.0, lambda a: a * -1.0, x)
+        out.rename("out")
+        path = str(tmp_path / "cond.sdz")
+        sd.save(path)
+        sd2 = SameDiff.load(path)
+        xs = np.asarray([1.0, 2.0], np.float32)
+        r1 = sd.output({"x": xs}, ["out"])["out"].numpy()
+        r2 = sd2.output({"x": xs}, ["out"])["out"].numpy()
+        np.testing.assert_allclose(r1, r2)
+        np.testing.assert_allclose(r2, [-1.0, -2.0])
+
+
+class TestWhileLoop:
+    def test_counter(self):
+        sd = SameDiff.create()
+        i0 = sd.constant(np.asarray(0.0, np.float32))
+        acc0 = sd.constant(np.asarray(1.0, np.float32))
+        i_f, acc_f = sd.while_loop(
+            lambda i, acc: i < 5.0,
+            lambda i, acc: (i + 1.0, acc * 2.0),
+            i0, acc0)
+        assert acc_f.eval({}).numpy() == pytest.approx(32.0)
+        assert i_f.eval({}).numpy() == pytest.approx(5.0)
+
+    def test_roundtrip(self, tmp_path):
+        sd = SameDiff.create()
+        n = sd.placeholder("n", ())
+        i0 = sd.constant(np.asarray(0.0, np.float32))
+        s0 = sd.constant(np.asarray(0.0, np.float32))
+        _, total = sd.while_loop(
+            lambda i, s: i < n,
+            lambda i, s: (i + 1.0, s + i),
+            i0, s0)
+        total.rename("total")
+        path = str(tmp_path / "while.sdz")
+        sd.save(path)
+        sd2 = SameDiff.load(path)
+        r = sd2.output({"n": np.asarray(4.0, np.float32)},
+                       ["total"])["total"].numpy()
+        assert r == pytest.approx(0 + 1 + 2 + 3)
+
+
+class TestScan:
+    def test_cumsum_scan(self):
+        sd = SameDiff.create()
+        xs = sd.placeholder("xs", (4,))
+        c0 = sd.constant(np.asarray(0.0, np.float32))
+        final, ys = sd.scan(lambda c, x: (c + x, c + x), c0, xs)
+        r = ys.eval({"xs": np.asarray([1, 2, 3, 4], np.float32)})
+        np.testing.assert_allclose(r.numpy(), [1, 3, 6, 10])
+
+    def test_rnn_decode_trains_and_roundtrips(self, tmp_path):
+        """VERDICT item 6 'done' criterion: an RNN-decode-style looped graph
+        builds, trains (gradient through the loop), and save/loads. The
+        body closes over the weight var (auto-captured as loop invariant)."""
+        B, T, F = 2, 5, 3
+        rs = np.random.RandomState(0)
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (T, B, F))
+        w = sd.var("w", rs.randn(F, F).astype(np.float32) * 0.5)
+        h0 = sd.constant(np.zeros((B, F), np.float32))
+
+        def body(h, x_t):
+            nh = x_t.mmul(w) + h   # closes over parent var w
+            return nh, nh
+
+        final_h, h_seq = sd.scan(body, init=[h0], xs=[x])
+        loss = final_h.sum()
+        loss.rename("loss")
+        sd.set_loss_variables("loss")
+        xs_val = rs.randn(T, B, F).astype(np.float32)
+        g = sd.calculate_gradients({"x": xs_val}, ["w"])["w"].numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+        h_seq.rename("h_seq")
+        path = str(tmp_path / "scan.sdz")
+        sd.save(path)
+        sd2 = SameDiff.load(path)
+        r1 = sd.output({"x": xs_val}, ["h_seq"])["h_seq"].numpy()
+        r2 = sd2.output({"x": xs_val}, ["h_seq"])["h_seq"].numpy()
+        np.testing.assert_allclose(r1, r2, atol=1e-6)
+        # the loop really ran: h_seq[t] = cumulative sum of x[:t+1] @ w
+        expected = np.cumsum(xs_val @ (w.get_arr().numpy()), axis=0)
+        np.testing.assert_allclose(r1, expected, atol=1e-4)
+
+
+class TestTensorArray:
+    def test_write_read_stack(self):
+        sd = SameDiff.create()
+        ta = sd.tensor_array(3, (2,))
+        a = sd.constant(np.asarray([1.0, 2.0], np.float32))
+        b = sd.constant(np.asarray([3.0, 4.0], np.float32))
+        ta.write(0, a).write(2, b)
+        stacked = ta.stack()
+        r = stacked.eval({}).numpy()
+        np.testing.assert_allclose(r, [[1, 2], [0, 0], [3, 4]])
+        np.testing.assert_allclose(ta.read(2).eval({}).numpy(), [3, 4])
+
+
+class TestSerializableSlicing:
+    def test_getitem_graph_roundtrips(self, tmp_path):
+        """VERDICT round-1 weak #2: sliced graphs must be saveable."""
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (4, 6))
+        y = x[1:3, ::2] * 2.0
+        z = x[0] + x[-1]
+        out = y.sum() + z.sum()
+        out.rename("out")
+        path = str(tmp_path / "sliced.sdz")
+        sd.save(path)
+        sd2 = SameDiff.load(path)
+        xs = np.arange(24, dtype=np.float32).reshape(4, 6)
+        r1 = sd.output({"x": xs}, ["out"])["out"].numpy()
+        r2 = sd2.output({"x": xs}, ["out"])["out"].numpy()
+        np.testing.assert_allclose(r1, r2)
+        expected = (xs[1:3, ::2] * 2.0).sum() + (xs[0] + xs[-1]).sum()
+        np.testing.assert_allclose(r1, expected)
+
+    def test_newaxis_and_ellipsis(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (2, 3, 4))
+        y = x[..., 0]
+        z = x[:, None, 1, :]
+        assert y.eval({"x": np.ones((2, 3, 4), np.float32)}).shape == (2, 3)
+        assert z.eval({"x": np.ones((2, 3, 4), np.float32)}).shape == (2, 1, 4)
