@@ -302,6 +302,7 @@ class ComputationGraph:
         self._train_step = None
         self._rng_key = jax.random.key(conf.seed)
         self._initialized = False
+        self._mesh = None
         self.score_value = float("nan")
 
     # -- init ------------------------------------------------------------
@@ -326,6 +327,29 @@ class ComputationGraph:
     def _check_init(self):
         if not self._initialized:
             raise RuntimeError("call init() first")
+
+    def distribute(self, mesh):
+        """Shard the graph network over a device mesh (dp/fsdp/tp) — see
+        MultiLayerNetwork.distribute / nn/sharding.py."""
+        self._check_init()
+        from ..sharding import shard_layer_params
+        self._mesh = mesh
+        new_params = {}
+        for name, p in self._params.items():
+            v = self.conf.vertices[name]
+            layer = v.layer if isinstance(v, LayerVertex) else v
+            new_params[name] = shard_layer_params(mesh, layer, p) if p else p
+        self._params = new_params
+        self._updater_state = self.conf.updater.init(
+            self._trainable(self._params))
+        self._train_step = None
+        return self
+
+    def _shard_batch(self, x):
+        if self._mesh is None:
+            return x
+        from ..sharding import shard_batch_value
+        return shard_batch_value(self._mesh, x)
 
     def _trainable(self, params):
         return {n: {k: v for k, v in p.items() if not k.startswith("state_")}
@@ -366,10 +390,12 @@ class ComputationGraph:
 
     def _inputs_dict(self, inputs) -> Dict[str, jax.Array]:
         if isinstance(inputs, dict):
-            return {k: _unwrap(v) for k, v in inputs.items()}
+            return {k: self._shard_batch(_unwrap(v))
+                    for k, v in inputs.items()}
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
-        return {n: _unwrap(x) for n, x in zip(self.conf.inputs, inputs)}
+        return {n: self._shard_batch(_unwrap(x))
+                for n, x in zip(self.conf.inputs, inputs)}
 
     def output(self, *inputs, training: bool = False) -> List[NDArray]:
         """Multi-output inference (reference ComputationGraph.output)."""
@@ -453,11 +479,11 @@ class ComputationGraph:
     # -- training --------------------------------------------------------
     def _split_dataset(self, ds):
         if isinstance(ds, MultiDataSet):
-            feats = [_unwrap(f) for f in ds.features]
-            labs = [_unwrap(l) for l in ds.labels]
+            feats = [self._shard_batch(_unwrap(f)) for f in ds.features]
+            labs = [self._shard_batch(_unwrap(l)) for l in ds.labels]
         else:
-            feats = [_unwrap(ds.features)]
-            labs = [_unwrap(ds.labels)]
+            feats = [self._shard_batch(_unwrap(ds.features))]
+            labs = [self._shard_batch(_unwrap(ds.labels))]
         return {n: x for n, x in zip(self.conf.inputs, feats)}, labs
 
     def _build_train_step(self):

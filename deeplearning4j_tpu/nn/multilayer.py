@@ -43,6 +43,7 @@ class MultiLayerNetwork:
         self._train_step = None
         self._rng_key = jax.random.key(conf.seed)
         self._initialized = False
+        self._mesh = None
         self.score_value = float("nan")
 
     # -- init ------------------------------------------------------------
@@ -65,6 +66,32 @@ class MultiLayerNetwork:
     def _check_init(self):
         if not self._initialized:
             raise RuntimeError("call init() first")
+
+    def distribute(self, mesh):
+        """Shard this network over a device mesh (dp/fsdp/tp).
+
+        Each layer's params are placed per its PartitionSpec rule
+        (`nn/sharding.py`) and batches are sharded over (data, fsdp); the
+        jitted train step then compiles under GSPMD with XLA inserting the
+        ICI collectives. Replaces the reference's replica-thread
+        ParallelWrapper for the layer API — and adds the TP/FSDP modes the
+        reference never had."""
+        self._check_init()
+        from .sharding import shard_layer_params
+        self._mesh = mesh
+        self._params = [shard_layer_params(mesh, layer, p) if p else p
+                        for layer, p in zip(self.layers, self._params)]
+        self._updater_state = self.conf.updater.init(
+            self._trainable(self._params))
+        self._train_step = None
+        self._out_fns = {}
+        return self
+
+    def _shard_batch(self, x):
+        if self._mesh is None:
+            return x
+        from .sharding import shard_batch_value
+        return shard_batch_value(self._mesh, x)
 
     def _trainable(self, params):
         """Trainable subset (excludes `state_*` running stats)."""
@@ -90,7 +117,8 @@ class MultiLayerNetwork:
     def output(self, x, training: bool = False) -> NDArray:
         """Inference forward pass (reference MultiLayerNetwork.output)."""
         self._check_init()
-        return NDArray(self._output_jit(training)(self._params, _unwrap(x)))
+        return NDArray(self._output_jit(training)(self._params,
+                                                  self._shard_batch(_unwrap(x))))
 
     def _output_jit(self, training=False):
         if not hasattr(self, "_out_fns"):
@@ -255,8 +283,8 @@ class MultiLayerNetwork:
             if hasattr(data, "reset"):
                 data.reset()
             for ds in data:
-                x = _unwrap(ds.features)
-                y = _unwrap(ds.labels)
+                x = self._shard_batch(_unwrap(ds.features))
+                y = self._shard_batch(_unwrap(ds.labels))
                 self._rng_key, step_key = jax.random.split(self._rng_key)
                 trainable, states, ustate, loss = self._train_step(
                     trainable, states, ustate, self._iteration, x, y, step_key)

@@ -1,0 +1,161 @@
+"""Layer-API sharding: MLN/ComputationGraph training on dp x tp x fsdp
+meshes matches single-device numerics (VERDICT round-1 item 5).
+
+Runs on the virtual 8-device CPU mesh (conftest).
+"""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding
+
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.config import (InputType,
+                                               NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.graph.vertices import MergeVertex
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.mesh import MeshConfig, make_mesh
+
+
+def _mln():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Adam(learning_rate=1e-2))
+            .list()
+            .layer(L.DenseLayer(n_in=16, n_out=32, activation="relu"))
+            .layer(L.DenseLayer(n_out=24, activation="tanh"))
+            .layer(L.OutputLayer(n_out=4, activation="softmax",
+                                 loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _batches(rs, n, b=8, f=16, c=4):
+    xs = [rs.randn(b, f).astype(np.float32) for _ in range(n)]
+    ys = []
+    for _ in range(n):
+        lab = np.zeros((b, c), np.float32)
+        lab[np.arange(b), rs.randint(0, c, b)] = 1.0
+        ys.append(lab)
+    return xs, ys
+
+
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 devices")
+class TestMLNSharding:
+    def test_dp_tp_fsdp_matches_single_device(self):
+        rs = np.random.RandomState(0)
+        xs, ys = _batches(rs, 4)
+
+        ref = _mln()
+        for x, y in zip(xs, ys):
+            ref.fit(x, y)
+        ref_losses = ref.score_value
+        ref_params = ref.params().numpy()
+
+        mesh = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+        net = _mln()
+        net.distribute(mesh)
+        for x, y in zip(xs, ys):
+            net.fit(x, y)
+        np.testing.assert_allclose(net.score_value, ref_losses, atol=1e-5)
+        np.testing.assert_allclose(net.params().numpy(), ref_params,
+                                   atol=1e-4)
+
+    def test_params_actually_sharded(self):
+        mesh = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+        net = _mln().distribute(mesh)
+        w = net._params[0]["W"]  # (16, 32) -> fsdp x tensor
+        assert isinstance(w.sharding, NamedSharding)
+        shard_shape = w.sharding.shard_shape(w.shape)
+        assert shard_shape == (8, 16)  # 16/fsdp2, 32/tensor2
+
+    def test_output_matches_after_distribute(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(8, 16).astype(np.float32)
+        ref = _mln()
+        out_ref = ref.output(x).numpy()
+        mesh = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+        out_sh = _mln().distribute(mesh).output(x).numpy()
+        np.testing.assert_allclose(out_sh, out_ref, atol=1e-5)
+
+
+def _cg():
+    builder = (NeuralNetConfiguration.builder()
+               .seed(11)
+               .updater(Sgd(learning_rate=5e-2))
+               .graph_builder())
+    builder.add_inputs("in")
+    builder.set_input_types(InputType.feed_forward(12))
+    builder.add_layer("fa", L.DenseLayer(n_in=12, n_out=16,
+                                         activation="relu"), "in")
+    builder.add_layer("fb", L.DenseLayer(n_in=12, n_out=16,
+                                         activation="tanh"), "in")
+    builder.add_vertex("merge", MergeVertex(), "fa", "fb")
+    builder.add_layer("out", L.OutputLayer(n_in=32, n_out=4,
+                                           activation="softmax",
+                                           loss="mcxent"), "merge")
+    builder.set_outputs("out")
+    net = ComputationGraph(builder.build())
+    net.init()
+    return net
+
+
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 devices")
+class TestComputationGraphSharding:
+    def test_dp_tp_fsdp_matches_single_device(self):
+        """VERDICT item 5 'done' criterion: a ComputationGraph at
+        dp=2,tp=2,fsdp=2 matches the single-device step numerically."""
+        rs = np.random.RandomState(3)
+        xs, ys = _batches(rs, 4, b=8, f=12, c=4)
+
+        ref = _cg()
+        for x, y in zip(xs, ys):
+            ref.fit(x, y)
+        ref_params = ref.params().numpy()
+
+        mesh = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+        net = _cg().distribute(mesh)
+        for x, y in zip(xs, ys):
+            net.fit(x, y)
+        np.testing.assert_allclose(net.score_value, ref.score_value,
+                                   atol=1e-5)
+        np.testing.assert_allclose(net.params().numpy(), ref_params,
+                                   atol=1e-4)
+
+    def test_conv_net_tp(self):
+        """Conv layers shard in/out channels; training still matches."""
+        def build():
+            conf = (NeuralNetConfiguration.builder()
+                    .seed(5)
+                    .updater(Sgd(learning_rate=1e-2))
+                    .list()
+                    .layer(L.ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                              activation="relu",
+                                              convolution_mode="same"))
+                    .layer(L.SubsamplingLayer(kernel_size=(2, 2)))
+                    .layer(L.OutputLayer(n_out=3, activation="softmax",
+                                         loss="mcxent"))
+                    .set_input_type(InputType.convolutional(8, 8, 4))
+                    .build())
+            n = MultiLayerNetwork(conf)
+            n.init()
+            return n
+
+        rs = np.random.RandomState(4)
+        x = rs.randn(8, 4, 8, 8).astype(np.float32)
+        y = np.zeros((8, 3), np.float32)
+        y[np.arange(8), rs.randint(0, 3, 8)] = 1.0
+
+        ref = build()
+        ref.fit(x, y)
+        mesh = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+        net = build().distribute(mesh)
+        net.fit(x, y)
+        np.testing.assert_allclose(net.score_value, ref.score_value,
+                                   atol=1e-5)
+        np.testing.assert_allclose(net.params().numpy(), ref.params().numpy(),
+                                   atol=1e-4)
