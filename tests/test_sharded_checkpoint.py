@@ -1,0 +1,103 @@
+"""Sharded checkpoint/resume, incl. restore onto a RESHAPED mesh
+(VERDICT round-1 item 10 'done' criterion)."""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.checkpoint import (ShardedCheckpointer,
+                                              ShardedCheckpointListener)
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.config import (InputType,
+                                               NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.mesh import MeshConfig, make_mesh
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3)
+            .updater(Adam(learning_rate=1e-2))
+            .list()
+            .layer(L.DenseLayer(n_in=16, n_out=32, activation="relu"))
+            .layer(L.OutputLayer(n_out=4, activation="softmax",
+                                 loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(rs, n, b=8):
+    xs = [rs.randn(b, 16).astype(np.float32) for _ in range(n)]
+    ys = []
+    for _ in range(n):
+        y = np.zeros((b, 4), np.float32)
+        y[np.arange(b), rs.randint(0, 4, b)] = 1.0
+        ys.append(y)
+    return xs, ys
+
+
+class TestShardedCheckpoint:
+    def test_save_restore_same_placement(self, tmp_path):
+        rs = np.random.RandomState(0)
+        xs, ys = _data(rs, 3)
+        net = _net()
+        for x, y in zip(xs, ys):
+            net.fit(x, y)
+        ck = ShardedCheckpointer(str(tmp_path / "ck"))
+        ck.save(net._iteration, net)
+
+        net2 = _net()
+        ck.restore(net2)
+        assert net2._iteration == net._iteration
+        np.testing.assert_allclose(net2.params().numpy(),
+                                   net.params().numpy(), atol=1e-7)
+
+    @pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 devices")
+    def test_restore_on_reshaped_mesh_continues_identically(self, tmp_path):
+        """Train 5 steps on mesh A, checkpoint, restore on mesh B with a
+        different shape, keep training — losses match the uninterrupted
+        run step for step."""
+        rs = np.random.RandomState(1)
+        xs, ys = _data(rs, 10)
+
+        # uninterrupted reference run (single device)
+        ref = _net()
+        ref_losses = []
+        for x, y in zip(xs, ys):
+            ref.fit(x, y)
+            ref_losses.append(ref.score_value)
+
+        # run A: dp=4, tensor=2 for 5 steps -> checkpoint
+        mesh_a = make_mesh(MeshConfig(data=4, tensor=2))
+        a = _net().distribute(mesh_a)
+        for x, y in zip(xs[:5], ys[:5]):
+            a.fit(x, y)
+        ck = ShardedCheckpointer(str(tmp_path / "elastic"))
+        ck.save(5, a)
+
+        # run B: RESHAPED mesh dp=2, fsdp=2, tensor=2 -> restore + continue
+        mesh_b = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+        b = _net().distribute(mesh_b)
+        ck.restore(b)
+        losses_b = []
+        for x, y in zip(xs[5:], ys[5:]):
+            b.fit(x, y)
+            losses_b.append(b.score_value)
+        np.testing.assert_allclose(losses_b, ref_losses[5:], atol=2e-4)
+        np.testing.assert_allclose(b.params().numpy(), ref.params().numpy(),
+                                   atol=1e-3)
+
+    def test_listener_retention(self, tmp_path):
+        rs = np.random.RandomState(2)
+        xs, ys = _data(rs, 6)
+        net = _net()
+        lst = ShardedCheckpointListener(str(tmp_path / "ckl"),
+                                        save_every_n_iterations=1,
+                                        keep_last=2)
+        net._listeners.append(lst)
+        for x, y in zip(xs, ys):
+            net.fit(x, y)
+        steps = lst.ckpt.all_steps()
+        assert len(steps) == 2  # keep-last-K retention
+        assert steps[-1] == 5
