@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import DATA, FSDP, SEQ, TENSOR
+from ..parallel.mesh import DATA, FSDP, PIPE, SEQ, TENSOR
 from ..parallel.ring_attention import blockwise_attention, ring_attention
 
 
@@ -302,6 +302,136 @@ def make_train_step(config: BertConfig, mesh: Optional[Mesh] = None,
         step, donate_argnums=donate,
         in_shardings=(param_sh, opt_sh, batch_sh, None),
         out_shardings=(param_sh, opt_sh, None))
+
+
+# -- pipeline parallelism (dp x pp) --------------------------------------
+
+def to_pipeline_params(params, n_stages: int):
+    """Restructure flat params for the pipeline: encoder layers grouped
+    into stages and stacked (leading stage dim); embed/head unchanged."""
+    from ..parallel.pipeline import split_stages, stack_stage_params
+    groups = split_stages(params["layers"], n_stages)
+    return {
+        "embeddings": params["embeddings"],
+        "stages": stack_stage_params(groups),
+        "mlm": params["mlm"],
+        "pooler": params["pooler"],
+    }
+
+
+def from_pipeline_params(pp_params):
+    """Inverse of to_pipeline_params: unstack stages back to a flat layer
+    list (for checkpoint interchange with the non-pipelined layout)."""
+    stages = pp_params["stages"]
+    n_stages = jax.tree_util.tree_leaves(stages)[0].shape[0]
+    layers = []
+    for s in range(n_stages):
+        layers.extend(jax.tree_util.tree_map(lambda p: p[s], stages))
+    return {
+        "embeddings": pp_params["embeddings"],
+        "layers": layers,
+        "mlm": pp_params["mlm"],
+        "pooler": pp_params["pooler"],
+    }
+
+
+def make_pipeline_train_step(config: BertConfig, mesh: Mesh,
+                             n_microbatches: int,
+                             learning_rate: float = 1e-4,
+                             remat: bool = True):
+    """BERT training with pipeline parallelism over the `pipe` mesh axis,
+    composed with data parallelism over (data, fsdp).
+
+    The reference has no PP at all (SURVEY §2.4) — this is the TPU-first
+    differentiator: embed/head are the heterogeneous ends outside the loop,
+    the repeated encoder block is the uniform pipelined stage, loss is
+    scored on the last stage (scalar psum — no activation broadcast), and
+    per-microbatch remat gives the 1F1B memory profile under jax.grad.
+
+    Use with `to_pipeline_params(init_params(...), n_stages)`.
+    """
+    from ..ops import updater_ops
+    from ..parallel.pipeline import make_pipeline_loss
+    c = config
+
+    def stage_fn(stage_layers, h):
+        # stage_layers: list of layer dicts (this stage's slice)
+        for layer in stage_layers:
+            attn_out = _attention(layer, h, None, c, None, False)
+            h = _ln(h + attn_out, layer["ln1_g"], layer["ln1_b"],
+                    c.layer_norm_eps)
+            mlp = layer["mlp"]
+            inter = jax.nn.gelu(jnp.einsum("bte,ef->btf", h, mlp["w1"])
+                                + mlp["b1"])
+            mlp_out = jnp.einsum("btf,fe->bte", inter, mlp["w2"]) + mlp["b2"]
+            h = _ln(h + mlp_out, layer["ln2_g"], layer["ln2_b"],
+                    c.layer_norm_eps)
+        return h
+
+    def head_fn(head_params, y, aux):
+        m = head_params["mlm"]
+        h = jax.nn.gelu(jnp.einsum("bte,ef->btf", y, m["dense"])
+                        + m["dense_b"])
+        h = _ln(h, m["ln_g"], m["ln_b"], c.layer_norm_eps)
+        logits = jnp.einsum("bte,ve->btv", h, head_params["word"])
+        logits = logits.astype(jnp.float32) + m["bias"]
+        labels = aux["labels"]
+        valid = labels >= 0
+        safe = jnp.where(valid, labels, 0)
+        lsm = jax.nn.log_softmax(logits, axis=-1)
+        per_tok = -jnp.take_along_axis(lsm, safe[..., None], axis=-1)[..., 0]
+        per_tok = jnp.where(valid, per_tok, 0.0)
+        return jnp.sum(per_tok), jnp.sum(valid).astype(jnp.float32)
+
+    pipe_loss = make_pipeline_loss(stage_fn, head_fn, mesh, n_microbatches,
+                                   remat=remat)
+
+    def loss_fn(params, batch):
+        e = params["embeddings"]
+        ids = batch["input_ids"]
+        B, T = ids.shape
+        h = jnp.take(e["word"], ids, axis=0) + e["position"][None, :T]
+        tt = batch.get("token_type_ids")
+        h = h + (jnp.take(e["token_type"], tt, axis=0) if tt is not None
+                 else e["token_type"][0])
+        h = _ln(h, e["ln_g"], e["ln_b"], c.layer_norm_eps)
+        head_params = {"mlm": params["mlm"], "word": e["word"]}
+        aux = {"labels": batch["labels"]}
+        loss_sum, wsum = pipe_loss(params["stages"], head_params, h, aux)
+        return loss_sum / jnp.maximum(wsum, 1.0)
+
+    def step(params, opt_state, batch, iteration):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_u, flat_m = opt_state
+        flat_p = jax.tree_util.tree_flatten(params)[0]
+        new_p, new_u, new_m = [], [], []
+        for p, g, u, m in zip(flat_p, flat_g, flat_u, flat_m):
+            upd, u2, m2 = updater_ops.adam_updater(
+                g.astype(jnp.float32), u, m, lr=learning_rate,
+                iteration=iteration)
+            new_p.append((p.astype(jnp.float32) - upd).astype(p.dtype))
+            new_u.append(u2)
+            new_m.append(m2)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                (new_u, new_m), loss)
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def place_pipeline_params(pipe_params, mesh: Mesh):
+    """Stage-stacked leaves sharded over pipe; embed/head replicated."""
+    def place(path_is_stage, tree):
+        spec = P(PIPE) if path_is_stage else P()
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, spec)), tree)
+
+    return {
+        "embeddings": place(False, pipe_params["embeddings"]),
+        "stages": place(True, pipe_params["stages"]),
+        "mlm": place(False, pipe_params["mlm"]),
+        "pooler": place(False, pipe_params["pooler"]),
+    }
 
 
 def init_opt_state(params):

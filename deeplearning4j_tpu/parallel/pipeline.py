@@ -97,3 +97,91 @@ def stack_stage_params(per_stage_params: Sequence):
     """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim."""
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
                                   *per_stage_params)
+
+
+# ---------------------------------------------------------------------------
+# v2: production pipeline — heterogeneous embed/head outside the loop, loss
+# computed ON the last stage (scalar psum, no full-output broadcast), per-
+# microbatch rematerialization (1F1B's memory profile under jax.grad), and
+# dp x pp composition (batch stays data-sharded inside the shard_map).
+# ---------------------------------------------------------------------------
+
+def make_pipeline_loss(stage_fn: Callable, head_fn: Callable, mesh: Mesh,
+                       n_microbatches: int, axis: str = PIPE,
+                       batch_axes=(DATA, FSDP), remat: bool = True):
+    """Build loss(stacked_stage_params, head_params, x, aux) -> (sum, count).
+
+    - stage_fn(stage_params, x) -> y: the uniform repeated block (shapes
+      equal across stages — the XLA SPMD pipeline contract; non-uniform
+      first/last components belong in the caller's embed/head).
+    - head_fn(head_params, y_mb, aux_mb) -> (loss_sum, weight) computed on
+      the LAST stage only; aux is any pytree of per-microbatch targets
+      (labels, masks), microbatched on its leading dim.
+    - x: [B, ...] embedded activations (computed by the caller outside the
+      loop — the heterogeneous embed component).
+    Returns GLOBAL (psum over pipe+data) scalar loss sum and weight; divide
+    for the mean. Differentiable end-to-end (ppermute transposes).
+    """
+    data_axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def local(stage_params, head_params, x, aux):
+        stage_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        n_stages = lax.axis_size(axis)
+        stage = lax.axis_index(axis)
+        n_micro = n_microbatches
+        mb_shape = x.shape[1:]
+
+        def step_body(carry, t):
+            incoming, loss_sum, wsum = carry
+            mb_idx = jnp.clip(t, 0, n_micro - 1)
+            xin = jnp.where(stage == 0, x[mb_idx], incoming)
+            y = body(stage_params, xin)
+            # the stage that just finished microbatch (t - S + 1) scores it
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            is_out = (t >= n_stages - 1) & (stage == n_stages - 1)
+            aux_mb = jax.tree_util.tree_map(lambda a: a[out_idx], aux)
+            l, w = head_fn(head_params, y, aux_mb)
+            loss_sum = loss_sum + jnp.where(is_out, l, 0.0)
+            wsum = wsum + jnp.where(is_out, w, 0.0)
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            incoming = lax.ppermute(y, axis, perm)
+            return (incoming, loss_sum, wsum), None
+
+        init = (jnp.zeros(mb_shape, x.dtype), jnp.float32(0.0),
+                jnp.float32(0.0))
+        (_, loss_sum, wsum), _ = lax.scan(
+            step_body, init,
+            jnp.arange(n_microbatches + lax.axis_size(axis) - 1))
+        for a in (axis,) + data_axes:
+            loss_sum = lax.psum(loss_sum, a)
+            wsum = lax.psum(wsum, a)
+        return loss_sum, wsum
+
+    data_spec = batch_axes if len(batch_axes) > 1 else batch_axes[0]
+
+    def loss(stacked_stage_params, head_params, x, aux):
+        B = x.shape[0]
+        assert B % n_microbatches == 0
+        mb = B // n_microbatches
+        xm = x.reshape((n_microbatches, mb) + x.shape[1:])
+        auxm = jax.tree_util.tree_map(
+            lambda a: a.reshape((n_microbatches, mb) + a.shape[1:]), aux)
+        param_spec = jax.tree_util.tree_map(lambda _: P(axis),
+                                            stacked_stage_params)
+        fn = shard_map(local, mesh=mesh,
+                       in_specs=(param_spec, P(),
+                                 P(None, data_spec), P(None, data_spec)),
+                       out_specs=(P(), P()), check_vma=False)
+        return fn(stacked_stage_params, head_params, xm, auxm)
+
+    return loss
+
+
+def split_stages(items: Sequence, n_stages: int):
+    """Split a layer list into n_stages contiguous groups (must divide)."""
+    if len(items) % n_stages != 0:
+        raise ValueError(f"{len(items)} layers not divisible into "
+                         f"{n_stages} stages")
+    per = len(items) // n_stages
+    return [list(items[i * per:(i + 1) * per]) for i in range(n_stages)]

@@ -1,0 +1,146 @@
+"""Pipeline parallelism v2: loss on last stage, heterogeneous embed/head,
+BERT dp x pp training parity (VERDICT round-1 item 8).
+
+'Done' criterion: pp=4 BERT step matches pp=1 numerically on the CPU mesh.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.models import bert
+from deeplearning4j_tpu.parallel.mesh import MeshConfig, make_mesh
+from deeplearning4j_tpu.parallel.pipeline import (make_pipeline_loss,
+                                                  split_stages,
+                                                  stack_stage_params)
+
+needs8 = pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 devices")
+
+
+def _f32_config(n_layers=4):
+    c = bert.BertConfig.tiny()
+    c.num_layers = n_layers
+    c.dtype = jnp.float32
+    return c
+
+
+def _batch(rs, c, B=8, T=16):
+    ids = rs.randint(0, c.vocab_size, (B, T)).astype(np.int32)
+    labels = np.where(rs.rand(B, T) < 0.15,
+                      rs.randint(0, c.vocab_size, (B, T)), -100).astype(
+                          np.int32)
+    return {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(labels)}
+
+
+@needs8
+class TestPipelineLoss:
+    def test_matches_sequential(self):
+        """Pipelined MLP stack == running the stages sequentially."""
+        rs = np.random.RandomState(0)
+        S, B, D = 4, 8, 16
+        stage_params = [
+            {"w": jnp.asarray(rs.randn(D, D).astype(np.float32) * 0.3),
+             "b": jnp.zeros((D,), jnp.float32)} for _ in range(S)]
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        def head_fn(hp, y, aux):
+            d = (y - aux["target"]) ** 2
+            return jnp.sum(d), jnp.float32(d.size)
+
+        mesh = make_mesh(MeshConfig(data=2, pipe=4))
+        x = jnp.asarray(rs.randn(B, D).astype(np.float32))
+        target = jnp.asarray(rs.randn(B, D).astype(np.float32))
+
+        loss = make_pipeline_loss(stage_fn, head_fn, mesh, n_microbatches=4)
+        s, w = loss(stack_stage_params(stage_params), {}, x,
+                    {"target": target})
+        got = s / w
+
+        h = x
+        for p in stage_params:
+            h = stage_fn(p, h)
+        expected = jnp.mean((h - target) ** 2)
+        np.testing.assert_allclose(float(got), float(expected), atol=1e-5)
+
+    def test_differentiable(self):
+        rs = np.random.RandomState(1)
+        S, B, D = 2, 8, 8
+        stage_params = [
+            {"w": jnp.asarray(rs.randn(D, D).astype(np.float32) * 0.3)}
+            for _ in range(S)]
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"])
+
+        def head_fn(hp, y, aux):
+            return jnp.sum(y ** 2), jnp.float32(y.size)
+
+        mesh = make_mesh(MeshConfig(data=1, fsdp=1, tensor=1, seq=4, pipe=2)) \
+            if False else make_mesh(MeshConfig(data=4, pipe=2))
+        x = jnp.asarray(rs.randn(B, D).astype(np.float32))
+        loss = make_pipeline_loss(stage_fn, head_fn, mesh, n_microbatches=2)
+        stacked = stack_stage_params(stage_params)
+
+        def scalar_loss(sp):
+            s, w = loss(sp, {}, x, {})
+            return s / w
+
+        g = jax.grad(scalar_loss)(stacked)
+        leaves = jax.tree_util.tree_leaves(g)
+        assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+        assert sum(float(jnp.abs(l).sum()) for l in leaves) > 0
+
+
+@needs8
+class TestBertPipeline:
+    def test_pp4_matches_pp1(self):
+        """VERDICT 'done': pp=4 BERT step matches pp=1 numerically."""
+        c = _f32_config(n_layers=4)
+        rs = np.random.RandomState(2)
+        batch = _batch(rs, c)
+        params = bert.init_params(jax.random.key(0), c)
+
+        losses = {}
+        trained = {}
+        for pp in (1, 4):
+            # dp=2 in both runs; pp=1 uses a 2-device sub-mesh
+            mesh = make_mesh(MeshConfig(data=2, pipe=pp),
+                             devices=jax.devices()[:2 * pp])
+            pp_params = bert.to_pipeline_params(
+                jax.tree_util.tree_map(jnp.copy, params), pp)
+            pp_params = bert.place_pipeline_params(pp_params, mesh)
+            opt = bert.init_opt_state(pp_params)
+            step = bert.make_pipeline_train_step(c, mesh, n_microbatches=4,
+                                                 learning_rate=1e-3)
+            new_params, opt, loss = step(pp_params, opt, batch, 0)
+            losses[pp] = float(loss)
+            trained[pp] = new_params
+
+        np.testing.assert_allclose(losses[4], losses[1], rtol=1e-5)
+        # per-layer params must match after one update (unstack both)
+        flat1 = bert.from_pipeline_params(trained[1])
+        flat4 = bert.from_pipeline_params(trained[4])
+        for leaf1, leaf4 in zip(jax.tree_util.tree_leaves(flat1),
+                                jax.tree_util.tree_leaves(flat4)):
+            np.testing.assert_allclose(np.asarray(leaf1), np.asarray(leaf4),
+                                       atol=2e-5)
+
+    def test_pipeline_loss_matches_flat_bert(self):
+        """Pipelined BERT loss == the flat (non-pipelined) mlm_loss."""
+        c = _f32_config(n_layers=4)
+        rs = np.random.RandomState(3)
+        batch = _batch(rs, c)
+        params = bert.init_params(jax.random.key(1), c)
+        flat_loss = float(bert.mlm_loss(params, batch, c))
+
+        mesh = make_mesh(MeshConfig(data=2, pipe=4))
+        pp_params = bert.place_pipeline_params(
+            bert.to_pipeline_params(params, 4), mesh)
+        opt = bert.init_opt_state(pp_params)
+        step = bert.make_pipeline_train_step(c, mesh, n_microbatches=4,
+                                             learning_rate=0.0)
+        _, _, loss = step(pp_params, opt, batch, 0)
+        np.testing.assert_allclose(float(loss), flat_loss, rtol=1e-5)
