@@ -10,18 +10,24 @@ from . import (  # noqa: F401  (import for registration side effects)
     compression,
     controlflow,
     conv_ops,
+    image_ops,
     linalg_ops,
+    list_ops,
     loss_ops,
+    nlp_ops,
     nn_ops,
     pairwise,
+    parity_extra,
     random_ops,
     recurrent,
     reduce,
     segment_ops,
     shape_ops,
+    string_ops,
     transforms,
     updater_ops,
 )
+from . import autobp  # noqa: F401  (last: derives _bp ops from the above)
 
 
 def registry() -> OpRegistry:

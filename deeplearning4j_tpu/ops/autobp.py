@@ -1,0 +1,80 @@
+"""Auto-registered backprop (_bp/_grad) ops via jax.vjp.
+
+Reference: every declarable op family ships a hand-written `<op>_bp`
+(`libnd4j/include/ops/declarable/headers/*.h`, ~120 ops). On TPU the
+backprop rule IS `jax.vjp` of the forward — XLA differentiates and fuses
+it; hand-written backward kernels would be strictly worse. These wrappers
+exist for op-name parity and for graphs that invoke bp ops explicitly
+(imported gradient graphs, OpValidation-style per-op tests).
+
+Convention (matching the reference bp signature): positional args are the
+forward inputs followed by the upstream gradient(s); kwargs are forwarded.
+Gradients are returned for every floating-point input (zeros_like for
+integer inputs, as the reference does).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import OpRegistry, OpDef
+from .reference_inventory import all_reference_ops
+
+
+def _make_bp(fwd_fn, name):
+    def bp(*args, **kwargs):
+        if len(args) < 2:
+            raise ValueError(f"{name}: expected (inputs..., grad)")
+        *xs, g = args
+        is_diff = [hasattr(x, "dtype") and
+                   jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+                   for x in xs]
+
+        def fwd(*diff_args):
+            it = iter(diff_args)
+            full = [next(it) if d else x for x, d in zip(xs, is_diff)]
+            return fwd_fn(*full, **kwargs)
+
+        diff_xs = [x for x, d in zip(xs, is_diff) if d]
+        if not diff_xs:
+            return tuple(jnp.zeros_like(jnp.asarray(x)) for x in xs)
+        out, vjp = jax.vjp(fwd, *diff_xs)
+        # cotangent must match the output structure
+        cot = jax.tree_util.tree_map(
+            lambda o: jnp.broadcast_to(jnp.asarray(g, o.dtype), o.shape), out)
+        diff_grads = iter(vjp(cot))
+        grads = tuple(next(diff_grads) if d
+                      else jnp.zeros_like(jnp.asarray(x))
+                      for x, d in zip(xs, is_diff))
+        return grads[0] if len(grads) == 1 else grads
+
+    bp.__name__ = name
+    return bp
+
+
+def register_auto_bp():
+    """Register `<op>_bp` / `<op>_grad` for every registered differentiable
+    base op that the reference inventory lists a bp for."""
+    reg = OpRegistry.get()
+    for name in all_reference_ops():
+        for suffix in ("_bp", "_grad"):
+            if not name.endswith(suffix):
+                continue
+            base = name[: -len(suffix)]
+            if reg.has(name) or not reg.has(base):
+                continue
+            base_def = reg.lookup(base)
+            if not base_def.differentiable:
+                continue
+            reg.register(OpDef(name=name, fn=_make_bp(base_def.fn, name),
+                               category="autodiff_bp", differentiable=False))
+    # irregular names / bases flagged non-differentiable but with real vjps
+    for bp_name, base in (("lstmLayerCellBp", "lstmLayerCell"),
+                          ("dynamic_partition_bp", "dynamic_partition")):
+        if not reg.has(bp_name) and reg.has(base):
+            reg.register(OpDef(name=bp_name,
+                               fn=_make_bp(reg.lookup(base).fn, bp_name),
+                               category="autodiff_bp", differentiable=False))
+
+
+register_auto_bp()

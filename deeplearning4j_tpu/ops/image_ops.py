@@ -1,0 +1,298 @@
+"""Image ops (reference `libnd4j/include/ops/declarable/headers/images.h`
+and the image portion of parity_ops.h).
+
+Color conversions use the standard matrices; resizes lower to
+`jax.image.resize` (XLA-fused gathers/convs — no hand kernels needed on
+TPU). Channel convention: trailing axis = channels, like the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import op
+
+# -- color space conversions ---------------------------------------------
+
+_YIQ = np.array([[0.299, 0.587, 0.114],
+                 [0.595716, -0.274453, -0.321263],
+                 [0.211456, -0.522591, 0.311135]], np.float32)
+_YUV = np.array([[0.299, 0.587, 0.114],
+                 [-0.14714119, -0.28886916, 0.43601035],
+                 [0.61497538, -0.51496512, -0.10001026]], np.float32)
+
+
+@op("rgb_to_yiq", "images")
+def rgb_to_yiq(x):
+    return jnp.einsum("...c,dc->...d", x, jnp.asarray(_YIQ))
+
+
+@op("yiq_to_rgb", "images")
+def yiq_to_rgb(x):
+    return jnp.einsum("...c,dc->...d", x, jnp.asarray(np.linalg.inv(_YIQ)))
+
+
+@op("rgb_to_yuv", "images")
+def rgb_to_yuv(x):
+    return jnp.einsum("...c,dc->...d", x, jnp.asarray(_YUV))
+
+
+@op("yuv_to_rgb", "images")
+def yuv_to_rgb(x):
+    return jnp.einsum("...c,dc->...d", x, jnp.asarray(np.linalg.inv(_YUV)))
+
+
+@op("rgb_to_grs", "images")
+def rgb_to_grs(x):
+    w = jnp.asarray([0.2989, 0.5870, 0.1140], x.dtype)
+    return jnp.sum(x * w, axis=-1, keepdims=True)
+
+
+@op("rgb_to_hsv", "images")
+def rgb_to_hsv(x):
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = jnp.max(x, axis=-1)
+    mn = jnp.min(x, axis=-1)
+    diff = mx - mn
+    safe = jnp.where(diff == 0, 1.0, diff)
+    h = jnp.where(mx == r, (g - b) / safe % 6.0,
+                  jnp.where(mx == g, (b - r) / safe + 2.0,
+                            (r - g) / safe + 4.0))
+    h = jnp.where(diff == 0, 0.0, h) / 6.0
+    s = jnp.where(mx == 0, 0.0, diff / jnp.where(mx == 0, 1.0, mx))
+    return jnp.stack([h, s, mx], axis=-1)
+
+
+@op("hsv_to_rgb", "images")
+def hsv_to_rgb(x):
+    h, s, v = x[..., 0] * 6.0, x[..., 1], x[..., 2]
+    i = jnp.floor(h)
+    f = h - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(jnp.int32) % 6
+    r = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [v, q, p, p, t, v])
+    g = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [t, v, v, q, p, p])
+    b = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [p, p, t, v, v, q])
+    return jnp.stack([r, g, b], axis=-1)
+
+
+# -- resize family --------------------------------------------------------
+
+def _resize(x, size, method):
+    size = tuple(int(s) for s in size)
+    if x.ndim == 4:
+        shape = (x.shape[0],) + size + (x.shape[3],)
+    elif x.ndim == 3:
+        shape = size + (x.shape[2],)
+    else:
+        raise ValueError("resize expects [B,H,W,C] or [H,W,C]")
+    return jax.image.resize(x, shape, method=method)
+
+
+@op("resize_bilinear", "images")
+def resize_bilinear(x, size=None, height=None, width=None, **_):
+    return _resize(x, size or (height, width), "linear")
+
+
+@op("resize_nearest_neighbor", "images")
+def resize_nearest_neighbor(x, size=None, height=None, width=None, **_):
+    return _resize(x, size or (height, width), "nearest")
+
+
+@op("resize_bicubic", "images")
+def resize_bicubic(x, size=None, height=None, width=None, **_):
+    return _resize(x, size or (height, width), "cubic")
+
+
+@op("resize_area", "images")
+def resize_area(x, size=None, height=None, width=None, **_):
+    # area = anti-aliased linear downsample (XLA has no direct area kernel)
+    size = tuple(int(s) for s in (size or (height, width)))
+    if x.ndim == 4:
+        shape = (x.shape[0],) + size + (x.shape[3],)
+    else:
+        shape = size + (x.shape[2],)
+    return jax.image.resize(x, shape, method="linear", antialias=True)
+
+
+_METHODS = {0: "linear", 1: "cubic", 2: "nearest", 3: "linear", 4: "linear",
+             "bilinear": "linear", "bicubic": "cubic", "nearest": "nearest",
+             "area": "linear", "lanczos3": "lanczos3",
+             "lanczos5": "lanczos5", "gaussian": "linear",
+             "mitchellcubic": "cubic"}
+
+
+@op("image_resize", "images", aliases=("resize_images",))
+def image_resize(x, size, method="bilinear", **_):
+    return _resize(x, size, _METHODS.get(method, "linear"))
+
+
+@op("crop_and_resize", "images")
+def crop_and_resize(image, boxes, box_indices, crop_size, method="bilinear",
+                    extrapolation_value=0.0):
+    """TF CropAndResize: normalized boxes [y1,x1,y2,x2] per box."""
+    ch, cw = int(crop_size[0]), int(crop_size[1])
+    H, W = image.shape[1], image.shape[2]
+    m = _METHODS.get(method, "linear")
+
+    def one(box, idx):
+        y1, x1, y2, x2 = box[0], box[1], box[2], box[3]
+        img = image[idx]
+        ys = y1 * (H - 1) + jnp.arange(ch) / max(ch - 1, 1) * \
+            (y2 - y1) * (H - 1)
+        xs = x1 * (W - 1) + jnp.arange(cw) / max(cw - 1, 1) * \
+            (x2 - x1) * (W - 1)
+        if m == "nearest":
+            yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, W - 1)
+            return img[yi][:, xi]
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1)
+        y1i = jnp.clip(y0 + 1, 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1)
+        x1i = jnp.clip(x0 + 1, 0, W - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        tl = img[y0][:, x0]
+        tr = img[y0][:, x1i]
+        bl = img[y1i][:, x0]
+        br = img[y1i][:, x1i]
+        return (tl * (1 - wy) * (1 - wx) + tr * (1 - wy) * wx +
+                bl * wy * (1 - wx) + br * wy * wx)
+
+    return jax.vmap(one)(boxes, box_indices.astype(jnp.int32))
+
+
+# -- photometric adjustments ----------------------------------------------
+
+@op("adjust_contrast", "images", aliases=("adjust_contrast_v2",))
+def adjust_contrast(x, factor=1.0):
+    mean = jnp.mean(x, axis=(-3, -2), keepdims=True)
+    return (x - mean) * factor + mean
+
+
+@op("adjust_saturation", "images")
+def adjust_saturation(x, factor=1.0):
+    hsv = rgb_to_hsv(x)
+    s = jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)
+    return hsv_to_rgb(jnp.stack([hsv[..., 0], s, hsv[..., 2]], axis=-1))
+
+
+@op("adjust_hue", "images")
+def adjust_hue(x, delta=0.0):
+    hsv = rgb_to_hsv(x)
+    h = (hsv[..., 0] + delta) % 1.0
+    return hsv_to_rgb(jnp.stack([h, hsv[..., 1], hsv[..., 2]], axis=-1))
+
+
+# -- detection helpers ----------------------------------------------------
+
+def _iou(a, b):
+    y1 = jnp.maximum(a[0], b[0])
+    x1 = jnp.maximum(a[1], b[1])
+    y2 = jnp.minimum(a[2], b[2])
+    x2 = jnp.minimum(a[3], b[3])
+    inter = jnp.maximum(y2 - y1, 0) * jnp.maximum(x2 - x1, 0)
+    area_a = (a[2] - a[0]) * (a[3] - a[1])
+    area_b = (b[2] - b[0]) * (b[3] - b[1])
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-9)
+
+
+@op("non_max_suppression", "images", differentiable=False,
+    aliases=("non_max_suppression_v3",))
+def non_max_suppression(boxes, scores, max_output_size,
+                        iou_threshold=0.5, score_threshold=-jnp.inf):
+    """Greedy NMS returning selected indices (padded with -1)."""
+    n = boxes.shape[0]
+    max_out = int(max_output_size)
+    order = jnp.argsort(-scores)
+
+    def body(state, _):
+        selected, sel_count, suppressed = state
+        avail = (~suppressed) & (scores[order] > score_threshold)
+        idx_in_order = jnp.argmax(avail)
+        any_avail = jnp.any(avail)
+        cand = order[idx_in_order]
+        do = any_avail & (sel_count < max_out)
+        selected = jnp.where(
+            do, selected.at[jnp.clip(sel_count, 0, max_out - 1)].set(cand),
+            selected)
+        sel_count = sel_count + jnp.where(do, 1, 0)
+        ious = jax.vmap(lambda b: _iou(boxes[cand], b))(boxes[order])
+        suppressed = suppressed | (avail & (ious > iou_threshold)) | \
+            (jnp.arange(n) == idx_in_order)
+        return (selected, sel_count, suppressed), None
+
+    init = (jnp.full((max_out,), -1, jnp.int32), jnp.int32(0),
+            jnp.zeros((n,), bool))
+    (selected, _, _), _ = jax.lax.scan(body, init, None, length=min(n, max_out))
+    return selected
+
+
+@op("non_max_suppression_overlaps", "images", differentiable=False)
+def non_max_suppression_overlaps(overlaps, scores, max_output_size,
+                                 overlap_threshold=0.5,
+                                 score_threshold=-jnp.inf):
+    """NMS over a precomputed pairwise overlap matrix."""
+    n = overlaps.shape[0]
+    max_out = int(max_output_size)
+    order = jnp.argsort(-scores)
+
+    def body(state, _):
+        selected, sel_count, suppressed = state
+        avail = (~suppressed) & (scores[order] > score_threshold)
+        idx_in_order = jnp.argmax(avail)
+        any_avail = jnp.any(avail)
+        cand = order[idx_in_order]
+        do = any_avail & (sel_count < max_out)
+        selected = jnp.where(
+            do, selected.at[jnp.clip(sel_count, 0, max_out - 1)].set(cand),
+            selected)
+        sel_count = sel_count + jnp.where(do, 1, 0)
+        suppressed = suppressed | (avail &
+                                   (overlaps[cand][order] >
+                                    overlap_threshold)) | \
+            (jnp.arange(n) == idx_in_order)
+        return (selected, sel_count, suppressed), None
+
+    init = (jnp.full((max_out,), -1, jnp.int32), jnp.int32(0),
+            jnp.zeros((n,), bool))
+    (selected, _, _), _ = jax.lax.scan(body, init, None,
+                                       length=min(n, max_out))
+    return selected
+
+
+@op("draw_bounding_boxes", "images", differentiable=False)
+def draw_bounding_boxes(images, boxes, colors=None):
+    """Draw box outlines (normalized [y1,x1,y2,x2]) onto images [B,H,W,C]."""
+    B, H, W, C = images.shape
+    if colors is None:
+        colors = jnp.ones((1, C), images.dtype)
+    colors = jnp.asarray(colors)
+
+    def draw_one(img, img_boxes):
+        yy = jnp.arange(H)[:, None]
+        xx = jnp.arange(W)[None, :]
+
+        def body(im, bc):
+            box, color = bc
+            y1 = jnp.round(box[0] * (H - 1)).astype(jnp.int32)
+            x1 = jnp.round(box[1] * (W - 1)).astype(jnp.int32)
+            y2 = jnp.round(box[2] * (H - 1)).astype(jnp.int32)
+            x2 = jnp.round(box[3] * (W - 1)).astype(jnp.int32)
+            on_edge = (((yy == y1) | (yy == y2)) & (xx >= x1) & (xx <= x2)) \
+                | (((xx == x1) | (xx == x2)) & (yy >= y1) & (yy <= y2))
+            return jnp.where(on_edge[..., None], color, im), None
+
+        n_boxes = img_boxes.shape[0]
+        cols = jnp.broadcast_to(colors, (n_boxes, C)) \
+            if colors.shape[0] != n_boxes else colors
+        im, _ = jax.lax.scan(body, img, (img_boxes, cols))
+        return im
+
+    return jax.vmap(draw_one)(images, boxes)

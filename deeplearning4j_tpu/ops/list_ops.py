@@ -1,0 +1,92 @@
+"""TensorArray / NDArrayList ops (reference `headers/list.h`).
+
+The reference mutates a native NDArrayList inside the graph interpreter.
+Functionally on TPU a "list" is just a tuple of arrays (host-level) or a
+stacked array; these ops provide the API-parity surface used by imported
+TF1 graphs and the SameDiff TensorArray. All are host-structural
+(differentiable through contents where applicable).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import op
+
+
+@op("create_list", "list", differentiable=False)
+def create_list(*_, **__):
+    return ()
+
+
+@op("write_list", "list")
+def write_list(lst, value, index):
+    lst = tuple(lst)
+    i = int(index)
+    if i < len(lst):
+        return lst[:i] + (value,) + lst[i + 1:]
+    pad = (jnp.zeros_like(value),) * (i - len(lst))
+    return lst + pad + (value,)
+
+
+@op("read_list", "list")
+def read_list(lst, index):
+    return lst[int(index)]
+
+
+@op("pick_list", "list")
+def pick_list(lst, *indices):
+    idx = [int(i) for i in (indices[0] if len(indices) == 1 and
+                            hasattr(indices[0], "__iter__") else indices)]
+    return jnp.stack([lst[i] for i in idx])
+
+
+@op("size_list", "list", differentiable=False)
+def size_list(lst):
+    return jnp.asarray(len(lst), jnp.int32)
+
+
+@op("scatter_list", "list")
+def scatter_list(lst, indices, array):
+    """Scatter array rows into list positions."""
+    lst = list(lst)
+    for j, i in enumerate(int(x) for x in indices):
+        while len(lst) <= i:
+            lst.append(jnp.zeros_like(array[0]))
+        lst[i] = array[j]
+    return tuple(lst)
+
+
+@op("gather_list", "list")
+def gather_list(lst, indices):
+    return jnp.stack([lst[int(i)] for i in indices])
+
+
+@op("stack_list", "list")
+def stack_list(lst):
+    return jnp.stack(list(lst))
+
+
+@op("unstack_list", "list")
+def unstack_list(array, axis=0):
+    return tuple(jnp.squeeze(s, axis)
+                 for s in jnp.split(array, array.shape[axis], axis))
+
+
+@op("split_list", "list")
+def split_list(array, sizes):
+    out = []
+    offset = 0
+    for s in (int(x) for x in sizes):
+        out.append(array[offset:offset + s])
+        offset += s
+    return tuple(out)
+
+
+@op("clone_list", "list")
+def clone_list(lst):
+    return tuple(lst)
+
+
+@op("delete_list", "list", differentiable=False)
+def delete_list(lst):
+    return ()

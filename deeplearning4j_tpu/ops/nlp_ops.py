@@ -1,0 +1,79 @@
+"""NLP training-round ops (reference `headers/nlp.h`: skipgram, cbow).
+
+Reference: `libnd4j/include/ops/declarable/generic/nlp/` — SkipGramRound /
+CbowRound apply one negative-sampling SGD round in-place on syn0/syn1neg.
+TPU redesign: pure-functional batched rounds returning updated tables
+(functional scatter-update; XLA fuses gather+dot+scatter). The
+`nlp/sequence_vectors.py` trainer uses its own fused jit step; these ops
+exist for op-level parity and for graph-recorded training.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import op
+
+
+def _sg_round(syn0, syn1neg, target, context, neg_ids, lr):
+    """One skip-gram negative-sampling update for a batch of pairs.
+
+    target/context: [B] int ids; neg_ids: [B, K] negatives.
+    Returns (new_syn0, new_syn1neg, loss)."""
+    v = syn0[target]                               # [B, D]
+    ids = jnp.concatenate([context[:, None], neg_ids], axis=1)  # [B, 1+K]
+    labels = jnp.concatenate([jnp.ones_like(context[:, None]),
+                              jnp.zeros_like(neg_ids)],
+                             axis=1).astype(syn0.dtype)
+    u = syn1neg[ids]                               # [B, 1+K, D]
+    logits = jnp.einsum("bkd,bd->bk", u, v)
+    p = jax.nn.sigmoid(logits)
+    g = (labels - p) * lr                          # [B, 1+K]
+    dv = jnp.einsum("bk,bkd->bd", g, u)
+    du = jnp.einsum("bk,bd->bkd", g, v)
+    loss = -jnp.mean(labels * jax.nn.log_sigmoid(logits) +
+                     (1 - labels) * jax.nn.log_sigmoid(-logits))
+    syn0 = syn0.at[target].add(dv)
+    syn1neg = syn1neg.at[ids.reshape(-1)].add(
+        du.reshape(-1, du.shape[-1]))
+    return syn0, syn1neg, loss
+
+
+@op("skipgram", "nlp", differentiable=False)
+def skipgram(syn0, syn1neg, target, context, neg_ids, lr=0.025):
+    """Batched SkipGramRound (reference SkipGramRound.java / nlp/sg_cb.cpp)."""
+    return _sg_round(syn0, syn1neg, jnp.atleast_1d(target),
+                     jnp.atleast_1d(context), jnp.atleast_2d(neg_ids),
+                     jnp.asarray(lr, syn0.dtype))
+
+
+@op("cbow", "nlp", differentiable=False)
+def cbow(syn0, syn1neg, context_ids, context_mask, target, neg_ids,
+         lr=0.025):
+    """Batched CbowRound: mean of context vectors predicts the target.
+
+    context_ids: [B, C] (padded), context_mask: [B, C] 0/1,
+    target: [B], neg_ids: [B, K]."""
+    target = jnp.atleast_1d(target)
+    mask = context_mask.astype(syn0.dtype)
+    counts = jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
+    ctx_vecs = syn0[context_ids] * mask[..., None]
+    h = ctx_vecs.sum(axis=1) / counts              # [B, D]
+    ids = jnp.concatenate([target[:, None], neg_ids], axis=1)
+    labels = jnp.concatenate([jnp.ones_like(target[:, None]),
+                              jnp.zeros_like(neg_ids)],
+                             axis=1).astype(syn0.dtype)
+    u = syn1neg[ids]
+    logits = jnp.einsum("bkd,bd->bk", u, h)
+    p = jax.nn.sigmoid(logits)
+    g = (labels - p) * lr
+    dh = jnp.einsum("bk,bkd->bd", g, u)            # grad to the mean vector
+    du = jnp.einsum("bk,bd->bkd", g, h)
+    loss = -jnp.mean(labels * jax.nn.log_sigmoid(logits) +
+                     (1 - labels) * jax.nn.log_sigmoid(-logits))
+    syn1neg = syn1neg.at[ids.reshape(-1)].add(du.reshape(-1, du.shape[-1]))
+    # distribute dh across contributing context rows
+    per_row = (dh[:, None, :] / counts[..., None]) * mask[..., None]
+    syn0 = syn0.at[context_ids.reshape(-1)].add(
+        per_row.reshape(-1, per_row.shape[-1]))
+    return syn0, syn1neg, loss

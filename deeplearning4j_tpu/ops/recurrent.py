@@ -170,3 +170,75 @@ def simple_rnn(x, w_x, w_h, b=None, h0=None, activation=jnp.tanh,
     if not time_major:
         h_seq = jnp.swapaxes(h_seq, 0, 1)
     return h_seq, h_last
+
+
+@op("lstmBlockCell", "recurrent")
+def lstm_block_cell(x, h_prev, c_prev, w, b, wci=None, wcf=None, wco=None,
+                    forget_bias=1.0, clip_value=0.0):
+    """TF-style LSTMBlockCell: fused weights w [In+H, 4H] ordered
+    [i, c(g), f, o], optional peephole weights (reference lstmBlockCell)."""
+    z = jnp.matmul(jnp.concatenate([x, h_prev], axis=-1), w) + b
+    H = h_prev.shape[-1]
+    i, g, f, o = (z[..., :H], z[..., H:2 * H], z[..., 2 * H:3 * H],
+                  z[..., 3 * H:])
+    if wci is not None:
+        i = i + c_prev * wci
+    if wcf is not None:
+        f = f + c_prev * wcf
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forget_bias)
+    g = jnp.tanh(g)
+    c = f * c_prev + i * g
+    if clip_value > 0:
+        c = jnp.clip(c, -clip_value, clip_value)
+    if wco is not None:
+        o = o + c * wco
+    o = jax.nn.sigmoid(o)
+    h = o * jnp.tanh(c)
+    return i, c, f, o, g, jnp.tanh(c), h
+
+
+@op("lstmBlock", "recurrent")
+def lstm_block(x, h0, c0, w, b, wci=None, wcf=None, wco=None,
+               forget_bias=1.0, clip_value=0.0, time_major=True):
+    """Full-sequence TF-style block LSTM (reference lstmBlock)."""
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+
+    def step(carry, x_t):
+        h, c = carry
+        outs = lstm_block_cell(x_t, h, c, w, b, wci, wcf, wco,
+                               forget_bias, clip_value)
+        c_new, h_new = outs[1], outs[6]
+        return (h_new, c_new), h_new
+
+    (h_last, c_last), h_seq = lax.scan(step, (h0, c0), x)
+    if not time_major:
+        h_seq = jnp.swapaxes(h_seq, 0, 1)
+    return h_seq, h_last, c_last
+
+
+@op("sru_bi", "recurrent")
+def sru_bi(x, w_f, b_f, w_b, b_b, c0_f=None, c0_b=None, time_major=False):
+    """Bidirectional SRU (reference sru_bi): fwd + reversed bwd, concat."""
+    fwd, cf = sru(x, w_f, b_f, c0_f, time_major=time_major)
+    axis = 0 if time_major else 1
+    bwd, cb = sru(jnp.flip(x, axis=axis), w_b, b_b, c0_b,
+                  time_major=time_major)
+    bwd = jnp.flip(bwd, axis=axis)
+    return jnp.concatenate([fwd, bwd], axis=-1), cf, cb
+
+
+@op("static_bidirectional_rnn", "recurrent",
+    aliases=("dynamic_bidirectional_rnn",))
+def bidirectional_rnn(x, w_x_f, w_h_f, b_f, w_x_b, w_h_b, b_b, h0_f=None,
+                      h0_b=None, activation=jnp.tanh, time_major=False):
+    """Bidirectional Elman RNN (reference static/dynamic_bidirectional_rnn;
+    on TPU both lower to the same lax.scan — XLA unrolls nothing)."""
+    fwd_seq, hf = simple_rnn(x, w_x_f, w_h_f, b_f, h0_f, activation,
+                             time_major)
+    axis = 0 if time_major else 1
+    bwd_seq, hb = simple_rnn(jnp.flip(x, axis=axis), w_x_b, w_h_b, b_b,
+                             h0_b, activation, time_major)
+    bwd_seq = jnp.flip(bwd_seq, axis=axis)
+    return jnp.concatenate([fwd_seq, bwd_seq], axis=-1), hf, hb

@@ -1,0 +1,81 @@
+"""String / compat ops (reference `headers/strings.h`, `headers/compat.h`,
+plus hashcode from transforms.h).
+
+String tensors are host-side numpy object arrays (XLA has no string type —
+same situation as libnd4j, where utf8 ops run on CPU regardless of
+backend). All non-differentiable.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import op
+
+
+def _as_str_array(x):
+    return np.asarray(x, dtype=object)
+
+
+@op("split_string", "strings", differentiable=False)
+def split_string(x, delimiter=" "):
+    """Split each string; returns (values, row_lengths)."""
+    arr = _as_str_array(x).ravel()
+    values = []
+    lengths = []
+    for s in arr:
+        parts = str(s).split(delimiter) if delimiter else str(s).split()
+        values.extend(parts)
+        lengths.append(len(parts))
+    return (np.asarray(values, object), np.asarray(lengths, np.int64))
+
+
+@op("compat_string_split", "strings", differentiable=False)
+def compat_string_split(x, delimiter=" "):
+    """TF-compat StringSplit: returns (indices [N,2], values, dense_shape)."""
+    arr = _as_str_array(x).ravel()
+    indices = []
+    values = []
+    max_cols = 0
+    for r, s in enumerate(arr):
+        parts = str(s).split(delimiter) if delimiter else str(s).split()
+        max_cols = max(max_cols, len(parts))
+        for c, p in enumerate(parts):
+            indices.append([r, c])
+            values.append(p)
+    return (np.asarray(indices, np.int64).reshape(-1, 2),
+            np.asarray(values, object),
+            np.asarray([len(arr), max_cols], np.int64))
+
+
+@op("compat_sparse_to_dense", "strings", differentiable=False)
+def compat_sparse_to_dense(indices, dense_shape, values, default_value=0):
+    """Densify COO (indices [N, rank]) — string or numeric values."""
+    vals = np.asarray(values)
+    shape = tuple(int(s) for s in np.asarray(dense_shape))
+    if vals.dtype == object:
+        out = np.full(shape, default_value if isinstance(default_value, str)
+                      else "", object)
+    else:
+        out = np.full(shape, default_value, vals.dtype)
+    idx = np.asarray(indices).reshape(-1, len(shape))
+    for i, v in zip(idx, vals.ravel()):
+        out[tuple(int(j) for j in i)] = v
+    return out
+
+
+@op("hashcode", "transforms", differentiable=False)
+def hashcode(x):
+    """Java-style deterministic content hash (reference transforms.h)."""
+    data = np.asarray(x).ravel()
+    h = 1
+    if data.dtype == object:
+        for s in data:
+            h = (31 * h + (hash(str(s)) & 0xFFFFFFFF)) & 0xFFFFFFFFFFFFFFFF
+    else:
+        for b in data.tobytes():
+            h = (31 * h + b) & 0xFFFFFFFFFFFFFFFF
+    if h >= 1 << 63:
+        h -= 1 << 64
+    # host numpy scalar: jnp would truncate int64 under the default x32 mode
+    return np.int64(h)

@@ -1,0 +1,243 @@
+"""Enforced op-coverage accounting vs the reference's declarable inventory
+(VERDICT round-1 item 7) + behavior tests for the new op families.
+
+The coverage test is the OpValidation accounting analog
+(`nd4j/.../autodiff/validation/OpValidation.java:117-232`): it enumerates
+the reference's 517 DECLARE_* names and FAILS if coverage drops below 95%,
+printing the exact diff.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.registry import OpRegistry, exec_op
+from deeplearning4j_tpu.ops.reference_inventory import (EXEMPT,
+                                                        REFERENCE_OPS,
+                                                        all_reference_ops)
+
+
+class TestCoverage:
+    def test_reference_coverage_at_least_95_percent(self):
+        reg = OpRegistry.get()
+        names = all_reference_ops()
+        missing = sorted(n for n in names
+                         if not reg.has(n) and n not in EXEMPT)
+        covered = len(names) - len(missing) - \
+            sum(1 for n in names if n in EXEMPT)
+        pct = 100.0 * covered / len(names)
+        assert pct >= 95.0, (
+            f"op coverage {pct:.1f}% ({covered}/{len(names)}); "
+            f"missing: {missing}")
+
+    def test_no_category_fully_missing(self):
+        reg = OpRegistry.get()
+        for header, names in REFERENCE_OPS.items():
+            real = [n for n in names if n not in EXEMPT]
+            if not real:
+                continue
+            present = sum(1 for n in real if reg.has(n))
+            assert present > 0, f"entire header {header} unimplemented"
+
+    def test_exempt_list_is_small_and_documented(self):
+        assert len(EXEMPT) <= 10
+
+
+class TestAutoBp:
+    def test_tanh_bp_matches_analytic(self):
+        x = jnp.asarray([0.3, -1.2, 2.0], jnp.float32)
+        g = jnp.asarray([1.0, 1.0, 1.0], jnp.float32)
+        got = exec_op("tanh_bp", x, g)
+        expected = (1 - jnp.tanh(x) ** 2) * g
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   atol=1e-6)
+
+    def test_matmul_bp_shapes(self):
+        a = jnp.ones((3, 4))
+        b = jnp.ones((4, 5))
+        g = jnp.ones((3, 5))
+        ga, gb = exec_op("matmul_bp", a, b, g)
+        assert ga.shape == a.shape and gb.shape == b.shape
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(g @ b.T))
+
+    def test_add_bp_broadcast(self):
+        a = jnp.ones((2, 3))
+        b = jnp.ones((3,))
+        g = jnp.full((2, 3), 2.0)
+        ga, gb = exec_op("add_bp", a, b, g)
+        assert ga.shape == (2, 3) and gb.shape == (3,)
+        np.testing.assert_allclose(np.asarray(gb), [4.0, 4.0, 4.0])
+
+    def test_softmax_cross_entropy_loss_grad_registered(self):
+        reg = OpRegistry.get()
+        assert reg.has("softmax_cross_entropy_loss_grad")
+        assert reg.has("sigm_cross_entropy_loss_grad")
+
+
+class TestImageOps:
+    def test_color_roundtrips(self):
+        rs = np.random.RandomState(0)
+        img = jnp.asarray(rs.rand(4, 4, 3).astype(np.float32))
+        for fwd, bwd in (("rgb_to_yiq", "yiq_to_rgb"),
+                         ("rgb_to_yuv", "yuv_to_rgb"),
+                         ("rgb_to_hsv", "hsv_to_rgb")):
+            back = exec_op(bwd, exec_op(fwd, img))
+            np.testing.assert_allclose(np.asarray(back), np.asarray(img),
+                                       atol=1e-4)
+
+    def test_resize(self):
+        img = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+        out = exec_op("resize_nearest_neighbor", img, size=(2, 2))
+        assert out.shape == (1, 2, 2, 1)
+        out = exec_op("resize_bilinear", img, size=(8, 8))
+        assert out.shape == (1, 8, 8, 1)
+
+    def test_adjust_contrast(self):
+        img = jnp.asarray([[[[1.0], [3.0]], [[5.0], [7.0]]]])
+        out = exec_op("adjust_contrast", img, factor=2.0)
+        np.testing.assert_allclose(np.asarray(out).ravel(),
+                                   [-2.0, 2.0, 6.0, 10.0])
+
+    def test_nms(self):
+        boxes = jnp.asarray([[0, 0, 1, 1], [0, 0, 1, 1.01], [0, 2, 1, 3]],
+                            jnp.float32)
+        scores = jnp.asarray([0.9, 0.8, 0.7], jnp.float32)
+        sel = exec_op("non_max_suppression", boxes, scores, 3,
+                      iou_threshold=0.5)
+        sel = [i for i in np.asarray(sel) if i >= 0]
+        assert sel == [0, 2]
+
+
+class TestListOps:
+    def test_write_read_stack(self):
+        lst = exec_op("create_list")
+        lst = exec_op("write_list", lst, jnp.asarray([1.0, 2.0]), 0)
+        lst = exec_op("write_list", lst, jnp.asarray([3.0, 4.0]), 2)
+        assert int(exec_op("size_list", lst)) == 3
+        stacked = exec_op("stack_list", lst)
+        np.testing.assert_allclose(np.asarray(stacked),
+                                   [[1, 2], [0, 0], [3, 4]])
+        np.testing.assert_allclose(
+            np.asarray(exec_op("read_list", lst, 2)), [3, 4])
+
+    def test_unstack_split(self):
+        arr = jnp.arange(6.0).reshape(3, 2)
+        lst = exec_op("unstack_list", arr)
+        assert len(lst) == 3
+        parts = exec_op("split_list", arr, [1, 2])
+        assert parts[0].shape == (1, 2) and parts[1].shape == (2, 2)
+
+
+class TestStringOps:
+    def test_split_string(self):
+        vals, lens = exec_op("split_string",
+                             np.asarray(["a b c", "d e"], object))
+        assert list(vals) == ["a", "b", "c", "d", "e"]
+        assert list(lens) == [3, 2]
+
+    def test_compat_string_split_and_densify(self):
+        idx, vals, shape = exec_op("compat_string_split",
+                                   np.asarray(["x y", "z"], object))
+        assert list(shape) == [2, 2]
+        dense = exec_op("compat_sparse_to_dense", idx, shape, vals,
+                        default_value="")
+        assert dense[0][0] == "x" and dense[1][0] == "z" and dense[1][1] == ""
+
+    def test_hashcode_deterministic(self):
+        a = exec_op("hashcode", jnp.asarray([1, 2, 3], jnp.int32))
+        b = exec_op("hashcode", jnp.asarray([1, 2, 3], jnp.int32))
+        c = exec_op("hashcode", jnp.asarray([1, 2, 4], jnp.int32))
+        assert int(a) == int(b) and int(a) != int(c)
+
+
+class TestNlpOps:
+    def test_skipgram_reduces_loss(self):
+        rs = np.random.RandomState(0)
+        syn0 = jnp.asarray(rs.randn(20, 8).astype(np.float32) * 0.1)
+        syn1 = jnp.asarray(rs.randn(20, 8).astype(np.float32) * 0.1)
+        target = jnp.asarray([1, 2], jnp.int32)
+        context = jnp.asarray([3, 4], jnp.int32)
+        neg = jnp.asarray([[5, 6], [7, 8]], jnp.int32)
+        losses = []
+        for _ in range(30):
+            syn0, syn1, loss = exec_op("skipgram", syn0, syn1, target,
+                                       context, neg, lr=0.1)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_cbow_reduces_loss(self):
+        rs = np.random.RandomState(1)
+        syn0 = jnp.asarray(rs.randn(20, 8).astype(np.float32) * 0.1)
+        syn1 = jnp.asarray(rs.randn(20, 8).astype(np.float32) * 0.1)
+        ctx = jnp.asarray([[1, 2, 0], [3, 4, 5]], jnp.int32)
+        mask = jnp.asarray([[1, 1, 0], [1, 1, 1]], jnp.int32)
+        target = jnp.asarray([6, 7], jnp.int32)
+        neg = jnp.asarray([[8, 9], [10, 11]], jnp.int32)
+        losses = []
+        for _ in range(30):
+            syn0, syn1, loss = exec_op("cbow", syn0, syn1, ctx, mask,
+                                       target, neg, lr=0.1)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+
+class TestRecurrentExtra:
+    def test_lstm_block_runs(self):
+        rs = np.random.RandomState(0)
+        B, T, In, H = 2, 5, 3, 4
+        x = jnp.asarray(rs.randn(T, B, In).astype(np.float32))
+        w = jnp.asarray(rs.randn(In + H, 4 * H).astype(np.float32) * 0.3)
+        b = jnp.zeros((4 * H,), jnp.float32)
+        h0 = jnp.zeros((B, H), jnp.float32)
+        c0 = jnp.zeros((B, H), jnp.float32)
+        h_seq, h_last, c_last = exec_op("lstmBlock", x, h0, c0, w, b)
+        assert h_seq.shape == (T, B, H)
+        np.testing.assert_allclose(np.asarray(h_seq[-1]),
+                                   np.asarray(h_last), atol=1e-6)
+
+    def test_bidirectional_rnn(self):
+        rs = np.random.RandomState(1)
+        B, T, In, H = 2, 4, 3, 5
+        x = jnp.asarray(rs.randn(B, T, In).astype(np.float32))
+        args = [jnp.asarray(rs.randn(In, H).astype(np.float32) * 0.3),
+                jnp.asarray(rs.randn(H, H).astype(np.float32) * 0.3),
+                jnp.zeros((H,), jnp.float32)]
+        args2 = [jnp.asarray(rs.randn(In, H).astype(np.float32) * 0.3),
+                 jnp.asarray(rs.randn(H, H).astype(np.float32) * 0.3),
+                 jnp.zeros((H,), jnp.float32)]
+        seq, hf, hb = exec_op("static_bidirectional_rnn", x, *args, *args2)
+        assert seq.shape == (B, T, 2 * H)
+
+
+class TestParityExtra:
+    def test_confusion_matrix(self):
+        cm = exec_op("confusion_matrix", jnp.asarray([0, 1, 2, 1]),
+                     jnp.asarray([0, 2, 2, 1]), num_classes=3)
+        np.testing.assert_allclose(np.asarray(cm),
+                                   [[1, 0, 0], [0, 1, 1], [0, 0, 1]])
+
+    def test_fake_quant(self):
+        x = jnp.asarray([-0.1, 0.0, 0.5, 1.1], jnp.float32)
+        q = exec_op("fake_quant_with_min_max_vars", x, 0.0, 1.0)
+        assert float(q[0]) >= -1e-6 and float(q[-1]) <= 1.0 + 1e-6
+
+    def test_ctc_beam_greedy_case(self):
+        # peaked logits decode to the obvious collapsed sequence
+        T, C = 5, 4
+        logits = np.full((1, T, C), -5.0, np.float32)
+        for t, c in enumerate([1, 1, 0, 2, 2]):  # -> "1 2" after collapse
+            logits[0, t, c] = 5.0
+        paths, logp = exec_op("ctc_beam", jnp.asarray(logits),
+                              beam_width=4, blank_index=0)
+        decoded = [int(i) for i in np.asarray(paths)[0, 0] if i >= 0]
+        assert decoded == [1, 2]
+
+    def test_broadcastgradientargs(self):
+        ra, rb = exec_op("broadcastgradientargs",
+                         np.asarray([2, 3]), np.asarray([3]))
+        assert list(rb) == [0] and list(ra) == []
+
+    def test_barnes_gains(self):
+        g = exec_op("barnes_gains", jnp.ones(3), jnp.asarray([1.0, -1.0, 1.0]),
+                    jnp.asarray([1.0, 1.0, -1.0]))
+        np.testing.assert_allclose(np.asarray(g), [0.8, 1.2, 1.2])
