@@ -1,0 +1,157 @@
+"""Layered environment/config system.
+
+Reference: the four config layers of SURVEY §5 —
+(1) backend selection (Maven artifact → here: JAX platform),
+(2) env vars (`ND4JEnvironmentVars.java`, 192 lines),
+(3) system properties (`ND4JSystemProperties.java`, 204 lines),
+(4) runtime singleton (`Nd4j.getEnvironment()` → native `sd::Environment`,
+    `libnd4j/include/system/Environment.h:41`).
+
+TPU mapping: properties resolve env vars first (DL4J_TPU_* then the
+documented legacy ND4J names), then programmatic overrides, then defaults.
+The runtime singleton exposes the reference Environment getters
+(debug/verbose/maxThreads/precision knobs) wired to their JAX equivalents.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+
+class EnvironmentVars:
+    """Documented env var names (ND4JEnvironmentVars analog)."""
+    BACKEND_PRIORITY_CPU = "BACKEND_PRIORITY_CPU"
+    BACKEND_PRIORITY_GPU = "BACKEND_PRIORITY_GPU"
+    ND4J_RESOURCES_DIR = "ND4J_RESOURCES_DIR"
+    DL4J_TPU_DEBUG = "DL4J_TPU_DEBUG"
+    DL4J_TPU_VERBOSE = "DL4J_TPU_VERBOSE"
+    DL4J_TPU_MAX_THREADS = "DL4J_TPU_MAX_THREADS"
+    DL4J_TPU_PLATFORM = "JAX_PLATFORMS"
+    DL4J_TPU_DEFAULT_DTYPE = "DL4J_TPU_DEFAULT_DTYPE"
+    DL4J_TPU_MATMUL_PRECISION = "DL4J_TPU_MATMUL_PRECISION"
+    DL4J_TPU_CACHE_DIR = "DL4J_TPU_CACHE_DIR"
+    XLA_FLAGS = "XLA_FLAGS"
+
+
+class SystemProperties:
+    """Programmatic property keys (ND4JSystemProperties analog)."""
+    DTYPE = "dtype"
+    DEBUG = "debug"
+    VERBOSE = "verbose"
+    MAX_THREADS = "max_threads"
+    MATMUL_PRECISION = "matmul_precision"
+    RESOURCES_DIR = "resources_dir"
+    LOG_INITIALIZATION = "log_initialization"
+
+
+_ENV_FOR_PROP = {
+    SystemProperties.DTYPE: EnvironmentVars.DL4J_TPU_DEFAULT_DTYPE,
+    SystemProperties.DEBUG: EnvironmentVars.DL4J_TPU_DEBUG,
+    SystemProperties.VERBOSE: EnvironmentVars.DL4J_TPU_VERBOSE,
+    SystemProperties.MAX_THREADS: EnvironmentVars.DL4J_TPU_MAX_THREADS,
+    SystemProperties.MATMUL_PRECISION:
+        EnvironmentVars.DL4J_TPU_MATMUL_PRECISION,
+    SystemProperties.RESOURCES_DIR: EnvironmentVars.ND4J_RESOURCES_DIR,
+}
+
+_DEFAULTS = {
+    SystemProperties.DTYPE: "float32",
+    SystemProperties.DEBUG: "0",
+    SystemProperties.VERBOSE: "0",
+    SystemProperties.MATMUL_PRECISION: "default",
+    SystemProperties.LOG_INITIALIZATION: "1",
+}
+
+
+class Environment:
+    """Runtime config singleton (reference Nd4j.getEnvironment() /
+    sd::Environment). Resolution order: programmatic set > env var >
+    default."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._overrides: Dict[str, str] = {}
+
+    @classmethod
+    def get(cls) -> "Environment":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = Environment()
+        return cls._instance
+
+    # -- layered property resolution --------------------------------------
+    def property(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        if key in self._overrides:
+            return self._overrides[key]
+        env_name = _ENV_FOR_PROP.get(key)
+        if env_name and env_name in os.environ:
+            return os.environ[env_name]
+        return _DEFAULTS.get(key, default)
+
+    def set_property(self, key: str, value: Any):
+        self._overrides[key] = str(value)
+        if key == SystemProperties.MATMUL_PRECISION:
+            self._apply_matmul_precision(str(value))
+        return self
+
+    # -- reference Environment getters ------------------------------------
+    def is_debug(self) -> bool:
+        return self.property(SystemProperties.DEBUG) not in ("0", "false",
+                                                             None)
+
+    def is_verbose(self) -> bool:
+        return self.property(SystemProperties.VERBOSE) not in ("0", "false",
+                                                               None)
+
+    def set_debug(self, v: bool):
+        return self.set_property(SystemProperties.DEBUG, "1" if v else "0")
+
+    def set_verbose(self, v: bool):
+        return self.set_property(SystemProperties.VERBOSE, "1" if v else "0")
+
+    def max_threads(self) -> int:
+        v = self.property(SystemProperties.MAX_THREADS)
+        return int(v) if v else os.cpu_count() or 1
+
+    def default_float_dtype(self) -> str:
+        return self.property(SystemProperties.DTYPE)
+
+    def set_default_float_dtype(self, dtype: str):
+        return self.set_property(SystemProperties.DTYPE, dtype)
+
+    def matmul_precision(self) -> str:
+        return self.property(SystemProperties.MATMUL_PRECISION)
+
+    def _apply_matmul_precision(self, precision: str):
+        """highest = f32 accumulate everywhere (reference "allowed precision
+        boost" knob inverted for TPU: bf16 passes are the default)."""
+        import jax
+        if precision in ("default", "bfloat16", "fastest"):
+            jax.config.update("jax_default_matmul_precision", "default")
+        elif precision in ("float32", "highest"):
+            jax.config.update("jax_default_matmul_precision", "highest")
+        elif precision in ("tensorfloat32", "high"):
+            jax.config.update("jax_default_matmul_precision", "high")
+
+    # -- device introspection (reference Environment memory getters) ------
+    def backend(self) -> str:
+        import jax
+        return jax.default_backend()
+
+    def num_devices(self) -> int:
+        import jax
+        return jax.device_count()
+
+    def memory_stats(self) -> Dict[str, int]:
+        import jax
+        dev = jax.devices()[0]
+        stats = getattr(dev, "memory_stats", lambda: None)()
+        return dict(stats) if stats else {}
+
+
+def environment() -> Environment:
+    return Environment.get()

@@ -1,0 +1,18 @@
+"""Pallas TPU kernels — the hand-written hot-op layer.
+
+Role parity: the reference's per-op vendor kernels
+(`libnd4j/include/ops/declarable/platform/{cudnn,mkldnn}/`) — ops where
+letting the compiler lower naively leaves performance on the table. On TPU
+that list is short (XLA fuses most of the op library); the kernels here
+cover the two known gaps for the flagship workloads:
+
+- `flash_attention`: online-softmax attention, no [S,S] HBM materialization
+- `fused_softmax_xent`: streaming vocab-tiled MLM loss (30k vocab)
+
+All kernels run `interpret=True` on CPU so the unit tests exercise the
+exact kernel code path hardware-free.
+"""
+from .flash_attention import flash_attention
+from .softmax_xent import fused_softmax_xent
+
+__all__ = ["flash_attention", "fused_softmax_xent"]

@@ -26,8 +26,8 @@ def _lr_at(lr: Schedule, iteration):
     return lr(iteration) if callable(lr) else lr
 
 
-def _tree(fn, *trees):
-    return jax.tree_util.tree_map(fn, *trees)
+def _tree(fn, *trees, **kwargs):
+    return jax.tree_util.tree_map(fn, *trees, **kwargs)
 
 
 class IUpdater:

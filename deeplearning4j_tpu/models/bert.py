@@ -156,13 +156,17 @@ def _ln(x, g, b, eps):
 # -- forward ------------------------------------------------------------
 
 def _attention(layer_params, h, attention_mask, config: BertConfig,
-               mesh: Optional[Mesh], seq_parallel: bool):
+               mesh: Optional[Mesh], seq_parallel: bool,
+               use_flash: bool = False):
     a = layer_params["attn"]
     q = jnp.einsum("bte,ehd->bthd", h, a["wq"]) + a["bq"]
     k = jnp.einsum("bte,ehd->bthd", h, a["wk"]) + a["bk"]
     v = jnp.einsum("bte,ehd->bthd", h, a["wv"]) + a["bv"]
     if seq_parallel and mesh is not None:
         ctx = ring_attention(q, k, v, mesh, mask=attention_mask, causal=False)
+    elif use_flash:
+        from ..kernels import flash_attention
+        ctx = flash_attention(q, k, v, mask=attention_mask)
     else:
         scale = config.head_dim ** -0.5
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -179,7 +183,7 @@ def _attention(layer_params, h, attention_mask, config: BertConfig,
 
 def encode(params, input_ids, token_type_ids=None, attention_mask=None, *,
            config: BertConfig, mesh: Optional[Mesh] = None,
-           seq_parallel: bool = False):
+           seq_parallel: bool = False, use_flash: bool = False):
     """Token ids [B, T] → contextual encodings [B, T, E]."""
     c = config
     e = params["embeddings"]
@@ -197,7 +201,8 @@ def encode(params, input_ids, token_type_ids=None, attention_mask=None, *,
                                      None)))
 
     for layer in params["layers"]:
-        attn_out = _attention(layer, h, attention_mask, c, mesh, seq_parallel)
+        attn_out = _attention(layer, h, attention_mask, c, mesh, seq_parallel,
+                              use_flash)
         h = _ln(h + attn_out, layer["ln1_g"], layer["ln1_b"], c.layer_norm_eps)
         mlp = layer["mlp"]
         inter = jax.nn.gelu(jnp.einsum("bte,ef->btf", h, mlp["w1"]) + mlp["b1"])
@@ -231,19 +236,32 @@ def pooled(params, encodings):
 
 
 def mlm_loss(params, batch, config: BertConfig, mesh=None,
-             seq_parallel=False):
+             seq_parallel=False, use_flash=False, use_fused_xent=False):
     """Masked-LM cross entropy. batch: input_ids, labels (-100 = unmasked),
     attention_mask."""
     enc = encode(params, batch["input_ids"],
                  batch.get("token_type_ids"), batch.get("attention_mask"),
-                 config=config, mesh=mesh, seq_parallel=seq_parallel)
+                 config=config, mesh=mesh, seq_parallel=seq_parallel,
+                 use_flash=use_flash)
     logits = mlm_logits(params, enc, config)
     labels = batch["labels"]
     valid = labels >= 0
     safe_labels = jnp.where(valid, labels, 0)
-    lsm = jax.nn.log_softmax(logits, axis=-1)
-    per_tok = -jnp.take_along_axis(lsm, safe_labels[..., None],
-                                   axis=-1)[..., 0]
+    if use_fused_xent:
+        from ..kernels import fused_softmax_xent
+        B, T, V = logits.shape
+        tile_v = 1024
+        pad = (-V) % tile_v
+        flat = logits.reshape(B * T, V)
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.full((B * T, pad), -1e30, flat.dtype)], axis=1)
+        per_tok = fused_softmax_xent(flat, safe_labels.reshape(-1),
+                                     8, tile_v).reshape(B, T)
+    else:
+        lsm = jax.nn.log_softmax(logits, axis=-1)
+        per_tok = -jnp.take_along_axis(lsm, safe_labels[..., None],
+                                       axis=-1)[..., 0]
     per_tok = jnp.where(valid, per_tok, 0.0)
     return jnp.sum(per_tok) / jnp.maximum(jnp.sum(valid), 1)
 
@@ -252,17 +270,22 @@ def mlm_loss(params, batch, config: BertConfig, mesh=None,
 
 def make_train_step(config: BertConfig, mesh: Optional[Mesh] = None,
                     learning_rate: float = 1e-4, seq_parallel: bool = False,
-                    remat: bool = True):
+                    remat: bool = True, use_flash: bool = False,
+                    use_fused_xent: bool = False):
     """Single jitted train step: fwd+bwd+Adam, donated params/state.
 
     With a mesh: params placed per param_specs (TP/FSDP), batch sharded over
     (data, fsdp), sequence over seq when seq_parallel — XLA emits all ICI
     collectives (the entire reference PS stack, §2.5).
+    use_flash / use_fused_xent select the Pallas kernels for attention and
+    the vocab softmax-xent.
     """
     from ..ops import updater_ops
 
     loss_fn = functools.partial(mlm_loss, config=config, mesh=mesh,
-                                seq_parallel=seq_parallel)
+                                seq_parallel=seq_parallel,
+                                use_flash=use_flash,
+                                use_fused_xent=use_fused_xent)
     if remat:
         # rematerialize the encoder to trade FLOPs for HBM (checkpointing)
         loss_fn = jax.checkpoint(loss_fn)

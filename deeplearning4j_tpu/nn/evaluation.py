@@ -8,7 +8,7 @@ bincount per batch.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional
 
 import numpy as np
 
@@ -24,9 +24,12 @@ def _np(x):
 class Evaluation:
     """Multi-class classification metrics (reference Evaluation.java)."""
 
-    def __init__(self, num_classes: Optional[int] = None):
+    def __init__(self, num_classes: Optional[int] = None, top_n: int = 1):
         self.num_classes = num_classes
         self.confusion: Optional[np.ndarray] = None
+        self.top_n = top_n
+        self._top_n_correct = 0
+        self._top_n_total = 0
 
     def eval(self, labels, predictions):
         y = _np(labels)
@@ -38,6 +41,13 @@ class Evaluation:
             y = y.squeeze(-1) if y.ndim > 1 and y.shape[-1] == 1 else y
         if p.ndim > 1 and p.shape[-1] > 1:
             n = p.shape[-1]
+            if self.top_n > 1:  # reference topNAccuracy
+                kth = min(self.top_n, n)
+                top = np.argpartition(-p.reshape(-1, n), kth - 1,
+                                      axis=-1)[:, :kth]
+                self._top_n_correct += int(
+                    np.sum(top == y.ravel()[:, None]))
+                self._top_n_total += top.shape[0]
             p = np.argmax(p, axis=-1)
         else:
             p = p.squeeze(-1) if p.ndim > 1 else p
@@ -65,6 +75,13 @@ class Evaluation:
     def accuracy(self) -> float:
         total = self.confusion.sum()
         return float(self._tp().sum() / total) if total else 0.0
+
+    def top_n_accuracy(self) -> float:
+        """Fraction where the true class was in the top-N predictions
+        (reference Evaluation.topNAccuracy)."""
+        if self._top_n_total == 0:
+            return self.accuracy()
+        return self._top_n_correct / self._top_n_total
 
     def precision(self, cls: Optional[int] = None) -> float:
         col = self.confusion.sum(axis=0).astype(np.float64)
@@ -228,3 +245,129 @@ class RegressionEvaluation:
     def r_squared(self, col: int = 0) -> float:
         ss_tot = self._sum_y2[col] - self._sum_y[col] ** 2 / self._n
         return float(1.0 - self._sum_sq[col] / max(ss_tot, 1e-12))
+
+
+class ROCBinary:
+    """Per-output binary ROC (reference ROCBinary.java): one ROC curve per
+    output column of a multi-label network."""
+
+    def __init__(self):
+        self._rocs: List[ROC] = []
+
+    def eval(self, labels, predictions):
+        y = _np(labels)
+        p = _np(predictions)
+        y2 = y.reshape(-1, y.shape[-1])
+        p2 = p.reshape(-1, p.shape[-1])
+        while len(self._rocs) < y2.shape[-1]:
+            self._rocs.append(ROC())
+        for i in range(y2.shape[-1]):
+            self._rocs[i].eval(y2[:, i], p2[:, i])
+
+    def num_outputs(self) -> int:
+        return len(self._rocs)
+
+    def calculate_auc(self, i: int = 0) -> float:
+        return self._rocs[i].calculate_auc()
+
+    def calculate_auprc(self, i: int = 0) -> float:
+        return self._rocs[i].calculate_auprc()
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc() for r in self._rocs]))
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class (reference ROCMultiClass.java)."""
+
+    def __init__(self):
+        self._rocs: List[ROC] = []
+
+    def eval(self, labels, predictions):
+        y = _np(labels)
+        p = _np(predictions)
+        y2 = y.reshape(-1, y.shape[-1])
+        p2 = p.reshape(-1, p.shape[-1])
+        n = y2.shape[-1]
+        while len(self._rocs) < n:
+            self._rocs.append(ROC())
+        cls = np.argmax(y2, axis=-1)
+        for i in range(n):
+            self._rocs[i].eval((cls == i).astype(np.float64), p2[:, i])
+
+    def num_classes(self) -> int:
+        return len(self._rocs)
+
+    def calculate_auc(self, cls: int) -> float:
+        return self._rocs[cls].calculate_auc()
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc() for r in self._rocs]))
+
+
+class EvaluationCalibration:
+    """Reliability diagram + histogram calibration metrics (reference
+    EvaluationCalibration.java): bins predicted probabilities and records
+    observed positive fraction per bin, plus residual-probability and
+    probability histograms."""
+
+    def __init__(self, reliability_bins: int = 10, histogram_bins: int = 10):
+        self.n_bins = reliability_bins
+        self.hist_bins = histogram_bins
+        self.bin_counts = None        # [C, bins]
+        self.bin_pos = None           # [C, bins] positives per bin
+        self.bin_prob_sum = None      # [C, bins] sum of predicted prob
+        self.residual_hist = np.zeros(histogram_bins, np.int64)
+        self.prob_hist = None
+
+    def eval(self, labels, predictions):
+        y = _np(labels)
+        p = _np(predictions)
+        y2 = y.reshape(-1, y.shape[-1])
+        p2 = p.reshape(-1, p.shape[-1])
+        C = y2.shape[-1]
+        if self.bin_counts is None:
+            self.bin_counts = np.zeros((C, self.n_bins), np.int64)
+            self.bin_pos = np.zeros((C, self.n_bins), np.int64)
+            self.bin_prob_sum = np.zeros((C, self.n_bins), np.float64)
+            self.prob_hist = np.zeros((C, self.hist_bins), np.int64)
+        bins = np.clip((p2 * self.n_bins).astype(np.int64), 0,
+                       self.n_bins - 1)
+        hbins = np.clip((p2 * self.hist_bins).astype(np.int64), 0,
+                        self.hist_bins - 1)
+        for c in range(C):
+            np.add.at(self.bin_counts[c], bins[:, c], 1)
+            np.add.at(self.bin_pos[c], bins[:, c],
+                      (y2[:, c] > 0.5).astype(np.int64))
+            np.add.at(self.bin_prob_sum[c], bins[:, c], p2[:, c])
+            np.add.at(self.prob_hist[c], hbins[:, c], 1)
+        # residual = |label - prob| pooled over all outputs
+        resid = np.abs(y2 - p2).ravel()
+        rbins = np.clip((resid * self.hist_bins).astype(np.int64), 0,
+                        self.hist_bins - 1)
+        np.add.at(self.residual_hist, rbins, 1)
+
+    def reliability_curve(self, cls: int = 0):
+        """(mean predicted prob, observed fraction) per non-empty bin."""
+        counts = self.bin_counts[cls]
+        mask = counts > 0
+        mean_pred = np.where(mask, self.bin_prob_sum[cls] /
+                             np.maximum(counts, 1), 0.0)
+        observed = np.where(mask, self.bin_pos[cls] /
+                            np.maximum(counts, 1), 0.0)
+        return mean_pred[mask], observed[mask]
+
+    def expected_calibration_error(self, cls: int = 0) -> float:
+        counts = self.bin_counts[cls].astype(np.float64)
+        total = counts.sum()
+        if total == 0:
+            return 0.0
+        mean_pred = self.bin_prob_sum[cls] / np.maximum(counts, 1)
+        observed = self.bin_pos[cls] / np.maximum(counts, 1)
+        return float(np.sum(counts / total * np.abs(mean_pred - observed)))
+
+    def probability_histogram(self, cls: int = 0):
+        return self.prob_hist[cls].copy()
+
+    def residual_plot(self):
+        return self.residual_hist.copy()
