@@ -101,7 +101,8 @@ def _xent_bwd_call(logits, labels, m, l, g, tile_n, tile_v):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def fused_softmax_xent(logits, labels, tile_n: int = 8, tile_v: int = 2048):
+def fused_softmax_xent(logits, labels, tile_n: int = 128,
+                       tile_v: int = 2048):
     """Per-row -log softmax(logits)[label]; logits [N, V], labels [N] int.
 
     Returns [N] float32 losses. Differentiable wrt logits; the softmax

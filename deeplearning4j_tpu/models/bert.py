@@ -257,7 +257,7 @@ def mlm_loss(params, batch, config: BertConfig, mesh=None,
             flat = jnp.concatenate(
                 [flat, jnp.full((B * T, pad), -1e30, flat.dtype)], axis=1)
         per_tok = fused_softmax_xent(flat, safe_labels.reshape(-1),
-                                     8, tile_v).reshape(B, T)
+                                     128, tile_v).reshape(B, T)
     else:
         lsm = jax.nn.log_softmax(logits, axis=-1)
         per_tok = -jnp.take_along_axis(lsm, safe_labels[..., None],
