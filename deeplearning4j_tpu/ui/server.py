@@ -1,0 +1,166 @@
+"""Training dashboard HTTP server (reference VertxUIServer + TrainModule).
+
+Reference: `deeplearning4j-vertx/.../VertxUIServer.java:78` serving the
+train module (`module/train/TrainModule.java`) over HTTP, plus the remote
+POST endpoints used by RemoteUIStatsStorageRouter.
+
+stdlib http.server; endpoints:
+  GET  /                      dashboard (score chart, param norms, ratios)
+  GET  /train/sessions        session id list
+  GET  /train/overview?sid=   static info + updates
+  POST /remote/static|update  remote stats ingestion
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from .stats import BaseStatsStorage, InMemoryStatsStorage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>DL4J-TPU Training UI</title>
+<style>
+ body { font-family: sans-serif; margin: 20px; background: #fafafa; }
+ h1 { font-size: 20px; } h2 { font-size: 15px; color: #444; }
+ .row { display: flex; gap: 24px; flex-wrap: wrap; }
+ canvas { background: #fff; border: 1px solid #ccc; }
+ #meta { color: #666; font-size: 13px; }
+</style></head>
+<body>
+<h1>Training Dashboard</h1>
+<div id="meta"></div>
+<div class="row">
+ <div><h2>Score vs Iteration</h2><canvas id="score" width="460" height="260"></canvas></div>
+ <div><h2>Update : Param Ratio (log10)</h2><canvas id="ratio" width="460" height="260"></canvas></div>
+</div>
+<script>
+function drawLine(canvas, xs, ys, color) {
+  const c = canvas.getContext('2d');
+  c.clearRect(0, 0, canvas.width, canvas.height);
+  if (xs.length < 2) return;
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  const ymin = Math.min(...ys), ymax = Math.max(...ys);
+  const px = x => 40 + (x - xmin) / (xmax - xmin || 1) * (canvas.width - 50);
+  const py = y => canvas.height - 25 - (y - ymin) / (ymax - ymin || 1) * (canvas.height - 40);
+  c.strokeStyle = '#999'; c.strokeRect(40, 15, canvas.width - 50, canvas.height - 40);
+  c.fillStyle = '#333'; c.font = '11px sans-serif';
+  c.fillText(ymax.toPrecision(4), 2, 20); c.fillText(ymin.toPrecision(4), 2, canvas.height - 25);
+  c.strokeStyle = color; c.beginPath();
+  xs.forEach((x, i) => i ? c.lineTo(px(x), py(ys[i])) : c.moveTo(px(x), py(ys[i])));
+  c.stroke();
+}
+async function refresh() {
+  const sessions = await (await fetch('train/sessions')).json();
+  if (!sessions.length) return;
+  const sid = sessions[sessions.length - 1];
+  const data = await (await fetch('train/overview?sid=' + sid)).json();
+  const ups = data.updates || [];
+  const iters = ups.map(u => u.iteration);
+  drawLine(document.getElementById('score'), iters, ups.map(u => u.score), '#c33');
+  const rat = ups.filter(u => u.update_param_ratio != null);
+  drawLine(document.getElementById('ratio'), rat.map(u => u.iteration),
+           rat.map(u => Math.log10(u.update_param_ratio + 1e-12)), '#36c');
+  const s = data.static || {};
+  document.getElementById('meta').textContent =
+    `session ${sid} | ${s.model_class || ''} | params: ${s.n_params || '?'} ` +
+    `| backend: ${s.backend || '?'} x${s.device_count || 1} | updates: ${ups.length}`;
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class UIServer:
+    """Reference UIServer.getInstance().attach(statsStorage) pattern."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self.storage: BaseStatsStorage = InMemoryStatsStorage()
+        self._httpd = None
+        self._thread = None
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+        return cls._instance
+
+    def attach(self, storage: BaseStatsStorage):
+        self.storage = storage
+        return self
+
+    # -- http -------------------------------------------------------------
+    def _handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                if url.path in ("/", "/train", "/train/"):
+                    body = _PAGE.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif url.path == "/train/sessions":
+                    self._json(server.storage.list_session_ids())
+                elif url.path == "/train/overview":
+                    q = parse_qs(url.query)
+                    sid = q.get("sid", [""])[0]
+                    if not sid:
+                        ids = server.storage.list_session_ids()
+                        sid = ids[-1] if ids else ""
+                    self._json({
+                        "static": server.storage.get_static_info(sid),
+                        "updates": server.storage.get_updates(sid),
+                    })
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/remote/static":
+                    server.storage.put_static_info(payload["session"],
+                                                   payload["data"])
+                    self._json({"ok": True})
+                elif self.path == "/remote/update":
+                    server.storage.put_update(payload["session"],
+                                              payload["data"])
+                    self._json({"ok": True})
+                else:
+                    self._json({"error": "not found"}, 404)
+
+        return Handler
+
+    def start(self) -> int:
+        """Start serving (daemon thread); returns the bound port."""
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
+                                          self._handler())
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

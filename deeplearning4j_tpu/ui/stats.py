@@ -1,0 +1,258 @@
+"""StatsListener + StatsStorage (reference deeplearning4j-ui-model).
+
+Reference: `StatsListener.java` (scores, param/update histograms and norms,
+update:param ratios, memory, timing per iteration), `InMemoryStatsStorage`,
+MapDB-backed `FileStatsStorage`, `RemoteUIStatsStorageRouter`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class BaseStatsStorage:
+    """StatsStorage API (reference org/deeplearning4j/api/storage)."""
+
+    def put_static_info(self, session_id: str, info: Dict):
+        raise NotImplementedError
+
+    def put_update(self, session_id: str, record: Dict):
+        raise NotImplementedError
+
+    def list_session_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    def get_static_info(self, session_id: str) -> Optional[Dict]:
+        raise NotImplementedError
+
+    def get_updates(self, session_id: str,
+                    since_iteration: int = -1) -> List[Dict]:
+        raise NotImplementedError
+
+    def get_latest_update(self, session_id: str) -> Optional[Dict]:
+        ups = self.get_updates(session_id)
+        return ups[-1] if ups else None
+
+
+class InMemoryStatsStorage(BaseStatsStorage):
+    """Reference InMemoryStatsStorage."""
+
+    def __init__(self):
+        self._static: Dict[str, Dict] = {}
+        self._updates: Dict[str, List[Dict]] = {}
+        self._lock = threading.Lock()
+
+    def put_static_info(self, session_id, info):
+        with self._lock:
+            self._static[session_id] = dict(info)
+            self._updates.setdefault(session_id, [])
+
+    def put_update(self, session_id, record):
+        with self._lock:
+            self._updates.setdefault(session_id, []).append(dict(record))
+
+    def list_session_ids(self):
+        with self._lock:
+            return sorted(set(self._static) | set(self._updates))
+
+    def get_static_info(self, session_id):
+        with self._lock:
+            return self._static.get(session_id)
+
+    def get_updates(self, session_id, since_iteration=-1):
+        with self._lock:
+            ups = list(self._updates.get(session_id, []))
+        return [u for u in ups if u.get("iteration", 0) > since_iteration]
+
+
+class FileStatsStorage(BaseStatsStorage):
+    """JSONL-file-backed storage (reference FileStatsStorage, minus MapDB):
+    append-only updates file + static-info sidecar, reload-safe."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                    exist_ok=True)
+        self._lock = threading.Lock()
+        self._mem = InMemoryStatsStorage()
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    if rec["kind"] == "static":
+                        self._mem.put_static_info(rec["session"],
+                                                  rec["data"])
+                    else:
+                        self._mem.put_update(rec["session"], rec["data"])
+
+    def _append(self, kind, session_id, data):
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(json.dumps({"kind": kind, "session": session_id,
+                                    "data": data}) + "\n")
+
+    def put_static_info(self, session_id, info):
+        self._mem.put_static_info(session_id, info)
+        self._append("static", session_id, info)
+
+    def put_update(self, session_id, record):
+        self._mem.put_update(session_id, record)
+        self._append("update", session_id, record)
+
+    def list_session_ids(self):
+        return self._mem.list_session_ids()
+
+    def get_static_info(self, session_id):
+        return self._mem.get_static_info(session_id)
+
+    def get_updates(self, session_id, since_iteration=-1):
+        return self._mem.get_updates(session_id, since_iteration)
+
+
+class RemoteUIStatsStorageRouter(BaseStatsStorage):
+    """POST records to a remote UIServer (reference
+    RemoteUIStatsStorageRouter)."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+
+    def _post(self, endpoint: str, payload: Dict):
+        import urllib.request
+        req = urllib.request.Request(
+            self.url + endpoint, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.read()
+
+    def put_static_info(self, session_id, info):
+        self._post("/remote/static", {"session": session_id, "data": info})
+
+    def put_update(self, session_id, record):
+        self._post("/remote/update", {"session": session_id, "data": record})
+
+    def list_session_ids(self):
+        return []
+
+    def get_static_info(self, session_id):
+        return None
+
+    def get_updates(self, session_id, since_iteration=-1):
+        return []
+
+
+def _histogram(arr, bins=20):
+    a = np.asarray(arr).ravel()
+    if a.size == 0:
+        return {"counts": [], "edges": []}
+    counts, edges = np.histogram(a, bins=bins)
+    return {"counts": counts.tolist(),
+            "edges": [float(e) for e in edges]}
+
+
+class StatsListener:
+    """Per-iteration training stats collector (reference StatsListener).
+
+    Attach to MultiLayerNetwork/ComputationGraph via `add_listener` /
+    `_listeners`. Collects: score, per-layer param/gradient L2 norms and
+    mean magnitudes, update:param ratios, histograms (every
+    `histogram_frequency` iters), timing, device memory.
+    """
+
+    def __init__(self, storage: BaseStatsStorage, session_id: str = None,
+                 update_frequency: int = 1, histogram_frequency: int = 10):
+        self.storage = storage
+        self.session_id = session_id or f"session_{int(time.time())}"
+        self.update_frequency = update_frequency
+        self.histogram_frequency = histogram_frequency
+        self._static_sent = False
+        self._last_time = None
+        self._prev_flat: Optional[np.ndarray] = None
+
+    def _send_static(self, model):
+        info = {
+            "model_class": type(model).__name__,
+            "n_layers": len(getattr(model, "layers", [])) or
+            len(getattr(model, "_order", [])),
+            "n_params": int(model.num_params())
+            if hasattr(model, "num_params") else 0,
+            "start_time": time.time(),
+        }
+        try:
+            import jax
+            info["backend"] = jax.default_backend()
+            info["device_count"] = jax.device_count()
+        except Exception:
+            pass
+        self.storage.put_static_info(self.session_id, info)
+        self._static_sent = True
+
+    def _param_items(self, model):
+        params = getattr(model, "_params", None)
+        if isinstance(params, dict):
+            for name, p in params.items():
+                for k, v in p.items():
+                    yield f"{name}/{k}", v
+        elif isinstance(params, list):
+            for i, p in enumerate(params):
+                for k, v in p.items():
+                    yield f"layer{i}/{k}", v
+
+    def iteration_done(self, model, iteration, loss=None):
+        if iteration % self.update_frequency != 0:
+            return
+        if not self._static_sent:
+            self._send_static(model)
+        now = time.time()
+        dt = (now - self._last_time) if self._last_time else None
+        self._last_time = now
+
+        record: Dict[str, Any] = {
+            "iteration": int(iteration),
+            "time": now,
+            "score": float(loss) if loss is not None else
+            float(getattr(model, "score_value", float("nan"))),
+            "iter_seconds": dt,
+        }
+        flats = []
+        param_stats = {}
+        with_hist = iteration % self.histogram_frequency == 0
+        for name, v in self._param_items(model):
+            if name.split("/")[-1].startswith("state_"):
+                continue
+            a = np.asarray(v)
+            flats.append(a.ravel())
+            s = {"l2": float(np.linalg.norm(a)),
+                 "mean_mag": float(np.mean(np.abs(a)))}
+            if with_hist:
+                s["histogram"] = _histogram(a)
+            param_stats[name] = s
+        record["params"] = param_stats
+        if flats:
+            flat = np.concatenate(flats)
+            if self._prev_flat is not None and \
+                    self._prev_flat.shape == flat.shape:
+                upd = flat - self._prev_flat
+                p_norm = float(np.linalg.norm(self._prev_flat))
+                record["update_param_ratio"] = \
+                    float(np.linalg.norm(upd) / max(p_norm, 1e-12))
+            self._prev_flat = flat
+        try:
+            import jax
+            stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
+            if stats:
+                record["memory"] = {
+                    "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                    "peak_bytes_in_use": int(
+                        stats.get("peak_bytes_in_use", 0)),
+                }
+        except Exception:
+            pass
+        self.storage.put_update(self.session_id, record)
+
+    def on_epoch_end(self, epoch, model):
+        pass

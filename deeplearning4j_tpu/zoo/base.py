@@ -83,6 +83,15 @@ class ZooModel:
         want = self.pretrained_checksums.get(ptype)
         if want is not None and _md5(path) != want:
             raise ValueError(f"checksum mismatch for {name}:{ptype}")
+        # reference-published DL4J zips (configuration.json +
+        # coefficients.bin) convert via the ModelSerializer-format reader;
+        # native artifacts restore through our own serde
+        import zipfile
+        with zipfile.ZipFile(path) as z:
+            names = set(z.namelist())
+        if "coefficients.bin" in names:
+            from .dl4j_import import restore_multi_layer_network
+            return restore_multi_layer_network(path)
         from ..nn import serde
         # the artifact carries config + ALL params incl. state_* running
         # stats (BN means/vars), which set_params(loaded.params()) would drop

@@ -1,0 +1,94 @@
+"""UI/observability: StatsListener -> StatsStorage -> UIServer endpoints
+(reference deeplearning4j-ui-parent behavior; VERDICT missing #6)."""
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.config import (InputType,
+                                               NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   RemoteUIStatsStorageRouter, StatsListener,
+                                   UIServer)
+
+
+def _train(storage, iters=6, session_id="s1"):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(learning_rate=1e-2)).list()
+            .layer(L.DenseLayer(n_in=8, n_out=16, activation="relu"))
+            .layer(L.OutputLayer(n_out=3, activation="softmax",
+                                 loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    net._listeners.append(StatsListener(storage, session_id=session_id,
+                                        histogram_frequency=2))
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 8).astype(np.float32)
+    y = np.zeros((16, 3), np.float32)
+    y[np.arange(16), rs.randint(0, 3, 16)] = 1.0
+    for _ in range(iters):
+        net.fit(x, y)
+    return net
+
+
+class TestStatsStorage:
+    def test_listener_collects(self):
+        st = InMemoryStatsStorage()
+        _train(st)
+        assert st.list_session_ids() == ["s1"]
+        info = st.get_static_info("s1")
+        assert info["model_class"] == "MultiLayerNetwork"
+        assert info["n_params"] > 0
+        ups = st.get_updates("s1")
+        assert len(ups) == 6
+        assert all(np.isfinite(u["score"]) for u in ups)
+        assert "layer0/W" in ups[0]["params"]
+        assert "histogram" in ups[0]["params"]["layer0/W"]  # iter 0 % 2 == 0
+        assert any("update_param_ratio" in u for u in ups[1:])
+
+    def test_incremental_query(self):
+        st = InMemoryStatsStorage()
+        _train(st)
+        later = st.get_updates("s1", since_iteration=3)
+        assert all(u["iteration"] > 3 for u in later)
+        assert st.get_latest_update("s1")["iteration"] == 5
+
+    def test_file_storage_reloads(self, tmp_path):
+        path = str(tmp_path / "stats.jsonl")
+        st = FileStatsStorage(path)
+        _train(st, iters=3)
+        st2 = FileStatsStorage(path)
+        assert st2.list_session_ids() == ["s1"]
+        assert len(st2.get_updates("s1")) == 3
+
+
+class TestUIServer:
+    def test_endpoints_and_remote_router(self, tmp_path):
+        server = UIServer(port=0)
+        st = InMemoryStatsStorage()
+        server.attach(st)
+        port = server.start()
+        try:
+            _train(st, iters=3)
+            base = f"http://127.0.0.1:{port}"
+            sessions = json.loads(urllib.request.urlopen(
+                base + "/train/sessions", timeout=5).read())
+            assert sessions == ["s1"]
+            overview = json.loads(urllib.request.urlopen(
+                base + "/train/overview?sid=s1", timeout=5).read())
+            assert len(overview["updates"]) == 3
+            assert overview["static"]["n_params"] > 0
+            page = urllib.request.urlopen(base + "/", timeout=5).read()
+            assert b"Training Dashboard" in page
+
+            # remote posting round-trips into the attached storage
+            router = RemoteUIStatsStorageRouter(base)
+            router.put_static_info("remote_sess", {"model_class": "X"})
+            router.put_update("remote_sess", {"iteration": 0, "score": 1.0})
+            assert "remote_sess" in st.list_session_ids()
+            assert st.get_latest_update("remote_sess")["score"] == 1.0
+        finally:
+            server.stop()
