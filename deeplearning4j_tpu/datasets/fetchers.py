@@ -54,7 +54,19 @@ def _find(name: str, *candidates: str) -> str:
 
 
 def parse_idx(path: str) -> np.ndarray:
-    """Parse an IDX-format file (the MNIST container format)."""
+    """Parse an IDX-format file (the MNIST container format).
+
+    Plain u8 files route through the native C++ decoder when built
+    (`deeplearning4j_tpu.native`); gz/typed files use the numpy path."""
+    if not path.endswith(".gz"):
+        try:
+            from .. import native
+            if native.available():
+                with open(path, "rb") as f:
+                    if f.read(3)[2:] == b"\x08":  # u8 payload
+                        return native.read_idx(path)
+        except Exception:
+            pass
     with _open_maybe_gz(path) as f:
         magic = struct.unpack(">I", f.read(4))[0]
         dtype_code = (magic >> 8) & 0xFF

@@ -207,3 +207,46 @@ class BenchmarkDataSetIterator(DataSetIterator):
 
     def batch(self):
         return self._features.shape[0]
+
+
+class NativeBatchDataSetIterator(DataSetIterator):
+    """Minibatch iterator backed by the C++ batch-assembler ring
+    (`deeplearning4j_tpu.native.NativeBatchIterator`): shuffling and
+    gather-copies happen on a native thread outside the GIL while the
+    previous step runs on device — the AsyncDataSetIterator role with
+    native workers (reference AsyncDataSetIterator + DataVec local
+    executor threads)."""
+
+    def __init__(self, features, labels, batch_size: int,
+                 shuffle: bool = True, seed: int = 0, n_slots: int = 4):
+        import numpy as _np
+        self._x = _np.asarray(features.numpy() if hasattr(features, "numpy")
+                              else features, _np.float32)
+        self._y = _np.asarray(labels.numpy() if hasattr(labels, "numpy")
+                              else labels, _np.float32)
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.n_slots = n_slots
+        self._epoch = 0
+        self._it = None
+        self.reset()
+
+    def reset(self):
+        from .. import native
+        if self._it is not None:
+            self._it.close()
+        self._it = native.NativeBatchIterator(
+            self._x, self._y, self.batch_size, shuffle=self.shuffle,
+            seed=self.seed + self._epoch, num_epochs=1,
+            n_slots=self.n_slots)
+        self._epoch += 1
+
+    def __next__(self) -> DataSet:
+        x, y = next(self._it)
+        return DataSet(x, y)
+
+    def close(self):
+        if self._it is not None:
+            self._it.close()
+            self._it = None

@@ -167,19 +167,10 @@ class CSVRecordReader(_ListBackedReader):
         return self
 
     def _parse(self, f, uri):
-        # native fast path (runtime/nativeio C++ parser) when available and
-        # the dialect is simple; falls back to Python csv
-        if self.quote == '"' and hasattr(f, "name"):
-            try:
-                from ..runtime.nativeio import parse_csv_file
-                rows = parse_csv_file(f.name, self.delimiter, self.skip)
-                if rows is not None:
-                    for i, row in enumerate(rows):
-                        self._records.append(row)
-                        self._metas.append(RecordMetaData(uri, i + self.skip))
-                    return
-            except ImportError:
-                pass
+        # Records stay text-typed (Schema/TransformProcess do the typing),
+        # so parsing here is Python csv; the NUMERIC fast path is the C++
+        # parser in `deeplearning4j_tpu.native.read_csv`, used by
+        # NativeBatchDataSetIterator / fetchers where matrices are wanted.
         reader = _csv.reader(f, delimiter=self.delimiter,
                              quotechar=self.quote)
         for i, row in enumerate(reader):

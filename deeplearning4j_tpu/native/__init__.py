@@ -1,0 +1,210 @@
+"""Native IO runtime: ctypes bindings over the C++ data-loading library.
+
+Reference counterpart: the native side of the reference's input pipeline
+(DataVec record readers + AsyncDataSetIterator copy threads; libnd4j host
+loaders). The TPU compute path is XLA — this keeps host-side ETL (CSV
+parse, IDX decode, shuffled minibatch assembly) off the Python interpreter
+and outside the GIL.
+
+The shared library builds on demand with g++ (cached next to the sources);
+every consumer has a pure-Python fallback, so absence of a toolchain only
+costs speed, never functionality. `available()` reports the state.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "dl4jtpu_io.cpp")
+_LIB_PATH = os.path.join(_HERE, "libdl4jtpu_io.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_error: Optional[str] = None
+
+
+def _build() -> Optional[str]:
+    """Compile the shared library; returns an error string or None."""
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", _LIB_PATH]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"g++ unavailable: {e}"
+    if proc.returncode != 0:
+        return proc.stderr[-2000:]
+    return None
+
+
+def _load():
+    global _lib, _build_error
+    with _lib_lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or \
+                os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+            err = _build()
+            if err is not None:
+                _build_error = err
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            _build_error = str(e)
+            return None
+        c = ctypes.c_char_p
+        i64 = ctypes.c_int64
+        p_i64 = ctypes.POINTER(i64)
+        p_f32 = ctypes.POINTER(ctypes.c_float)
+        lib.csv_dims.argtypes = [c, ctypes.c_char, ctypes.c_int, p_i64,
+                                 p_i64]
+        lib.csv_dims.restype = ctypes.c_int
+        lib.csv_parse.argtypes = [c, ctypes.c_char, ctypes.c_int, p_f32,
+                                  i64, i64]
+        lib.csv_parse.restype = ctypes.c_int
+        lib.idx_dims.argtypes = [c, p_i64, p_i64]
+        lib.idx_dims.restype = ctypes.c_int
+        lib.idx_read_f32.argtypes = [c, p_f32, i64, ctypes.c_int]
+        lib.idx_read_f32.restype = ctypes.c_int
+        lib.ring_create.argtypes = [p_f32, p_f32, i64, i64, i64, i64,
+                                    ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_uint64, i64]
+        lib.ring_create.restype = ctypes.c_void_p
+        lib.ring_next.argtypes = [ctypes.c_void_p, p_f32, p_f32]
+        lib.ring_next.restype = ctypes.c_int
+        lib.ring_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+# ---------------------------------------------------------------- CSV
+def read_csv(path: str, delimiter: str = ",",
+             skip_lines: int = 0) -> np.ndarray:
+    """Numeric CSV -> float32 matrix via the native parser."""
+    lib = _load()
+    if lib is None:
+        return np.loadtxt(path, delimiter=delimiter, skiprows=skip_lines,
+                          dtype=np.float32, ndmin=2)
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    d = delimiter.encode()[0:1]
+    rc = lib.csv_dims(path.encode(), d, skip_lines, ctypes.byref(rows),
+                      ctypes.byref(cols))
+    if rc != 0:
+        raise IOError(f"csv_dims({path}) failed: {rc}")
+    out = np.empty((rows.value, cols.value), np.float32)
+    rc = lib.csv_parse(path.encode(), d, skip_lines, _fptr(out), rows.value,
+                       cols.value)
+    if rc != 0:
+        raise IOError(f"csv_parse({path}) failed: {rc}")
+    return out
+
+
+# ---------------------------------------------------------------- IDX
+def read_idx(path: str, normalize: bool = False) -> np.ndarray:
+    """MNIST/EMNIST IDX (u8) file -> float32 array."""
+    lib = _load()
+    if lib is None:
+        return _read_idx_py(path, normalize)
+    ndim = ctypes.c_int64()
+    dims = (ctypes.c_int64 * 4)()
+    rc = lib.idx_dims(path.encode(), ctypes.byref(ndim), dims)
+    if rc != 0:
+        raise IOError(f"idx_dims({path}) failed: {rc}")
+    shape = tuple(dims[i] for i in range(ndim.value))
+    out = np.empty(shape, np.float32)
+    rc = lib.idx_read_f32(path.encode(), _fptr(out), out.size,
+                          1 if normalize else 0)
+    if rc != 0:
+        raise IOError(f"idx_read_f32({path}) failed: {rc}")
+    return out
+
+
+def _read_idx_py(path, normalize):
+    with open(path, "rb") as f:
+        hdr = f.read(4)
+        nd = hdr[3]
+        shape = tuple(int.from_bytes(f.read(4), "big") for _ in range(nd))
+        data = np.frombuffer(f.read(int(np.prod(shape))), np.uint8)
+    out = data.astype(np.float32).reshape(shape)
+    return out / 255.0 if normalize else out
+
+
+# ------------------------------------------------------------- BatchRing
+class NativeBatchIterator:
+    """Shuffled minibatch iterator backed by the C++ assembler thread
+    (AsyncDataSetIterator analog: batches are gathered off-GIL while the
+    previous step runs on device)."""
+
+    def __init__(self, features: np.ndarray, labels: Optional[np.ndarray],
+                 batch_size: int, shuffle: bool = True, seed: int = 0,
+                 num_epochs: int = 1, n_slots: int = 4):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native library unavailable: {_build_error}")
+        self._lib = lib
+        self.features = np.ascontiguousarray(features, np.float32)
+        self.labels = np.ascontiguousarray(labels, np.float32) \
+            if labels is not None else None
+        n = self.features.shape[0]
+        self.xf = int(np.prod(self.features.shape[1:]) or 1)
+        self.yf = int(np.prod(self.labels.shape[1:]) or 1) \
+            if self.labels is not None else 0
+        self.batch = int(batch_size)
+        self._x_shape = (self.batch,) + self.features.shape[1:]
+        self._y_shape = (self.batch,) + (self.labels.shape[1:]
+                                         if self.labels is not None else ())
+        self._handle = lib.ring_create(
+            _fptr(self.features),
+            _fptr(self.labels) if self.labels is not None
+            else _fptr(self.features),
+            n, self.xf, self.yf, self.batch, n_slots, 1 if shuffle else 0,
+            seed, num_epochs)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._handle is None:
+            raise StopIteration
+        bx = np.empty((self.batch, self.xf), np.float32)
+        by = np.empty((self.batch, max(self.yf, 1)), np.float32)
+        ok = self._lib.ring_next(self._handle, _fptr(bx), _fptr(by))
+        if not ok:
+            self.close()
+            raise StopIteration
+        x = bx.reshape(self._x_shape)
+        if self.yf:
+            return x, by.reshape(self._y_shape)
+        return x, None
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.ring_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,106 @@
+"""Native C++ IO runtime: CSV parser, IDX decoder, batch assembler ring
+(ctypes over g++-built shared library; pure-Python fallbacks exist but the
+tests require the native path to actually build)."""
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _require_native():
+    if not native.available():
+        pytest.skip(f"native toolchain unavailable: {native.build_error()}")
+
+
+class TestCsv:
+    def test_parse(self, tmp_path):
+        p = tmp_path / "data.csv"
+        rows = ["1.5,2,3", "-4,5.25,6e2", "7,8,9"]
+        p.write_text("\n".join(rows) + "\n")
+        got = native.read_csv(str(p))
+        np.testing.assert_allclose(
+            got, [[1.5, 2, 3], [-4, 5.25, 600], [7, 8, 9]])
+        assert got.dtype == np.float32
+
+    def test_skip_header(self, tmp_path):
+        p = tmp_path / "h.csv"
+        p.write_text("a,b\n1,2\n3,4\n")
+        got = native.read_csv(str(p), skip_lines=1)
+        np.testing.assert_allclose(got, [[1, 2], [3, 4]])
+
+    def test_matches_numpy_on_random(self, tmp_path):
+        rs = np.random.RandomState(0)
+        arr = rs.randn(50, 7).astype(np.float32)
+        p = tmp_path / "r.csv"
+        np.savetxt(p, arr, delimiter=",", fmt="%.6g")
+        got = native.read_csv(str(p))
+        ref = np.loadtxt(p, delimiter=",", dtype=np.float32)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+class TestIdx:
+    def _write_idx(self, path, arr):
+        arr = np.asarray(arr, np.uint8)
+        with open(path, "wb") as f:
+            f.write(bytes([0, 0, 8, arr.ndim]))
+            for d in arr.shape:
+                f.write(struct.pack(">I", d))
+            f.write(arr.tobytes())
+
+    def test_images(self, tmp_path):
+        rs = np.random.RandomState(0)
+        imgs = rs.randint(0, 256, (5, 4, 4)).astype(np.uint8)
+        p = tmp_path / "imgs.idx"
+        self._write_idx(p, imgs)
+        got = native.read_idx(str(p))
+        np.testing.assert_allclose(got, imgs.astype(np.float32))
+        norm = native.read_idx(str(p), normalize=True)
+        np.testing.assert_allclose(norm, imgs / 255.0, atol=1e-6)
+
+    def test_labels(self, tmp_path):
+        labels = np.asarray([3, 1, 4, 1, 5], np.uint8)
+        p = tmp_path / "lab.idx"
+        self._write_idx(p, labels)
+        np.testing.assert_allclose(native.read_idx(str(p)),
+                                   labels.astype(np.float32))
+
+
+class TestBatchRing:
+    def test_covers_epoch_shuffled(self):
+        rs = np.random.RandomState(0)
+        n, f, c, b = 64, 5, 3, 8
+        x = rs.randn(n, f).astype(np.float32)
+        y = np.eye(c, dtype=np.float32)[rs.randint(0, c, n)]
+        it = native.NativeBatchIterator(x, y, batch_size=b, shuffle=True,
+                                        seed=7, num_epochs=1)
+        seen = []
+        pairs_ok = True
+        for bx, by in it:
+            assert bx.shape == (b, f) and by.shape == (b, c)
+            for i in range(b):
+                idx = np.argmin(np.abs(x - bx[i]).sum(axis=1))
+                pairs_ok &= np.allclose(y[idx], by[i])
+                seen.append(idx)
+        assert len(seen) == n
+        assert sorted(seen) == list(range(n))  # full epoch, no repeats
+        assert pairs_ok  # features stay paired with their labels
+        assert seen != list(range(n))          # actually shuffled
+
+    def test_multi_epoch(self):
+        x = np.arange(16, dtype=np.float32).reshape(8, 2)
+        it = native.NativeBatchIterator(x, None, batch_size=4, shuffle=False,
+                                        num_epochs=3)
+        batches = sum(1 for _ in it)
+        assert batches == 6  # 2 per epoch * 3
+
+    def test_conv_shaped_features(self):
+        rs = np.random.RandomState(1)
+        x = rs.rand(12, 1, 4, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 12)]
+        it = native.NativeBatchIterator(x, y, batch_size=4, num_epochs=1)
+        bx, by = next(it)
+        assert bx.shape == (4, 1, 4, 4)
+        it.close()
