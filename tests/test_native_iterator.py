@@ -1,0 +1,16 @@
+import numpy as np
+from deeplearning4j_tpu.datasets.iterators import NativeBatchDataSetIterator
+
+def test_native_dataset_iterator():
+    import deeplearning4j_tpu.native as native
+    import pytest
+    if not native.available():
+        pytest.skip("no native lib")
+    rs = np.random.RandomState(0)
+    it = NativeBatchDataSetIterator(
+        rs.randn(32, 4).astype(np.float32),
+        np.eye(2, dtype=np.float32)[rs.randint(0, 2, 32)], batch_size=8)
+    assert sum(1 for _ in it) == 4
+    it.reset()
+    assert sum(1 for _ in it) == 4
+    it.close()
