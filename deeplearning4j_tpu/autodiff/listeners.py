@@ -112,3 +112,101 @@ class ProfilingListener(BaseListener):
     def epoch_done(self, sd, epoch):
         with open(self.output_path, "w") as f:
             json.dump({"traceEvents": self.events}, f)
+
+
+class UIListener(BaseListener):
+    """Streams SameDiff training into the UI StatsStorage (reference
+    autodiff/listeners/impl/UIListener.java writing the same storage the
+    DL4J StatsListener feeds)."""
+
+    def __init__(self, storage, session_id: str = None,
+                 update_frequency: int = 1):
+        import time as _t
+        self.storage = storage
+        self.session_id = session_id or f"samediff_{int(_t.time())}"
+        self.update_frequency = update_frequency
+        self._static_sent = False
+
+    def iteration_done(self, sd, iteration, epoch, loss):
+        import numpy as _np
+        if iteration % self.update_frequency:
+            return
+        if not self._static_sent:
+            self.storage.put_static_info(self.session_id, {
+                "model_class": "SameDiff",
+                "n_layers": len(sd._ops),
+                "n_params": int(sum(
+                    _np.prod(_np.asarray(a).shape)
+                    for n, a in sd._arrays.items()
+                    if sd._vars[n].var_type.value == "VARIABLE")),
+                "start_time": time.time(),
+            })
+            self._static_sent = True
+        record = {"iteration": int(iteration), "epoch": int(epoch),
+                  "time": time.time(), "score": float(loss), "params": {}}
+        for name, arr in sd._arrays.items():
+            v = sd._vars.get(name)
+            if v is None or v.var_type.value != "VARIABLE":
+                continue
+            a = _np.asarray(arr)
+            record["params"][name] = {
+                "l2": float(_np.linalg.norm(a)),
+                "mean_mag": float(_np.mean(_np.abs(a)))}
+        self.storage.put_update(self.session_id, record)
+
+
+class ExecDebuggingListener(BaseListener):
+    """Logs per-iteration loss + variable summaries (reference
+    ExecDebuggingListener; per-op prints don't exist under whole-graph XLA
+    compilation, so the granularity is per-step)."""
+
+    def __init__(self, log_fn=print, print_arrays: bool = False):
+        self.log_fn = log_fn
+        self.print_arrays = print_arrays
+
+    def iteration_done(self, sd, iteration, epoch, loss):
+        import numpy as _np
+        self.log_fn(f"[exec-debug] iter={iteration} epoch={epoch} "
+                    f"loss={loss:.6f}")
+        if self.print_arrays:
+            for name, arr in sd._arrays.items():
+                a = _np.asarray(arr)
+                self.log_fn(f"  {name}: shape={a.shape} "
+                            f"min={a.min():.4g} max={a.max():.4g} "
+                            f"mean={a.mean():.4g}")
+
+
+class OpBenchmarkListener(BaseListener):
+    """Wall-time per training step (reference OpBenchmarkListener — per-op
+    times fuse away under XLA; the jitted step IS the op)."""
+
+    def __init__(self):
+        self.times: List[float] = []
+        self._last = None
+
+    def iteration_done(self, sd, iteration, epoch, loss):
+        now = time.perf_counter()
+        if self._last is not None:
+            self.times.append(now - self._last)
+        self._last = now
+
+    def average_seconds(self) -> float:
+        return sum(self.times) / len(self.times) if self.times else 0.0
+
+
+class ArraySavingListener(BaseListener):
+    """Dumps variable arrays every N iterations (reference
+    ArraySavingListener) for offline diffing."""
+
+    def __init__(self, directory: str, frequency: int = 1):
+        self.directory = directory
+        self.frequency = frequency
+        os.makedirs(directory, exist_ok=True)
+
+    def iteration_done(self, sd, iteration, epoch, loss):
+        import numpy as _np
+        if iteration % self.frequency:
+            return
+        path = os.path.join(self.directory, f"iter_{iteration}.npz")
+        _np.savez(path, **{n.replace("/", "__"): _np.asarray(a)
+                           for n, a in sd._arrays.items()})

@@ -1,0 +1,211 @@
+"""Op validation framework: declarative per-op TestCases.
+
+Reference: `nd4j/.../autodiff/validation/OpValidation.java:117-232` —
+`validate(TestCase)` checks (a) forward vs expected, (b) analytic vs
+numeric gradients (GradCheckUtil central difference), (c) serialization
+round-trip equality, and (d) records per-op coverage so CI can report
+untested ops. Same four checks here, over registered jax ops and the
+SameDiff zip format.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.registry import OpRegistry
+
+
+class TestCase:
+    """Declarative op test (reference validation/TestCase.java)."""
+
+    def __init__(self, op_name: str, inputs: Sequence[Any] = (),
+                 kwargs: Optional[Dict] = None):
+        self.op_name = op_name
+        self.inputs = [jnp.asarray(i) for i in inputs]
+        self.kwargs = kwargs or {}
+        self.expected: Optional[Any] = None
+        self.expected_fn: Optional[Callable] = None
+        self.gradient_check = False
+        self.serialization_check = True
+        self.tolerance = 1e-5
+        self.grad_tolerance = 1e-3
+
+    def expect(self, value) -> "TestCase":
+        self.expected = value
+        return self
+
+    def expect_fn(self, fn: Callable) -> "TestCase":
+        """Expected output computed from a reference (numpy) function."""
+        self.expected_fn = fn
+        return self
+
+    def grad_check(self, enabled: bool = True) -> "TestCase":
+        self.gradient_check = enabled
+        return self
+
+    def no_serialization(self) -> "TestCase":
+        self.serialization_check = False
+        return self
+
+    def tol(self, t: float) -> "TestCase":
+        self.tolerance = t
+        return self
+
+
+class OpValidation:
+    """validate(TestCase) + coverage accounting."""
+
+    _validated: set = set()
+    _lock = threading.Lock()
+
+    @staticmethod
+    def validate(tc: TestCase) -> Optional[str]:
+        """Runs all enabled checks; returns None on success, else the
+        failure description (reference returns an error string too)."""
+        reg = OpRegistry.get()
+        opdef = reg.lookup(tc.op_name)
+        errors: List[str] = []
+
+        out = opdef.fn(*tc.inputs, **tc.kwargs)
+
+        # (a) forward vs expected
+        expected = tc.expected
+        if expected is None and tc.expected_fn is not None:
+            expected = tc.expected_fn(*[np.asarray(i) for i in tc.inputs])
+        if expected is not None:
+            got = out[0] if isinstance(out, (tuple, list)) and \
+                not isinstance(expected, (tuple, list)) else out
+            try:
+                if isinstance(expected, (tuple, list)):
+                    for g, e in zip(got, expected):
+                        np.testing.assert_allclose(np.asarray(g),
+                                                   np.asarray(e),
+                                                   atol=tc.tolerance,
+                                                   rtol=tc.tolerance)
+                else:
+                    np.testing.assert_allclose(np.asarray(got),
+                                               np.asarray(expected),
+                                               atol=tc.tolerance,
+                                               rtol=tc.tolerance)
+            except AssertionError as e:
+                errors.append(f"forward mismatch: {e}")
+
+        # (b) analytic vs numeric gradient (central difference)
+        if tc.gradient_check and opdef.differentiable:
+            err = OpValidation._grad_check(opdef.fn, tc)
+            if err:
+                errors.append(err)
+
+        # (c) serialization round-trip through the SameDiff zip format
+        if tc.serialization_check:
+            err = OpValidation._serialization_check(tc, out)
+            if err:
+                errors.append(err)
+
+        if not errors:
+            with OpValidation._lock:
+                OpValidation._validated.add(opdef.name)
+            return None
+        return f"{tc.op_name}: " + "; ".join(errors)
+
+    @staticmethod
+    def _grad_check(fn, tc: TestCase, eps: float = 1e-2) -> Optional[str]:
+        # eps balances f32 round-off vs truncation: 1e-2 keeps the central
+        # difference's signal above float32 summation noise (GradCheckUtil
+        # uses 1e-6 but computes in f64)
+        diff_idx = [i for i, x in enumerate(tc.inputs)
+                    if jnp.issubdtype(x.dtype, jnp.floating)]
+        if not diff_idx:
+            return None
+
+        def scalar_fn(*diff_inputs):
+            full = list(tc.inputs)
+            for i, v in zip(diff_idx, diff_inputs):
+                full[i] = v
+            out = fn(*full, **tc.kwargs)
+            if isinstance(out, (tuple, list)):
+                out = out[0]
+            return jnp.sum(out.astype(jnp.float64)
+                           if jnp.issubdtype(out.dtype, jnp.floating)
+                           else out)
+
+        diff_inputs = [tc.inputs[i].astype(jnp.float32) for i in diff_idx]
+        analytic = jax.grad(scalar_fn,
+                            argnums=tuple(range(len(diff_idx))))(*diff_inputs)
+        for k, (x, g) in enumerate(zip(diff_inputs, analytic)):
+            flat = np.asarray(x, np.float64).ravel()
+            g_flat = np.asarray(g, np.float64).ravel()
+            # probe a bounded sample of coordinates (reference subsampling)
+            idxs = range(len(flat)) if len(flat) <= 32 else \
+                np.linspace(0, len(flat) - 1, 32).astype(int)
+            for j in idxs:
+                xp = flat.copy()
+                xm = flat.copy()
+                xp[j] += eps
+                xm[j] -= eps
+                args_p = list(diff_inputs)
+                args_m = list(diff_inputs)
+                args_p[k] = jnp.asarray(xp.reshape(x.shape), jnp.float32)
+                args_m[k] = jnp.asarray(xm.reshape(x.shape), jnp.float32)
+                numeric = (float(scalar_fn(*args_p)) -
+                           float(scalar_fn(*args_m))) / (2 * eps)
+                if abs(numeric - g_flat[j]) > tc.grad_tolerance * \
+                        max(1.0, abs(numeric), abs(g_flat[j])):
+                    return (f"gradient mismatch input {k} elem {j}: "
+                            f"analytic={g_flat[j]:.6g} "
+                            f"numeric={numeric:.6g}")
+        return None
+
+    @staticmethod
+    def _serialization_check(tc: TestCase, eager_out) -> Optional[str]:
+        import io
+        import tempfile
+        import os
+        from .samediff import SameDiff
+
+        sd = SameDiff.create()
+        vars_ = [sd.constant(np.asarray(x), f"in{i}")
+                 for i, x in enumerate(tc.inputs)]
+        try:
+            out_var = sd._record(tc.op_name, vars_, **tc.kwargs)
+        except Exception as e:
+            return f"graph-record failed: {type(e).__name__}: {e}"
+        out_var.rename("out")
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "op.sdz")
+            try:
+                sd.save(path)
+                sd2 = SameDiff.load(path)
+                r2 = sd2.output({}, ["out"])["out"].numpy()
+            except Exception as e:
+                return f"serialization round-trip failed: " \
+                       f"{type(e).__name__}: {e}"
+        ref = eager_out[0] if isinstance(eager_out, (tuple, list)) \
+            else eager_out
+        try:
+            np.testing.assert_allclose(r2, np.asarray(ref),
+                                       atol=tc.tolerance, rtol=tc.tolerance)
+        except AssertionError as e:
+            return f"post-serialization output mismatch: {e}"
+        return None
+
+    # -- coverage accounting (reference :117-232) -------------------------
+    @staticmethod
+    def validated_ops() -> List[str]:
+        with OpValidation._lock:
+            return sorted(OpValidation._validated)
+
+    @staticmethod
+    def coverage_report() -> Dict[str, Any]:
+        reg = OpRegistry.get()
+        all_ops = set(reg.names())
+        validated = set(OpValidation.validated_ops())
+        return {
+            "validated": len(validated & all_ops),
+            "total": len(all_ops),
+            "unvalidated": sorted(all_ops - validated),
+        }

@@ -1,0 +1,93 @@
+"""RNG parity shim: stateful Random facade over JAX philox keys.
+
+Reference: `org/nd4j/linalg/api/rng/` — `Nd4j.getRandom()` returns a
+stateful `NativeRandom` (philox counter stream) with setSeed and typed
+next* methods; ops consume the stream implicitly.
+
+SURVEY §7 hard part 6: per-op philox streams vs JAX keys. The shim maps a
+reference seed to a JAX key and advances a split-counter per draw, so (a)
+the stateful API ports unchanged, (b) a given (seed, draw-sequence) is
+reproducible across runs/hosts — the property the reference's golden tests
+rely on. (Bit-exact parity with libnd4j's stream is impossible and not
+attempted; goldens use tolerances, SURVEY §7.)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class NativeRandom:
+    """Stateful random facade (reference api/rng/DefaultRandom)."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.set_seed(seed)
+
+    # -- seed management ---------------------------------------------------
+    def set_seed(self, seed: int):
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed) & 0xFFFFFFFFFFFFFFFF
+            self._key = jax.random.key(self._seed)
+            self._counter = 0
+
+    def get_seed(self) -> int:
+        return self._seed
+
+    def _next_key(self):
+        """Advance the stream: one subkey per draw (philox counter analog)."""
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            self._counter += 1
+            return sub
+
+    @property
+    def position(self) -> int:
+        """Stream position (reference getPosition on the philox counter)."""
+        return self._counter
+
+    # -- typed draws (reference next* surface) ------------------------------
+    def next_int(self, bound: Optional[int] = None,
+                 shape: Tuple[int, ...] = ()) -> jax.Array:
+        hi = bound if bound is not None else 2 ** 31 - 1
+        return jax.random.randint(self._next_key(), shape, 0, hi, jnp.int32)
+
+    def next_long(self, shape: Tuple[int, ...] = ()) -> jax.Array:
+        return jax.random.randint(self._next_key(), shape, 0, 2 ** 31 - 1,
+                                  jnp.int32).astype(jnp.int64)
+
+    def next_double(self, shape: Tuple[int, ...] = ()) -> jax.Array:
+        return jax.random.uniform(self._next_key(), shape, jnp.float32)
+
+    def next_float(self, shape: Tuple[int, ...] = ()) -> jax.Array:
+        return jax.random.uniform(self._next_key(), shape, jnp.float32)
+
+    def next_gaussian(self, shape: Tuple[int, ...] = ()) -> jax.Array:
+        return jax.random.normal(self._next_key(), shape, jnp.float32)
+
+    def next_boolean(self, shape: Tuple[int, ...] = ()) -> jax.Array:
+        return jax.random.bernoulli(self._next_key(), 0.5, shape)
+
+    # -- array factories (reference Nd4j.rand/randn with rng arg) ----------
+    def uniform(self, shape: Sequence[int], minval=0.0, maxval=1.0):
+        return jax.random.uniform(self._next_key(), tuple(shape),
+                                  jnp.float32, minval, maxval)
+
+    def normal(self, shape: Sequence[int], mean=0.0, std=1.0):
+        return mean + std * jax.random.normal(self._next_key(),
+                                              tuple(shape), jnp.float32)
+
+
+_default = NativeRandom(seed=0)
+
+
+def get_random() -> NativeRandom:
+    """Reference Nd4j.getRandom() singleton."""
+    return _default
+
+
+def set_default_seed(seed: int):
+    _default.set_seed(seed)

@@ -1,0 +1,12 @@
+"""Foreign-runtime interop: run TF graphs / ONNX models on NDArrays.
+
+Reference: `nd4j/nd4j-tensorflow` (`GraphRunner.java:52` — execute a TF
+GraphDef on INDArrays via libtensorflow), `nd4j-onnxruntime`. Here:
+- `GraphRunner`: executes a frozen TF GraphDef through the tensorflow
+  runtime when installed, else through this framework's own TF importer
+  (same .pb, XLA execution) — so the API works in both environments.
+- `OnnxRunner`: executes ONNX models through the native importer.
+"""
+from .graph_runner import GraphRunner, OnnxRunner
+
+__all__ = ["GraphRunner", "OnnxRunner"]

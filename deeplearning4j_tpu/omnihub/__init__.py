@@ -1,0 +1,11 @@
+"""omnihub: model-hub abstraction (reference `omnihub/` module).
+
+Reference: omnihub downloads pretrained DL4J/SameDiff artifacts from a
+configured hub URL into a local cache and exposes namespaced accessors.
+Zero-egress environments pre-populate the cache directory; resolution is
+cache-first with an optional fetcher hook (same pattern as
+zoo.weights_fetcher).
+"""
+from .hub import OmniHub, hub
+
+__all__ = ["OmniHub", "hub"]

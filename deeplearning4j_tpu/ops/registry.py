@@ -95,13 +95,15 @@ def exec_op(name: str, *args, **kwargs):
     """Eager execution by name (Nd4j.exec(CustomOp) analog).
 
     Accepts NDArray or jax.Array inputs; returns raw jax output(s) — the
-    NDArray facade wraps at its own level.
+    NDArray facade wraps at its own level. Honors the executioner's
+    profiling mode (OpProfiler timing / NaN-INF panic checks).
     """
     from ..ndarray.ndarray import NDArray
+    from . import executioner
     reg = OpRegistry.get()
     d = reg.lookup(name)
     reg.mark_executed(d.name)
     args = [a.jax() if isinstance(a, NDArray) else a for a in args]
     kwargs = {k: (v.jax() if isinstance(v, NDArray) else v)
               for k, v in kwargs.items()}
-    return d.fn(*args, **kwargs)
+    return executioner.wrap_execution(d.name, d.fn, args, kwargs)

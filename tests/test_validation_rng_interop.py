@@ -1,0 +1,198 @@
+"""OpValidation harness, RNG shim, executioner profiling modes, interop
+GraphRunner/OnnxRunner, omnihub, SameDiff listener additions."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.validation import OpValidation, TestCase
+from deeplearning4j_tpu.common.rng import NativeRandom, get_random
+from deeplearning4j_tpu.ops import executioner
+from deeplearning4j_tpu.ops.registry import exec_op
+
+
+class TestOpValidation:
+    def test_forward_and_serialization(self):
+        tc = (TestCase("add", [np.asarray([1.0, 2.0]),
+                               np.asarray([3.0, 4.0])])
+              .expect(np.asarray([4.0, 6.0])))
+        assert OpValidation.validate(tc) is None
+        assert "add" in OpValidation.validated_ops()
+
+    def test_gradient_check(self):
+        tc = (TestCase("tanh", [np.asarray([0.3, -0.7, 1.5], np.float32)])
+              .expect_fn(np.tanh)
+              .grad_check())
+        assert OpValidation.validate(tc) is None
+
+    def test_detects_wrong_expected(self):
+        tc = TestCase("add", [np.asarray([1.0]), np.asarray([1.0])]) \
+            .expect(np.asarray([3.0]))
+        err = OpValidation.validate(tc)
+        assert err is not None and "forward mismatch" in err
+
+    def test_matmul_gradcheck_with_kwargs(self):
+        rs = np.random.RandomState(0)
+        tc = (TestCase("matmul",
+                       [rs.randn(3, 4).astype(np.float32),
+                        rs.randn(5, 4).astype(np.float32)],
+                       {"transpose_b": True})
+              .expect_fn(lambda a, b: a @ b.T)
+              .grad_check())
+        assert OpValidation.validate(tc) is None
+
+    def test_coverage_report(self):
+        rep = OpValidation.coverage_report()
+        assert rep["total"] > 500
+        assert rep["validated"] >= 1
+
+
+class TestRngShim:
+    def test_seed_reproducibility(self):
+        a = NativeRandom(seed=42)
+        b = NativeRandom(seed=42)
+        np.testing.assert_allclose(np.asarray(a.next_gaussian((4,))),
+                                   np.asarray(b.next_gaussian((4,))))
+        np.testing.assert_allclose(np.asarray(a.uniform((3, 3))),
+                                   np.asarray(b.uniform((3, 3))))
+        assert a.position == b.position == 2
+
+    def test_stream_advances(self):
+        r = NativeRandom(seed=1)
+        x1 = np.asarray(r.next_double((5,)))
+        x2 = np.asarray(r.next_double((5,)))
+        assert not np.allclose(x1, x2)
+        r.set_seed(1)
+        np.testing.assert_allclose(np.asarray(r.next_double((5,))), x1)
+
+    def test_singleton(self):
+        get_random().set_seed(7)
+        v1 = np.asarray(get_random().next_int(10, (4,)))
+        get_random().set_seed(7)
+        v2 = np.asarray(get_random().next_int(10, (4,)))
+        np.testing.assert_array_equal(v1, v2)
+
+
+class TestExecutionerModes:
+    def teardown_method(self):
+        executioner.set_profiling_mode(executioner.ProfilingMode.DISABLED)
+
+    def test_nan_panic(self):
+        executioner.set_profiling_mode(executioner.ProfilingMode.NAN_PANIC)
+        with pytest.raises(FloatingPointError, match="NaN"):
+            exec_op("log", np.asarray([-1.0], np.float32))
+        # clean values pass
+        exec_op("log", np.asarray([1.0], np.float32))
+
+    def test_inf_panic(self):
+        executioner.set_profiling_mode(executioner.ProfilingMode.INF_PANIC)
+        with pytest.raises(FloatingPointError, match="Inf"):
+            exec_op("divide", np.asarray([1.0], np.float32),
+                    np.asarray([0.0], np.float32))
+
+    def test_op_profiler(self):
+        executioner.set_profiling_mode(executioner.ProfilingMode.OPERATIONS)
+        prof = executioner.OpProfiler.get_instance()
+        prof.reset()
+        for _ in range(3):
+            exec_op("add", np.ones(4, np.float32), np.ones(4, np.float32))
+        stats = prof.stats()
+        assert stats and stats[0]["op"] == "add"
+        assert stats[0]["invocations"] == 3
+
+
+class TestInterop:
+    def _pb(self):
+        tf = pytest.importorskip("tensorflow")
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [2, 3], name="x")
+            tf.identity(x * 2.0 + 1.0, name="out")
+        return g.as_graph_def().SerializeToString()
+
+    def test_graph_runner_native_backend(self):
+        pb = self._pb()
+        from deeplearning4j_tpu.interop import GraphRunner
+        runner = GraphRunner(pb, output_names=["out"],
+                             input_shapes={"x": (2, 3)}, backend="native")
+        x = np.ones((2, 3), np.float32)
+        out = runner.run({"x": x})["out"].numpy()
+        np.testing.assert_allclose(out, x * 2 + 1)
+
+    def test_graph_runner_tf_backend_matches(self):
+        pb = self._pb()
+        from deeplearning4j_tpu.interop import GraphRunner
+        x = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+        with GraphRunner(pb, output_names=["out"],
+                         backend="tensorflow") as tf_runner:
+            ref = tf_runner.run({"x": x})["out"].numpy()
+        native = GraphRunner(pb, output_names=["out"],
+                             input_shapes={"x": (2, 3)},
+                             backend="native").run({"x": x})["out"].numpy()
+        np.testing.assert_allclose(native, ref, atol=1e-6)
+
+
+class TestOmniHub:
+    def test_cache_first_and_loaders(self, tmp_path):
+        from deeplearning4j_tpu.omnihub import OmniHub
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (2,))
+        (x * 3.0).rename("y")
+        art = tmp_path / "toy.sdz"
+        sd.save(str(art))
+
+        h = OmniHub(cache_dir=str(tmp_path))
+        h.register("toy", "samediff", "toy.sdz")
+        assert h.models() == ["toy"]
+        loaded = h.load("toy")
+        out = loaded.output({"x": np.asarray([1.0, 2.0], np.float32)},
+                            ["y"])["y"].numpy()
+        np.testing.assert_allclose(out, [3.0, 6.0])
+
+    def test_missing_artifact_message(self, tmp_path):
+        from deeplearning4j_tpu.omnihub import OmniHub
+        h = OmniHub(cache_dir=str(tmp_path))
+        h.register("ghost", "dl4j", "ghost.zip")
+        with pytest.raises(FileNotFoundError, match="pre-populate"):
+            h.path("ghost")
+
+
+class TestSameDiffListeners:
+    def test_ui_and_benchmark_listeners(self, tmp_path):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        from deeplearning4j_tpu.autodiff.listeners import (
+            ArraySavingListener, OpBenchmarkListener, UIListener)
+        from deeplearning4j_tpu.autodiff.training import TrainingConfig
+        from deeplearning4j_tpu.learning import Sgd
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+        rs = np.random.RandomState(0)
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (4, 3))
+        y = sd.placeholder("y", (4, 2))
+        w = sd.var("w", rs.randn(3, 2).astype(np.float32))
+        pred = x.mmul(w)
+        loss = ((pred - y) * (pred - y)).mean()
+        loss.rename("loss")
+        sd.set_loss_variables("loss")
+        sd.set_training_config(TrainingConfig(
+            updater=Sgd(learning_rate=0.05),
+            data_set_feature_mapping=["x"], data_set_label_mapping=["y"]))
+
+        st = InMemoryStatsStorage()
+        bench = OpBenchmarkListener()
+        sd.add_listener(UIListener(st, session_id="sdtest"))
+        sd.add_listener(bench)
+        sd.add_listener(ArraySavingListener(str(tmp_path), frequency=2))
+
+        ds = DataSet(rs.randn(4, 3).astype(np.float32),
+                     rs.randn(4, 2).astype(np.float32))
+        sd.fit(ListDataSetIterator([ds, ds]), num_epochs=2)
+
+        ups = st.get_updates("sdtest")
+        assert len(ups) == 4
+        assert "w" in ups[0]["params"]
+        assert len(list(tmp_path.glob("iter_*.npz"))) >= 1
+        assert bench.average_seconds() >= 0
