@@ -1,0 +1,91 @@
+"""Op descriptors: machine-readable IR of the op surface.
+
+Reference: `org/nd4j/ir` (24k generated LoC of OpNamespace/MapperNamespace
+protobuf descriptors describing every op's args) consumed by the
+samediff-import mapping rules and codegen. Here descriptors are derived by
+introspection from the live registry — no codegen step, always in sync —
+and export to JSON for external tooling.
+"""
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import json
+from typing import Any, Dict, List, Optional
+
+from .registry import OpRegistry
+
+
+@dataclasses.dataclass
+class ArgDescriptor:
+    """One op argument (reference OpNamespace$ArgDescriptor)."""
+    name: str
+    arg_type: str          # INPUT_TENSOR | DOUBLE | INT64 | BOOL | STRING...
+    required: bool
+    default: Optional[str] = None
+
+
+@dataclasses.dataclass
+class OpDescriptor:
+    """Reference OpNamespace$OpDescriptor."""
+    name: str
+    category: str
+    differentiable: bool
+    aliases: List[str]
+    args: List[ArgDescriptor]
+
+
+def _classify_default(v) -> str:
+    if isinstance(v, bool):
+        return "BOOL"
+    if isinstance(v, int):
+        return "INT64"
+    if isinstance(v, float):
+        return "DOUBLE"
+    if isinstance(v, str):
+        return "STRING"
+    if isinstance(v, (tuple, list)):
+        return "INT64_ARRAY"
+    return "INPUT_TENSOR"
+
+
+def describe(op_name: str) -> OpDescriptor:
+    reg = OpRegistry.get()
+    d = reg.lookup(op_name)
+    args: List[ArgDescriptor] = []
+    try:
+        sig = inspect.signature(d.fn)
+        for p in sig.parameters.values():
+            if p.kind == inspect.Parameter.VAR_POSITIONAL:
+                args.append(ArgDescriptor(p.name, "INPUT_TENSOR_ARRAY",
+                                          required=False))
+                continue
+            if p.kind == inspect.Parameter.VAR_KEYWORD:
+                continue
+            if p.default is inspect.Parameter.empty:
+                args.append(ArgDescriptor(p.name, "INPUT_TENSOR",
+                                          required=True))
+            else:
+                args.append(ArgDescriptor(
+                    p.name, _classify_default(p.default), required=False,
+                    default=repr(p.default)))
+    except (TypeError, ValueError):
+        pass
+    return OpDescriptor(name=d.name, category=d.category,
+                        differentiable=d.differentiable,
+                        aliases=list(d.aliases), args=args)
+
+
+def all_descriptors() -> Dict[str, OpDescriptor]:
+    reg = OpRegistry.get()
+    return {n: describe(n) for n in reg.names()}
+
+
+def to_json(path: Optional[str] = None) -> str:
+    """Export the full descriptor set (nd4j-op-def.pbtxt role)."""
+    data = {n: dataclasses.asdict(d) for n, d in all_descriptors().items()}
+    s = json.dumps(data, indent=1, sort_keys=True)
+    if path:
+        with open(path, "w") as f:
+            f.write(s)
+    return s

@@ -1,0 +1,71 @@
+"""Op descriptors (org/nd4j/ir analog) + ProfileAnalyzer trace comparison."""
+import json
+
+import numpy as np
+
+from deeplearning4j_tpu.common.profile_analyzer import (aggregate, compare,
+                                                        load_trace)
+from deeplearning4j_tpu.ops.descriptors import (all_descriptors, describe,
+                                                to_json)
+
+
+class TestOpDescriptors:
+    def test_describe_matmul(self):
+        d = describe("matmul")
+        assert d.name == "matmul" and d.category == "blas"
+        names = [a.name for a in d.args]
+        assert names[:2] == ["a", "b"]
+        ta = next(a for a in d.args if a.name == "transpose_a")
+        assert ta.arg_type == "BOOL" and not ta.required
+
+    def test_all_descriptors_cover_registry(self):
+        descs = all_descriptors()
+        assert len(descs) > 500
+        assert "conv2d" in descs and "scan" in descs
+
+    def test_json_export(self, tmp_path):
+        path = str(tmp_path / "ops.json")
+        to_json(path)
+        data = json.loads(open(path).read())
+        assert data["add"]["category"] == "broadcastable" or \
+            "category" in data["add"]
+
+
+def _trace(path, durs):
+    events = [{"name": n, "ph": "X", "pid": 0, "tid": 0,
+               "ts": i * 1000.0, "dur": d}
+              for i, (n, d) in enumerate(durs)]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+class TestProfileAnalyzer:
+    def test_aggregate(self, tmp_path):
+        p = str(tmp_path / "a.json")
+        _trace(p, [("matmul", 100.0), ("matmul", 300.0), ("softmax", 50.0)])
+        agg = aggregate(load_trace(p))
+        assert agg["matmul"]["total_us"] == 400.0
+        assert agg["matmul"]["count"] == 2
+        assert agg["softmax"]["avg_us"] == 50.0
+
+    def test_compare(self, tmp_path):
+        pa = str(tmp_path / "a.json")
+        pb = str(tmp_path / "b.json")
+        _trace(pa, [("matmul", 100.0), ("softmax", 50.0)])
+        _trace(pb, [("matmul", 400.0), ("softmax", 55.0), ("new_op", 10.0)])
+        rows = compare(pa, pb)
+        assert rows[0]["name"] == "matmul"       # largest delta first
+        assert rows[0]["ratio"] == 4.0
+        names = {r["name"] for r in rows}
+        assert "new_op" in names                  # present only in B
+
+    def test_begin_end_events(self, tmp_path):
+        p = str(tmp_path / "be.json")
+        events = [
+            {"name": "step", "ph": "B", "pid": 0, "tid": 1, "ts": 100.0},
+            {"name": "step", "ph": "E", "pid": 0, "tid": 1, "ts": 350.0},
+        ]
+        with open(p, "w") as f:
+            json.dump(events, f)   # bare-list flavor
+        agg = aggregate(load_trace(p))
+        assert agg["step"]["total_us"] == 250.0

@@ -1,0 +1,99 @@
+"""Fault-tolerant training: checkpoint auto-resume + injected failures
+(reference FailureTestingListener pattern, MeshOrganizer remap role)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.config import (InputType,
+                                               NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.fault_tolerance import (FaultTolerantTrainer,
+                                                         rebuild_mesh)
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5).updater(Adam(learning_rate=1e-2)).list()
+            .layer(L.DenseLayer(n_in=8, n_out=16, activation="relu"))
+            .layer(L.OutputLayer(n_out=3, activation="softmax",
+                                 loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data():
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 8).astype(np.float32)
+    y = np.zeros((16, 3), np.float32)
+    y[np.arange(16), rs.randint(0, 3, 16)] = 1.0
+    return x, y
+
+
+class TestFaultTolerantTrainer:
+    def test_auto_resume_after_injected_failure(self, tmp_path):
+        """Training crashes mid-run (FailureTestingListener-style injected
+        fault); the trainer restores the last checkpoint and completes."""
+        x, y = _data()
+        net = _net()
+        crashed = {"done": False}
+        restarts = []
+
+        def fit_fn(n, epoch):
+            if epoch == 3 and not crashed["done"]:
+                crashed["done"] = True
+                raise RuntimeError("injected device failure")
+            n.fit(x, y)
+
+        trainer = FaultTolerantTrainer(
+            net, str(tmp_path / "ft"), checkpoint_every_epochs=1,
+            max_restarts=2,
+            on_restart=lambda e, n: restarts.append(str(e)))
+        trainer.fit(fit_fn, num_epochs=6)
+        assert crashed["done"]
+        assert restarts == ["injected device failure"]
+        assert net._epoch == 6
+        assert np.isfinite(net.score_value)
+
+    def test_gives_up_after_max_restarts(self, tmp_path):
+        net = _net()
+
+        def always_fail(n, epoch):
+            raise RuntimeError("permanent failure")
+
+        trainer = FaultTolerantTrainer(net, str(tmp_path / "ft2"),
+                                       max_restarts=2)
+        with pytest.raises(RuntimeError, match="permanent"):
+            trainer.fit(always_fail, num_epochs=3)
+        assert trainer.restarts == 3
+
+    def test_resume_fresh_process(self, tmp_path):
+        """A new trainer over the same checkpoint dir resumes where the
+        previous run stopped (process-restart recovery)."""
+        x, y = _data()
+        d = str(tmp_path / "ft3")
+        net1 = _net()
+        t1 = FaultTolerantTrainer(net1, d, checkpoint_every_epochs=1)
+        t1.fit(lambda n, e: n.fit(x, y), num_epochs=3)
+
+        net2 = _net()
+        t2 = FaultTolerantTrainer(net2, d, checkpoint_every_epochs=1)
+        seen = []
+        t2.fit(lambda n, e: seen.append(e) or n.fit(x, y), num_epochs=5)
+        assert seen == [3, 4]   # resumed at epoch 3, not 0
+        np.testing.assert_allclose(net2._epoch, 5)
+
+
+class TestRebuildMesh:
+    def test_uses_live_devices(self):
+        import jax
+        mesh = rebuild_mesh()
+        assert mesh.devices.size == jax.device_count()
+
+    def test_shrunken_device_set(self):
+        import jax
+        if jax.device_count() < 4:
+            pytest.skip("needs 4 devices")
+        devs = jax.devices()[:4]
+        mesh = rebuild_mesh(devices=devs)
+        assert mesh.devices.size == 4
