@@ -302,3 +302,52 @@ class TestAnalysis:
               .build())
         out = LocalTransformExecutor.execute(list(rr), tp)
         assert out == [[0, 30, 1.5], [1, 40, 2.5]]
+
+
+class TestJoin:
+    """Reference transform/join/Join.java behavior."""
+
+    def _schemas(self):
+        from deeplearning4j_tpu.etl.schema import Schema
+        left = (Schema.Builder().add_column_integer("id")
+                .add_column_string("name").build())
+        right = (Schema.Builder().add_column_integer("id")
+                 .add_column_double("score").build())
+        return left, right
+
+    def test_inner_join(self):
+        from deeplearning4j_tpu.etl.join import Join, JoinType
+        left_s, right_s = self._schemas()
+        join = (Join.builder(JoinType.INNER)
+                .set_join_columns("id")
+                .set_schemas(left_s, right_s).build())
+        out = join.execute([[1, "a"], [2, "b"], [3, "c"]],
+                           [[2, 0.5], [3, 0.7], [4, 0.9]])
+        assert out == [[2, "b", 0.5], [3, "c", 0.7]]
+        assert join.output_schema().column_names() == ["id", "name", "score"]
+
+    def test_left_and_full_outer(self):
+        from deeplearning4j_tpu.etl.join import Join, JoinType
+        left_s, right_s = self._schemas()
+        left_rows = [[1, "a"], [2, "b"]]
+        right_rows = [[2, 0.5], [9, 0.9]]
+        lo = (Join.builder(JoinType.LEFT_OUTER).set_join_columns("id")
+              .set_schemas(left_s, right_s).build()).execute(left_rows,
+                                                             right_rows)
+        assert lo == [[1, "a", None], [2, "b", 0.5]]
+        fo = (Join.builder(JoinType.FULL_OUTER).set_join_columns("id")
+              .set_schemas(left_s, right_s).build()).execute(left_rows,
+                                                             right_rows)
+        assert [1, "a", None] in fo and [2, "b", 0.5] in fo \
+            and [9, None, 0.9] in fo
+
+    def test_name_collision_prefixed(self):
+        from deeplearning4j_tpu.etl.join import Join, JoinType
+        from deeplearning4j_tpu.etl.schema import Schema
+        left_s = (Schema.Builder().add_column_integer("id")
+                  .add_column_double("v").build())
+        right_s = (Schema.Builder().add_column_integer("id")
+                   .add_column_double("v").build())
+        join = (Join.builder(JoinType.INNER).set_join_columns("id")
+                .set_schemas(left_s, right_s).build())
+        assert join.output_schema().column_names() == ["id", "v", "right_v"]
