@@ -4,10 +4,15 @@ Reference counterpart: `softmax_cross_entropy_loss_with_logits` +
 `sparse_softmax_cross_entropy_loss_with_logits`
 (`libnd4j/include/ops/declarable/headers/loss.h`) — the MLM-loss hot path
 over the 30k-row vocab. The naive lowering materializes [N, V] softmax in
-HBM twice (fwd + bwd). This kernel streams vocab tiles through VMEM:
-fwd emits loss + the (max, logsumexp) stats per row; bwd regenerates
-softmax tiles and subtracts the one-hot — nothing [N, V]-shaped ever hits
-HBM beyond the logits themselves.
+HBM twice (fwd + bwd). This kernel streams [TN, TV] vocab tiles through
+VMEM (a full 30k-vocab row block would blow the 16MB VMEM budget):
+fwd accumulates the online-softmax state in VMEM scratch across the
+(sequential) vocab grid dimension and emits loss + (max, logsumexp) per
+row; bwd regenerates softmax tiles and subtracts the one-hot — nothing
+[N, V]-shaped beyond the logits themselves ever hits HBM.
+
+Layout note: per-row stats ride as [N, 1] (lane dim 1) — Mosaic rank-1
+blocks are restricted; 2-D trailing-1 blocks lower cleanly.
 """
 from __future__ import annotations
 
@@ -22,82 +27,86 @@ def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
-def _fwd_kernel(x_ref, lab_ref, loss_ref, m_ref, l_ref, *, tile_v, vocab):
-    labels = lab_ref[...]                     # [TN]
+def _fwd_kernel(x_ref, lab_ref, loss_ref, m_ref, l_ref, m_s, l_s, xl_s, *,
+                tile_v, n_v_blocks):
+    j = pl.program_id(1)
+    labels = lab_ref[...]                     # [TN, 1]
     tn = labels.shape[0]
 
-    def body(j, carry):
-        m, l, xl = carry
-        blk = x_ref[:, pl.ds(j * tile_v, tile_v)].astype(jnp.float32)
-        m_new = jnp.maximum(m, jnp.max(blk, axis=-1))
-        l_new = l * jnp.exp(m - m_new) + \
-            jnp.sum(jnp.exp(blk - m_new[:, None]), axis=-1)
-        cols = j * tile_v + jax.lax.broadcasted_iota(jnp.int32,
-                                                     (tn, tile_v), 1)
-        hit = cols == labels[:, None]
-        xl_new = xl + jnp.sum(jnp.where(hit, blk, 0.0), axis=-1)
-        return m_new, l_new, xl_new
+    @pl.when(j == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s[...], -1e30)
+        l_s[...] = jnp.zeros_like(l_s[...])
+        xl_s[...] = jnp.zeros_like(xl_s[...])
 
-    m0 = jnp.full((tn,), -1e30, jnp.float32)
-    l0 = jnp.zeros((tn,), jnp.float32)
-    xl0 = jnp.zeros((tn,), jnp.float32)
-    m, l, xl = jax.lax.fori_loop(0, vocab // tile_v, body, (m0, l0, xl0))
-    loss_ref[...] = jnp.log(l) + m - xl
-    m_ref[...] = m
-    l_ref[...] = l
+    blk = x_ref[...].astype(jnp.float32)      # [TN, TV]
+    m_old = m_s[...]                          # [TN, 1]
+    m_new = jnp.maximum(m_old, jnp.max(blk, axis=-1, keepdims=True))
+    l_new = l_s[...] * jnp.exp(m_old - m_new) + \
+        jnp.sum(jnp.exp(blk - m_new), axis=-1, keepdims=True)
+    cols = j * tile_v + jax.lax.broadcasted_iota(jnp.int32, (tn, tile_v), 1)
+    hit = cols == labels
+    xl_s[...] = xl_s[...] + jnp.sum(jnp.where(hit, blk, 0.0), axis=-1,
+                                    keepdims=True)
+    m_s[...] = m_new
+    l_s[...] = l_new
+
+    @pl.when(j == n_v_blocks - 1)
+    def _emit():
+        loss_ref[...] = jnp.log(l_s[...]) + m_s[...] - xl_s[...]
+        m_ref[...] = m_s[...]
+        l_ref[...] = l_s[...]
 
 
 def _bwd_kernel(x_ref, lab_ref, m_ref, l_ref, g_ref, dx_ref, *, tile_v):
     blk = x_ref[...].astype(jnp.float32)      # [TN, TV]
-    labels = lab_ref[...]
-    m = m_ref[...]
+    labels = lab_ref[...]                     # [TN, 1]
+    m = m_ref[...]                            # [TN, 1]
     l = l_ref[...]
     g = g_ref[...]
     tn, tv = blk.shape
     jv = pl.program_id(1)
-    probs = jnp.exp(blk - m[:, None]) / l[:, None]
+    probs = jnp.exp(blk - m) / l
     cols = jv * tv + jax.lax.broadcasted_iota(jnp.int32, (tn, tv), 1)
-    onehot = (cols == labels[:, None]).astype(jnp.float32)
-    dx_ref[...] = ((probs - onehot) * g[:, None]).astype(dx_ref.dtype)
+    onehot = (cols == labels).astype(jnp.float32)
+    dx_ref[...] = ((probs - onehot) * g).astype(dx_ref.dtype)
 
 
-def _xent_fwd_call(logits, labels, tile_n, tile_v):
+def _xent_fwd_call(logits, labels2d, tile_n, tile_v):
+    from jax.experimental.pallas import tpu as pltpu
     N, V = logits.shape
     tile_n = min(tile_n, N)
     tile_v = min(tile_v, V)
-    kern = functools.partial(_fwd_kernel, tile_v=tile_v, vocab=V)
+    n_v_blocks = V // tile_v
+    kern = functools.partial(_fwd_kernel, tile_v=tile_v,
+                             n_v_blocks=n_v_blocks)
+    col = pl.BlockSpec((tile_n, 1), lambda i, j: (i, 0))
     return pl.pallas_call(
         kern,
-        grid=(N // tile_n,),
-        in_specs=[pl.BlockSpec((tile_n, V), lambda i: (i, 0)),
-                  pl.BlockSpec((tile_n,), lambda i: (i,))],
-        out_specs=[pl.BlockSpec((tile_n,), lambda i: (i,)),
-                   pl.BlockSpec((tile_n,), lambda i: (i,)),
-                   pl.BlockSpec((tile_n,), lambda i: (i,))],
-        out_shape=[jax.ShapeDtypeStruct((N,), jnp.float32),
-                   jax.ShapeDtypeStruct((N,), jnp.float32),
-                   jax.ShapeDtypeStruct((N,), jnp.float32)],
+        grid=(N // tile_n, n_v_blocks),
+        in_specs=[pl.BlockSpec((tile_n, tile_v), lambda i, j: (i, j)), col],
+        out_specs=[col, col, col],
+        out_shape=[jax.ShapeDtypeStruct((N, 1), jnp.float32)] * 3,
+        scratch_shapes=[pltpu.VMEM((tile_n, 1), jnp.float32)] * 3,
         interpret=_interpret(),
-    )(logits, labels)
+    )(logits, labels2d)
 
 
-def _xent_bwd_call(logits, labels, m, l, g, tile_n, tile_v):
+def _xent_bwd_call(logits, labels2d, m, l, g, tile_n, tile_v):
     N, V = logits.shape
     tile_n = min(tile_n, N)
     tile_v = min(tile_v, V)
     kern = functools.partial(_bwd_kernel, tile_v=tile_v)
+    col = pl.BlockSpec((tile_n, 1), lambda i, j: (i, 0))
     return pl.pallas_call(
         kern,
         grid=(N // tile_n, V // tile_v),
         in_specs=[pl.BlockSpec((tile_n, tile_v), lambda i, j: (i, j)),
-                  pl.BlockSpec((tile_n,), lambda i, j: (i,)),
-                  pl.BlockSpec((tile_n,), lambda i, j: (i,)),
-                  pl.BlockSpec((tile_n,), lambda i, j: (i,)),
-                  pl.BlockSpec((tile_n,), lambda i, j: (i,))],
+                  col, col, col, col],
         out_specs=pl.BlockSpec((tile_n, tile_v), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct(logits.shape, logits.dtype),
         interpret=_interpret(),
-    )(logits, labels, m, l, g)
+    )(logits, labels2d, m, l, g)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
@@ -107,19 +116,20 @@ def fused_softmax_xent(logits, labels, tile_n: int = 128,
 
     Returns [N] float32 losses. Differentiable wrt logits; the softmax
     matrix is regenerated tile-wise in bwd (never stored)."""
-    loss, _, _ = _xent_fwd_call(logits, labels, tile_n, tile_v)
-    return loss
+    loss, _, _ = _xent_fwd_call(logits, labels[:, None], tile_n, tile_v)
+    return loss[:, 0]
 
 
 def _f(logits, labels, tile_n, tile_v):
-    loss, m, l = _xent_fwd_call(logits, labels, tile_n, tile_v)
-    return loss, (logits, labels, m, l)
+    lab2 = labels[:, None]
+    loss, m, l = _xent_fwd_call(logits, lab2, tile_n, tile_v)
+    return loss[:, 0], (logits, lab2, m, l)
 
 
 def _b(tile_n, tile_v, res, g):
-    logits, labels, m, l = res
-    dx = _xent_bwd_call(logits, labels, m, l, g.astype(jnp.float32),
-                        tile_n, tile_v)
+    logits, lab2, m, l = res
+    dx = _xent_bwd_call(logits, lab2, m, l,
+                        g.astype(jnp.float32)[:, None], tile_n, tile_v)
     return dx, None
 
 

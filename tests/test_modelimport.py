@@ -250,6 +250,37 @@ class TestBertImport:
         np.testing.assert_allclose(res[pooled_name].numpy(), golden_pooled,
                                    atol=2e-5)
 
+    @pytest.mark.skipif(not os.environ.get("BERT_FULL"),
+                        reason="full-size run (~3 min, 440MB graph); "
+                               "set BERT_FULL=1. Verified 2026-07-30: "
+                               "seq maxdiff 5.7e-06, pooled 2.0e-06")
+    def test_bert_base_full_size_golden(self):
+        B, S = 2, 128
+        g, (seq_name, pooled_name) = build_tf1_bert(
+            B, S, hidden=768, n_layers=12, heads=12, vocab=30522,
+            intermediate=3072, max_pos=512)
+        pb = g.as_graph_def().SerializeToString()
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 30522, (B, S)).astype(np.int32)
+        mask = np.ones((B, S), np.int32)
+        mask[:, 100:] = 0
+        types = np.zeros((B, S), np.int32)
+        with tf1.Session(graph=g) as s:
+            golden_seq, golden_pool = s.run(
+                [seq_name + ":0", pooled_name + ":0"],
+                {"input_ids:0": ids, "input_mask:0": mask,
+                 "token_type_ids:0": types})
+        imp = import_tf_graph(
+            pb, input_shapes={"input_ids": (B, S), "input_mask": (B, S),
+                              "token_type_ids": (B, S)},
+            outputs=[seq_name, pooled_name])
+        res = imp.output({"input_ids": ids, "input_mask": mask,
+                          "token_type_ids": types}, [seq_name, pooled_name])
+        np.testing.assert_allclose(res[seq_name].numpy(), golden_seq,
+                                   atol=5e-4)
+        np.testing.assert_allclose(res[pooled_name].numpy(), golden_pool,
+                                   atol=5e-4)
+
     def test_bert_graph_is_one_xla_program(self):
         """The imported graph jit-compiles whole-program (no interpreter)."""
         B, S = 2, 8
