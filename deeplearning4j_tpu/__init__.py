@@ -3,17 +3,31 @@
 A ground-up JAX/XLA/Pallas re-design with the capabilities of the Eclipse
 Deeplearning4j ecosystem (reference surveyed in SURVEY.md):
 
-- ``ndarray``   — eager NDArray API (INDArray/Nd4j analog)
-- ``ops``       — registered op library (libnd4j declarable-op analog)
-- ``autodiff``  — define-then-run graph + jit/grad (SameDiff analog)
-- ``nn``        — layer-based NN API (DL4J MultiLayerNetwork/ComputationGraph)
-- ``datasets``  — DataSet/iterators (nd4j dataset + dl4j-datasets analog)
-- ``parallel``  — mesh/sharding/distributed training (ParallelWrapper/Spark/PS analog)
-- ``etl``       — record readers + transform DSL (DataVec analog)
-- ``models``    — model zoo (deeplearning4j-zoo analog)
+- ``ndarray``     — eager NDArray API (INDArray/Nd4j analog)
+- ``ops``         — registered op library, descriptors, executioner modes
+                    (libnd4j declarable ops + org/nd4j/ir analog)
+- ``autodiff``    — define-then-run graph + jit/grad, control flow,
+                    validation harness (SameDiff analog)
+- ``nn``          — layer NN API, evaluation, solvers, transfer learning,
+                    sharded checkpoints (DL4J MultiLayerNetwork/
+                    ComputationGraph)
+- ``datasets``    — DataSet/iterators/fetchers/normalizers
+- ``etl``         — record readers + transform DSL + joins (DataVec)
+- ``parallel``    — mesh/sharding/pipeline/distributed + fault tolerance
+                    (ParallelWrapper/Spark/Aeron-PS stack)
+- ``models``      — flagship BERT (TP/SP/FSDP/PP) + Seq2Seq LSTM
+- ``kernels``     — Pallas TPU kernels (platform vendor-kernel analog)
+- ``modelimport`` — TF GraphDef / ONNX / Keras h5 importers
+- ``zoo``         — 16 architectures + DL4J-zip pretrained converter
+- ``nlp``         — Word2Vec/ParagraphVectors/fastText/DeepWalk
+- ``ui``          — StatsListener/StatsStorage/dashboard (deeplearning4j-ui)
+- ``native``      — C++ IO runtime over ctypes
+- ``interop``     — GraphRunner/OnnxRunner (nd4j-tensorflow/onnxruntime)
+- ``omnihub``     — model hub
+- ``runtime``/``common`` — workspace shims, env config, RNG, profiling
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from .common.config import get_environment  # noqa: F401
 from .common.dtype import DataType  # noqa: F401

@@ -18,6 +18,7 @@ from .transform_process import (TransformProcess, Reducer, FilterStep,
 from .executor import (LocalTransformExecutor, analyze_local,
                        analyze_quality_local, DataAnalysis,
                        DataQualityAnalysis)
+from .join import Join, JoinType
 from .records import (InputSplit, FileSplit, CollectionInputSplit, StringSplit,
                       RecordReader, CSVRecordReader, LineRecordReader,
                       CollectionRecordReader, JacksonLineRecordReader,

@@ -1,0 +1,51 @@
+"""Distributed training in one line: distribute() over a dp/fsdp/tp mesh.
+
+Run on any host (virtual 8-device CPU mesh):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/distributed_training.py
+"""
+import numpy as np
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.config import (InputType,
+                                               NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.mesh import (MeshConfig, local_mesh_info,
+                                              make_mesh)
+
+
+def main():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).updater(Adam(learning_rate=1e-3))
+            .list()
+            .layer(L.DenseLayer(n_in=64, n_out=256, activation="relu"))
+            .layer(L.DenseLayer(n_out=128, activation="relu"))
+            .layer(L.OutputLayer(n_out=10, activation="softmax",
+                                 loss="mcxent"))
+            .set_input_type(InputType.feed_forward(64))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    import jax
+    n = jax.device_count()
+    if n >= 8:
+        mesh = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    elif n >= 2:
+        mesh = make_mesh(MeshConfig(data=n))
+    else:
+        mesh = None
+    if mesh is not None:
+        net.distribute(mesh)
+        print("training over", local_mesh_info(mesh))
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(256, 64).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, 256)]
+    for step in range(20):
+        net.fit(x, y)
+    print("final loss:", net.score_value)
+
+
+if __name__ == "__main__":
+    main()

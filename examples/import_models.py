@@ -1,0 +1,70 @@
+"""Model import: run TF / Keras / ONNX models as native XLA programs.
+
+Builds tiny in-memory fixtures when the source frameworks are installed;
+the importers themselves never need them.
+
+Run: python examples/import_models.py [model.pb|model.h5|model.onnx]
+"""
+import sys
+
+import numpy as np
+
+
+def demo_tf():
+    try:
+        import tensorflow as tf
+    except ImportError:
+        print("tensorflow not installed — skipping TF demo")
+        return
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [2, 4], name="x")
+        w = tf.constant(np.eye(4, 3, dtype=np.float32))
+        tf.nn.softmax(tf.matmul(x, w), name="probs")
+    pb = g.as_graph_def().SerializeToString()
+
+    from deeplearning4j_tpu.modelimport import import_tf_graph
+    imp = import_tf_graph(pb, input_shapes={"x": (2, 4)},
+                          outputs=["probs"])
+    out = imp.output({"x": np.ones((2, 4), np.float32)}, ["probs"])
+    print("TF import:", out["probs"].numpy())
+
+
+def demo_keras():
+    try:
+        import keras
+    except ImportError:
+        print("keras not installed — skipping Keras demo")
+        return
+    import tempfile
+    from keras import layers
+    m = keras.Sequential([keras.Input((8,)),
+                          layers.Dense(4, activation="softmax")])
+    with tempfile.NamedTemporaryFile(suffix=".h5") as f:
+        m.save(f.name)
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_sequential_model_and_weights
+        net = import_keras_sequential_model_and_weights(f.name)
+    print("Keras import:", net.output(np.ones((1, 8), np.float32)).numpy())
+
+
+def main():
+    if len(sys.argv) > 1:
+        path = sys.argv[1]
+        if path.endswith(".pb"):
+            from deeplearning4j_tpu.modelimport import import_tf_graph
+            print(import_tf_graph(path).sd.summary())
+        elif path.endswith(".onnx"):
+            from deeplearning4j_tpu.modelimport import import_onnx_model
+            print(import_onnx_model(path).sd.summary())
+        elif path.endswith(".h5"):
+            from deeplearning4j_tpu.modelimport import \
+                import_keras_sequential_model_and_weights
+            print(import_keras_sequential_model_and_weights(path).conf)
+        return
+    demo_tf()
+    demo_keras()
+
+
+if __name__ == "__main__":
+    main()

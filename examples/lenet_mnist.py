@@ -1,0 +1,49 @@
+"""LeNet on MNIST end-to-end (the reference dl4j-examples LeNet config).
+
+With real MNIST idx files under $DL4J_TPU_DATA/mnist (or ~/.dl4j_tpu/data),
+trains on the full set; otherwise falls back to a synthetic batch so the
+example always runs.
+
+Run: python examples/lenet_mnist.py
+"""
+import numpy as np
+
+from deeplearning4j_tpu.nn.listeners import ScoreIterationListener
+from deeplearning4j_tpu.zoo import LeNet
+
+
+def load_data():
+    try:
+        from deeplearning4j_tpu.datasets.fetchers import MnistDataFetcher
+        x, y = MnistDataFetcher(train=True).fetch()
+        xt, yt = MnistDataFetcher(train=False).fetch()
+        onehot = np.eye(10, dtype=np.float32)
+        return (x.reshape(-1, 1, 28, 28), onehot[y],
+                xt.reshape(-1, 1, 28, 28), onehot[yt])
+    except Exception:
+        print("MNIST files not found — using a synthetic stand-in")
+        rs = np.random.RandomState(0)
+        x = rs.rand(512, 1, 28, 28).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, 512)]
+        return x, y, x[:128], y[:128]
+
+
+def main():
+    x, y, xt, yt = load_data()
+    net = LeNet(num_classes=10, input_shape=(1, 28, 28)).init_model()
+    net._listeners.append(ScoreIterationListener(10))
+    B = 128
+    for epoch in range(2):
+        perm = np.random.RandomState(epoch).permutation(len(x))
+        for i in range(0, len(x) - B + 1, B):
+            idx = perm[i:i + B]
+            net.fit(x[idx], y[idx])
+    from deeplearning4j_tpu.nn.evaluation import Evaluation
+    e = Evaluation()
+    for i in range(0, len(xt) - B + 1, B):
+        e.eval(yt[i:i + B], net.output(xt[i:i + B]))
+    print(e.stats())
+
+
+if __name__ == "__main__":
+    main()

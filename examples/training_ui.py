@@ -1,0 +1,50 @@
+"""Training dashboard: StatsListener -> StatsStorage -> UIServer.
+
+Run: python examples/training_ui.py   (then open http://127.0.0.1:9000)
+"""
+import time
+
+import numpy as np
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.config import (InputType,
+                                               NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener, UIServer
+
+
+def main():
+    storage = InMemoryStatsStorage()
+    server = UIServer.get_instance(port=9000)
+    server.attach(storage)
+    port = server.start()
+    print(f"dashboard: http://127.0.0.1:{port}")
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(learning_rate=1e-3))
+            .list()
+            .layer(L.DenseLayer(n_in=32, n_out=64, activation="relu"))
+            .layer(L.OutputLayer(n_out=5, activation="softmax",
+                                 loss="mcxent"))
+            .set_input_type(InputType.feed_forward(32))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net._listeners.append(StatsListener(storage))
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(128, 32).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rs.randint(0, 5, 128)]
+    for _ in range(200):
+        net.fit(x, y)
+        time.sleep(0.05)
+    print("done — dashboard stays up (ctrl-c to exit)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()
