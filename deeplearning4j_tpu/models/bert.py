@@ -327,6 +327,71 @@ def make_train_step(config: BertConfig, mesh: Optional[Mesh] = None,
         out_shardings=(param_sh, opt_sh, None))
 
 
+# -- SQuAD-style QA fine-tune head (BASELINE config 3) -------------------
+
+def init_qa_params(key, config: BertConfig) -> Dict:
+    """Span-extraction head: start/end logits per token (BERT-for-QA)."""
+    w = 0.02 * jax.random.normal(key, (config.hidden_size, 2), jnp.float32)
+    return {"w": w.astype(config.dtype),
+            "b": jnp.zeros((2,), jnp.float32)}
+
+
+def qa_logits(params, qa_params, batch, config: BertConfig, mesh=None):
+    enc = encode(params, batch["input_ids"], batch.get("token_type_ids"),
+                 batch.get("attention_mask"), config=config, mesh=mesh)
+    logits = jnp.einsum("bte,ek->btk", enc, qa_params["w"]) \
+        .astype(jnp.float32) + qa_params["b"]
+    return logits[..., 0], logits[..., 1]      # start, end [B, T]
+
+
+def qa_loss(params, qa_params, batch, config: BertConfig, mesh=None):
+    """Cross entropy over start/end positions (SQuAD objective)."""
+    start_logits, end_logits = qa_logits(params, qa_params, batch, config,
+                                         mesh)
+    mask = batch.get("attention_mask")
+    if mask is not None:
+        big_neg = jnp.finfo(jnp.float32).min
+        start_logits = jnp.where(mask.astype(bool), start_logits, big_neg)
+        end_logits = jnp.where(mask.astype(bool), end_logits, big_neg)
+
+    def ce(logits, positions):
+        lsm = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(lsm, positions[:, None],
+                                             axis=-1)[:, 0])
+
+    return 0.5 * (ce(start_logits, batch["start_positions"]) +
+                  ce(end_logits, batch["end_positions"]))
+
+
+def make_qa_train_step(config: BertConfig, mesh: Optional[Mesh] = None,
+                       learning_rate: float = 3e-5):
+    """Fine-tune step: encoder + QA head trained jointly (the BASELINE
+    config-3 workload: BERT-base SQuAD fine-tune)."""
+    from ..ops import updater_ops
+
+    def loss_fn(all_params, batch):
+        return qa_loss(all_params["bert"], all_params["qa"], batch, config,
+                       mesh)
+
+    def step(all_params, opt_state, batch, iteration):
+        loss, grads = jax.value_and_grad(loss_fn)(all_params, batch)
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_p = jax.tree_util.tree_flatten(all_params)[0]
+        u, m = opt_state
+        new_p, new_u, new_m = [], [], []
+        for p, g, ui, mi in zip(flat_p, flat_g, u, m):
+            upd, u2, m2 = updater_ops.adam_updater(
+                g.astype(jnp.float32), ui, mi, lr=learning_rate,
+                iteration=iteration)
+            new_p.append((p.astype(jnp.float32) - upd).astype(p.dtype))
+            new_u.append(u2)
+            new_m.append(m2)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                (new_u, new_m), loss)
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
 # -- pipeline parallelism (dp x pp) --------------------------------------
 
 def to_pipeline_params(params, n_stages: int):

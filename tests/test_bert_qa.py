@@ -1,0 +1,50 @@
+"""BERT QA fine-tune head (BASELINE config 3: SQuAD-style span extraction)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models import bert
+
+
+class TestBertQA:
+    def test_finetune_reduces_loss(self):
+        c = bert.BertConfig.tiny()
+        c.dtype = jnp.float32
+        rs = np.random.RandomState(0)
+        B, T = 8, 32
+        params = bert.init_params(jax.random.key(0), c)
+        qa = bert.init_qa_params(jax.random.key(1), c)
+        all_params = {"bert": params, "qa": qa}
+        flat = jax.tree_util.tree_leaves(all_params)
+        opt = ([jnp.zeros(p.shape, jnp.float32) for p in flat],
+               [jnp.zeros(p.shape, jnp.float32) for p in flat])
+        step = bert.make_qa_train_step(c, learning_rate=1e-3)
+
+        batch = {
+            "input_ids": jnp.asarray(
+                rs.randint(0, c.vocab_size, (B, T)), jnp.int32),
+            "attention_mask": jnp.ones((B, T), jnp.int32),
+            "start_positions": jnp.asarray(rs.randint(0, T, B), jnp.int32),
+            "end_positions": jnp.asarray(rs.randint(0, T, B), jnp.int32),
+        }
+        losses = []
+        for i in range(12):
+            all_params, opt, loss = step(all_params, opt, batch, i)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()
+
+    def test_qa_logits_shapes_and_mask(self):
+        c = bert.BertConfig.tiny()
+        c.dtype = jnp.float32
+        rs = np.random.RandomState(1)
+        B, T = 2, 16
+        params = bert.init_params(jax.random.key(0), c)
+        qa = bert.init_qa_params(jax.random.key(1), c)
+        mask = np.ones((B, T), np.int32)
+        mask[:, 10:] = 0
+        batch = {"input_ids": jnp.asarray(
+                     rs.randint(0, c.vocab_size, (B, T)), jnp.int32),
+                 "attention_mask": jnp.asarray(mask)}
+        start, end = bert.qa_logits(params, qa, batch, c)
+        assert start.shape == (B, T) and end.shape == (B, T)
