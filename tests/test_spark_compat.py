@@ -1,0 +1,57 @@
+"""Spark-API compatibility facade: reference-style distributed training
+entry points over the mesh."""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.config import (InputType,
+                                               NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.mesh import MeshConfig, make_mesh
+from deeplearning4j_tpu.parallel.spark_compat import (
+    ParameterAveragingTrainingMaster, SharedTrainingMaster,
+    SparkDl4jMultiLayer)
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(learning_rate=1e-2)).list()
+            .layer(L.DenseLayer(n_in=8, n_out=16, activation="relu"))
+            .layer(L.OutputLayer(n_out=3, activation="softmax",
+                                 loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 devices")
+class TestSparkCompat:
+    def test_parameter_averaging_style_fit(self):
+        master = (ParameterAveragingTrainingMaster.Builder(16)
+                  .averaging_frequency(5).aggregation_depth(2).build())
+        mesh = make_mesh(MeshConfig(data=8))
+        spark_net = SparkDl4jMultiLayer(mesh, _net(), master)
+        rs = np.random.RandomState(0)
+        data = []
+        for _ in range(4):
+            x = rs.randn(16, 8).astype(np.float32)
+            y = np.zeros((16, 3), np.float32)
+            y[np.arange(16), rs.randint(0, 3, 16)] = 1.0
+            data.append(DataSet(x, y))
+        spark_net.fit(data, num_epochs=2)
+        assert np.isfinite(spark_net.get_score())
+
+    def test_shared_training_master_knobs_accepted(self):
+        master = (SharedTrainingMaster.Builder(32)
+                  .update_threshold(1e-3)
+                  .workers_per_node(4).build())
+        assert master.threshold == 1e-3
+        mesh = make_mesh(MeshConfig(data=2, tensor=2, fsdp=2))
+        spark_net = SparkDl4jMultiLayer(mesh, _net(), master)
+        rs = np.random.RandomState(1)
+        x = rs.randn(8, 8).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 8)]
+        spark_net.fit([DataSet(x, y)])
+        assert np.isfinite(spark_net.get_score())
