@@ -74,13 +74,23 @@ class RnnToFeedForwardPreProcessor:
 
 @dataclasses.dataclass
 class FeedForwardToRnnPreProcessor:
+    """[B*T, F] → [B, F, T] (reference FeedForwardToRnnPreProcessor).
+
+    `timesteps` must be set (the flat batch carries no T); the reference
+    recovers it from the input mini-batch metadata, here it is explicit."""
     timesteps: int = -1
 
     def __call__(self, x):
-        raise NotImplementedError("requires timestep context; use RnnOutputLayer")
+        if self.timesteps <= 0:
+            raise ValueError(
+                "FeedForwardToRnnPreProcessor needs timesteps set (the "
+                "[B*T, F] input cannot carry T)")
+        t = self.timesteps
+        b = x.shape[0] // t
+        return jnp.swapaxes(x.reshape(b, t, x.shape[-1]), 1, 2)
 
     def out_type(self, input_type):
-        return input_type
+        return (input_type[0], self.timesteps)
 
 
 @dataclasses.dataclass

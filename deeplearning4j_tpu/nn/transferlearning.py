@@ -78,7 +78,86 @@ class FineTuneConfiguration:
 
 
 class TransferLearning:
-    """Reference TransferLearning entry: `TransferLearning.Builder(net)`."""
+    """Reference TransferLearning entry: `TransferLearning.Builder(net)`
+    (MultiLayerNetwork) / `TransferLearning.GraphBuilder(graph)`."""
+
+    class GraphBuilder:
+        """ComputationGraph transfer learning (reference
+        TransferLearning.GraphBuilder): freeze up to a vertex, replace a
+        layer vertex's nOut, fine-tune config."""
+
+        def __init__(self, graph):
+            graph._check_init()
+            self._src = graph
+            self._ftc: Optional[FineTuneConfiguration] = None
+            self._frozen_until: Optional[str] = None
+            self._nout_replace = {}
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._ftc = ftc
+            return self
+
+        def set_feature_extractor(self, vertex_name: str):
+            """Freeze vertex_name and everything topologically before it."""
+            self._frozen_until = vertex_name
+            return self
+
+        def n_out_replace(self, vertex_name: str, n_out: int,
+                          weight_init: str = "xavier"):
+            self._nout_replace[vertex_name] = (int(n_out), weight_init)
+            return self
+
+        def build(self):
+            import jax as _jax
+            from .graph.computation_graph import (ComputationGraph,
+                                                  LayerVertex)
+            src = self._src
+            conf = copy.deepcopy(src.conf)
+            params = {n: dict(p) for n, p in src._params.items()}
+
+            # nOut replacement re-inits that vertex + direct consumers
+            types = src.conf.vertex_output_types()
+            key = _jax.random.key(conf.seed + 13)
+            for name, (n_out, w_init) in self._nout_replace.items():
+                v = conf.vertices[name]
+                layer = v.layer if isinstance(v, LayerVertex) else v
+                layer.n_out = n_out
+                if hasattr(layer, "weight_init"):
+                    layer.weight_init = w_init
+                in_types = [types.get(i)
+                            for i in conf.vertex_inputs[name]]
+                key, k1 = _jax.random.split(key)
+                params[name] = v.init_params(k1, in_types)
+                out_type = layer.output_type(in_types[0]
+                                             if in_types else None)
+                for consumer, ins in conf.vertex_inputs.items():
+                    if name in ins and consumer in conf.vertices:
+                        cv = conf.vertices[consumer]
+                        cl = cv.layer if isinstance(cv, LayerVertex) else cv
+                        if hasattr(cl, "n_in"):
+                            cl.n_in = n_out
+                        if cv.has_params():
+                            key, k2 = _jax.random.split(key)
+                            params[consumer] = cv.init_params(
+                                k2, [out_type])
+
+            # freeze the feature extractor sub-DAG
+            if self._frozen_until is not None:
+                order = conf.topological_order()
+                cutoff = order.index(self._frozen_until)
+                for name in order[:cutoff + 1]:
+                    if name in conf.inputs or name not in conf.vertices:
+                        continue
+                    v = conf.vertices[name]
+                    if isinstance(v, LayerVertex) and v.layer.has_params():
+                        v.layer = FrozenLayer(underlying=v.layer)
+                        params[name] = FrozenLayer.wrap_params(params[name])
+
+            if self._ftc is not None:
+                self._ftc.apply_to(conf)
+            net = ComputationGraph(conf)
+            net.init(params=params)
+            return net
 
     class Builder:
         def __init__(self, net: MultiLayerNetwork):

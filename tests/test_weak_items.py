@@ -205,3 +205,56 @@ class TestEnvironment:
         assert env is environment()
         assert env.num_devices() >= 1
         assert env.backend() in ("cpu", "tpu", "gpu", "axon")
+
+
+class TestGraphTransferLearning:
+    def test_freeze_and_replace_on_graph(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.graph.computation_graph import \
+            ComputationGraph
+        rs = np.random.RandomState(0)
+        b = (NeuralNetConfiguration.builder()
+             .seed(2).updater(Adam(learning_rate=1e-2)).graph_builder())
+        b.add_inputs("in")
+        b.set_input_types(InputType.feed_forward(8))
+        b.add_layer("f1", L.DenseLayer(n_in=8, n_out=16,
+                                       activation="relu"), "in")
+        b.add_layer("out", L.OutputLayer(n_in=16, n_out=4,
+                                         activation="softmax",
+                                         loss="mcxent"), "f1")
+        b.set_outputs("out")
+        src = ComputationGraph(b.build()).init()
+
+        x, y = _xy(rs)
+        src.fit(x, y)
+        net = (TransferLearning.GraphBuilder(src)
+               .fine_tune_configuration(
+                   FineTuneConfiguration.builder()
+                   .updater(Sgd(learning_rate=5e-2)).build())
+               .set_feature_extractor("f1")
+               .n_out_replace("out", 6)
+               .build())
+        frozen_before = {k: np.asarray(v)
+                         for k, v in net._params["f1"].items()}
+        y6 = np.zeros((16, 6), np.float32)
+        y6[np.arange(16), rs.randint(0, 6, 16)] = 1.0
+        net.fit(x, y6)
+        net.fit(x, y6)
+        for k, before in frozen_before.items():
+            np.testing.assert_allclose(before,
+                                       np.asarray(net._params["f1"][k]))
+        assert net.output(x)[0].shape == (16, 6)
+
+
+class TestFeedForwardToRnnPreProcessor:
+    def test_roundtrip(self):
+        from deeplearning4j_tpu.nn.conf.config import (
+            FeedForwardToRnnPreProcessor, RnnToFeedForwardPreProcessor)
+        import jax.numpy as jnp
+        rs = np.random.RandomState(0)
+        x_rnn = jnp.asarray(rs.randn(4, 3, 5).astype(np.float32))  # [B,F,T]
+        flat = RnnToFeedForwardPreProcessor()(x_rnn)               # [B*T,F]
+        assert flat.shape == (20, 3)
+        back = FeedForwardToRnnPreProcessor(timesteps=5)(flat)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x_rnn),
+                                   atol=1e-6)
