@@ -1,0 +1,8 @@
+"""Cross-cutting services: dtype system, layered env config, RNG facade,
+chrome-trace profile analysis (nd4j-common / linalg.api.environment role)."""
+from .dtype import DataType
+from .environment import Environment, EnvironmentVars, SystemProperties, environment
+from .rng import NativeRandom, get_random, set_default_seed
+
+__all__ = ["DataType", "Environment", "EnvironmentVars", "SystemProperties",
+           "environment", "NativeRandom", "get_random", "set_default_seed"]

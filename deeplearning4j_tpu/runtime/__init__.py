@@ -1,0 +1,9 @@
+"""Runtime services: memory-workspace shims (the XLA-arena-backed
+MemoryWorkspace API surface). See `workspace.py`."""
+from .workspace import (DummyWorkspace, LayerWorkspaceMgr, MemoryWorkspace,
+                        Nd4jWorkspaceManager, WorkspaceConfiguration,
+                        workspace_manager)
+
+__all__ = ["DummyWorkspace", "LayerWorkspaceMgr", "MemoryWorkspace",
+           "Nd4jWorkspaceManager", "WorkspaceConfiguration",
+           "workspace_manager"]
