@@ -11,14 +11,19 @@ axis; callers reshape). The kernel grid is (BH, S // TILE_Q); each program
 streams K/V blocks of TILE_K rows with jax.lax.fori_loop.
 
 Backward: jax.custom_vjp whose bwd recomputes attention with the standard
-XLA path (flash bwd kernel is a follow-up; recompute keeps memory at
-O(S) while XLA fuses the bwd matmuls onto the MXU).
+XLA path — NOTE this materializes the [S, S] score matrix in the backward,
+so the O(S) memory benefit applies to the forward/inference path only (a
+flash backward kernel is the follow-up for O(S) training memory).
+
+Sequence lengths that don't divide the tiles are zero-padded to the tile
+boundary (padded keys masked off, padded query rows sliced away).
 
 Tests run interpret mode on CPU; the real chip runs compiled.
 """
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -157,15 +162,30 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
                     tile_k: int = 128):
     """Flash attention over [B, S, H, D] (BTHD, the framework convention).
 
-    mask: optional [B, S] key validity (1 = attend). Differentiable."""
+    mask: optional [B, S] key validity (1 = attend). Differentiable.
+    Any S is accepted: inputs are zero-padded to the tile boundary (padded
+    keys masked off; padded query rows sliced away)."""
     B, S, H, D = q.shape
     scale = scale if scale is not None else D ** -0.5
-    qf = jnp.moveaxis(q, 2, 1).reshape(B * H, S, D)
-    kf = jnp.moveaxis(k, 2, 1).reshape(B * H, S, D)
-    vf = jnp.moveaxis(v, 2, 1).reshape(B * H, S, D)
+    tile_q = min(tile_q, max(S, 1))
+    tile_k = min(tile_k, max(S, 1))
+    lcm = tile_q * tile_k // math.gcd(tile_q, tile_k)
+    S_pad = -(-S // lcm) * lcm
+    if S_pad != S:
+        pad = [(0, 0), (0, S_pad - S), (0, 0), (0, 0)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+        if mask is None:
+            mask = jnp.ones((B, S), jnp.int32)
+        mask = jnp.pad(mask, [(0, 0), (0, S_pad - S)])
+    qf = jnp.moveaxis(q, 2, 1).reshape(B * H, S_pad, D)
+    kf = jnp.moveaxis(k, 2, 1).reshape(B * H, S_pad, D)
+    vf = jnp.moveaxis(v, 2, 1).reshape(B * H, S_pad, D)
     if mask is not None:
         mf = jnp.repeat(mask.astype(jnp.int32), H, axis=0)
         out = _flash_masked(qf, kf, vf, mf, scale, causal, tile_q, tile_k)
     else:
         out = _flash(qf, kf, vf, 0, scale, causal, tile_q, tile_k)
-    return jnp.moveaxis(out.reshape(B, H, S, D), 1, 2)
+    out = jnp.moveaxis(out.reshape(B, H, S_pad, D), 1, 2)
+    return out[:, :S] if S_pad != S else out

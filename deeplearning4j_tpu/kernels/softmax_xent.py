@@ -109,28 +109,52 @@ def _xent_bwd_call(logits, labels2d, m, l, g, tile_n, tile_v):
     )(logits, labels2d, m, l, g)
 
 
+def _pad_inputs(logits, labels, tile_n, tile_v):
+    """Pad N to the row-tile boundary (zero rows/labels, sliced away) and V
+    to the vocab-tile boundary (-1e30 columns: exp -> 0, no effect)."""
+    N, V = logits.shape
+    n_pad = (-N) % tile_n
+    v_pad = (-V) % tile_v
+    if v_pad:
+        logits = jnp.concatenate(
+            [logits, jnp.full((N, v_pad), -1e30, logits.dtype)], axis=1)
+    if n_pad:
+        logits = jnp.concatenate(
+            [logits, jnp.zeros((n_pad, logits.shape[1]), logits.dtype)],
+            axis=0)
+        labels = jnp.concatenate(
+            [labels, jnp.zeros((n_pad,), labels.dtype)], axis=0)
+    return logits, labels, N
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
 def fused_softmax_xent(logits, labels, tile_n: int = 128,
                        tile_v: int = 2048):
     """Per-row -log softmax(logits)[label]; logits [N, V], labels [N] int.
 
     Returns [N] float32 losses. Differentiable wrt logits; the softmax
-    matrix is regenerated tile-wise in bwd (never stored)."""
-    loss, _, _ = _xent_fwd_call(logits, labels[:, None], tile_n, tile_v)
-    return loss[:, 0]
+    matrix is regenerated tile-wise in bwd (never stored). Non-tile-multiple
+    N/V are padded internally (padded rows sliced away, padded vocab at
+    -1e30 contributes nothing)."""
+    lp, labp, N = _pad_inputs(logits, labels, tile_n, tile_v)
+    loss, _, _ = _xent_fwd_call(lp, labp[:, None], tile_n, tile_v)
+    return loss[:N, 0]
 
 
 def _f(logits, labels, tile_n, tile_v):
-    lab2 = labels[:, None]
-    loss, m, l = _xent_fwd_call(logits, lab2, tile_n, tile_v)
-    return loss[:, 0], (logits, lab2, m, l)
+    lp, labp, N = _pad_inputs(logits, labels, tile_n, tile_v)
+    lab2 = labp[:, None]
+    loss, m, l = _xent_fwd_call(lp, lab2, tile_n, tile_v)
+    return loss[:N, 0], (lp, lab2, m, l, logits.shape)
 
 
 def _b(tile_n, tile_v, res, g):
-    logits, lab2, m, l = res
-    dx = _xent_bwd_call(logits, lab2, m, l,
-                        g.astype(jnp.float32)[:, None], tile_n, tile_v)
-    return dx, None
+    lp, lab2, m, l, orig_shape = res
+    N, V = orig_shape
+    g_pad = jnp.zeros((lp.shape[0],), jnp.float32).at[:N].set(
+        g.astype(jnp.float32))
+    dx = _xent_bwd_call(lp, lab2, m, l, g_pad[:, None], tile_n, tile_v)
+    return dx[:N, :V], None
 
 
 fused_softmax_xent.defvjp(_f, _b)

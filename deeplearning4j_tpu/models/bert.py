@@ -27,6 +27,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import DATA, FSDP, PIPE, SEQ, TENSOR
+from . import _optim
 from ..parallel.ring_attention import blockwise_attention, ring_attention
 
 
@@ -250,14 +251,9 @@ def mlm_loss(params, batch, config: BertConfig, mesh=None,
     if use_fused_xent:
         from ..kernels import fused_softmax_xent
         B, T, V = logits.shape
-        tile_v = 1024
-        pad = (-V) % tile_v
-        flat = logits.reshape(B * T, V)
-        if pad:
-            flat = jnp.concatenate(
-                [flat, jnp.full((B * T, pad), -1e30, flat.dtype)], axis=1)
-        per_tok = fused_softmax_xent(flat, safe_labels.reshape(-1),
-                                     128, tile_v).reshape(B, T)
+        per_tok = fused_softmax_xent(logits.reshape(B * T, V),
+                                     safe_labels.reshape(-1),
+                                     128, 1024).reshape(B, T)
     else:
         lsm = jax.nn.log_softmax(logits, axis=-1)
         per_tok = -jnp.take_along_axis(lsm, safe_labels[..., None],
@@ -280,8 +276,6 @@ def make_train_step(config: BertConfig, mesh: Optional[Mesh] = None,
     use_flash / use_fused_xent select the Pallas kernels for attention and
     the vocab softmax-xent.
     """
-    from ..ops import updater_ops
-
     loss_fn = functools.partial(mlm_loss, config=config, mesh=mesh,
                                 seq_parallel=seq_parallel,
                                 use_flash=use_flash,
@@ -292,19 +286,9 @@ def make_train_step(config: BertConfig, mesh: Optional[Mesh] = None,
 
     def step(params, opt_state, batch, iteration):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        flat_g, treedef = jax.tree_util.tree_flatten(grads)
-        flat_u, flat_m = opt_state
-        new_p, new_u, new_m = [], [], []
-        flat_p = jax.tree_util.tree_flatten(params)[0]
-        for p, g, u, m in zip(flat_p, flat_g, flat_u, flat_m):
-            upd, u2, m2 = updater_ops.adam_updater(
-                g.astype(jnp.float32), u, m, lr=learning_rate,
-                iteration=iteration)
-            new_p.append((p.astype(jnp.float32) - upd).astype(p.dtype))
-            new_u.append(u2)
-            new_m.append(m2)
-        return (jax.tree_util.tree_unflatten(treedef, new_p),
-                (new_u, new_m), loss)
+        new_params, opt_state = _optim.adam_apply(
+            params, grads, opt_state, learning_rate, iteration)
+        return new_params, opt_state, loss
 
     donate = (0, 1)
     if mesh is None:
@@ -367,7 +351,7 @@ def make_qa_train_step(config: BertConfig, mesh: Optional[Mesh] = None,
                        learning_rate: float = 3e-5):
     """Fine-tune step: encoder + QA head trained jointly (the BASELINE
     config-3 workload: BERT-base SQuAD fine-tune)."""
-    from ..ops import updater_ops
+
 
     def loss_fn(all_params, batch):
         return qa_loss(all_params["bert"], all_params["qa"], batch, config,
@@ -375,19 +359,9 @@ def make_qa_train_step(config: BertConfig, mesh: Optional[Mesh] = None,
 
     def step(all_params, opt_state, batch, iteration):
         loss, grads = jax.value_and_grad(loss_fn)(all_params, batch)
-        flat_g, treedef = jax.tree_util.tree_flatten(grads)
-        flat_p = jax.tree_util.tree_flatten(all_params)[0]
-        u, m = opt_state
-        new_p, new_u, new_m = [], [], []
-        for p, g, ui, mi in zip(flat_p, flat_g, u, m):
-            upd, u2, m2 = updater_ops.adam_updater(
-                g.astype(jnp.float32), ui, mi, lr=learning_rate,
-                iteration=iteration)
-            new_p.append((p.astype(jnp.float32) - upd).astype(p.dtype))
-            new_u.append(u2)
-            new_m.append(m2)
-        return (jax.tree_util.tree_unflatten(treedef, new_p),
-                (new_u, new_m), loss)
+        new_params, opt_state = _optim.adam_apply(
+            all_params, grads, opt_state, learning_rate, iteration)
+        return new_params, opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1))
 
@@ -438,7 +412,7 @@ def make_pipeline_train_step(config: BertConfig, mesh: Mesh,
 
     Use with `to_pipeline_params(init_params(...), n_stages)`.
     """
-    from ..ops import updater_ops
+
     from ..parallel.pipeline import make_pipeline_loss
     c = config
 
@@ -490,19 +464,9 @@ def make_pipeline_train_step(config: BertConfig, mesh: Mesh,
 
     def step(params, opt_state, batch, iteration):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        flat_g, treedef = jax.tree_util.tree_flatten(grads)
-        flat_u, flat_m = opt_state
-        flat_p = jax.tree_util.tree_flatten(params)[0]
-        new_p, new_u, new_m = [], [], []
-        for p, g, u, m in zip(flat_p, flat_g, flat_u, flat_m):
-            upd, u2, m2 = updater_ops.adam_updater(
-                g.astype(jnp.float32), u, m, lr=learning_rate,
-                iteration=iteration)
-            new_p.append((p.astype(jnp.float32) - upd).astype(p.dtype))
-            new_u.append(u2)
-            new_m.append(m2)
-        return (jax.tree_util.tree_unflatten(treedef, new_p),
-                (new_u, new_m), loss)
+        new_params, opt_state = _optim.adam_apply(
+            params, grads, opt_state, learning_rate, iteration)
+        return new_params, opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1))
 

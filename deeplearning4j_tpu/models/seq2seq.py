@@ -16,7 +16,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops import recurrent, updater_ops
+from ..ops import recurrent
+from . import _optim
 
 
 @dataclasses.dataclass
@@ -86,27 +87,15 @@ def loss_fn(params, batch, c: Seq2SeqConfig):
 def make_train_step(c: Seq2SeqConfig, learning_rate: float = 1e-2):
     def step(params, opt_state, batch, iteration):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, c)
-        flat_g, treedef = jax.tree_util.tree_flatten(grads)
-        flat_p = jax.tree_util.tree_flatten(params)[0]
-        u, m = opt_state
-        new_p, new_u, new_m = [], [], []
-        for p, g, ui, mi in zip(flat_p, flat_g, u, m):
-            upd, u2, m2 = updater_ops.adam_updater(g, ui, mi,
-                                                   lr=learning_rate,
-                                                   iteration=iteration)
-            new_p.append(p - upd)
-            new_u.append(u2)
-            new_m.append(m2)
-        return (jax.tree_util.tree_unflatten(treedef, new_p),
-                (new_u, new_m), loss)
+        new_params, opt_state = _optim.adam_apply(
+            params, grads, opt_state, learning_rate, iteration)
+        return new_params, opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1))
 
 
 def init_opt_state(params):
-    flat = jax.tree_util.tree_leaves(params)
-    return ([jnp.zeros_like(p) for p in flat],
-            [jnp.zeros_like(p) for p in flat])
+    return _optim.adam_init(params)
 
 
 def greedy_decode(params, src_ids, max_len: int, c: Seq2SeqConfig):

@@ -28,18 +28,21 @@ from .mesh import MeshConfig, make_mesh
 def rebuild_mesh(config: MeshConfig = None, devices: Optional[Sequence] = None):
     """Re-mesh over the CURRENTLY live device list (remapNode analog).
 
-    With a shrunken device set, axes that no longer divide are folded into
-    `data` (data parallelism degrades gracefully; tensor/seq axes must fit)."""
+    If the requested model-parallel axes (fsdp*tensor*seq*pipe) still
+    divide the surviving device count, data parallelism absorbs the
+    difference; otherwise the mesh degrades to pure DP — every sharding in
+    this framework has a replicated fallback, so training continues
+    (slower), which beats dying. Callers that REQUIRE model parallelism
+    should check the returned mesh's axis sizes."""
     config = config or MeshConfig()
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
     fixed = config.fsdp * config.tensor * config.seq * config.pipe
-    if n % fixed != 0:
-        # fold non-data axes down until the device count fits
-        config = MeshConfig()
-    return make_mesh(MeshConfig(
-        data=-1, fsdp=config.fsdp, tensor=config.tensor, seq=config.seq,
-        pipe=config.pipe) if n % fixed == 0 else MeshConfig(), devices)
+    if n % fixed == 0:
+        return make_mesh(MeshConfig(data=-1, fsdp=config.fsdp,
+                                    tensor=config.tensor, seq=config.seq,
+                                    pipe=config.pipe), devices)
+    return make_mesh(MeshConfig(), devices)  # pure-DP degradation
 
 
 class FaultTolerantTrainer:

@@ -98,3 +98,51 @@ class TestFusedSoftmaxXent:
                                  labels[:, None], axis=1)[:, 0]))(logits)
         np.testing.assert_allclose(np.asarray(g), np.asarray(ref_g),
                                    atol=1e-6)
+
+
+class TestNonDivisibleShapes:
+    """Regression: non-tile-multiple shapes must pad, not silently corrupt."""
+
+    def test_flash_attention_odd_seq_len(self):
+        rs = np.random.RandomState(7)
+        B, S, H, D = 2, 200, 2, 16   # 200 % 128 != 0
+        mk = lambda: jnp.asarray(rs.randn(B, S, H, D).astype(np.float32))
+        q, k, v = mk(), mk(), mk()
+        out = flash_attention(q, k, v)
+        ref = _ref_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_flash_attention_odd_seq_with_mask_and_grad(self):
+        rs = np.random.RandomState(8)
+        B, S, H, D = 1, 150, 2, 8
+        mk = lambda: jnp.asarray(rs.randn(B, S, H, D).astype(np.float32))
+        q, k, v = mk(), mk(), mk()
+        mask = np.ones((B, S), np.int32)
+        mask[:, 120:] = 0
+        out = flash_attention(q, k, v, mask=jnp.asarray(mask))
+        ref = _ref_attention(q, k, v, mask=jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+        g = jax.grad(lambda q: jnp.sum(
+            flash_attention(q, k, v, mask=jnp.asarray(mask)) ** 2))(q)
+        gr = jax.grad(lambda q: jnp.sum(
+            _ref_attention(q, k, v, mask=jnp.asarray(mask)) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=1e-4)
+
+    def test_fused_xent_odd_rows_and_vocab(self):
+        rs = np.random.RandomState(9)
+        N, V = 200, 1000   # neither divides the tiles
+        logits = jnp.asarray(rs.randn(N, V).astype(np.float32))
+        labels = jnp.asarray(rs.randint(0, V, N).astype(np.int32))
+        loss = fused_softmax_xent(logits, labels)
+        ref = -jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
+                                   labels[:, None], axis=1)[:, 0]
+        np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
+                                   atol=1e-5)
+        g = jax.grad(lambda x: jnp.mean(fused_softmax_xent(x, labels)))(
+            logits)
+        gr = jax.grad(lambda x: jnp.mean(
+            -jnp.take_along_axis(jax.nn.log_softmax(x, axis=-1),
+                                 labels[:, None], axis=1)[:, 0]))(logits)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=1e-6)
