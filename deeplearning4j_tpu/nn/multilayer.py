@@ -183,55 +183,70 @@ class MultiLayerNetwork:
         return float(self._compute_loss(trainable, x, y, None))
 
     # -- training --------------------------------------------------------
-    def _build_train_step(self):
-        updater = self.conf.updater
+    def _loss_with_bn(self, trainable, states, x, y, key):
+        """Loss + collected stateful-layer inputs (the train-step loss)."""
+        params = self._merge_states(trainable, states)
+        out, bn_inputs = self._forward_collect_bn(params, x, key)
+        ll = self._loss_layer()
+        li = len(self.layers) - 1
+        if hasattr(ll, "compute_loss_ext"):
+            loss = ll.compute_loss_ext(params[li], y, out,
+                                       bn_inputs.get(li))
+        else:
+            loss = ll.compute_loss(y, out)
+        if self.conf.l2 > 0 or self.conf.l1 > 0:
+            for p in trainable:
+                for v in p.values():
+                    if self.conf.l2 > 0:
+                        loss = loss + 0.5 * self.conf.l2 * jnp.sum(v * v)
+                    if self.conf.l1 > 0:
+                        loss = loss + self.conf.l1 * jnp.sum(jnp.abs(v))
+        return loss, bn_inputs
+
+    def _clip_grads(self, grads):
+        """conf.gradient_normalization (clip_l2 / clip_value) applied."""
         grad_norm = self.conf.gradient_normalization
         grad_clip = self.conf.gradient_clip
+        if grad_norm == "clip_l2":
+            gnorm = jnp.sqrt(sum(jnp.sum(g * g)
+                                 for g in jax.tree_util.tree_leaves(grads)))
+            scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-12))
+            return jax.tree_util.tree_map(lambda g: g * scale, grads)
+        if grad_norm == "clip_value":
+            return jax.tree_util.tree_map(
+                lambda g: jnp.clip(g, -grad_clip, grad_clip), grads)
+        return grads
+
+    def _apply_update(self, trainable, updater_state, iteration, grads):
+        """Clip -> updater -> weight decay (one shared update rule)."""
+        grads = self._clip_grads(grads)
+        update, updater_state = self.conf.updater.apply(grads, updater_state,
+                                                        iteration)
         wd = self.conf.weight_decay
+        new_trainable = jax.tree_util.tree_map(
+            lambda p, u: p - u.astype(p.dtype) - wd * p, trainable, update)
+        return new_trainable, updater_state
 
+    def _refresh_states(self, states, bn_inputs, y):
+        """Stateful layers (batchnorm running stats, center-loss centers)
+        refresh from inputs collected during the fwd pass."""
+        new_states = []
+        for i, layer in enumerate(self.layers):
+            if hasattr(layer, "new_state") and i in bn_inputs:
+                new_states.append(layer.new_state(states[i], bn_inputs[i],
+                                                  labels=y))
+            else:
+                new_states.append(states[i])
+        return new_states
+
+    def _build_train_step(self):
         def step(trainable, states, updater_state, iteration, x, y, key):
-            def loss_fn(tr):
-                params = self._merge_states(tr, states)
-                out, bn_inputs = self._forward_collect_bn(params, x, key)
-                ll = self._loss_layer()
-                li = len(self.layers) - 1
-                if hasattr(ll, "compute_loss_ext"):
-                    loss = ll.compute_loss_ext(params[li], y, out,
-                                               bn_inputs.get(li))
-                else:
-                    loss = ll.compute_loss(y, out)
-                if self.conf.l2 > 0 or self.conf.l1 > 0:
-                    for p in tr:
-                        for v in p.values():
-                            if self.conf.l2 > 0:
-                                loss = loss + 0.5 * self.conf.l2 * jnp.sum(v * v)
-                            if self.conf.l1 > 0:
-                                loss = loss + self.conf.l1 * jnp.sum(jnp.abs(v))
-                return loss, bn_inputs
-
             (loss, bn_inputs), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(trainable)
-            if grad_norm == "clip_l2":
-                gnorm = jnp.sqrt(sum(jnp.sum(g * g)
-                                     for p in jax.tree_util.tree_leaves(grads)
-                                     for g in [p]))
-                scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-12))
-                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-            elif grad_norm == "clip_value":
-                grads = jax.tree_util.tree_map(
-                    lambda g: jnp.clip(g, -grad_clip, grad_clip), grads)
-            update, updater_state = updater.apply(grads, updater_state, iteration)
-            new_trainable = jax.tree_util.tree_map(
-                lambda p, u: p - u.astype(p.dtype) - wd * p, trainable, update)
-            # stateful layers (batchnorm running stats, center-loss centers)
-            # refresh from inputs collected during the fwd pass
-            new_states = []
-            for i, layer in enumerate(self.layers):
-                if hasattr(layer, "new_state") and i in bn_inputs:
-                    new_states.append(layer.new_state(states[i],
-                                                      bn_inputs[i], labels=y))
-                else:
-                    new_states.append(states[i])
+                self._loss_with_bn, has_aux=True)(trainable, states, x, y,
+                                                  key)
+            new_trainable, updater_state = self._apply_update(
+                trainable, updater_state, iteration, grads)
+            new_states = self._refresh_states(states, bn_inputs, y)
             return new_trainable, new_states, updater_state, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))

@@ -1,0 +1,126 @@
+"""Gradient accumulation: micro-batch gradients folded into one update.
+
+Reference: `optimize/solvers/accumulation/EncodedGradientsAccumulator.java`
+(ring buffer of updates shared across trainer threads, threshold-encoded
+via `EncodingHandler.java:134`) feeding `StochasticGradientDescent`'s
+accumulator hook. On TPU the cross-device part is XLA's allreduce; what
+remains useful is the *accumulation* semantics — k micro-batches, one
+optimizer step — for batch sizes that don't fit HBM.
+
+`GradientsAccumulator` keeps the reference API (store_update/apply, with
+optional threshold encoding applied to the accumulated tensor for wire/
+storage parity experiments); `fit_accumulated` drives a MultiLayerNetwork
+with it. Gradients are averaged, matching a single large batch exactly for
+mean-reduced losses.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..datasets.dataset import DataSet
+
+
+class GradientsAccumulator:
+    """store_update(grads) k times -> get_average() (reference
+    EncodedGradientsAccumulator.storeUpdate/applyUpdate)."""
+
+    def __init__(self, threshold: Optional[float] = None):
+        self.threshold = threshold
+        self._sum = None
+        self._count = 0
+
+    def store_update(self, grads):
+        if self._sum is None:
+            self._sum = jax.tree_util.tree_map(jnp.asarray, grads)
+        else:
+            self._sum = jax.tree_util.tree_map(jnp.add, self._sum, grads)
+        self._count += 1
+
+    def get_average(self):
+        if self._sum is None:
+            raise ValueError("no updates stored")
+        avg = jax.tree_util.tree_map(lambda s: s / self._count, self._sum)
+        if self.threshold is not None:
+            # reference EncodingHandler path: threshold-encode + decode (on
+            # TPU this is storage/parity only — ICI moves dense tensors)
+            from ..ops import compression
+
+            def roundtrip(g):
+                _, enc = compression.encode_threshold(g, self.threshold)
+                return compression.decode_threshold(enc, self.threshold,
+                                                    g.dtype)
+
+            avg = jax.tree_util.tree_map(roundtrip, avg)
+        return avg
+
+    def reset(self):
+        self._sum = None
+        self._count = 0
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+
+def fit_accumulated(net, batches: List, accumulation_steps: int = None,
+                    threshold: Optional[float] = None):
+    """One optimizer step per `accumulation_steps` micro-batches.
+
+    `batches`: list of DataSets (or (x, y) pairs). Returns the losses (one
+    per optimizer step, averaged over its micro-batches). Shares the
+    network's update rule (gradient clipping, updater, weight decay) and
+    refreshes stateful-layer running stats per micro-batch; a trailing
+    partial window is applied as a final (smaller) step."""
+    net._check_init()
+    accumulation_steps = accumulation_steps or len(batches)
+
+    def unwrap(ds):
+        if not isinstance(ds, DataSet):
+            ds = DataSet(*ds)
+        x = ds.features.jax() if hasattr(ds.features, "jax") \
+            else jnp.asarray(ds.features)
+        y = ds.labels.jax() if hasattr(ds.labels, "jax") \
+            else jnp.asarray(ds.labels)
+        return x, y
+
+    # loss over explicit (trainable, states) — nothing baked as constants;
+    # aux carries the stateful-layer inputs for the running-stat refresh
+    grad_fn = jax.jit(jax.value_and_grad(net._loss_with_bn, has_aux=True))
+    apply_fn = jax.jit(net._apply_update)
+
+    losses = []
+    acc = GradientsAccumulator(threshold=threshold)
+    micro_losses = []
+    trainable = net._trainable(net._params)
+    states = net._states(net._params)
+    ustate = net._updater_state
+
+    def flush():
+        nonlocal trainable, ustate, micro_losses
+        trainable, ustate = apply_fn(trainable, ustate, net._iteration,
+                                     acc.get_average())
+        net._params = net._merge_states(trainable, states)
+        net._updater_state = ustate
+        net._iteration += 1
+        losses.append(sum(micro_losses) / len(micro_losses))
+        net.score_value = losses[-1]
+        acc.reset()
+        micro_losses = []
+
+    for ds in batches:
+        x, y = unwrap(ds)
+        net._rng_key, step_key = jax.random.split(net._rng_key)
+        (loss, bn_inputs), grads = grad_fn(trainable, states, x, y,
+                                           step_key)
+        states = net._refresh_states(states, bn_inputs, y)
+        acc.store_update(grads)
+        micro_losses.append(float(loss))
+        if acc.count >= accumulation_steps:
+            flush()
+    if acc.count:  # trailing partial window still contributes
+        flush()
+    net._params = net._merge_states(trainable, states)
+    return losses

@@ -1,0 +1,107 @@
+"""Gradient accumulation: k micro-batches == one big batch exactly
+(EncodedGradientsAccumulator role, minus the wire)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.config import (InputType,
+                                               NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.accumulation import (GradientsAccumulator,
+                                                      fit_accumulated)
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Sgd(learning_rate=5e-2)).list()
+            .layer(L.DenseLayer(n_in=8, n_out=16, activation="tanh"))
+            .layer(L.OutputLayer(n_out=3, activation="softmax",
+                                 loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestAccumulation:
+    def test_matches_big_batch(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(32, 8).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 32)]
+
+        big = _net()
+        big.fit(x, y)
+
+        acc = _net()
+        micro = [(x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8])
+                 for i in range(4)]
+        fit_accumulated(acc, micro, accumulation_steps=4)
+
+        np.testing.assert_allclose(acc.params().numpy(),
+                                   big.params().numpy(), atol=1e-6)
+
+    def test_multiple_steps(self):
+        rs = np.random.RandomState(1)
+        net = _net()
+        batches = []
+        for _ in range(6):
+            x = rs.randn(8, 8).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 8)]
+            batches.append((x, y))
+        losses = fit_accumulated(net, batches, accumulation_steps=2)
+        assert len(losses) == 3          # 6 micro / 2 per step
+        assert net._iteration == 3
+
+    def test_trailing_partial_window_applies(self):
+        rs = np.random.RandomState(2)
+        net = _net()
+        batches = [(rs.randn(8, 8).astype(np.float32),
+                    np.eye(3, dtype=np.float32)[rs.randint(0, 3, 8)])
+                   for _ in range(5)]
+        losses = fit_accumulated(net, batches, accumulation_steps=2)
+        assert len(losses) == 3          # 2 + 2 + trailing 1
+        assert net._iteration == 3
+
+    def test_gradient_clipping_applied(self):
+        """fit_accumulated must honor conf.gradient_normalization like
+        net.fit (shared _apply_update)."""
+        rs = np.random.RandomState(4)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).updater(Sgd(learning_rate=5e-2))
+                .gradient_normalization("clip_value", 1e-4)
+                .list()
+                .layer(L.DenseLayer(n_in=8, n_out=16, activation="tanh"))
+                .layer(L.OutputLayer(n_out=3, activation="softmax",
+                                     loss="mcxent"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        net = MultiLayerNetwork(conf).init()
+        before = net.params().numpy()
+        x = rs.randn(8, 8).astype(np.float32) * 100  # huge grads
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 8)]
+        fit_accumulated(net, [(x, y)], accumulation_steps=1)
+        delta = np.abs(net.params().numpy() - before).max()
+        assert delta <= 5e-2 * 1e-4 * 1.01  # lr * clip bound (+f32 rounding)
+
+    def test_batchnorm_stats_refresh(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(5).updater(Sgd(learning_rate=1e-2)).list()
+                .layer(L.DenseLayer(n_in=8, n_out=16, activation="relu"))
+                .layer(L.BatchNormalization())
+                .layer(L.OutputLayer(n_out=3, activation="softmax",
+                                     loss="mcxent"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        net = MultiLayerNetwork(conf).init()
+        mean_before = np.asarray(net._params[1]["state_mean"]).copy()
+        rs = np.random.RandomState(6)
+        x = rs.randn(16, 8).astype(np.float32) + 3.0
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 16)]
+        fit_accumulated(net, [(x, y)] * 2, accumulation_steps=2)
+        mean_after = np.asarray(net._params[1]["state_mean"])
+        assert np.abs(mean_after - mean_before).max() > 1e-3
+
+    def test_threshold_roundtrip_quantizes(self):
+        import jax.numpy as jnp
+        acc = GradientsAccumulator(threshold=0.1)
+        acc.store_update({"w": jnp.asarray([0.25, -0.03, -0.4, 0.0])})
+        avg = acc.get_average()
+        np.testing.assert_allclose(np.asarray(avg["w"]),
+                                   [0.1, 0.0, -0.1, 0.0], atol=1e-7)

@@ -134,5 +134,8 @@ def test_op_validation_sweep(tc):
 
 
 def test_sweep_records_coverage():
+    # self-contained: run the sweep here so ordering/xdist can't break it
+    for tc in CASES:
+        OpValidation.validate(tc)
     rep = OpValidation.coverage_report()
     assert rep["validated"] >= 30
