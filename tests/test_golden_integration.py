@@ -1,0 +1,93 @@
+"""Golden-baseline integration tests (reference `platform-tests/.../
+integration/IntegrationTestRunner` pattern): fixed-seed end-to-end runs
+compared against committed expected values — regression tripwires for the
+whole stack (init -> fit -> serde), with tolerances for cross-version
+float drift (SURVEY §7 hard part 6)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.config import (InputType,
+                                               NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _data(rs, b, f, c):
+    x = rs.randn(b, f).astype(np.float32)
+    y = np.zeros((b, c), np.float32)
+    y[np.arange(b), rs.randint(0, c, b)] = 1.0
+    return x, y
+
+
+class TestGoldenMLP:
+    """Golden values generated 2026-07-30 (jax 0.9.0, CPU, seed 12345)."""
+
+    GOLDEN_LOSSES = [1.558639, 1.519035, 1.48349, 1.451367, 1.422158]
+    GOLDEN_FINAL_SCORE = 1.395449
+
+    def _run(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(12345).updater(Sgd(learning_rate=0.1)).list()
+                .layer(L.DenseLayer(n_in=10, n_out=20, activation="tanh"))
+                .layer(L.OutputLayer(n_out=4, activation="softmax",
+                                     loss="mcxent"))
+                .set_input_type(InputType.feed_forward(10)).build())
+        net = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(777)
+        x, y = _data(rs, 32, 10, 4)
+        losses = []
+        for _ in range(5):
+            net.fit(x, y)
+            losses.append(net.score_value)
+        return net, x, y, losses
+
+    def test_loss_trajectory_matches_golden(self):
+        _, _, _, losses = self._run()
+        np.testing.assert_allclose(losses, self.GOLDEN_LOSSES, rtol=2e-3)
+
+    def test_post_training_score(self):
+        net, x, y, _ = self._run()
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        score = net.score(DataSet(x, y))
+        np.testing.assert_allclose(score, self.GOLDEN_FINAL_SCORE,
+                                   rtol=2e-3)
+
+    def test_serde_preserves_golden_outputs(self, tmp_path):
+        net, x, _, _ = self._run()
+        path = str(tmp_path / "golden.zip")
+        net.save(path)
+        from deeplearning4j_tpu.nn.serde import restore_model
+        net2 = restore_model(path)
+        np.testing.assert_allclose(net2.output(x).numpy(),
+                                   net.output(x).numpy(), atol=1e-6)
+
+
+class TestGoldenSameDiff:
+    GOLDEN = [1.38945, 1.296639, 1.214418, 1.141212, 1.075134]
+
+    def test_samediff_training_trajectory(self):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        from deeplearning4j_tpu.autodiff.training import TrainingConfig
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+        rs = np.random.RandomState(5)
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (16, 6))
+        y = sd.placeholder("y", (16, 3))
+        w = sd.var("w", rs.randn(6, 3).astype(np.float32) * 0.5)
+        b = sd.var("b", np.zeros(3, np.float32))
+        logits = x.mmul(w) + b
+        loss = sd.invoke("softmax_cross_entropy_loss_with_logits",
+                         logits, sd.nn.softmax(y * 8.0)).mean()
+        loss.rename("loss")
+        sd.set_loss_variables("loss")
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(learning_rate=0.05),
+            data_set_feature_mapping=["x"], data_set_label_mapping=["y"]))
+        xs, ys = _data(rs, 16, 6, 3)
+        hist = sd.fit(ListDataSetIterator([DataSet(xs, ys)]), num_epochs=5)
+        losses = [round(float(v), 6) for c in hist.loss_curves
+                  for v in c.losses]
+        np.testing.assert_allclose(losses, self.GOLDEN, rtol=2e-3)
