@@ -182,9 +182,29 @@ class TFGraphImporter:
                      import_weights_as_variables: bool = False
                      ) -> ImportedGraph:
         g = self.graph
+
+        # TF1 while frames (Enter/Merge/Switch/... cycles) lower to single
+        # while_loop nodes before the acyclic pass
+        from .while_frames import plan_frames
+        plans = plan_frames(g)
+        if plans:
+            removed = set()
+            for p in plans:
+                removed |= p.consumed
+            kept = [n for n in g.nodes if n.name not in removed]
+            for i, p in enumerate(plans):
+                kept.append(IRNode(
+                    name=f"__while_frame_{i}", op_type="_TF1WhileFrame",
+                    inputs=list(p.init_tensors) + list(p.cap_union),
+                    outputs=list(p.out_tensors), attrs={"plan": i}))
+            g = IRGraph(framework=g.framework, nodes=kept,
+                        initializers=g.initializers, inputs=g.inputs,
+                        outputs=g.outputs)
+
         unmapped = sorted({n.op_type for n in g.nodes
                            if get_mapper(g.framework, n.op_type) is None
-                           and n.op_type not in _FOLD})
+                           and n.op_type not in _FOLD
+                           and n.op_type != "_TF1WhileFrame"})
         if unmapped:
             raise ImportException(
                 f"no tensorflow mapping rule for op type(s): {unmapped}")
@@ -203,6 +223,9 @@ class TFGraphImporter:
 
         known = set(g.initializers) | set(g.inputs)
         for node in _toposort(g.nodes, known):
+            if node.op_type == "_TF1WhileFrame":
+                plans[node.attrs["plan"]].emit(ctx)
+                continue
             folder = _FOLD.get(node.op_type)
             if folder is not None and all(i in ctx.const_np
                                           for i in node.inputs):

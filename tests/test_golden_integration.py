@@ -26,6 +26,11 @@ class TestGoldenMLP:
     GOLDEN_LOSSES = [1.558639, 1.519035, 1.48349, 1.451367, 1.422158]
     GOLDEN_FINAL_SCORE = 1.395449
 
+    @pytest.fixture(scope="class")
+    def run_once(self):
+        """Deterministic fixed-seed run shared by the class's tests."""
+        return self._run()
+
     def _run(self):
         conf = (NeuralNetConfiguration.builder()
                 .seed(12345).updater(Sgd(learning_rate=0.1)).list()
@@ -42,19 +47,19 @@ class TestGoldenMLP:
             losses.append(net.score_value)
         return net, x, y, losses
 
-    def test_loss_trajectory_matches_golden(self):
-        _, _, _, losses = self._run()
+    def test_loss_trajectory_matches_golden(self, run_once):
+        _, _, _, losses = run_once
         np.testing.assert_allclose(losses, self.GOLDEN_LOSSES, rtol=2e-3)
 
-    def test_post_training_score(self):
-        net, x, y, _ = self._run()
+    def test_post_training_score(self, run_once):
+        net, x, y, _ = run_once
         from deeplearning4j_tpu.datasets.dataset import DataSet
         score = net.score(DataSet(x, y))
         np.testing.assert_allclose(score, self.GOLDEN_FINAL_SCORE,
                                    rtol=2e-3)
 
-    def test_serde_preserves_golden_outputs(self, tmp_path):
-        net, x, _, _ = self._run()
+    def test_serde_preserves_golden_outputs(self, tmp_path, run_once):
+        net, x, _, _ = run_once
         path = str(tmp_path / "golden.zip")
         net.save(path)
         from deeplearning4j_tpu.nn.serde import restore_model

@@ -470,3 +470,76 @@ class TestKerasImport:
         r1, r2 = [o.numpy() for o in net.output(x)]
         np.testing.assert_allclose(r1, g1, atol=1e-5)
         np.testing.assert_allclose(r2, g2, atol=1e-5)
+
+
+class TestTF1WhileImport:
+    """TF1 control-flow frames (Enter/Merge/Switch/Exit) lower to
+    lax.while_loop (while_frames.py)."""
+
+    @pytest.fixture
+    def _v1_control_flow(self):
+        tf1.disable_control_flow_v2()
+        try:
+            yield
+        finally:
+            tf1.enable_control_flow_v2()
+
+    def test_reference_frozen_model_while(self):
+        path = f"{REF}/frozen_model_while.pb"
+        if not os.path.exists(path):
+            pytest.skip("reference fixture not present")
+        with open(path, "rb") as f:
+            data = f.read()
+        imp = import_tf_graph(data, outputs=["while/Exit", "while/Exit_1"])
+        res = imp.output({}, ["while/Exit", "while/Exit_1"])
+        gd = tf1.GraphDef()
+        gd.ParseFromString(data)
+        g = tf.Graph()
+        with g.as_default():
+            tf.import_graph_def(gd, name="")
+        with tf1.Session(graph=g) as s:
+            golden = s.run(["while/Exit:0", "while/Exit_1:0"])
+        np.testing.assert_allclose(res["while/Exit"].numpy(), golden[0])
+        np.testing.assert_allclose(res["while/Exit_1"].numpy(), golden[1])
+
+    def test_synthetic_while_with_placeholder(self, _v1_control_flow):
+        g = tf.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [], name="x")
+            i0 = tf.constant(0.0)
+            s0 = tf.constant(1.0)
+            _, out = tf1.while_loop(
+                lambda i, s: tf.less(i, 6.0),
+                lambda i, s: (tf.add(i, 1.0), tf.multiply(s, x)),
+                [i0, s0])
+            tf.identity(out, name="result")
+        pb = g.as_graph_def().SerializeToString()
+        with tf1.Session(graph=g) as sess:
+            golden = sess.run("result:0", {"x:0": 1.5})
+        imp = import_tf_graph(pb, input_shapes={"x": ()},
+                              outputs=["result"])
+        res = imp.output({"x": np.float32(1.5)}, ["result"])["result"]
+        np.testing.assert_allclose(res.numpy(), golden, rtol=1e-6)
+        np.testing.assert_allclose(res.numpy(), 1.5 ** 6, rtol=1e-6)
+
+    def test_two_sequential_while_loops(self, _v1_control_flow):
+        """Regression: a later loop whose bound depends on an earlier
+        loop's Exit must not be misread as nested frames."""
+        g = tf.Graph()
+        with g.as_default():
+            i0 = tf.constant(0.0)
+            _, out1 = tf1.while_loop(
+                lambda i, s: tf.less(i, 3.0),
+                lambda i, s: (tf.add(i, 1.0), tf.add(s, 2.0)),
+                [i0, tf.constant(0.0)], name="loopA")
+            _, out2 = tf1.while_loop(
+                lambda i, s: tf.less(i, out1),
+                lambda i, s: (tf.add(i, 1.0), tf.add(s, i)),
+                [tf.constant(0.0), tf.constant(0.0)], name="loopB")
+            tf.identity(out2, name="result")
+        pb = g.as_graph_def().SerializeToString()
+        with tf1.Session(graph=g) as sess:
+            golden = sess.run("result:0")
+        imp = import_tf_graph(pb, outputs=["result"])
+        res = imp.output({}, ["result"])["result"].numpy()
+        np.testing.assert_allclose(res, golden)
