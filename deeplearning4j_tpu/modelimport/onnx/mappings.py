@@ -411,3 +411,61 @@ def _shape(node, ctx):
 def _einsum(node, ctx):
     ctx.emit("einsum", [ctx.get(i) for i in node.inputs], node.outputs[0],
              equation=node.attrs.get("equation"))
+
+
+@mapper(ONNX, "LSTM")
+def _lstm(node, ctx):
+    """ONNX LSTM -> lstmLayer. ONNX gate order is [i, o, f, c]; the
+    registered op uses [i, f, g(c), o] — weight blocks are reordered at
+    import (weights must be initializers, as exported models' are).
+
+    Layout: X [T, B, In]; W [1, 4H, In]; R [1, 4H, H]; B [1, 8H].
+    Outputs: Y [T, 1, B, H], Y_h [1, B, H], Y_c [1, B, H]."""
+    if node.attrs.get("direction", "forward") != "forward":
+        raise ImportException("only forward ONNX LSTM supported")
+    for attr in ("activations", "activation_alpha", "activation_beta",
+                 "clip", "input_forget"):
+        if node.attrs.get(attr):
+            raise ImportException(f"ONNX LSTM attr {attr!r} not supported")
+    if int(node.attrs.get("layout", 0)) != 0:
+        raise ImportException("ONNX LSTM layout=1 (batch-major) not "
+                              "supported; export with layout=0")
+    if len(node.inputs) > 4 and node.inputs[4]:
+        raise ImportException("ONNX LSTM sequence_lens not supported")
+    if len(node.inputs) > 7 and node.inputs[7]:
+        raise ImportException("ONNX LSTM peepholes (P) not supported")
+    H = int(node.attrs["hidden_size"])
+    w_np = ctx.const_value(node.inputs[1])[0]     # [4H, In]
+    r_np = ctx.const_value(node.inputs[2])[0]     # [4H, H]
+    b_np = ctx.const_value(node.inputs[3])[0] if len(node.inputs) > 3 and \
+        node.inputs[3] else np.zeros(8 * H, np.float32)
+    h0 = c0 = None
+    if len(node.inputs) > 5 and node.inputs[5]:   # initial_h [1, B, H]
+        h0 = ctx.sd._record("squeeze", [ctx.get(node.inputs[5])], axis=0)
+    if len(node.inputs) > 6 and node.inputs[6]:   # initial_c
+        c0 = ctx.sd._record("squeeze", [ctx.get(node.inputs[6])], axis=0)
+
+    def reorder(m):  # [4H, ...] blocks [i,o,f,c] -> [i,f,c,o]
+        i, o, f, c = np.split(m, 4, axis=0)
+        return np.concatenate([i, f, c, o], axis=0)
+
+    wx = ctx.sd.constant(reorder(w_np).T, node.name + "_wx")   # [In, 4H]
+    wh = ctx.sd.constant(reorder(r_np).T, node.name + "_wh")   # [H, 4H]
+    bias = ctx.sd.constant(
+        reorder((b_np[:4 * H] + b_np[4 * H:]).reshape(4, H)).reshape(-1),
+        node.name + "_b")
+    x = ctx.get(node.inputs[0])
+    lstm_in = [x, wx, wh, bias]
+    if h0 is not None or c0 is not None:
+        lstm_in += [h0, c0]
+    h_seq, h_last, c_last = ctx.sd._record(
+        "lstmLayer", lstm_in, n_outputs=3,
+        out_name=node.name.replace(":", "_"), time_major=True)
+    # ONNX inserts a num_directions axis
+    outs = node.outputs
+    if len(outs) > 0 and outs[0]:
+        ctx.emit("expand_dims", [h_seq], outs[0], axis=1)
+    if len(outs) > 1 and outs[1]:
+        ctx.emit("expand_dims", [h_last], outs[1], axis=0)
+    if len(outs) > 2 and outs[2]:
+        ctx.emit("expand_dims", [c_last], outs[2], axis=0)

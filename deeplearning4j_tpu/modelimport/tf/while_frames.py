@@ -154,11 +154,12 @@ def _build_subgraph(graph: IRGraph, interior: List[IRNode],
 
     produced = {o for n in interior for o in n.outputs} | set(var_aliases)
     captured: List[str] = []
-    for n in interior:
-        for i in n.inputs:
-            if i not in produced and i not in graph.initializers and \
-                    i not in captured:
-                captured.append(i)
+    # interior inputs AND requested outputs may live outside the frame
+    # (e.g. a loop var whose update is a loop-invariant outer expression)
+    for t in [i for n in interior for i in n.inputs] + list(out_tensors):
+        if t not in produced and t not in graph.initializers and \
+                t not in captured:
+            captured.append(t)
     # captured outer tensors appear as extra placeholders named verbatim
     for c in captured:
         ctx.bind(c, sub_sd.placeholder(c.replace(":", "_")))

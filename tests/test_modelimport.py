@@ -543,3 +543,114 @@ class TestTF1WhileImport:
         imp = import_tf_graph(pb, outputs=["result"])
         res = imp.output({}, ["result"])["result"].numpy()
         np.testing.assert_allclose(res, golden)
+
+
+class TestOnnxLSTM:
+    def test_lstm_matches_numpy(self):
+        rs = np.random.RandomState(0)
+        T, B, In, H = 5, 2, 3, 4
+        W = rs.randn(1, 4 * H, In).astype(np.float32) * 0.4
+        R = rs.randn(1, 4 * H, H).astype(np.float32) * 0.4
+        Bb = rs.randn(1, 8 * H).astype(np.float32) * 0.1
+
+        gw = pio.Writer()
+        gw.msg(1, _onnx_node("LSTM", ["x", "W", "R", "B"],
+                             ["Y", "Y_h", "Y_c"], hidden_size=H))
+        gw.str_(2, "lstm")
+        for name, arr in (("W", W), ("R", R), ("B", Bb)):
+            gw.msg(5, _onnx_tensor(name, arr))
+        gw.msg(11, _onnx_vi("x", (T, B, In)))
+        gw.msg(12, _onnx_vi("Y", (T, 1, B, H)))
+        data = pio.Writer().int_(1, 8).msg(7, gw).build()
+
+        imp = import_onnx_model(data)
+        x = rs.randn(T, B, In).astype(np.float32)
+        res = imp.output({"x": x}, ["Y", "Y_h"])
+        y = res["Y"].numpy()
+        assert y.shape == (T, 1, B, H)
+
+        # numpy reference with ONNX [i,o,f,c] gate order
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+        Wi, Wo, Wf, Wc = np.split(W[0], 4, axis=0)
+        Ri, Ro, Rf, Rc = np.split(R[0], 4, axis=0)
+        wb, rb = Bb[0][:4 * H], Bb[0][4 * H:]
+        bi, bo, bf, bc = np.split(wb + rb, 4)
+        h = np.zeros((B, H), np.float32)
+        c = np.zeros((B, H), np.float32)
+        ys = []
+        for t in range(T):
+            xt = x[t]
+            i = sig(xt @ Wi.T + h @ Ri.T + bi)
+            o = sig(xt @ Wo.T + h @ Ro.T + bo)
+            f = sig(xt @ Wf.T + h @ Rf.T + bf)
+            g = np.tanh(xt @ Wc.T + h @ Rc.T + bc)
+            c = f * c + i * g
+            h = o * np.tanh(c)
+            ys.append(h.copy())
+        ref = np.stack(ys)[:, None]
+        np.testing.assert_allclose(y, ref, atol=1e-5)
+        np.testing.assert_allclose(res["Y_h"].numpy()[0], ys[-1], atol=1e-5)
+
+
+class TestTF1WhileImportEdgeCases:
+    @pytest.fixture
+    def _v1_control_flow(self):
+        tf1.disable_control_flow_v2()
+        try:
+            yield
+        finally:
+            tf1.enable_control_flow_v2()
+
+    def test_loop_invariant_body_output(self, _v1_control_flow):
+        """Regression: a loop var updated to a loop-invariant OUTER
+        expression must be captured, not treated as interior."""
+        g = tf.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [], name="x")
+            outer = tf.add(x, 1.0)
+            _, out = tf1.while_loop(
+                lambda i, s: tf.less(i, 3.0),
+                lambda i, s: (tf.add(i, 1.0), tf.identity(outer)),
+                [tf.constant(0.0), tf.constant(0.0)])
+            tf.identity(out, name="result")
+        pb = g.as_graph_def().SerializeToString()
+        with tf1.Session(graph=g) as sess:
+            golden = sess.run("result:0", {"x:0": 2.0})
+        imp = import_tf_graph(pb, input_shapes={"x": ()},
+                              outputs=["result"])
+        res = imp.output({"x": np.float32(2.0)}, ["result"])["result"]
+        np.testing.assert_allclose(res.numpy(), golden)  # == 3.0
+
+    def test_lstm_initial_state_and_unsupported(self):
+        rs = np.random.RandomState(1)
+        T, B, In, H = 3, 2, 3, 4
+        W = rs.randn(1, 4 * H, In).astype(np.float32) * 0.3
+        R = rs.randn(1, 4 * H, H).astype(np.float32) * 0.3
+        Bb = np.zeros((1, 8 * H), np.float32)
+        h0 = rs.randn(1, B, H).astype(np.float32) * 0.5
+        c0 = rs.randn(1, B, H).astype(np.float32) * 0.5
+
+        def build(extra_inputs, **attrs):
+            gw = pio.Writer()
+            gw.msg(1, _onnx_node("LSTM",
+                                 ["x", "W", "R", "B"] + extra_inputs,
+                                 ["Y"], hidden_size=H, **attrs))
+            gw.str_(2, "lstm2")
+            arrays = {"W": W, "R": R, "B": Bb, "h0": h0, "c0": c0}
+            for name in ["W", "R", "B"] + [e for e in extra_inputs if e]:
+                gw.msg(5, _onnx_tensor(name, arrays[name]))
+            gw.msg(11, _onnx_vi("x", (T, B, In)))
+            gw.msg(12, _onnx_vi("Y", (T, 1, B, H)))
+            return pio.Writer().int_(1, 8).msg(7, gw).build()
+
+        x = rs.randn(T, B, In).astype(np.float32)
+        # with initial state: first step differs from the zero-state run
+        imp0 = import_onnx_model(build([]))
+        imp1 = import_onnx_model(build(["", "h0", "c0"]))
+        y0 = imp0.output({"x": x}, ["Y"])["Y"].numpy()
+        y1 = imp1.output({"x": x}, ["Y"])["Y"].numpy()
+        assert not np.allclose(y0[0], y1[0])
+        # unsupported layout raises a clear error
+        with pytest.raises(ImportException, match="layout"):
+            import_onnx_model(build([], layout=1))
