@@ -116,6 +116,9 @@ def _toposort(nodes: List[IRNode], known: set) -> List[IRNode]:
             if t in known:
                 continue
             prod = by_out.get(t)
+            if prod is None and ":" in t:
+                # secondary outputs (e.g. Switch:1) alias the :0 producer
+                prod = by_out.get(t.split(":")[0] + ":0")
             if prod is not None:
                 visit(prod)
         state[n.name] = 1

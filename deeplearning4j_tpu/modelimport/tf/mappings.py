@@ -107,6 +107,83 @@ def _noop(node, ctx):
     pass
 
 
+# -- TF1 cond (frameless Switch/Merge) ------------------------------------
+# While-loop frames are consumed by while_frames.py before mapping, so any
+# Switch/Merge reaching these rules belongs to a tf.cond region. XLA
+# computes both branches anyway (no frames), so Switch passes its value
+# through on both ports and Merge becomes an elementwise select on the
+# Switch predicate — exact for the side-effect-free graphs freezing
+# produces.
+
+@mapper(TF, "Switch")
+def _switch(node, ctx):
+    v = ctx.get(node.inputs[0])
+    ctx.bind(f"{node.name}:0", v, aval=ctx.aval(node.inputs[0]))
+    ctx.bind(f"{node.name}:1", v, aval=ctx.aval(node.inputs[0]))
+    ctx.bind(node.outputs[0], v, aval=ctx.aval(node.inputs[0]))
+
+
+def _trace_switch_port(ctx, tensor):
+    """Which Switch port (0=false, 1=true) a tensor derives from, and the
+    Switch's predicate. Stops at intervening Merge nodes (an inner cond's
+    output is branch *data* for the outer cond, not its routing)."""
+    seen = set()
+    stack = [tensor]
+    while stack:
+        t = stack.pop()
+        if t in seen:
+            continue
+        seen.add(t)
+        prod = ctx.producer(t)
+        if prod is None:
+            base = t.split(":")[0]
+            prod = ctx.producer(base + ":0")
+        if prod is None:
+            continue
+        if prod.op_type == "Switch":
+            port = int(t.split(":")[1]) if ":" in t else 0
+            return port, prod.inputs[1]
+        if prod.op_type == "Merge":
+            continue  # frame boundary of an inner cond
+        stack.extend(prod.inputs)
+    return None, None
+
+
+@mapper(TF, "Merge")
+def _merge(node, ctx):
+    if len(node.inputs) != 2:
+        raise ImportException(
+            f"Merge {node.name!r}: {len(node.inputs)}-way merges (tf.case) "
+            f"are not supported")
+    for n in ctx.graph.nodes:
+        if f"{node.name}:1" in n.inputs:
+            raise ImportException(
+                f"Merge {node.name!r}: its value_index output is consumed "
+                f"by {n.name!r} — runtime branch indices are not "
+                f"representable in a frameless lowering")
+    a_port, a_pred = _trace_switch_port(ctx, node.inputs[0])
+    b_port, b_pred = _trace_switch_port(ctx, node.inputs[1])
+    if a_pred is not None and b_pred is not None and a_pred != b_pred:
+        raise ImportException(
+            f"Merge {node.name!r}: inputs route through different "
+            f"predicates ({a_pred!r} vs {b_pred!r})")
+    pred = a_pred if a_pred is not None else b_pred
+    # a branch with no data-path Switch (e.g. a constant branch) infers
+    # the complementary port
+    if a_port is None and b_port is not None:
+        a_port = 1 - b_port
+    if b_port is None and a_port is not None:
+        b_port = 1 - a_port
+    if pred is None or a_port == b_port:
+        raise ImportException(
+            f"Merge {node.name!r}: cannot identify its cond branches "
+            f"(ports {a_port}/{b_port}) — unsupported control-flow shape")
+    true_t = node.inputs[0] if a_port == 1 else node.inputs[1]
+    false_t = node.inputs[1] if a_port == 1 else node.inputs[0]
+    ctx.emit("select", [ctx.get(pred), ctx.get(true_t), ctx.get(false_t)],
+             node.outputs[0])
+
+
 # -- matmul family --------------------------------------------------------
 @mapper(TF, "MatMul")
 def _matmul(node, ctx):

@@ -654,3 +654,76 @@ class TestTF1WhileImportEdgeCases:
         # unsupported layout raises a clear error
         with pytest.raises(ImportException, match="layout"):
             import_onnx_model(build([], layout=1))
+
+
+class TestTF1CondImport:
+    @pytest.fixture
+    def _v1_control_flow(self):
+        tf1.disable_control_flow_v2()
+        try:
+            yield
+        finally:
+            tf1.enable_control_flow_v2()
+
+    def test_cond_both_branches(self, _v1_control_flow):
+        g = tf.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [3], name="x")
+            p = tf1.placeholder(tf.bool, [], name="p")
+            out = tf.cond(p, lambda: x * 2.0 + 1.0, lambda: x - 5.0)
+            tf.identity(out, name="result")
+        pb = g.as_graph_def().SerializeToString()
+        xs = np.asarray([1.0, 2.0, 3.0], np.float32)
+        for flag in (True, False):
+            with tf1.Session(graph=g) as sess:
+                golden = sess.run("result:0", {"x:0": xs, "p:0": flag})
+            imp = import_tf_graph(pb, input_shapes={"x": (3,), "p": ()},
+                                  outputs=["result"])
+            res = imp.output({"x": xs, "p": np.asarray(flag)},
+                             ["result"])["result"].numpy()
+            np.testing.assert_allclose(res, golden)
+
+    def test_cond_constant_branch(self, _v1_control_flow):
+        """One branch with no data-path Switch (a constant) must not flip
+        the select orientation."""
+        g = tf.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [], name="x")
+            p = tf1.placeholder(tf.bool, [], name="p")
+            out = tf.cond(p, lambda: tf.constant(7.0), lambda: x - 5.0)
+            tf.identity(out, name="result")
+        pb = g.as_graph_def().SerializeToString()
+        for flag in (True, False):
+            with tf1.Session(graph=g) as sess:
+                golden = sess.run("result:0", {"x:0": 2.0, "p:0": flag})
+            imp = import_tf_graph(pb, input_shapes={"x": (), "p": ()},
+                                  outputs=["result"])
+            res = imp.output({"x": np.float32(2.0), "p": np.asarray(flag)},
+                             ["result"])["result"].numpy()
+            np.testing.assert_allclose(res, golden), flag
+
+    def test_nested_cond(self, _v1_control_flow):
+        g = tf.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [], name="x")
+            p = tf1.placeholder(tf.bool, [], name="p")
+            q = tf1.placeholder(tf.bool, [], name="q")
+            out = tf.cond(p,
+                          lambda: tf.cond(q, lambda: x * 2.0,
+                                          lambda: x * 3.0),
+                          lambda: x - 1.0)
+            tf.identity(out, name="result")
+        pb = g.as_graph_def().SerializeToString()
+        for pv in (True, False):
+            for qv in (True, False):
+                with tf1.Session(graph=g) as sess:
+                    golden = sess.run("result:0",
+                                      {"x:0": 5.0, "p:0": pv, "q:0": qv})
+                imp = import_tf_graph(
+                    pb, input_shapes={"x": (), "p": (), "q": ()},
+                    outputs=["result"])
+                res = imp.output({"x": np.float32(5.0),
+                                  "p": np.asarray(pv),
+                                  "q": np.asarray(qv)},
+                                 ["result"])["result"].numpy()
+                np.testing.assert_allclose(res, golden), (pv, qv)
