@@ -276,16 +276,21 @@ class BatchNormalization(Layer):
     def forward(self, params, x, training=False, key=None):
         axis = 1 if x.ndim >= 3 else -1  # NCHW channel axis; FF feature axis
         reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+        # batch statistics always in f32: under bf16 compute (conf.dtype) a
+        # bf16 mean/var over large reduce axes loses too many mantissa bits
+        xf = x.astype(jnp.float32)
         if training:
-            mean = jnp.mean(x, axis=reduce_axes)
-            var = jnp.var(x, axis=reduce_axes)
+            mean = jnp.mean(xf, axis=reduce_axes)
+            var = jnp.var(xf, axis=reduce_axes)
         else:
             mean, var = params["state_mean"], params["state_var"]
-        return nn_ops.batchnorm(x, mean, var, params.get("gamma"),
-                                params.get("beta"), self.eps, axis)
+        out = nn_ops.batchnorm(xf, mean, var, params.get("gamma"),
+                               params.get("beta"), self.eps, axis)
+        return out.astype(x.dtype)
 
     def new_state(self, params, x, labels=None):
         """Updated running stats given a training batch (applied by the net)."""
+        x = x.astype(jnp.float32)  # running stats are f32 master state
         axis = 1 if x.ndim >= 3 else -1
         reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
         mean = jnp.mean(x, axis=reduce_axes)

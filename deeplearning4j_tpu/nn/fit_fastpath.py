@@ -1,0 +1,175 @@
+"""Shared fit fast path + mixed precision for the layer-API networks.
+
+MultiLayerNetwork and ComputationGraph both train through this mixin:
+
+- **Mixed precision** (reference `DataType.HALF` networks / configuration
+  dataType): with ``conf.dtype = "bfloat16"`` the layer *body* runs in bf16
+  (MXU-native operands) while master params, updater state, BN running stats,
+  and the loss head stay f32.
+- **Scanned epochs**: finite data sources are staged to device once and, when
+  no listener overrides per-iteration callbacks, a whole epoch runs as ONE
+  jitted `lax.scan` — no per-step dispatch, no per-step `float(loss)` host
+  sync. The reference's per-iteration fit loop
+  (`MultiLayerNetwork.java:1684`) has no analog of this; workspaces only
+  amortize allocation, not dispatch.
+
+Subclasses provide `_step_fn()` (un-jitted single-batch step with signature
+``step(trainable, states, ustate, iteration, data, labels, key)``),
+`_materialize_batches(data)`, `_coerce_fit_data(data, labels)`, and the class
+attr `_DONATE` (which step args are donated to XLA).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class FitFastPathMixin:
+    _DONATE = (0, 1, 2)
+
+    # -- mixed precision -------------------------------------------------
+    def _compute_dtype(self):
+        """conf.dtype as a jnp dtype, or None for plain f32 (no casting)."""
+        cd = str(getattr(self.conf, "dtype", "float32") or "float32")
+        return None if cd in ("float32", "f32", "FLOAT") else jnp.dtype(cd)
+
+    @staticmethod
+    def _cast_layer_params(p, dt):
+        return {k: (v.astype(dt)
+                    if (not k.startswith("state_") and hasattr(v, "dtype")
+                        and jnp.issubdtype(v.dtype, jnp.floating)) else v)
+                for k, v in p.items()}
+
+    @staticmethod
+    def _cast_act(h, dt):
+        return h.astype(dt) if jnp.issubdtype(h.dtype, jnp.floating) else h
+
+    # -- jitted steps ----------------------------------------------------
+    def _build_train_step(self):
+        return jax.jit(self._step_fn(), donate_argnums=self._DONATE)
+
+    def _build_epoch_step(self):
+        """One jitted lax.scan over a whole epoch of stacked batches."""
+        base = self._step_fn()
+
+        def epoch(trainable, states, updater_state, it0, data, labels, keys):
+            def body(carry, inp):
+                tr, st, us, it = carry
+                x, y, k = inp
+                tr, st, us, loss = base(tr, st, us, it, x, y, k)
+                return (tr, st, us, it + 1), loss
+
+            (tr, st, us, _), losses = jax.lax.scan(
+                body, (trainable, states, updater_state, it0),
+                (data, labels, keys))
+            return tr, st, us, losses
+
+        return jax.jit(epoch, donate_argnums=self._DONATE)
+
+    def _step_keys(self, n):
+        """The same key sequence the per-step path would draw (split chain),
+        stacked for scan."""
+        keys = []
+        k = self._rng_key
+        for _ in range(n):
+            k, s = jax.random.split(k)
+            keys.append(s)
+        self._rng_key = k
+        return jnp.stack(keys)
+
+    @staticmethod
+    def _listener_overrides(lst, name):
+        """True if the listener meaningfully implements `name` (a duck-typed
+        method, or a TrainingListener subclass that overrides the base no-op
+        — attaching e.g. a CheckpointListener must not force the slow
+        per-step path)."""
+        if not hasattr(lst, name):
+            return False
+        from .listeners import TrainingListener
+        if isinstance(lst, TrainingListener):
+            return getattr(type(lst), name) is not getattr(TrainingListener,
+                                                           name)
+        return True
+
+    # -- fit -------------------------------------------------------------
+    def fit(self, data, labels=None, num_epochs: int = 1):
+        """Train. Accepts a DataSet(/MultiDataSet for graphs), a list of
+        them, a DataSetIterator, or (features, labels).
+
+        Finite sources are staged to device once per call; with no listener
+        overriding `iteration_done`, each epoch is ONE jitted lax.scan.
+        """
+        self._check_init()
+        data = self._coerce_fit_data(data, labels)
+        batches = self._materialize_batches(data)
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+            self._epoch_step = None
+
+        trainable = self._trainable(self._params)
+        states = self._states(self._params)
+        ustate = self._updater_state
+
+        iter_listeners = [l for l in self._listeners
+                          if self._listener_overrides(l, "iteration_done")]
+        epoch_listeners = [l for l in self._listeners
+                           if self._listener_overrides(l, "on_epoch_end")]
+
+        def sig(b):
+            return jax.tree_util.tree_map(lambda a: (a.shape, str(a.dtype)), b)
+
+        use_scan = (batches is not None and batches and not iter_listeners
+                    and all(sig(b) == sig(batches[0]) for b in batches[1:]))
+        loss = None
+        if use_scan:
+            if getattr(self, "_epoch_step", None) is None:
+                self._epoch_step = self._build_epoch_step()
+            n = len(batches)
+            xs, ys = jax.tree_util.tree_map(lambda *a: jnp.stack(a), *batches)
+            batches = None  # free the unstacked device copies
+            for _ in range(num_epochs):
+                keys = self._step_keys(n)
+                trainable, states, ustate, losses = self._epoch_step(
+                    trainable, states, ustate,
+                    jnp.asarray(self._iteration, jnp.int32), xs, ys, keys)
+                # the donated buffers self._params aliased are now invalid —
+                # repoint live model state before anything can observe it
+                self._params = self._merge_states(trainable, states)
+                self._updater_state = ustate
+                self._iteration += n
+                loss = losses[-1]
+                self._epoch += 1
+                if epoch_listeners:
+                    self.score_value = float(loss)
+                    for lst in epoch_listeners:
+                        lst.on_epoch_end(self._epoch, self)
+        else:
+            for _ in range(num_epochs):
+                if batches is None and hasattr(data, "reset"):
+                    data.reset()
+                for item in (batches if batches is not None else data):
+                    x, y = item if batches is not None \
+                        else self._stage_batch(item)
+                    self._rng_key, step_key = jax.random.split(self._rng_key)
+                    trainable, states, ustate, loss = self._train_step(
+                        trainable, states, ustate, self._iteration, x, y,
+                        step_key)
+                    self._params = self._merge_states(trainable, states)
+                    self._updater_state = ustate
+                    if iter_listeners:
+                        self.score_value = float(loss)
+                        for lst in iter_listeners:
+                            lst.iteration_done(self, self._iteration,
+                                               loss=self.score_value)
+                    self._iteration += 1
+                self._epoch += 1
+                if epoch_listeners:
+                    if loss is not None:
+                        self.score_value = float(loss)
+                    for lst in epoch_listeners:
+                        lst.on_epoch_end(self._epoch, self)
+        self._params = self._merge_states(trainable, states)
+        self._updater_state = ustate
+        if loss is not None:
+            self.score_value = float(loss)
+        return self

@@ -24,6 +24,7 @@ from ...learning import IUpdater, Sgd
 from ...ndarray.ndarray import NDArray
 from ..conf import layers as L
 from ..conf.config import infer_preprocessor
+from ..fit_fastpath import FitFastPathMixin
 from .vertices import VERTEX_CLASSES, GraphVertex, PreprocessorVertex
 
 
@@ -77,6 +78,7 @@ class ComputationGraphConfiguration:
     weight_decay: float = 0.0
     gradient_normalization: Optional[str] = None
     gradient_clip: float = 1.0
+    dtype: str = "float32"
 
     def topological_order(self) -> List[str]:
         """Kahn topological sort (reference ComputationGraph.java:484-515)."""
@@ -158,7 +160,7 @@ class ComputationGraphConfiguration:
             "seed": self.seed, "l1": self.l1, "l2": self.l2,
             "weight_decay": self.weight_decay,
             "gradient_normalization": self.gradient_normalization,
-            "gradient_clip": self.gradient_clip,
+            "gradient_clip": self.gradient_clip, "dtype": self.dtype,
         }, indent=1, default=str)
 
     @staticmethod
@@ -216,7 +218,8 @@ class ComputationGraphConfiguration:
             seed=data.get("seed", 12345), l1=data.get("l1", 0.0),
             l2=data.get("l2", 0.0), weight_decay=data.get("weight_decay", 0.0),
             gradient_normalization=data.get("gradient_normalization"),
-            gradient_clip=data.get("gradient_clip", 1.0))
+            gradient_clip=data.get("gradient_clip", 1.0),
+            dtype=data.get("dtype", "float32"))
 
 
 class GraphBuilder:
@@ -268,6 +271,7 @@ class GraphBuilder:
             conf.weight_decay = b._weight_decay
             conf.gradient_normalization = b._grad_norm
             conf.gradient_clip = b._grad_clip
+            conf.dtype = b._dtype
         # auto-insert preprocessors from inferred types (reference
         # GraphBuilder.setInputTypes shape-inference pass)
         if self._input_types:
@@ -287,8 +291,10 @@ class GraphBuilder:
         return conf
 
 
-class ComputationGraph:
+class ComputationGraph(FitFastPathMixin):
     """Reference org/deeplearning4j/nn/graph/ComputationGraph.java."""
+
+    _DONATE = (0, 2)
 
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
@@ -300,6 +306,7 @@ class ComputationGraph:
         self._epoch = 0
         self._listeners: List[Any] = []
         self._train_step = None
+        self._epoch_step = None
         self._rng_key = jax.random.key(conf.seed)
         self._initialized = False
         self._mesh = None
@@ -368,12 +375,22 @@ class ComputationGraph:
         """Topological forward. With collect_state, also returns each stateful
         vertex's actual layer input (post-preprocessor) so the train step can
         refresh running state (batchnorm etc.) without a second pass."""
+        cd = self._compute_dtype()
         acts: Dict[str, jax.Array] = dict(inputs)
+        if cd is not None:
+            acts = {k: self._cast_act(v, cd) for k, v in acts.items()}
+        out_set = set(self.conf.outputs)
         state_inputs: Dict[str, jax.Array] = {}
         stateful = set(self._stateful_vertices()) if collect_state else ()
         for name in self._order:
             v = self.conf.vertices[name]
             ins = [acts[i] for i in self.conf.vertex_inputs[name]]
+            p = params[name]
+            if cd is not None:
+                if name in out_set:  # loss head stays f32
+                    ins = [self._cast_act(a, jnp.float32) for a in ins]
+                else:
+                    p = self._cast_layer_params(p, cd)
             if name in stateful:
                 si = ins[0]
                 pre = getattr(v, "preprocessor", None)
@@ -383,7 +400,7 @@ class ComputationGraph:
             vkey = None
             if training and key is not None and v.needs_key():
                 key, vkey = jax.random.split(key)
-            acts[name] = v.forward(params[name], ins, training=training, key=vkey)
+            acts[name] = v.forward(p, ins, training=training, key=vkey)
         if collect_state:
             return acts, state_inputs
         return acts
@@ -486,7 +503,9 @@ class ComputationGraph:
             labs = [self._shard_batch(_unwrap(ds.labels))]
         return {n: x for n, x in zip(self.conf.inputs, feats)}, labs
 
-    def _build_train_step(self):
+    def _step_fn(self):
+        """Un-jitted single-batch train step (shared by per-step jit and the
+        scanned epoch jit — see MultiLayerNetwork._build_epoch_step)."""
         updater = self.conf.updater
         grad_norm = self.conf.gradient_normalization
         grad_clip = self.conf.gradient_clip
@@ -529,47 +548,27 @@ class ComputationGraph:
                 lambda p, u: p - u.astype(p.dtype) - wd * p, trainable, update)
             return new_trainable, states, updater_state, loss
 
-        return jax.jit(step, donate_argnums=(0, 2))
+        return step
 
-    def fit(self, data, labels=None, num_epochs: int = 1):
-        """Train (reference ComputationGraph.fit). Accepts a DataSet,
-        MultiDataSet, iterator of either, or (features, labels)."""
-        self._check_init()
-        if labels is not None:
-            data = DataSet(data, labels)
+    def _coerce_fit_data(self, data, labels):
+        return DataSet(data, labels) if labels is not None else data
+
+    def _stage_batch(self, item):
+        return self._split_dataset(item)
+
+    def _materialize_batches(self, data):
+        """Device-resident [(inputs, labels)] for finite reusable sources."""
+        from ...datasets.iterators import ListDataSetIterator
         if isinstance(data, (DataSet, MultiDataSet)):
-            data = [data]
-        if self._train_step is None:
-            self._train_step = self._build_train_step()
-
-        trainable = self._trainable(self._params)
-        states = self._states(self._params)
-        ustate = self._updater_state
-
-        for _ in range(num_epochs):
-            if hasattr(data, "reset"):
-                data.reset()
-            for ds in data:
-                inputs, labs = self._split_dataset(ds)
-                self._rng_key, step_key = jax.random.split(self._rng_key)
-                trainable, states, ustate, loss = self._train_step(
-                    trainable, states, ustate, self._iteration, inputs, labs,
-                    step_key)
-                self._params = self._merge_states(trainable, states)
-                self._updater_state = ustate
-                self.score_value = float(loss)
-                for lst in self._listeners:
-                    if hasattr(lst, "iteration_done"):
-                        lst.iteration_done(self, self._iteration,
-                                           loss=self.score_value)
-                self._iteration += 1
-            self._epoch += 1
-            for lst in self._listeners:
-                if hasattr(lst, "on_epoch_end"):
-                    lst.on_epoch_end(self._epoch, self)
-        self._params = self._merge_states(trainable, states)
-        self._updater_state = ustate
-        return self
+            items = [data]
+        elif isinstance(data, (list, tuple)) and data and \
+                all(isinstance(d, (DataSet, MultiDataSet)) for d in data):
+            items = list(data)
+        elif isinstance(data, ListDataSetIterator):
+            items = list(data._list)
+        else:
+            return None
+        return [self._split_dataset(d) for d in items]
 
     # -- evaluation ------------------------------------------------------
     def evaluate(self, iterator):

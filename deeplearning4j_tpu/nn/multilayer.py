@@ -25,13 +25,14 @@ from ..learning import IUpdater
 from ..ndarray.ndarray import NDArray
 from .conf.config import MultiLayerConfiguration
 from .conf.layers import BatchNormalization, LossLayer, OutputLayer, RnnOutputLayer
+from .fit_fastpath import FitFastPathMixin
 
 
 def _unwrap(x):
     return x.jax() if isinstance(x, NDArray) else jnp.asarray(x)
 
 
-class MultiLayerNetwork:
+class MultiLayerNetwork(FitFastPathMixin):
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers = conf.layers
@@ -41,6 +42,7 @@ class MultiLayerNetwork:
         self._epoch = 0
         self._listeners: List[Any] = []
         self._train_step = None
+        self._epoch_step = None
         self._rng_key = jax.random.key(conf.seed)
         self._initialized = False
         self._mesh = None
@@ -103,15 +105,23 @@ class MultiLayerNetwork:
 
     # -- forward ---------------------------------------------------------
     def _forward(self, params, x, training: bool, key=None):
-        h = x
+        cd = self._compute_dtype()
+        last = len(self.layers) - 1
+        h = self._cast_act(x, cd) if cd is not None else x
         for i, layer in enumerate(self.layers):
             pre = self.conf.preprocessors.get(i)
             if pre is not None:
                 h = pre(h)
+            p = params[i]
+            if cd is not None:
+                if i == last:  # loss head in f32
+                    h = self._cast_act(h, jnp.float32)
+                else:
+                    p = self._cast_layer_params(p, cd)
             layer_key = None
             if training and key is not None and layer.needs_key():
                 key, layer_key = jax.random.split(key)
-            h = layer.forward(params[i], h, training=training, key=layer_key)
+            h = layer.forward(p, h, training=training, key=layer_key)
         return h
 
     def output(self, x, training: bool = False) -> NDArray:
@@ -239,7 +249,9 @@ class MultiLayerNetwork:
                 new_states.append(states[i])
         return new_states
 
-    def _build_train_step(self):
+    def _step_fn(self):
+        """The un-jitted single-batch train step (shared by the per-step jit
+        and the scanned multi-batch epoch jit)."""
         def step(trainable, states, updater_state, iteration, x, y, key):
             (loss, bn_inputs), grads = jax.value_and_grad(
                 self._loss_with_bn, has_aux=True)(trainable, states, x, y,
@@ -249,7 +261,7 @@ class MultiLayerNetwork:
             new_states = self._refresh_states(states, bn_inputs, y)
             return new_trainable, new_states, updater_state, loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return step
 
     def _merge_states(self, trainable, states):
         return [{**t, **s} for t, s in zip(trainable, states)]
@@ -258,67 +270,53 @@ class MultiLayerNetwork:
         """Forward pass that also returns each BatchNormalization layer's
         input, so the train step can refresh running stats without a second
         forward pass (has_aux path)."""
-        h = x
+        cd = self._compute_dtype()
+        last = len(self.layers) - 1
+        h = self._cast_act(x, cd) if cd is not None else x
         bn_inputs = {}
         for i, layer in enumerate(self.layers):
             pre = self.conf.preprocessors.get(i)
             if pre is not None:
                 h = pre(h)
+            p = params[i]
+            if cd is not None:
+                if i == last:
+                    h = self._cast_act(h, jnp.float32)
+                else:
+                    p = self._cast_layer_params(p, cd)
             if hasattr(layer, "new_state"):
                 bn_inputs[i] = h
             layer_key = None
             if key is not None and layer.needs_key():
                 key, layer_key = jax.random.split(key)
-            h = layer.forward(params[i], h, training=True, key=layer_key)
+            h = layer.forward(p, h, training=True, key=layer_key)
         return h, bn_inputs
 
     def _states(self, params):
         return [{k: v for k, v in p.items() if k.startswith("state_")}
                 for p in params]
 
-    def fit(self, data, labels=None, num_epochs: int = 1):
-        """Train (reference fit(DataSetIterator) :1684 / fit(INDArray,INDArray)).
+    def _coerce_fit_data(self, data, labels):
+        return DataSet(data, labels) if labels is not None else data
 
-        Accepts a DataSetIterator, a DataSet, or (features, labels).
-        """
-        self._check_init()
-        if labels is not None:
-            data = DataSet(data, labels)
+    def _stage_batch(self, ds):
+        return (self._shard_batch(_unwrap(ds.features)),
+                self._shard_batch(_unwrap(ds.labels)))
+
+    def _materialize_batches(self, data):
+        """Device-resident [(x, y)] if `data` is a finite reusable source
+        (DataSet, list of DataSets, ListDataSetIterator); None → stream it."""
+        from ..datasets.iterators import ListDataSetIterator
         if isinstance(data, DataSet):
-            from ..datasets.iterators import ListDataSetIterator
-            data = ListDataSetIterator([data])
-        if self._train_step is None:
-            self._train_step = self._build_train_step()
-
-        trainable = self._trainable(self._params)
-        states = self._states(self._params)
-        ustate = self._updater_state
-
-        for epoch in range(num_epochs):
-            if hasattr(data, "reset"):
-                data.reset()
-            for ds in data:
-                x = self._shard_batch(_unwrap(ds.features))
-                y = self._shard_batch(_unwrap(ds.labels))
-                self._rng_key, step_key = jax.random.split(self._rng_key)
-                trainable, states, ustate, loss = self._train_step(
-                    trainable, states, ustate, self._iteration, x, y, step_key)
-                # donated input buffers are now invalid — repoint the live
-                # model state before any listener can touch it
-                self._params = self._merge_states(trainable, states)
-                self._updater_state = ustate
-                self.score_value = float(loss)
-                for lst in self._listeners:
-                    if hasattr(lst, "iteration_done"):
-                        lst.iteration_done(self, self._iteration, loss=self.score_value)
-                self._iteration += 1
-            self._epoch += 1
-            for lst in self._listeners:
-                if hasattr(lst, "on_epoch_end"):
-                    lst.on_epoch_end(self._epoch, self)
-        self._params = self._merge_states(trainable, states)
-        self._updater_state = ustate
-        return self
+            items = [data]
+        elif isinstance(data, (list, tuple)) and data and \
+                all(isinstance(d, DataSet) for d in data):
+            items = list(data)
+        elif isinstance(data, ListDataSetIterator):
+            items = list(data._list)
+        else:
+            return None
+        return [self._stage_batch(d) for d in items]
 
     # -- evaluation ------------------------------------------------------
     def evaluate(self, iterator):

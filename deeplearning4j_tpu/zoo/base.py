@@ -51,6 +51,9 @@ class ZooModel:
     num_classes: int = 1000
     seed: int = 123
     input_shape: Tuple[int, int, int] = (3, 224, 224)  # (C, H, W)
+    #: compute dtype for the built network ("bfloat16" puts the conv/matmul
+    #: body on the MXU in bf16 with f32 masters — see nn config dtype)
+    dtype: str = "float32"
 
     #: md5 of the pretrained artifact, when one is published
     pretrained_checksums: dict = dataclasses.field(default_factory=dict)
@@ -58,6 +61,13 @@ class ZooModel:
     def init_model(self):
         """Build + init the network (MultiLayerNetwork or ComputationGraph)."""
         raise NotImplementedError
+
+    def build_conf(self):
+        """self.conf() with the zoo-level dtype applied."""
+        conf = self.conf()
+        if self.dtype and self.dtype != "float32":
+            conf.dtype = self.dtype
+        return conf
 
     def pretrained_available(self, ptype: str = PretrainedType.IMAGENET) -> bool:
         return ptype in self.pretrained_checksums

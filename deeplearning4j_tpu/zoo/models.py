@@ -61,7 +61,7 @@ class LeNet(ZooModel):
                 .build())
 
     def init_model(self) -> MultiLayerNetwork:
-        return MultiLayerNetwork(self.conf()).init()
+        return MultiLayerNetwork(self.build_conf()).init()
 
 
 @dataclasses.dataclass
@@ -88,7 +88,7 @@ class SimpleCNN(ZooModel):
                 .build())
 
     def init_model(self) -> MultiLayerNetwork:
-        return MultiLayerNetwork(self.conf()).init()
+        return MultiLayerNetwork(self.build_conf()).init()
 
 
 @dataclasses.dataclass
@@ -126,7 +126,7 @@ class AlexNet(ZooModel):
                 .build())
 
     def init_model(self) -> MultiLayerNetwork:
-        return MultiLayerNetwork(self.conf()).init()
+        return MultiLayerNetwork(self.build_conf()).init()
 
 
 def _vgg_conf(blocks: Sequence[Tuple[int, int]], seed, num_classes, input_shape):
@@ -157,7 +157,7 @@ class VGG16(ZooModel):
                          self.seed, self.num_classes, self.input_shape)
 
     def init_model(self) -> MultiLayerNetwork:
-        return MultiLayerNetwork(self.conf()).init()
+        return MultiLayerNetwork(self.build_conf()).init()
 
 
 @dataclasses.dataclass
@@ -169,7 +169,7 @@ class VGG19(ZooModel):
                          self.seed, self.num_classes, self.input_shape)
 
     def init_model(self) -> MultiLayerNetwork:
-        return MultiLayerNetwork(self.conf()).init()
+        return MultiLayerNetwork(self.build_conf()).init()
 
 
 @dataclasses.dataclass
@@ -209,7 +209,7 @@ class Darknet19(ZooModel):
         return b.set_input_type(InputType.convolutional(h, w, c)).build()
 
     def init_model(self) -> MultiLayerNetwork:
-        return MultiLayerNetwork(self.conf()).init()
+        return MultiLayerNetwork(self.build_conf()).init()
 
 
 #: VOC anchors used by the reference TinyYOLO/YOLO2 priors
@@ -249,7 +249,7 @@ class TinyYOLO(ZooModel):
         return b.set_input_type(InputType.convolutional(h, w, c)).build()
 
     def init_model(self) -> MultiLayerNetwork:
-        return MultiLayerNetwork(self.conf()).init()
+        return MultiLayerNetwork(self.build_conf()).init()
 
 
 @dataclasses.dataclass
@@ -274,4 +274,4 @@ class TextGenerationLSTM(ZooModel):
                 .build())
 
     def init_model(self) -> MultiLayerNetwork:
-        return MultiLayerNetwork(self.conf()).init()
+        return MultiLayerNetwork(self.build_conf()).init()

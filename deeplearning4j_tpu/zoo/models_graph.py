@@ -117,7 +117,7 @@ class ResNet50(ZooModel):
         return b.build()
 
     def init_model(self) -> ComputationGraph:
-        return ComputationGraph(self.conf()).init()
+        return ComputationGraph(self.build_conf()).init()
 
 
 @dataclasses.dataclass
@@ -161,7 +161,7 @@ class SqueezeNet(ZooModel):
         return b.build()
 
     def init_model(self) -> ComputationGraph:
-        return ComputationGraph(self.conf()).init()
+        return ComputationGraph(self.build_conf()).init()
 
 
 @dataclasses.dataclass
@@ -212,7 +212,7 @@ class UNet(ZooModel):
         return b.build()
 
     def init_model(self) -> ComputationGraph:
-        return ComputationGraph(self.conf()).init()
+        return ComputationGraph(self.build_conf()).init()
 
 
 @dataclasses.dataclass
@@ -275,7 +275,7 @@ class Xception(ZooModel):
         return b.build()
 
     def init_model(self) -> ComputationGraph:
-        return ComputationGraph(self.conf()).init()
+        return ComputationGraph(self.build_conf()).init()
 
 
 @dataclasses.dataclass
@@ -377,7 +377,7 @@ class InceptionResNetV1(ZooModel):
         return b.build()
 
     def init_model(self) -> ComputationGraph:
-        return ComputationGraph(self.conf()).init()
+        return ComputationGraph(self.build_conf()).init()
 
 
 @dataclasses.dataclass
@@ -438,7 +438,7 @@ class FaceNetNN4Small2(ZooModel):
         return b.build()
 
     def init_model(self) -> ComputationGraph:
-        return ComputationGraph(self.conf()).init()
+        return ComputationGraph(self.build_conf()).init()
 
 
 @dataclasses.dataclass
@@ -534,7 +534,7 @@ class NASNet(ZooModel):
         return b.build()
 
     def init_model(self) -> ComputationGraph:
-        return ComputationGraph(self.conf()).init()
+        return ComputationGraph(self.build_conf()).init()
 
 
 @dataclasses.dataclass
@@ -586,4 +586,4 @@ class YOLO2(ZooModel):
         return b.build()
 
     def init_model(self) -> ComputationGraph:
-        return ComputationGraph(self.conf()).init()
+        return ComputationGraph(self.build_conf()).init()

@@ -1,0 +1,189 @@
+"""The scanned fit fast path and mixed precision (conf.dtype).
+
+The scan path (one jitted lax.scan per epoch) must be numerically identical
+to the per-step path (what a per-iteration listener forces), and bf16
+compute (reference: DataType.HALF networks) must keep f32 master params.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn.conf.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _mk_net(dtype="float32", seed=7):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(1e-2)).data_type(dtype).list()
+            .layer(L.DenseLayer(n_in=12, n_out=32, activation="relu"))
+            .layer(L.BatchNormalization())
+            .layer(L.OutputLayer(n_in=32, n_out=3, activation="softmax",
+                                 loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _mk_batches(n=4, b=16, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        x = rng.randn(b, 12).astype(np.float32)
+        y = np.zeros((b, 3), np.float32)
+        y[np.arange(b), rng.randint(0, 3, b)] = 1.0
+        out.append(DataSet(x, y))
+    return out
+
+
+class _IterListener:
+    """Having iteration_done forces the per-step fit path."""
+    def __init__(self):
+        self.calls = 0
+
+    def iteration_done(self, net, it, loss=None):
+        self.calls += 1
+
+
+def test_scan_path_matches_per_step_path():
+    batches = _mk_batches()
+    net_a = _mk_net()
+    net_a.fit(batches, num_epochs=2)  # scan path (no listeners)
+
+    net_b = _mk_net()
+    lst = _IterListener()
+    net_b.set_listeners(lst)
+    net_b.fit(batches, num_epochs=2)  # per-step path
+    assert lst.calls == 8
+
+    for pa, pb in zip(net_a._params, net_b._params):
+        for k in pa:
+            np.testing.assert_allclose(np.asarray(pa[k]), np.asarray(pb[k]),
+                                       rtol=2e-5, atol=2e-6)
+    assert net_a._iteration == net_b._iteration == 8
+
+
+def test_epoch_only_listener_keeps_scan_path_and_live_params():
+    """A TrainingListener subclass that only overrides on_epoch_end must NOT
+    force the per-step path, and model state must be live (not donated-away)
+    when the epoch hook runs."""
+    from deeplearning4j_tpu.nn.listeners import TrainingListener
+
+    class EpochL(TrainingListener):
+        def __init__(self):
+            self.epochs = 0
+
+        def on_epoch_end(self, epoch, model):
+            self.epochs += 1
+            # touching params mid-fit would raise if buffers were donated
+            model.output(np.zeros((2, 12), np.float32))
+
+    net = _mk_net()
+    lst = EpochL()
+    net.set_listeners(lst)
+    net.fit(_mk_batches(), num_epochs=2)
+    assert net._epoch_step is not None, "scan path should have engaged"
+    assert lst.epochs == 2
+
+
+def test_score_value_set_after_scan_fit():
+    net = _mk_net()
+    net.fit(_mk_batches(), num_epochs=1)
+    assert np.isfinite(net.score_value)
+
+
+def test_bf16_fit_keeps_f32_masters_and_learns():
+    batches = _mk_batches(n=6, b=32)
+    net = _mk_net(dtype="bfloat16")
+    loss0 = net.score(batches[0])
+    net.fit(batches, num_epochs=20)
+    loss1 = net.score(batches[0])
+    assert loss1 < loss0
+    for p in net._params:
+        for k, v in p.items():
+            assert v.dtype == jnp.float32, (k, v.dtype)
+
+
+def test_bf16_output_is_f32_logits():
+    net = _mk_net(dtype="bfloat16")
+    out = net.output(np.random.RandomState(0).randn(4, 12).astype(np.float32))
+    assert out.jax().dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out.jax()).sum(axis=-1), 1.0,
+                               rtol=1e-2)
+
+
+def test_bf16_close_to_f32_training():
+    batches = _mk_batches(n=2, b=16)
+    net32 = _mk_net(dtype="float32")
+    net16 = _mk_net(dtype="bfloat16")
+    net32.fit(batches, num_epochs=3)
+    net16.fit(batches, num_epochs=3)
+    for pa, pb in zip(net32._params, net16._params):
+        for k in pa:
+            np.testing.assert_allclose(np.asarray(pa[k]), np.asarray(pb[k]),
+                                       rtol=0.1, atol=0.05)
+
+
+def test_graph_scan_path_matches_per_step():
+    from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+
+    def mk():
+        b = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("d", L.DenseLayer(n_in=8, n_out=16,
+                                          activation="tanh"), "in")
+             .add_layer("out", L.OutputLayer(n_in=16, n_out=2,
+                                             activation="softmax",
+                                             loss="mcxent"), "d")
+             .set_outputs("out"))
+        return ComputationGraph(b.build()).init()
+
+    rng = np.random.RandomState(1)
+    batches = []
+    for _ in range(3):
+        x = rng.randn(8, 8).astype(np.float32)
+        y = np.zeros((8, 2), np.float32)
+        y[np.arange(8), rng.randint(0, 2, 8)] = 1.0
+        batches.append(DataSet(x, y))
+
+    g_a = mk()
+    g_a.fit(batches, num_epochs=2)
+    g_b = mk()
+    lst = _IterListener()
+    g_b.set_listeners(lst)
+    g_b.fit(batches, num_epochs=2)
+    assert lst.calls == 6
+    for n in g_a._params:
+        for k in g_a._params[n]:
+            np.testing.assert_allclose(np.asarray(g_a._params[n][k]),
+                                       np.asarray(g_b._params[n][k]),
+                                       rtol=2e-5, atol=2e-6)
+
+
+def test_graph_bf16_fit_learns():
+    from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+
+    b = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+         .data_type("bfloat16").graph_builder()
+         .add_inputs("in")
+         .add_layer("d", L.DenseLayer(n_in=8, n_out=16, activation="relu"),
+                    "in")
+         .add_layer("bn", L.BatchNormalization(n_out=16), "d")
+         .add_layer("out", L.OutputLayer(n_in=16, n_out=2,
+                                         activation="softmax",
+                                         loss="mcxent"), "bn")
+         .set_outputs("out"))
+    g = ComputationGraph(b.build()).init()
+    rng = np.random.RandomState(1)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = np.zeros((32, 2), np.float32)
+    y[np.arange(32), (x[:, 0] > 0).astype(int)] = 1.0
+    ds = DataSet(x, y)
+    l0 = g.score(ds)
+    g.fit(ds, num_epochs=30)
+    assert g.score(ds) < l0
+    for n, p in g._params.items():
+        for k, v in p.items():
+            assert v.dtype == jnp.float32
