@@ -62,7 +62,9 @@ def bench_bert(jax, jnp, tiny):
 
     best = None
     for variant in ({"remat": False, "use_fused_xent": False},
-                    {"remat": False, "use_fused_xent": True}):
+                    {"remat": False, "use_fused_xent": True},
+                    {"remat": False, "use_fused_xent": False,
+                     "use_flash": True}):
         try:
             params = bert.init_params(jax.random.key(0), config)
             opt = bert.init_opt_state(params)
@@ -167,10 +169,15 @@ def bench_word2vec(jax, jnp, tiny):
 
 
 def bench_flash_attention(jax, jnp, tiny):
-    """Pallas flash attention vs XLA attention at long sequence length."""
+    """Pallas flash attention vs XLA attention at long sequence length.
+
+    Timing runs N chained iterations inside ONE jitted lax.scan with a
+    scalar readback — per-call wall timing through the axon tunnel is
+    unreliable (repeated identical executes get replayed from cache)."""
     from deeplearning4j_tpu.kernels import flash_attention
 
     B, S, H, D = (1, 256, 2, 32) if tiny else (4, 2048, 12, 64)
+    N = 3 if tiny else 20
     rng = np.random.RandomState(0)
     mk = lambda: jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
     q, k, v = mk(), mk(), mk()
@@ -180,19 +187,49 @@ def bench_flash_attention(jax, jnp, tiny):
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
-    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v))
-    xla = jax.jit(xla_attn)
-    iters = 3 if tiny else 20
-    times = {}
-    for name, fn in (("flash", flash), ("xla", xla)):
-        out = fn(q, k, v)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(q, k, v)
-        jax.block_until_ready(out)
-        times[name] = (time.perf_counter() - t0) / iters
-    return times["xla"] / times["flash"], times
+    def timed(fn, grad):
+        if grad:
+            def one(c):
+                d = jax.grad(lambda a: jnp.sum(fn(a, k, v) ** 2))(c)
+                return c - 1e-6 * d
+        else:
+            def one(c):
+                return fn(c, k, v)
+
+        @jax.jit
+        def many(q):
+            out, _ = jax.lax.scan(lambda c, _: (one(c), ()), q, None,
+                                  length=N)
+            return jnp.sum(out)
+
+        float(many(q))  # compile + warm
+        runs = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(many(q))
+            runs.append((time.perf_counter() - t0) / N)
+        return sorted(runs)[1]  # median
+
+    fwd = timed(xla_attn, False) / timed(flash_attention, False)
+    train = timed(xla_attn, True) / timed(flash_attention, True)
+    return fwd, train
+
+
+def bench_flash_longseq(jax, jnp, tiny):
+    """S=8192 attention training step: the XLA path cannot even compile on
+    one chip (the [B,H,S,S] f32 score tensor is 12.9 GB / blows scoped
+    vmem); the Pallas fwd+bwd kernels train it in O(S) memory."""
+    from deeplearning4j_tpu.kernels import flash_attention
+
+    B, S, H, D = (1, 512, 2, 32) if tiny else (4, 8192, 12, 64)
+    rng = np.random.RandomState(0)
+    q, k, v = [jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+               for _ in range(3)]
+    g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(flash_attention(q, k, v)
+                                                 ** 2), argnums=(0, 1, 2)))
+    out = g(q, k, v)
+    jax.block_until_ready(out)
+    return "ok"
 
 
 def main():
@@ -222,6 +259,7 @@ def main():
         "batch": r["B"], "seq_len": r["T"], "platform": platform,
         "loss": round(r["loss"], 4),
         "fused_xent": r["variant"].get("use_fused_xent", False),
+        "flash_attn": r["variant"].get("use_flash", False),
     }
 
     if not skip_extras:
@@ -237,10 +275,16 @@ def main():
             except Exception as e:  # never let an extra kill the headline
                 out[key] = f"error: {type(e).__name__}"
         try:
-            speedup, _ = bench_flash_attention(jax, jnp, tiny)
-            out["flash_attn_speedup_vs_xla"] = round(speedup, 3)
+            fwd, train = bench_flash_attention(jax, jnp, tiny)
+            out["flash_attn_speedup_vs_xla"] = round(fwd, 3)
+            out["flash_attn_train_speedup_vs_xla"] = round(train, 3)
         except Exception as e:
             out["flash_attn_speedup_vs_xla"] = f"error: {type(e).__name__}"
+        try:
+            out["flash_attn_s8192_train"] = bench_flash_longseq(jax, jnp,
+                                                                tiny)
+        except Exception as e:
+            out["flash_attn_s8192_train"] = f"error: {type(e).__name__}"
 
     print(json.dumps(out))
 

@@ -1,22 +1,29 @@
-"""Flash attention — Pallas TPU kernel (online-softmax, O(S) memory).
+"""Flash attention — Pallas TPU kernels (online softmax, O(S) memory, fwd+bwd).
 
 Reference counterpart: the vendor-accelerated attention path
 (`libnd4j/include/ops/declarable/platform/cudnn/` attention kernels and
 `helpers/AttentionHelper.h`). On TPU the hot path is a Pallas kernel that
-keeps the [TQ, TK] score tile in VMEM, accumulates the online softmax in
-f32, and never materializes the [S, S] probability matrix in HBM.
+keeps only [TQ, TK] score tiles in VMEM, accumulates the online softmax in
+f32 scratch, and never materializes the [S, S] probability matrix in HBM —
+forward OR backward, so S=2048+ training fits where the XLA path OOMs.
 
-Layout: q/k/v are [BH, S, D] (batch*heads flattened into the grid's first
-axis; callers reshape). The kernel grid is (BH, S // TILE_Q); each program
-streams K/V blocks of TILE_K rows with jax.lax.fori_loop.
+Layout: q/k/v are [BH, S, D] (batch*heads flattened; callers reshape).
+All three kernels use a 3-D grid whose innermost dimension is the
+*sequential* stream (kv blocks for fwd/dq, q blocks for dkv) so Mosaic
+double-buffers the streamed blocks while f32 accumulators persist in VMEM
+scratch across the sequential steps:
 
-Backward: jax.custom_vjp whose bwd recomputes attention with the standard
-XLA path — NOTE this materializes the [S, S] score matrix in the backward,
-so the O(S) memory benefit applies to the forward/inference path only (a
-flash backward kernel is the follow-up for O(S) training memory).
+  fwd : grid (BH, nQ, nK)  scratch m/l/acc     outputs o, lse=m+log(l)
+  dq  : grid (BH, nQ, nK)  scratch dq_acc      p recomputed from q,k,lse
+  dkv : grid (BH, nK, nQ)  scratch dk/dv_acc   ds = p * (g·vᵀ − delta)
+
+delta = rowsum(o ⊙ do) is precomputed with plain XLA (one elementwise pass).
 
 Sequence lengths that don't divide the tiles are zero-padded to the tile
-boundary (padded keys masked off, padded query rows sliced away).
+boundary (padded keys masked off, padded query rows sliced away). A fully
+masked row degrades to a uniform softmax — identical to what the XLA
+softmax produces for an all-−1e30 row, and the lse identity keeps the
+backward consistent with that without special cases.
 
 Tests run interpret mode on CPU; the real chip runs compiled.
 """
@@ -28,6 +35,7 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
@@ -36,102 +44,275 @@ def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale, tile_k,
-                seq_len, causal, q_tile):
-    q = q_ref[0].astype(jnp.float32)                      # [TQ, D]
-    tq = q.shape[0]
-    iq = pl.program_id(1)
-    q_start = iq * q_tile
+def _params(n_parallel):
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel",) * n_parallel + ("arbitrary",))
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * tile_k, tile_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * tile_k, tile_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        if mask_ref is not None:
-            km = mask_ref[0, pl.ds(j * tile_k, tile_k)]
-            s = jnp.where(km[None, :] != 0, s, _NEG_INF)
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32,
-                                                       (tq, tile_k), 0)
-            k_pos = j * tile_k + jax.lax.broadcasted_iota(jnp.int32,
-                                                          (tq, tile_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + \
-            jnp.dot(p, v, preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
 
-    m0 = jnp.full((tq,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((tq,), jnp.float32)
-    a0 = jnp.zeros((tq, q.shape[1]), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, seq_len // tile_k, body, (m0, l0, a0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                m_sc, l_sc, acc_sc, *, scale, causal, n_k):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    # dots run in the input dtype (bf16 stays on the fast MXU path) with
+    # f32 accumulation; softmax stats are always f32
+    q, k, v = q_ref[0], k_ref[0], v_ref[0]               # [TQ,D],[TK,D]
+    tq, tk = q.shape[0], k.shape[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if mask_ref is not None:
+        s = jnp.where(mask_ref[0][:, 0][None, :] != 0, s, _NEG_INF)
+    if causal:
+        q_pos = iq * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        k_pos = ik * tk + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+    m_prev = m_sc[:, 0]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[:, None])
+    alpha = jnp.exp(m_prev - m_new)
+    m_sc[...] = m_new[:, None]
+    l_sc[...] = l_sc[...] * alpha[:, None] + jnp.sum(p, axis=-1)[:, None]
+    acc_sc[...] = acc_sc[...] * alpha[:, None] + \
+        jnp.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_k - 1)
+    def _done():
+        l = l_sc[:, 0]
+        o_ref[0] = (acc_sc[...] / jnp.maximum(l, 1e-30)[:, None]).astype(
+            o_ref.dtype)
+        lse_ref[0] = (m_sc[:, 0] + jnp.log(jnp.maximum(l, 1e-30)))[:, None]
+
+
+def _fwd_kernel_nomask(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       m_sc, l_sc, acc_sc, **kw):
+    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                m_sc, l_sc, acc_sc, **kw)
 
 
 def _flash_fwd(q, k, v, mask, scale, causal, tile_q, tile_k):
     BH, S, D = q.shape
-    tile_q = min(tile_q, S)
-    tile_k = min(tile_k, S)
-    grid = (BH, S // tile_q)
+    n_q, n_k = S // tile_q, S // tile_k
+    grid = (BH, n_q, n_k)
     in_specs = [
-        pl.BlockSpec((1, tile_q, D), lambda bh, iq: (bh, iq, 0)),
-        pl.BlockSpec((1, S, D), lambda bh, iq: (bh, 0, 0)),
-        pl.BlockSpec((1, S, D), lambda bh, iq: (bh, 0, 0)),
+        pl.BlockSpec((1, tile_q, D), lambda bh, iq, ik: (bh, iq, 0)),
+        pl.BlockSpec((1, tile_k, D), lambda bh, iq, ik: (bh, ik, 0)),
+        pl.BlockSpec((1, tile_k, D), lambda bh, iq, ik: (bh, ik, 0)),
     ]
     args = [q, k, v]
     if mask is not None:
-        in_specs.append(pl.BlockSpec((1, S), lambda bh, iq: (bh, 0)))
+        in_specs.append(pl.BlockSpec((1, tile_k, 1),
+                                     lambda bh, iq, ik: (bh, ik, 0)))
         args.append(mask)
     kern = functools.partial(
         _fwd_kernel if mask is not None else _fwd_kernel_nomask,
-        scale=scale, tile_k=tile_k, seq_len=S, causal=causal, q_tile=tile_q)
+        scale=scale, causal=causal, n_k=n_k)
     return pl.pallas_call(
         kern,
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, tile_q, D), lambda bh, iq: (bh, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, tile_q, D), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, tile_q, 1), lambda bh, iq, ik: (bh, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile_q, 1), jnp.float32),
+            pltpu.VMEM((tile_q, 1), jnp.float32),
+            pltpu.VMEM((tile_q, D), jnp.float32),
+        ],
+        compiler_params=_params(2),
         interpret=_interpret(),
     )(*args)
 
 
-def _fwd_kernel_nomask(q_ref, k_ref, v_ref, o_ref, **kw):
-    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, **kw)
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
 
-
-def _reference(q, k, v, mask, scale, causal):
-    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    if mask is not None:
-        s = jnp.where(mask[:, None, :] != 0, s, _NEG_INF)
+def _p_tile(q, k, mask_row, lse, iq, ik, scale, causal):
+    """Recompute the [TQ, TK] probability tile from saved lse."""
+    tq, tk = q.shape[0], k.shape[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if mask_row is not None:
+        s = jnp.where(mask_row[:, 0][None, :] != 0, s, _NEG_INF)
     if causal:
-        S = q.shape[1]
-        tri = jnp.tril(jnp.ones((S, S), bool))
-        s = jnp.where(tri[None], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+        q_pos = iq * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        k_pos = ik * tk + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    return jnp.exp(s - lse[:, 0][:, None]), s
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, has_mask_sentinel, scale, causal, tile_q, tile_k):
-    # has_mask_sentinel unused in the no-mask overload; see flash_attention
-    return _flash_fwd(q, k, v, None, scale, causal, tile_q, tile_k)
+def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, mask_ref,
+               dq_ref, dq_sc, *, scale, causal, n_k):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    q, k, v, g = q_ref[0], k_ref[0], v_ref[0], g_ref[0]
+    mrow = mask_ref[0] if mask_ref is not None else None
+    p, _ = _p_tile(q, k, mrow, lse_ref[0], iq, ik, scale, causal)
+    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # [TQ, TK]
+    ds = p * (dp - delta_ref[0])
+    dq_sc[...] += jnp.dot(ds.astype(k.dtype), k,
+                          preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ik == n_k - 1)
+    def _done():
+        dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
 
 
-def _flash_f(q, k, v, has_mask_sentinel, scale, causal, tile_q, tile_k):
-    out = _flash_fwd(q, k, v, None, scale, causal, tile_q, tile_k)
-    return out, (q, k, v)
+def _dq_kernel_nomask(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                      dq_ref, dq_sc, **kw):
+    _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, None,
+               dq_ref, dq_sc, **kw)
 
 
-def _flash_b(has_mask_sentinel, scale, causal, tile_q, tile_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _reference(q_, k_, v_, None, scale,
-                                                   causal), q, k, v)
-    return vjp(g)
+def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, mask_ref,
+                dk_ref, dv_ref, dk_sc, dv_sc, *, scale, causal, n_q):
+    ik, iq = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    q, k, v, g = q_ref[0], k_ref[0], v_ref[0], g_ref[0]
+    mrow = mask_ref[0] if mask_ref is not None else None
+    p, _ = _p_tile(q, k, mrow, lse_ref[0], iq, ik, scale, causal)
+    dv_sc[...] += jax.lax.dot_general(
+        p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0])
+    dk_sc[...] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+
+    @pl.when(iq == n_q - 1)
+    def _done():
+        dk_ref[0] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
+
+
+def _dkv_kernel_nomask(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_sc, dv_sc, **kw):
+    _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, None,
+                dk_ref, dv_ref, dk_sc, dv_sc, **kw)
+
+
+def _flash_bwd(q, k, v, mask, o, lse, g, scale, causal, tile_q, tile_k):
+    BH, S, D = q.shape
+    # the bwd kernels hold three [TQ, TK] f32 tiles live (p, dp, ds); cap
+    # tiles at 512 so long-seq fwd tiles (2048) don't blow the 16MB VMEM
+    if tile_q > 512 and S % 512 == 0:
+        tile_q = 512
+    if tile_k > 512 and S % 512 == 0:
+        tile_k = 512
+    n_q, n_k = S // tile_q, S // tile_k
+    delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # [BH, S, 1]
+
+    def qspec(f):
+        return pl.BlockSpec((1, tile_q, D), f)
+
+    def kspec(f):
+        return pl.BlockSpec((1, tile_k, D), f)
+
+    # dq: stream kv blocks for each q block
+    in_specs = [
+        qspec(lambda bh, iq, ik: (bh, iq, 0)),          # q
+        kspec(lambda bh, iq, ik: (bh, ik, 0)),          # k
+        kspec(lambda bh, iq, ik: (bh, ik, 0)),          # v
+        qspec(lambda bh, iq, ik: (bh, iq, 0)),          # g
+        pl.BlockSpec((1, tile_q, 1), lambda bh, iq, ik: (bh, iq, 0)),  # lse
+        pl.BlockSpec((1, tile_q, 1), lambda bh, iq, ik: (bh, iq, 0)),  # delta
+    ]
+    args = [q, k, v, g, lse, delta]
+    if mask is not None:
+        in_specs.append(pl.BlockSpec((1, tile_k, 1),
+                                     lambda bh, iq, ik: (bh, ik, 0)))
+        args.append(mask)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel if mask is not None else
+                          _dq_kernel_nomask,
+                          scale=scale, causal=causal, n_k=n_k),
+        grid=(BH, n_q, n_k),
+        in_specs=in_specs,
+        out_specs=qspec(lambda bh, iq, ik: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((tile_q, D), jnp.float32)],
+        compiler_params=_params(2),
+        interpret=_interpret(),
+    )(*args)
+
+    # dk/dv: stream q blocks for each kv block
+    in_specs = [
+        qspec(lambda bh, ik, iq: (bh, iq, 0)),          # q
+        kspec(lambda bh, ik, iq: (bh, ik, 0)),          # k
+        kspec(lambda bh, ik, iq: (bh, ik, 0)),          # v
+        qspec(lambda bh, ik, iq: (bh, iq, 0)),          # g
+        pl.BlockSpec((1, tile_q, 1), lambda bh, ik, iq: (bh, iq, 0)),  # lse
+        pl.BlockSpec((1, tile_q, 1), lambda bh, ik, iq: (bh, iq, 0)),  # delta
+    ]
+    args = [q, k, v, g, lse, delta]
+    if mask is not None:
+        in_specs.append(pl.BlockSpec((1, tile_k, 1),
+                                     lambda bh, ik, iq: (bh, ik, 0)))
+        args.append(mask)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel if mask is not None else
+                          _dkv_kernel_nomask,
+                          scale=scale, causal=causal, n_q=n_q),
+        grid=(BH, n_k, n_q),
+        in_specs=in_specs,
+        out_specs=[kspec(lambda bh, ik, iq: (bh, ik, 0)),
+                   kspec(lambda bh, ik, iq: (bh, ik, 0))],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((tile_k, D), jnp.float32),
+                        pltpu.VMEM((tile_k, D), jnp.float32)],
+        compiler_params=_params(2),
+        interpret=_interpret(),
+    )(*args)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing (mask variants split so mask=None stays cheap)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, tile_q, tile_k):
+    o, _ = _flash_fwd(q, k, v, None, scale, causal, tile_q, tile_k)
+    return o
+
+
+def _flash_f(q, k, v, scale, causal, tile_q, tile_k):
+    o, lse = _flash_fwd(q, k, v, None, scale, causal, tile_q, tile_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_b(scale, causal, tile_q, tile_k, res, g):
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, None, o, lse, g, scale, causal, tile_q, tile_k)
 
 
 _flash.defvjp(_flash_f, _flash_b)
@@ -139,38 +320,64 @@ _flash.defvjp(_flash_f, _flash_b)
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash_masked(q, k, v, mask, scale, causal, tile_q, tile_k):
-    return _flash_fwd(q, k, v, mask, scale, causal, tile_q, tile_k)
+    o, _ = _flash_fwd(q, k, v, mask, scale, causal, tile_q, tile_k)
+    return o
 
 
 def _flash_masked_f(q, k, v, mask, scale, causal, tile_q, tile_k):
-    out = _flash_fwd(q, k, v, mask, scale, causal, tile_q, tile_k)
-    return out, (q, k, v, mask)
+    o, lse = _flash_fwd(q, k, v, mask, scale, causal, tile_q, tile_k)
+    return o, (q, k, v, mask, o, lse)
 
 
 def _flash_masked_b(scale, causal, tile_q, tile_k, res, g):
-    q, k, v, mask = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _reference(q_, k_, v_, mask, scale,
-                                                   causal), q, k, v)
-    return vjp(g) + (None,)
+    q, k, v, mask, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, mask, o, lse, g, scale, causal,
+                            tile_q, tile_k)
+    return dq, dk, dv, None
 
 
 _flash_masked.defvjp(_flash_masked_f, _flash_masked_b)
 
 
+def _fit_tile(want, s_pad):
+    """Largest multiple of 128 ≤ want that divides s_pad (s_pad is a
+    multiple of 128)."""
+    t = min(want, s_pad)
+    t -= t % 128
+    while s_pad % t:
+        t -= 128
+    return t
+
+
 def flash_attention(q, k, v, mask=None, causal: bool = False,
-                    scale: float = None, tile_q: int = 128,
-                    tile_k: int = 128):
+                    scale: float = None, tile_q: int = None,
+                    tile_k: int = None):
     """Flash attention over [B, S, H, D] (BTHD, the framework convention).
 
-    mask: optional [B, S] key validity (1 = attend). Differentiable.
+    mask: optional [B, S] key validity (1 = attend). Differentiable in
+    q/k/v; O(S) HBM in both forward and backward (the probability matrix
+    only ever exists as [tile_q, tile_k] VMEM tiles).
     Any S is accepted: inputs are zero-padded to the tile boundary (padded
-    keys masked off; padded query rows sliced away)."""
+    keys masked off; padded query rows sliced away).
+
+    Default tiles are tuned on v5e at S=2048, D=64 (tq=2048/tk=512:
+    fwd 4.7ms vs XLA 8.8/7.1ms f32/bf16; train 5.8-6.1ms vs 13.5/7.5ms);
+    they shrink to divisors of the padded length for other shapes."""
     B, S, H, D = q.shape
     scale = scale if scale is not None else D ** -0.5
-    tile_q = min(tile_q, max(S, 1))
-    tile_k = min(tile_k, max(S, 1))
-    lcm = tile_q * tile_k // math.gcd(tile_q, tile_k)
-    S_pad = -(-S // lcm) * lcm
+    if tile_q is None or tile_k is None:
+        if S <= 128:
+            S_pad = S
+            tile_q = tile_k = S
+        else:
+            S_pad = -(-S // 128) * 128
+            tile_q = _fit_tile(tile_q or 2048, S_pad)
+            tile_k = _fit_tile(tile_k or 512, S_pad)
+    else:
+        tile_q = min(tile_q, max(S, 1))
+        tile_k = min(tile_k, max(S, 1))
+        lcm = tile_q * tile_k // math.gcd(tile_q, tile_k)
+        S_pad = -(-S // lcm) * lcm
     if S_pad != S:
         pad = [(0, 0), (0, S_pad - S), (0, 0), (0, 0)]
         q = jnp.pad(q, pad)
@@ -183,9 +390,9 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
     kf = jnp.moveaxis(k, 2, 1).reshape(B * H, S_pad, D)
     vf = jnp.moveaxis(v, 2, 1).reshape(B * H, S_pad, D)
     if mask is not None:
-        mf = jnp.repeat(mask.astype(jnp.int32), H, axis=0)
+        mf = jnp.repeat(mask.astype(jnp.int32), H, axis=0)[..., None]
         out = _flash_masked(qf, kf, vf, mf, scale, causal, tile_q, tile_k)
     else:
-        out = _flash(qf, kf, vf, 0, scale, causal, tile_q, tile_k)
+        out = _flash(qf, kf, vf, scale, causal, tile_q, tile_k)
     out = jnp.moveaxis(out.reshape(B, H, S_pad, D), 1, 2)
     return out[:, :S] if S_pad != S else out
