@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...nn.conf import layers as L
+from ...nn.conf import layers_extra as LX
 from ...nn.conf.config import (InputType, MultiLayerConfiguration,
                                NeuralNetConfiguration)
 from ...nn.graph.computation_graph import ComputationGraph
@@ -61,6 +62,9 @@ def _keras_shape_to_input_type(shape) -> Optional[Tuple[int, ...]]:
     if shape is None:
         return None
     dims = [d for d in shape]
+    if len(dims) == 4:
+        d, h, w, c = dims
+        return InputType.convolutional3d(d, h, w, c)
     if len(dims) == 3:
         h, w, c = dims
         return InputType.convolutional(h, w, c)
@@ -89,12 +93,13 @@ def _dense_adapter(cfg, keras_in_shape):
 
     def set_weights(weights, in_type):
         kernel = np.asarray(weights[0])
-        # Flatten-after-conv fixup: Keras flattens (h,w,c), ours (c,h,w)
-        if keras_in_shape is not None and len(keras_in_shape) == 3 and \
+        # Flatten-after-conv fixup: Keras flattens (..., c) channels-last,
+        # ours (c, ...) channels-first (2-D and 3-D conv activations)
+        if keras_in_shape is not None and len(keras_in_shape) in (3, 4) and \
                 kernel.shape[0] == int(np.prod(keras_in_shape)):
-            h, w, c = keras_in_shape
-            kernel = kernel.reshape(h, w, c, units).transpose(2, 0, 1, 3) \
-                .reshape(c * h * w, units)
+            nd = len(keras_in_shape)
+            k = kernel.reshape(*keras_in_shape, units)
+            kernel = np.moveaxis(k, nd - 1, 0).reshape(-1, units)
         p = {"W": jnp.asarray(kernel)}
         if use_bias:
             p["b"] = jnp.asarray(np.asarray(weights[1]))
@@ -179,7 +184,16 @@ def _embedding_adapter(cfg):
 
 def _lstm_adapter(cfg):
     units = int(cfg["units"])
-    layer = L.LSTM(n_out=units, activation=_act(cfg.get("activation", "tanh")),
+    if _act(cfg.get("activation", "tanh")) != "tanh" or \
+            cfg.get("recurrent_activation", "sigmoid") != "sigmoid":
+        # only the tanh/sigmoid kernel exists (nn/conf/layers.py LSTM ->
+        # recurrent.lstm_layer); importing anything else would silently
+        # compute different outputs
+        raise ImportException(
+            f"Keras LSTM with activation={cfg.get('activation')!r} / "
+            f"recurrent_activation={cfg.get('recurrent_activation')!r} is "
+            f"not supported (only tanh/sigmoid)")
+    layer = L.LSTM(n_out=units, activation="tanh",
                    return_sequence=bool(cfg.get("return_sequences", False)),
                    name=cfg.get("name"))
 
@@ -190,6 +204,251 @@ def _lstm_adapter(cfg):
                 "b": jnp.asarray(bias)}
 
     return _Adapted(layer, set_weights)
+
+
+def _gru_adapter(cfg):
+    """Keras GRU: gate columns (z, r, h). reset_after=True (the default,
+    CuDNN convention) maps to GRUResetAfter / the gru_onnx kernel;
+    reset_after=False maps to the fused-gate GRU layer."""
+    units = int(cfg["units"])
+    if _act(cfg.get("activation", "tanh")) != "tanh" or \
+            cfg.get("recurrent_activation", "sigmoid") != "sigmoid":
+        raise ImportException("Keras GRU with non-default activations is "
+                              "not supported")
+    reset_after = bool(cfg.get("reset_after", True))
+    ret_seq = bool(cfg.get("return_sequences", False))
+    if reset_after:
+        inner = LX.GRUResetAfter(n_out=units, name=cfg.get("name"))
+    else:
+        inner = LX.GRU(n_out=units, name=cfg.get("name"))
+    layer = inner if ret_seq else LX.LastTimeStep(underlying=inner,
+                                                  name=cfg.get("name"))
+
+    def set_weights(weights, in_type):
+        kernel, rec = np.asarray(weights[0]), np.asarray(weights[1])
+        bias = np.asarray(weights[2]) if len(weights) > 2 else None
+        H = units
+        if reset_after:
+            w = kernel.T                      # [3H, In], rows z|r|h
+            r = rec.T
+            if bias is None:
+                b = np.zeros(6 * H, np.float32)
+            elif bias.ndim == 2:              # [2, 3H]: input + recurrent
+                b = np.concatenate([bias[0], bias[1]])
+            else:
+                b = np.concatenate([bias, np.zeros(3 * H, bias.dtype)])
+            return {"W": jnp.asarray(w), "R": jnp.asarray(r),
+                    "b": jnp.asarray(b)}
+        kz, kr, kh = np.split(kernel, 3, axis=1)
+        rz, rr, rh = np.split(rec, 3, axis=1)
+        if bias is None:
+            bias = np.zeros(3 * H, np.float32)
+        bz, br, bh = np.split(bias.reshape(-1)[:3 * H], 3)
+        w_ru = np.concatenate([np.concatenate([kr, kz], 1),
+                               np.concatenate([rr, rz], 1)], 0)
+        w_c = np.concatenate([kh, rh], 0)
+        return {"Wru": jnp.asarray(w_ru), "Wc": jnp.asarray(w_c),
+                "bru": jnp.asarray(np.concatenate([br, bz])),
+                "bc": jnp.asarray(bh)}
+
+    return _Adapted(layer, set_weights)
+
+
+def _bidirectional_adapter(cfg):
+    inner_spec = cfg.get("layer", {})
+    inner_cls = inner_spec.get("class_name")
+    inner_cfg = dict(inner_spec.get("config", {}))
+    if not inner_cfg.get("return_sequences", False):
+        raise ImportException(
+            "Bidirectional(return_sequences=False) is not supported — the "
+            "backward half's final state is at t=0, which the sequence-"
+            "output wrapper cannot recover; re-export with "
+            "return_sequences=True + pooling")
+    mode = {"concat": "concat", "sum": "add", "mul": "mul",
+            "ave": "ave", None: "concat"}.get(cfg.get("merge_mode",
+                                                      "concat"))
+    if mode is None:
+        raise ImportException(
+            f"Bidirectional merge_mode={cfg.get('merge_mode')!r} "
+            f"unsupported")
+    inner = _adapt_layer(inner_cls, inner_cfg, None)
+    layer = L.Bidirectional(fwd=inner.layer, mode=mode,
+                            name=cfg.get("name"))
+
+    def set_weights(weights, in_type):
+        half = len(weights) // 2
+        return {"fwd": inner.set_weights(weights[:half], in_type),
+                "bwd": inner.set_weights(weights[half:], in_type)}
+
+    return _Adapted(layer, set_weights)
+
+
+def _time_distributed_adapter(cfg):
+    inner_spec = cfg.get("layer", {})
+    if inner_spec.get("class_name") != "Dense":
+        raise ImportException("TimeDistributed only supports Dense "
+                              f"(got {inner_spec.get('class_name')!r})")
+    inner = _dense_adapter(dict(inner_spec.get("config", {})), None)
+    layer = LX.TimeDistributed(underlying=inner.layer, name=cfg.get("name"))
+    return _Adapted(layer, inner.set_weights)
+
+
+def _conv1d_adapter(cfg):
+    pad = cfg.get("padding", "valid")
+    if pad == "causal":
+        raise ImportException("Conv1D padding='causal' not supported")
+    layer = L.Convolution1DLayer(
+        n_out=int(cfg["filters"]), kernel_size=int(_pair(cfg["kernel_size"])[0]),
+        stride=int(_pair(cfg.get("strides", 1))[0]),
+        padding="SAME" if pad == "same" else "VALID",
+        activation=_act(cfg.get("activation")),
+        has_bias=bool(cfg.get("use_bias", True)), name=cfg.get("name"))
+
+    def set_weights(weights, in_type):
+        p = {"W": jnp.asarray(np.asarray(weights[0]))}  # [k, in, out] shared
+        if cfg.get("use_bias", True):
+            p["b"] = jnp.asarray(np.asarray(weights[1]))
+        return p
+
+    return _Adapted(layer, set_weights)
+
+
+def _conv3d_adapter(cfg):
+    layer = LX.Convolution3D(
+        n_out=int(cfg["filters"]),
+        kernel_size=tuple(int(k) for k in cfg["kernel_size"]),
+        stride=tuple(int(s) for s in cfg.get("strides", (1, 1, 1))),
+        padding="SAME" if cfg.get("padding", "valid") == "same" else "VALID",
+        activation=_act(cfg.get("activation")),
+        has_bias=bool(cfg.get("use_bias", True)), name=cfg.get("name"))
+
+    def set_weights(weights, in_type):
+        p = {"W": jnp.asarray(np.asarray(weights[0]))}  # DHWIO both sides
+        if cfg.get("use_bias", True):
+            p["b"] = jnp.asarray(np.asarray(weights[1]))
+        return p
+
+    return _Adapted(layer, set_weights)
+
+
+def _separable_conv2d_adapter(cfg):
+    use_bias = bool(cfg.get("use_bias", True))
+    layer = L.SeparableConvolution2D(
+        n_out=int(cfg["filters"]), kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", (1, 1))),
+        padding="SAME" if cfg.get("padding", "valid") == "same" else "VALID",
+        depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+        activation=_act(cfg.get("activation")), has_bias=use_bias,
+        name=cfg.get("name"))
+
+    def set_weights(weights, in_type):
+        p = {"Wd": jnp.asarray(np.asarray(weights[0])),
+             "Wp": jnp.asarray(np.asarray(weights[1]))}
+        if use_bias:
+            p["b"] = jnp.asarray(np.asarray(weights[2]))
+        return p
+
+    return _Adapted(layer, set_weights)
+
+
+def _conv2d_transpose_adapter(cfg):
+    use_bias = bool(cfg.get("use_bias", True))
+    layer = L.DeconvolutionLayer(
+        n_out=int(cfg["filters"]), kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", (1, 1))),
+        padding="SAME" if cfg.get("padding", "valid") == "same" else "VALID",
+        activation=_act(cfg.get("activation")), has_bias=use_bias,
+        name=cfg.get("name"))
+
+    def set_weights(weights, in_type):
+        # keras kernel is [kh, kw, out, in] — ours too
+        p = {"W": jnp.asarray(np.asarray(weights[0]))}
+        if use_bias:
+            p["b"] = jnp.asarray(np.asarray(weights[1]))
+        return p
+
+    return _Adapted(layer, set_weights)
+
+
+def _locally_connected2d_adapter(cfg):
+    if int(cfg.get("implementation", 1)) not in (1, 2, 3):
+        raise ImportException("unknown LocallyConnected2D implementation")
+    use_bias = bool(cfg.get("use_bias", True))
+    kh, kw = _pair(cfg["kernel_size"])
+    layer = LX.LocallyConnected2D(
+        n_out=int(cfg["filters"]), kernel_size=(kh, kw),
+        stride=_pair(cfg.get("strides", (1, 1))),
+        activation=_act(cfg.get("activation")), has_bias=use_bias,
+        name=cfg.get("name"))
+
+    def set_weights(weights, in_type):
+        k = np.asarray(weights[0])        # [P, kh*kw*in, out] (keras order)
+        P, _, out = k.shape
+        c = k.shape[1] // (kh * kw)
+        # keras flattens patches (kh, kw, c); ours are channel-major (c,kh,kw)
+        k = k.reshape(P, kh, kw, c, out).transpose(0, 3, 1, 2, 4) \
+            .reshape(P, c * kh * kw, out)
+        p = {"W": jnp.asarray(k)}
+        if use_bias:
+            p["b"] = jnp.asarray(np.asarray(weights[1]).reshape(P, out))
+        return p
+
+    return _Adapted(layer, set_weights)
+
+
+def _prelu_adapter(cfg):
+    layer = LX.PReLULayer(name=cfg.get("name"))
+
+    def set_weights(weights, in_type):
+        alpha = np.asarray(weights[0])
+        if alpha.ndim > 1:
+            squeezed = alpha.reshape(-1) if alpha.size == alpha.shape[-1] \
+                else None
+            if squeezed is None:
+                raise ImportException(
+                    "PReLU with per-position alpha is not supported; use "
+                    "shared_axes over the spatial dims")
+            alpha = squeezed
+        return {"alpha": jnp.asarray(alpha)}
+
+    return _Adapted(layer, set_weights)
+
+
+def _layer_norm_adapter(cfg):
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, (list, tuple)):
+        if len(axis) != 1:
+            raise ImportException("multi-axis LayerNormalization "
+                                  "unsupported")
+        axis = axis[0]
+    if int(axis) not in (-1,):
+        raise ImportException("only axis=-1 LayerNormalization supported")
+    layer = LX.LayerNormalizationLayer(eps=float(cfg.get("epsilon", 1e-3)),
+                                       name=cfg.get("name"))
+
+    def set_weights(weights, in_type):
+        ws = [np.asarray(a) for a in weights]
+        if bool(cfg.get("scale", True)):
+            gamma, rest = ws[0], ws[1:]
+        else:
+            gamma, rest = np.ones(ws[0].shape[0], np.float32), ws
+        beta = rest[0] if rest and bool(cfg.get("center", True)) \
+            else np.zeros(gamma.shape[0], np.float32)
+        return {"gamma": jnp.asarray(gamma), "beta": jnp.asarray(beta)}
+
+    return _Adapted(layer, set_weights)
+
+
+def _cropping_tuple(val, n):
+    """Keras cropping/padding spec -> flat per-side tuple of length 2n."""
+    if isinstance(val, int):
+        return (val, val) * n
+    val = list(val)
+    if all(isinstance(v, int) for v in val):
+        if len(val) == n:          # symmetric per-dim
+            return tuple(x for v in val for x in (v, v))
+        return tuple(int(v) for v in val)  # already per-side (1-D case)
+    return tuple(int(x) for pair in val for x in pair)
 
 
 def _simple_rnn_adapter(cfg):
@@ -254,16 +513,107 @@ def _adapt_layer(class_name: str, cfg: Dict[str, Any],
     if class_name == "SimpleRNN":
         return _simple_rnn_adapter(cfg)
     if class_name == "ZeroPadding2D":
-        pad = cfg.get("padding", (1, 1))
-        if isinstance(pad, (list, tuple)) and pad and \
-                isinstance(pad[0], (list, tuple)):
-            padding = (int(pad[0][0]), int(pad[0][1]),
-                       int(pad[1][0]), int(pad[1][1]))
-        else:
-            ph, pw = _pair(pad)
-            padding = (ph, ph, pw, pw)
+        padding = _cropping_tuple(cfg.get("padding", (1, 1)), 2)
         return _Adapted(L.ZeroPaddingLayer(padding=padding,
                                            name=cfg.get("name")))
+    if class_name == "GRU":
+        return _gru_adapter(cfg)
+    if class_name == "Bidirectional":
+        return _bidirectional_adapter(cfg)
+    if class_name == "TimeDistributed":
+        return _time_distributed_adapter(cfg)
+    if class_name == "Conv1D":
+        return _conv1d_adapter(cfg)
+    if class_name == "Conv3D":
+        return _conv3d_adapter(cfg)
+    if class_name == "SeparableConv2D":
+        return _separable_conv2d_adapter(cfg)
+    if class_name == "Conv2DTranspose":
+        return _conv2d_transpose_adapter(cfg)
+    if class_name in ("LocallyConnected2D",):
+        return _locally_connected2d_adapter(cfg)
+    if class_name == "PReLU":
+        return _prelu_adapter(cfg)
+    if class_name == "LayerNormalization":
+        return _layer_norm_adapter(cfg)
+    if class_name in ("MaxPooling1D", "AveragePooling1D"):
+        if cfg.get("padding", "valid") == "same":
+            raise ImportException(f"{class_name} padding='same' unsupported")
+        pool = cfg.get("pool_size", 2)
+        pool = int(pool[0]) if isinstance(pool, (list, tuple)) else int(pool)
+        st = cfg.get("strides") or pool
+        st = int(st[0]) if isinstance(st, (list, tuple)) else int(st)
+        return _Adapted(LX.Subsampling1DLayer(
+            pooling_type="max" if class_name.startswith("Max") else "avg",
+            kernel_size=pool, stride=st, name=cfg.get("name")))
+    if class_name in ("MaxPooling3D", "AveragePooling3D"):
+        return _Adapted(LX.Subsampling3DLayer(
+            pooling_type="max" if class_name.startswith("Max") else "avg",
+            kernel_size=tuple(int(k) for k in cfg.get("pool_size",
+                                                      (2, 2, 2))),
+            stride=tuple(int(s) for s in (cfg.get("strides") or
+                                          cfg.get("pool_size", (2, 2, 2)))),
+            padding="SAME" if cfg.get("padding", "valid") == "same"
+            else "VALID", name=cfg.get("name")))
+    if class_name in ("GlobalAveragePooling1D", "GlobalAveragePooling3D"):
+        return _Adapted(L.GlobalPoolingLayer(pooling_type="avg",
+                                             name=cfg.get("name")))
+    if class_name in ("GlobalMaxPooling1D", "GlobalMaxPooling3D"):
+        return _Adapted(L.GlobalPoolingLayer(pooling_type="max",
+                                             name=cfg.get("name")))
+    if class_name == "UpSampling1D":
+        return _Adapted(LX.Upsampling1D(size=int(cfg.get("size", 2)),
+                                        name=cfg.get("name")))
+    if class_name == "UpSampling2D":
+        if cfg.get("interpolation", "nearest") != "nearest":
+            raise ImportException("UpSampling2D interpolation must be "
+                                  "'nearest'")
+        return _Adapted(L.Upsampling2D(size=_pair(cfg.get("size", (2, 2))),
+                                       name=cfg.get("name")))
+    if class_name == "UpSampling3D":
+        return _Adapted(LX.Upsampling3D(
+            size=tuple(int(s) for s in cfg.get("size", (2, 2, 2))),
+            name=cfg.get("name")))
+    if class_name == "Cropping1D":
+        return _Adapted(LX.Cropping1D(
+            cropping=_cropping_tuple(cfg.get("cropping", (1, 1)), 1),
+            name=cfg.get("name")))
+    if class_name == "Cropping2D":
+        return _Adapted(LX.Cropping2D(
+            cropping=_cropping_tuple(cfg.get("cropping", (1, 1)), 2),
+            name=cfg.get("name")))
+    if class_name == "Cropping3D":
+        return _Adapted(LX.Cropping3D(
+            cropping=_cropping_tuple(cfg.get("cropping", (1, 1, 1)), 3),
+            name=cfg.get("name")))
+    if class_name == "ZeroPadding1D":
+        return _Adapted(LX.ZeroPadding1DLayer(
+            padding=_cropping_tuple(cfg.get("padding", (1, 1)), 1),
+            name=cfg.get("name")))
+    if class_name == "ZeroPadding3D":
+        return _Adapted(LX.ZeroPadding3DLayer(
+            padding=_cropping_tuple(cfg.get("padding", (1, 1, 1)), 3),
+            name=cfg.get("name")))
+    if class_name in ("SpatialDropout1D", "SpatialDropout2D",
+                      "SpatialDropout3D"):
+        return _Adapted(LX.SpatialDropout(rate=float(cfg.get("rate", 0.5)),
+                                          name=cfg.get("name")))
+    if class_name == "GaussianDropout":
+        return _Adapted(LX.GaussianDropout(rate=float(cfg.get("rate", 0.5)),
+                                           name=cfg.get("name")))
+    if class_name == "GaussianNoise":
+        return _Adapted(LX.GaussianNoise(stddev=float(cfg.get("stddev",
+                                                              0.1)),
+                                         name=cfg.get("name")))
+    if class_name == "AlphaDropout":
+        return _Adapted(LX.AlphaDropout(rate=float(cfg.get("rate", 0.5)),
+                                        name=cfg.get("name")))
+    if class_name == "RepeatVector":
+        return _Adapted(LX.RepeatVector(n=int(cfg.get("n", 1)),
+                                        name=cfg.get("name")))
+    if class_name == "Softmax":
+        return _Adapted(L.ActivationLayer(activation="softmax",
+                                          name=cfg.get("name")))
     raise ImportException(f"unsupported Keras layer type {class_name!r}")
 
 
@@ -340,10 +690,79 @@ def _keras_out_shape(class_name, cfg, in_shape):
         return (int(np.prod(in_shape)),)
     if class_name == "Embedding":
         return tuple(in_shape) + (int(cfg["output_dim"]),)
-    if class_name == "LSTM":
+    if class_name in ("LSTM", "GRU", "SimpleRNN"):
         units = int(cfg["units"])
         return (in_shape[0], units) if cfg.get("return_sequences") \
             else (units,)
+    if class_name == "Bidirectional":
+        inner_cfg = cfg.get("layer", {}).get("config", {})
+        units = int(inner_cfg.get("units", 0))
+        if cfg.get("merge_mode", "concat") == "concat":
+            units *= 2
+        return (in_shape[0], units) if inner_cfg.get("return_sequences") \
+            else (units,)
+    if class_name == "TimeDistributed":
+        inner_cfg = cfg.get("layer", {}).get("config", {})
+        return (in_shape[0], int(inner_cfg.get("units", in_shape[-1])))
+    if class_name == "Conv1D":
+        t, f = in_shape
+        k = _pair(cfg["kernel_size"])[0]
+        s = _pair(cfg.get("strides", 1))[0]
+        ot = -(-t // s) if cfg.get("padding", "valid") == "same" \
+            else (t - k) // s + 1
+        return (ot, int(cfg["filters"]))
+    if class_name in ("MaxPooling1D", "AveragePooling1D"):
+        t, f = in_shape
+        pool = cfg.get("pool_size", 2)
+        pool = int(pool[0]) if isinstance(pool, (list, tuple)) else int(pool)
+        st = cfg.get("strides") or pool
+        st = int(st[0]) if isinstance(st, (list, tuple)) else int(st)
+        return ((t - pool) // st + 1, f)
+    if class_name in ("GlobalAveragePooling1D", "GlobalMaxPooling1D"):
+        return (in_shape[-1],)
+    if class_name in ("SeparableConv2D", "Conv2DTranspose"):
+        h, w, c = in_shape
+        sh, sw = _pair(cfg.get("strides", (1, 1)))
+        kh, kw = _pair(cfg["kernel_size"])
+        same = cfg.get("padding", "valid") == "same"
+        if class_name == "Conv2DTranspose":
+            oh = h * sh if same else sh * (h - 1) + kh
+            ow = w * sw if same else sw * (w - 1) + kw
+        elif same:
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:
+            oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        return (oh, ow, int(cfg["filters"]))
+    if class_name == "UpSampling2D":
+        h, w, c = in_shape
+        sh, sw = _pair(cfg.get("size", (2, 2)))
+        return (h * sh, w * sw, c)
+    if class_name == "Cropping2D":
+        h, w, c = in_shape
+        t, b, l, r = _cropping_tuple(cfg.get("cropping", (1, 1)), 2)
+        return (h - t - b, w - l - r, c)
+    if class_name == "RepeatVector":
+        return (int(cfg.get("n", 1)), in_shape[0])
+    if class_name == "Conv3D":
+        d, h, w, c = in_shape
+        kd, kh, kw = (int(k) for k in cfg["kernel_size"])
+        sd, sh, sw = (int(s) for s in cfg.get("strides", (1, 1, 1)))
+        if cfg.get("padding", "valid") == "same":
+            od, oh, ow = -(-d // sd), -(-h // sh), -(-w // sw)
+        else:
+            od, oh, ow = ((d - kd) // sd + 1, (h - kh) // sh + 1,
+                          (w - kw) // sw + 1)
+        return (od, oh, ow, int(cfg["filters"]))
+    if class_name in ("MaxPooling3D", "AveragePooling3D"):
+        d, h, w, c = in_shape
+        ps = cfg.get("pool_size", (2, 2, 2))
+        ps = (ps,) * 3 if isinstance(ps, int) else tuple(int(p) for p in ps)
+        st = cfg.get("strides") or ps
+        st = (st,) * 3 if isinstance(st, int) else tuple(int(s) for s in st)
+        if cfg.get("padding", "valid") == "same":
+            return (-(-d // st[0]), -(-h // st[1]), -(-w // st[2]), c)
+        return ((d - ps[0]) // st[0] + 1, (h - ps[1]) // st[1] + 1,
+                (w - ps[2]) // st[2] + 1, c)
     if class_name == "ZeroPadding2D":
         h, w, c = in_shape
         pad = cfg.get("padding", (1, 1))
@@ -398,7 +817,7 @@ class KerasModelImport:
         idx = 0
         for e in entries:
             cls, cfg = e["class_name"], e.get("config", {})
-            if cls == "Flatten" and cur is not None and len(cur) == 3:
+            if cls == "Flatten" and cur is not None and len(cur) in (3, 4):
                 conv_src = cur
             shape_for_adapter = conv_src if (cls == "Dense" and conv_src) \
                 else cur

@@ -139,7 +139,11 @@ def _conv(node, ctx):
         w = ctx.sd.constant(w_d, w_name.replace(":", "_") + "_dw")
         opn, kw = "depthwise_conv2d", {}
     else:
-        raise ImportException(f"grouped Conv (group={group}) not supported")
+        # grouped conv: OIHW [O, In/g, kh, kw] -> HWIO [kh, kw, In/g, O],
+        # lowered via lax feature_group_count (conv_ops.conv2d groups=)
+        w = ctx.sd.constant(np.transpose(w_np, (2, 3, 1, 0)),
+                            w_name.replace(":", "_") + "_hwio")
+        opn, kw = "conv2d", {"groups": group}
     bias = ctx.get(node.inputs[2]) if len(node.inputs) > 2 and \
         node.inputs[2] else None
     ctx.emit(opn, [x, w, bias], node.outputs[0], strides=strides,
@@ -411,6 +415,52 @@ def _shape(node, ctx):
 def _einsum(node, ctx):
     ctx.emit("einsum", [ctx.get(i) for i in node.inputs], node.outputs[0],
              equation=node.attrs.get("equation"))
+
+
+@mapper(ONNX, "GRU")
+def _gru(node, ctx):
+    """ONNX GRU -> gru_onnx (reference gruCell semantics,
+    `libnd4j/include/ops/declarable/headers/recurrent.h`). Both
+    linear_before_reset conventions are supported (torch exports 1).
+
+    Layout: X [T, B, In]; W [1, 3H, In] (z, r, h); R [1, 3H, H]; B [1, 6H].
+    Outputs: Y [T, 1, B, H], Y_h [1, B, H]."""
+    if node.attrs.get("direction", "forward") != "forward":
+        raise ImportException("only forward ONNX GRU supported")
+    for attr in ("activations", "activation_alpha", "activation_beta",
+                 "clip"):
+        if node.attrs.get(attr):
+            raise ImportException(f"ONNX GRU attr {attr!r} not supported")
+    if int(node.attrs.get("layout", 0)) != 0:
+        raise ImportException("ONNX GRU layout=1 (batch-major) not "
+                              "supported; export with layout=0")
+    if len(node.inputs) > 4 and node.inputs[4]:
+        raise ImportException("ONNX GRU sequence_lens not supported")
+    H = int(node.attrs["hidden_size"])
+    lbr = int(node.attrs.get("linear_before_reset", 0))
+    w_np = ctx.const_value(node.inputs[1])[0]     # [3H, In]
+    r_np = ctx.const_value(node.inputs[2])[0]     # [3H, H]
+    b_np = ctx.const_value(node.inputs[3])[0] if len(node.inputs) > 3 and \
+        node.inputs[3] else np.zeros(6 * H, np.float32)
+    h0 = None
+    if len(node.inputs) > 5 and node.inputs[5]:   # initial_h [1, B, H]
+        h0 = ctx.sd._record("squeeze", [ctx.get(node.inputs[5])], axis=0)
+    w = ctx.sd.constant(w_np, node.name + "_w")
+    r = ctx.sd.constant(r_np, node.name + "_r")
+    b = ctx.sd.constant(b_np, node.name + "_b")
+    x = ctx.get(node.inputs[0])
+    gru_in = [x, w, r, b]
+    if h0 is not None:
+        gru_in.append(h0)
+    h_seq, h_last = ctx.sd._record(
+        "gru_onnx", gru_in, n_outputs=2,
+        out_name=node.name.replace(":", "_"), linear_before_reset=lbr,
+        time_major=True)
+    outs = node.outputs
+    if len(outs) > 0 and outs[0]:
+        ctx.emit("expand_dims", [h_seq], outs[0], axis=1)
+    if len(outs) > 1 and outs[1]:
+        ctx.emit("expand_dims", [h_last], outs[1], axis=0)
 
 
 @mapper(ONNX, "LSTM")

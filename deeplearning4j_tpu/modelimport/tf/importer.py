@@ -187,22 +187,10 @@ class TFGraphImporter:
         g = self.graph
 
         # TF1 while frames (Enter/Merge/Switch/... cycles) lower to single
-        # while_loop nodes before the acyclic pass
+        # while_loop nodes before the acyclic pass; nested frames lower
+        # innermost-first via graph rewriting (see while_frames.plan_frames)
         from .while_frames import plan_frames
-        plans = plan_frames(g)
-        if plans:
-            removed = set()
-            for p in plans:
-                removed |= p.consumed
-            kept = [n for n in g.nodes if n.name not in removed]
-            for i, p in enumerate(plans):
-                kept.append(IRNode(
-                    name=f"__while_frame_{i}", op_type="_TF1WhileFrame",
-                    inputs=list(p.init_tensors) + list(p.cap_union),
-                    outputs=list(p.out_tensors), attrs={"plan": i}))
-            g = IRGraph(framework=g.framework, nodes=kept,
-                        initializers=g.initializers, inputs=g.inputs,
-                        outputs=g.outputs)
+        plans, g = plan_frames(g)
 
         unmapped = sorted({n.op_type for n in g.nodes
                            if get_mapper(g.framework, n.op_type) is None

@@ -12,8 +12,10 @@ Frame anatomy (per TF control-flow spec, one frame per while):
   cond nodes -> LoopCond -> Switch_i(Merge_i, LoopCond)
   Switch_i:1 -> body nodes -> NextIteration_i        (loop taken)
   Switch_i:0 -> Exit_i                               (loop done)
-Nested frames are rejected (no fixture exercises them; lax nesting exists
-when needed).
+Nested frames lower innermost-first: each planned frame is replaced in the
+graph by a synthetic `_TF1WhileFrame` node, so an outer frame's body simply
+contains an already-lowered inner `while_loop` (arbitrary nesting depth,
+matching the reference interpreter's FrameIter stack semantics).
 """
 from __future__ import annotations
 
@@ -139,10 +141,12 @@ def _interior(frame: WhileFrame, nodes: List[IRNode],
 
 def _build_subgraph(graph: IRGraph, interior: List[IRNode],
                     var_aliases: Dict[str, int], n_vars: int,
-                    out_tensors: List[str], prefix: str
+                    out_tensors: List[str], prefix: str,
+                    plans: List["FramePlan"] = ()
                     ) -> Tuple[SubGraph, List[str]]:
     """Map interior TF nodes into a SubGraph whose placeholders are the
-    loop variables; external tensors become captured names."""
+    loop variables; external tensors become captured names. `plans` holds
+    already-lowered inner frames (`_TF1WhileFrame` interior nodes)."""
     sub_sd = SameDiff.create()
     ctx = ImportContext(
         IRGraph(framework="tensorflow", nodes=interior,
@@ -164,7 +168,12 @@ def _build_subgraph(graph: IRGraph, interior: List[IRNode],
     for c in captured:
         ctx.bind(c, sub_sd.placeholder(c.replace(":", "_")))
 
-    for node in interior:
+    # graph-rewriting (nested frames) can leave interior out of order
+    from .importer import _toposort
+    for node in _toposort(interior, set(var_aliases) | set(captured)):
+        if node.op_type == "_TF1WhileFrame":
+            plans[node.attrs["plan"]].emit(ctx)
+            continue
         rule = get_mapper("tensorflow", node.op_type)
         if rule is None:
             raise ImportException(
@@ -191,32 +200,54 @@ def _build_subgraph(graph: IRGraph, interior: List[IRNode],
     return sg, captured
 
 
+class _NestedFrame(Exception):
+    """Raised when a frame's interior still contains another (un-lowered)
+    frame — plan_frames defers it until the inner frame is rewritten."""
+
+
 class FramePlan:
     """Pre-built lowering of one while frame (SubGraphs are static — only
     the init/capture VALUES need the outer import context)."""
 
-    def __init__(self, graph: IRGraph, frame: WhileFrame):
+    _STRUCTURAL_OPS = ("Enter", "Merge", "Switch", "Exit", "NextIteration",
+                       "LoopCond")
+
+    def __init__(self, graph: IRGraph, frame: WhileFrame,
+                 plans: List["FramePlan"] = ()):
         n = frame.n_vars()
         nodes = graph.nodes
 
         merge_alias = {m.outputs[0]: i for i, m in enumerate(frame.merges)}
         cond_stop = frame.structural
         cond_interior = _interior(frame, nodes, list(merge_alias), cond_stop)
-        self.cond_sg, cond_caps = _build_subgraph(
-            graph, cond_interior, merge_alias, n,
-            [frame.loop_cond.inputs[0]], "c")
 
         body_alias = dict(merge_alias)
         for idx, s in frame.switch_for_var.items():
             body_alias[f"{s.name}:1"] = idx
         body_interior = _interior(frame, nodes, list(body_alias), cond_stop)
+
+        for node in cond_interior + body_interior:
+            if node.op_type in self._STRUCTURAL_OPS and \
+                    node.name not in frame.structural:
+                # an is_constant Enter of an ALREADY-lowered inner frame is
+                # a plain identity pass-through (the Enter mapper handles
+                # it); a live inner frame also exposes Merge/LoopCond here
+                # and still defers
+                if node.op_type == "Enter":
+                    continue
+                raise _NestedFrame(frame.frame_name)
+
+        self.cond_sg, cond_caps = _build_subgraph(
+            graph, cond_interior, merge_alias, n,
+            [frame.loop_cond.inputs[0]], "c", plans)
+
         body_outs = []
         for i in range(n):
             t = frame.next_iters[i].inputs[0] if i in frame.next_iters \
                 else frame.merges[i].outputs[0]  # un-advanced var
             body_outs.append(t)
         self.body_sg, body_caps = _build_subgraph(
-            graph, body_interior, body_alias, n, body_outs, "b")
+            graph, body_interior, body_alias, n, body_outs, "b", plans)
 
         self.cap_union: List[str] = []
         for c in cond_caps + body_caps:
@@ -245,7 +276,45 @@ class FramePlan:
             ctx.bind(tensor, outs[i])
 
 
-def plan_frames(graph: IRGraph) -> List[FramePlan]:
-    """Recognize and pre-lower every while frame in the graph."""
-    return [FramePlan(graph, WhileFrame(fname, graph.nodes))
-            for fname in find_frames(graph.nodes)]
+def plan_frames(graph: IRGraph) -> Tuple[List[FramePlan], IRGraph]:
+    """Recognize and pre-lower every while frame, innermost-first.
+
+    Each planned frame's nodes are replaced by one synthetic
+    `_TF1WhileFrame` node, so outer frames see inner loops as ordinary
+    single nodes (arbitrary nesting). Returns (plans, rewritten graph);
+    the synthetic node's attrs["plan"] indexes into plans.
+    """
+    plans: List[FramePlan] = []
+    while True:
+        pending = find_frames(graph.nodes)
+        # a lowered frame leaves its is_constant (loop-invariant) Enters
+        # behind as identity pass-throughs — only frames that still have a
+        # Merge-fed loop variable remain to be planned
+        merges_in = {i for n in graph.nodes if n.op_type == "Merge"
+                     for i in n.inputs}
+        pending = {f: ens for f, ens in pending.items()
+                   if any(e.outputs[0] in merges_in for e in ens)}
+        if not pending:
+            return plans, graph
+        progressed = False
+        for fname in list(pending):
+            try:
+                plan = FramePlan(graph, WhileFrame(fname, graph.nodes),
+                                 plans)
+            except _NestedFrame:
+                continue  # an inner frame must lower first
+            idx = len(plans)
+            plans.append(plan)
+            kept = [n for n in graph.nodes if n.name not in plan.consumed]
+            kept.append(IRNode(
+                name=f"__while_frame_{idx}", op_type="_TF1WhileFrame",
+                inputs=list(plan.init_tensors) + list(plan.cap_union),
+                outputs=list(plan.out_tensors), attrs={"plan": idx}))
+            graph = IRGraph(framework=graph.framework, nodes=kept,
+                            initializers=graph.initializers,
+                            inputs=graph.inputs, outputs=graph.outputs)
+            progressed = True
+        if not progressed:
+            raise ImportException(
+                f"could not lower while frames {sorted(pending)} — "
+                f"mutually nested or irregular frame structure")

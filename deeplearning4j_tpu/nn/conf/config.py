@@ -36,6 +36,10 @@ class InputType:
     def convolutional(height: int, width: int, channels: int):
         return (int(channels), int(height), int(width))
 
+    @staticmethod
+    def convolutional3d(depth: int, height: int, width: int, channels: int):
+        return (int(channels), int(depth), int(height), int(width))
+
 
 # -- input preprocessors (auto-inserted reshapes) ------------------------
 @dataclasses.dataclass
@@ -44,8 +48,10 @@ class CnnToFeedForwardPreProcessor:
         return x.reshape(x.shape[0], -1)
 
     def out_type(self, input_type):
-        c, h, w = input_type
-        return (c * h * w,)
+        n = 1
+        for d in input_type:  # (c, h, w) or (c, d, h, w)
+            n *= int(d)
+        return (n,)
 
 
 @dataclasses.dataclass
@@ -105,7 +111,7 @@ class CnnToRnnPreProcessor:
 
 
 def _is_cnn(t):
-    return t is not None and len(t) == 3
+    return t is not None and len(t) in (3, 4)  # 2-D or 3-D conv activations
 
 
 def _is_rnn(t):

@@ -317,6 +317,85 @@ class GRU(Layer):
 
 
 @dataclasses.dataclass
+class GRUResetAfter(Layer):
+    """GRU with the reset-gate applied AFTER the recurrent matmul and
+    separate input/recurrent biases — the Keras `reset_after=True` (CuDNN)
+    convention, which the fused-gate GRU above cannot express. Params use
+    the ONNX/keras-transposed layout: W [3H, In], R [3H, H], b [6H] with
+    gate rows (z, r, h). Runs over [B, F, T] like the other RNN layers."""
+    n_in: int = 0
+    n_out: int = 0
+    weight_init: str = "xavier"
+
+    def init_params(self, key, input_type):
+        n_in = self.n_in or input_type[0]
+        k1, k2 = jax.random.split(key)
+        return {"W": init_weights(k1, (3 * self.n_out, n_in),
+                                  self.weight_init),
+                "R": init_weights(k2, (3 * self.n_out, self.n_out),
+                                  self.weight_init),
+                "b": jnp.zeros((6 * self.n_out,))}
+
+    def forward(self, params, x, training=False, key=None):
+        xt = jnp.swapaxes(x, 1, 2)  # [B, T, F]
+        h_seq, _ = recurrent.gru_onnx(xt, params["W"], params["R"],
+                                      params["b"], linear_before_reset=1,
+                                      time_major=False)
+        return jnp.swapaxes(h_seq, 1, 2)
+
+    def output_type(self, input_type):
+        return (self.n_out, input_type[1])
+
+
+@dataclasses.dataclass
+class SpatialDropout(Layer):
+    """Drop whole channels (reference conf/dropout/SpatialDropout.java):
+    one mask entry per [B, C], broadcast over the trailing spatial/time
+    dims."""
+    rate: float = 0.5
+
+    def forward(self, params, x, training=False, key=None):
+        if not training or key is None or self.rate <= 0:
+            return x
+        keep = 1.0 - self.rate
+        mask_shape = x.shape[:2] + (1,) * (x.ndim - 2)
+        mask = jax.random.bernoulli(key, keep, mask_shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+    def has_params(self):
+        return False
+
+    def needs_key(self):
+        return True
+
+
+@dataclasses.dataclass
+class LayerNormalizationLayer(Layer):
+    """Feature-axis layer norm with learned gamma/beta (the Keras
+    LayerNormalization adapter target; SameDiff-side reference is the
+    layer_norm op, `libnd4j/.../declarable/headers/nn.h` layer_norm).
+    Normalizes over the channel axis (axis 1 for rank>=3, else last)."""
+    n_out: int = 0  # inferred
+    eps: float = 1e-3
+
+    def init_params(self, key, input_type):
+        c = self.n_out or input_type[0]
+        return {"gamma": jnp.ones((c,)), "beta": jnp.zeros((c,))}
+
+    def forward(self, params, x, training=False, key=None):
+        axis = 1 if x.ndim >= 3 else -1
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axis, keepdims=True)
+        var = jnp.var(xf, axis=axis, keepdims=True)
+        norm = (xf - mean) / jnp.sqrt(var + self.eps)
+        shape = [1] * x.ndim
+        shape[axis] = params["gamma"].shape[0]
+        out = norm * params["gamma"].reshape(shape) + \
+            params["beta"].reshape(shape)
+        return out.astype(x.dtype)
+
+
+@dataclasses.dataclass
 class LastTimeStep(Layer):
     """Wrapper: last time step of an RNN layer's [B, F, T] output
     (reference conf/layers/recurrent/LastTimeStep.java)."""

@@ -35,6 +35,9 @@ def _pair(v: IntOrPair, n=2) -> Tuple[int, ...]:
 def _padding(padding, kernel, strides, dilation, n):
     if isinstance(padding, str):
         return padding.upper()
+    if isinstance(padding, (list, tuple)) and padding and \
+            isinstance(padding[0], (list, tuple)):
+        return [tuple(int(x) for x in p) for p in padding]  # per-side pairs
     p = _pair(padding, n)
     return [(x, x) for x in p]
 
@@ -51,12 +54,15 @@ def _dn(data_format: str, n: int):
 
 @op("conv2d", "conv")
 def conv2d(x, weights, bias=None, strides=(1, 1), padding="SAME",
-           dilation=(1, 1), data_format="NCHW"):
+           dilation=(1, 1), data_format="NCHW", groups=1):
+    """groups > 1 = grouped convolution (weights [kh, kw, inC/groups, outC]),
+    lowered to XLA's native feature_group_count — no per-group slicing."""
     dn = lax.conv_dimension_numbers(x.shape, weights.shape, _dn(data_format, 2))
     out = lax.conv_general_dilated(
         x, weights, window_strides=_pair(strides),
         padding=_padding(padding, weights.shape[:2], strides, dilation, 2),
-        rhs_dilation=_pair(dilation), dimension_numbers=dn)
+        rhs_dilation=_pair(dilation), dimension_numbers=dn,
+        feature_group_count=int(groups))
     if bias is not None:
         out = out + (bias.reshape(1, -1, 1, 1) if data_format == "NCHW" else bias)
     return out

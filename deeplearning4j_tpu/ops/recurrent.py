@@ -120,6 +120,46 @@ def gru(x, h0, w_ru, w_c, b_ru=None, b_c=None, time_major=False):
     return h_seq, h_last
 
 
+@op("gru_onnx", "recurrent")
+def gru_onnx(x, w, r, b=None, h0=None, linear_before_reset=0,
+             time_major=True):
+    """GRU with the ONNX weight layout and both candidate conventions
+    (reference gruCell kernel: `libnd4j/include/ops/declarable/headers/
+    recurrent.h` gruCell; the ONNX importer needs linear_before_reset=1,
+    which torch exports, and which the fused [x, r*h] gruCell above cannot
+    express).
+
+    x [T, B, In]; w [3H, In] gate rows (z, r, h); r [3H, H]; b [6H]
+    (Wb z,r,h then Rb z,r,h). Returns (h_seq [T, B, H], h_last [B, H]).
+    """
+    H = r.shape[-1]
+    if b is None:
+        b = jnp.zeros((6 * H,), x.dtype)
+    wz, wr, wh = w[:H], w[H:2 * H], w[2 * H:]
+    rz, rr, rh = r[:H], r[H:2 * H], r[2 * H:]
+    wbz, wbr, wbh = b[:H], b[H:2 * H], b[2 * H:3 * H]
+    rbz, rbr, rbh = b[3 * H:4 * H], b[4 * H:5 * H], b[5 * H:]
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+    if h0 is None:
+        h0 = jnp.zeros((x.shape[1], H), x.dtype)
+
+    def step(h, x_t):
+        z = jax.nn.sigmoid(x_t @ wz.T + h @ rz.T + wbz + rbz)
+        g = jax.nn.sigmoid(x_t @ wr.T + h @ rr.T + wbr + rbr)
+        if linear_before_reset:
+            hh = jnp.tanh(x_t @ wh.T + g * (h @ rh.T + rbh) + wbh)
+        else:
+            hh = jnp.tanh(x_t @ wh.T + (g * h) @ rh.T + rbh + wbh)
+        h = z * h + (1.0 - z) * hh
+        return h, h
+
+    h_last, h_seq = lax.scan(step, h0, x)
+    if not time_major:
+        h_seq = jnp.swapaxes(h_seq, 0, 1)
+    return h_seq, h_last
+
+
 @op("sruCell", "recurrent")
 def sru_cell(x_t, c_prev, w, b):
     """Simple Recurrent Unit step (reference sru op family).

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .registry import op
@@ -17,7 +18,8 @@ from .registry import op
 op("reshape", "shape")(lambda x, shape: jnp.reshape(x, tuple(int(s) for s in shape)))
 op("reshapeas", "shape")(lambda x, y: jnp.reshape(x, y.shape))
 op("flatten", "shape")(lambda *xs: jnp.concatenate([x.ravel() for x in xs]))
-op("flatten_2d", "shape")(lambda x, axis=1: jnp.reshape(x, (int(jnp.prod(jnp.asarray(x.shape[:axis]))), -1)))
+op("flatten_2d", "shape")(lambda x, axis=1: jnp.reshape(
+    x, (int(np.prod(x.shape[:axis], dtype=np.int64)), -1)))
 op("transpose", "shape")(lambda x, axes=None: jnp.transpose(x, axes))
 op("permute", "shape")(lambda x, axes: jnp.transpose(x, axes))
 op("squeeze", "shape")(lambda x, axis=None: jnp.squeeze(x, axis=axis))

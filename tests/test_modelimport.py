@@ -472,6 +472,121 @@ class TestKerasImport:
         np.testing.assert_allclose(r2, g2, atol=1e-5)
 
 
+class TestKerasAdapterBreadth:
+    """Round-3 Keras adapter sweep (reference keras/layers/** 62 adapters):
+    conv variants, wrappers, croppings/paddings, norm/activation layers —
+    all golden-matched against keras.predict."""
+
+    def _roundtrip_sequential(self, m, x, tmp_path, name, nchw=True):
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_sequential_model_and_weights
+        golden = m.predict(x, verbose=0)
+        path = str(tmp_path / f"{name}.h5")
+        m.save(path)
+        net = import_keras_sequential_model_and_weights(path)
+        xin = x.transpose(0, 3, 1, 2) if (nchw and x.ndim == 4) else x
+        if x.ndim == 3 and nchw:
+            xin = x.transpose(0, 2, 1)  # [B,T,F] -> [B,F,T]
+        res = net.output(xin).numpy()
+        return res, golden
+
+    def test_conv_variants(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(0)
+        m = keras.Sequential([
+            keras.Input((10, 10, 3)),
+            layers.ZeroPadding2D(1, name="zp"),
+            layers.SeparableConv2D(6, 3, activation="relu", name="sc"),
+            layers.Conv2DTranspose(4, 3, strides=2, name="ct"),
+            layers.UpSampling2D(2, name="us"),
+            layers.Cropping2D(((1, 2), (2, 1)), name="cr"),
+            layers.GlobalAveragePooling2D(name="gap"),
+            layers.Dense(5, activation="softmax", name="d"),
+        ])
+        x = rs.randn(2, 10, 10, 3).astype(np.float32)
+        res, golden = self._roundtrip_sequential(m, x, tmp_path, "convs")
+        np.testing.assert_allclose(res, golden, atol=2e-5)
+
+    def test_temporal_stack(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(1)
+        m = keras.Sequential([
+            keras.Input((12, 5)),
+            layers.Conv1D(8, 3, padding="same", activation="relu",
+                          name="c1"),
+            layers.MaxPooling1D(2, name="p1"),
+            layers.Bidirectional(layers.LSTM(4, return_sequences=True),
+                                 name="bi"),
+            layers.GlobalMaxPooling1D(name="gmp"),
+            layers.Dense(3, name="d"),
+        ])
+        x = rs.randn(2, 12, 5).astype(np.float32)
+        res, golden = self._roundtrip_sequential(m, x, tmp_path, "temporal")
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    @pytest.mark.parametrize("reset_after", [True, False])
+    def test_gru(self, tmp_path, reset_after):
+        from keras import layers
+        rs = np.random.RandomState(2)
+        m = keras.Sequential([
+            keras.Input((6,)),
+            layers.Embedding(15, 4, name="e"),
+            layers.GRU(5, reset_after=reset_after, name="g"),
+            layers.Dense(2, activation="softmax", name="d"),
+        ])
+        ix = rs.randint(0, 15, (3, 6))
+        golden = m.predict(ix, verbose=0)
+        path = str(tmp_path / f"gru{int(reset_after)}.h5")
+        m.save(path)
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_sequential_model_and_weights
+        net = import_keras_sequential_model_and_weights(path)
+        res = net.output(ix).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    def test_timedistributed_layernorm_prelu(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(3)
+        m = keras.Sequential([
+            keras.Input((7, 6)),
+            layers.TimeDistributed(layers.Dense(9), name="td"),
+            layers.LayerNormalization(name="ln"),
+            layers.PReLU(shared_axes=[1], name="pr"),
+            layers.GlobalAveragePooling1D(name="gap"),
+            layers.Dense(2, name="d"),
+        ])
+        x = rs.randn(2, 7, 6).astype(np.float32)
+        res, golden = self._roundtrip_sequential(m, x, tmp_path, "tdlp")
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    def test_conv3d_pool3d(self, tmp_path):
+        from keras import layers
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_sequential_model_and_weights
+        rs = np.random.RandomState(4)
+        m = keras.Sequential([
+            keras.Input((6, 6, 6, 2)),
+            layers.Conv3D(4, 3, activation="relu", name="c3"),
+            layers.MaxPooling3D(2, name="p3"),
+            layers.Flatten(name="f"),
+            layers.Dense(3, name="d"),
+        ])
+        x = rs.randn(2, 6, 6, 6, 2).astype(np.float32)
+        golden = m.predict(x, verbose=0)
+        path = str(tmp_path / "c3d.h5")
+        m.save(path)
+        net = import_keras_sequential_model_and_weights(path)
+        res = net.output(x.transpose(0, 4, 1, 2, 3)).numpy()
+        np.testing.assert_allclose(res, golden, atol=2e-5)
+
+    def test_unsupported_lstm_activation_raises(self):
+        from deeplearning4j_tpu.modelimport.keras.importer import \
+            _adapt_layer
+        from deeplearning4j_tpu.modelimport.ir import ImportException
+        with pytest.raises(ImportException, match="LSTM"):
+            _adapt_layer("LSTM", {"units": 4, "activation": "relu"}, None)
+
+
 class TestTF1WhileImport:
     """TF1 control-flow frames (Enter/Merge/Switch/Exit) lower to
     lax.while_loop (while_frames.py)."""
@@ -593,6 +708,180 @@ class TestOnnxLSTM:
         np.testing.assert_allclose(res["Y_h"].numpy()[0], ys[-1], atol=1e-5)
 
 
+class TestOnnxGRU:
+    def _model(self, W, R, Bb, H, lbr, T, B, In):
+        gw = pio.Writer()
+        gw.msg(1, _onnx_node("GRU", ["x", "W", "R", "B"], ["Y", "Y_h"],
+                             hidden_size=H, linear_before_reset=lbr))
+        gw.str_(2, "gru")
+        for name, arr in (("W", W), ("R", R), ("B", Bb)):
+            gw.msg(5, _onnx_tensor(name, arr))
+        gw.msg(11, _onnx_vi("x", (T, B, In)))
+        gw.msg(12, _onnx_vi("Y", (T, 1, B, H)))
+        return pio.Writer().int_(1, 8).msg(7, gw).build()
+
+    def test_gru_lbr0_matches_numpy(self):
+        rs = np.random.RandomState(0)
+        T, B, In, H = 5, 2, 3, 4
+        W = rs.randn(1, 3 * H, In).astype(np.float32) * 0.4
+        R = rs.randn(1, 3 * H, H).astype(np.float32) * 0.4
+        Bb = rs.randn(1, 6 * H).astype(np.float32) * 0.1
+        imp = import_onnx_model(self._model(W, R, Bb, H, 0, T, B, In))
+        x = rs.randn(T, B, In).astype(np.float32)
+        res = imp.output({"x": x}, ["Y", "Y_h"])
+        y = res["Y"].numpy()
+        assert y.shape == (T, 1, B, H)
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+        Wz, Wr, Wh = np.split(W[0], 3, axis=0)
+        Rz, Rr, Rh = np.split(R[0], 3, axis=0)
+        wb, rb = Bb[0][:3 * H], Bb[0][3 * H:]
+        wbz, wbr, wbh = np.split(wb, 3)
+        rbz, rbr, rbh = np.split(rb, 3)
+        h = np.zeros((B, H), np.float32)
+        ys = []
+        for t in range(T):
+            xt = x[t]
+            z = sig(xt @ Wz.T + h @ Rz.T + wbz + rbz)
+            r = sig(xt @ Wr.T + h @ Rr.T + wbr + rbr)
+            hh = np.tanh(xt @ Wh.T + (r * h) @ Rh.T + rbh + wbh)
+            h = z * h + (1 - z) * hh
+            ys.append(h.copy())
+        np.testing.assert_allclose(y, np.stack(ys)[:, None], atol=1e-5)
+        np.testing.assert_allclose(res["Y_h"].numpy()[0], ys[-1], atol=1e-5)
+
+    def test_gru_lbr1_matches_torch(self):
+        """linear_before_reset=1 is what torch.onnx emits — golden vs
+        torch.nn.GRU (gate order remap r,z,n -> z,r,h)."""
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(1)
+        T, B, In, H = 4, 3, 5, 6
+        gru = torch.nn.GRU(In, H)
+        sd = {k: v.detach().numpy() for k, v in gru.state_dict().items()}
+        w_ih, w_hh = sd["weight_ih_l0"], sd["weight_hh_l0"]   # [3H, *], r,z,n
+        b_ih, b_hh = sd["bias_ih_l0"], sd["bias_hh_l0"]
+
+        def reorder(m):
+            r, z, n = np.split(m, 3, axis=0)
+            return np.concatenate([z, r, n], axis=0)
+
+        W = reorder(w_ih)[None]
+        R = reorder(w_hh)[None]
+        Bb = np.concatenate([reorder(b_ih.reshape(3, H)).reshape(-1),
+                             reorder(b_hh.reshape(3, H)).reshape(-1)])[None]
+        imp = import_onnx_model(self._model(
+            W.astype(np.float32), R.astype(np.float32),
+            Bb.astype(np.float32), H, 1, T, B, In))
+        x = rs.randn(T, B, In).astype(np.float32)
+        res = imp.output({"x": x}, ["Y", "Y_h"])
+        with torch.no_grad():
+            golden, hn = gru(torch.from_numpy(x))
+        np.testing.assert_allclose(res["Y"].numpy()[:, 0], golden.numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(res["Y_h"].numpy(), hn.numpy(), atol=1e-5)
+
+
+class TestOnnxResNetBlock:
+    def test_residual_block_matches_torch(self):
+        """A real-world-shaped ONNX graph (torchvision BasicBlock + head:
+        Conv-BN-Relu-Conv-BN-Add-Relu-GAP-Flatten-Gemm), golden vs torch."""
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+        rs = np.random.RandomState(0)
+        C, B, side, classes = 8, 2, 12, 5
+        w1 = (rs.randn(C, C, 3, 3) * 0.2).astype(np.float32)
+        w2 = (rs.randn(C, C, 3, 3) * 0.2).astype(np.float32)
+        fc_w = (rs.randn(classes, C) * 0.3).astype(np.float32)
+        fc_b = rs.randn(classes).astype(np.float32)
+        bn = {}
+        for i in (1, 2):
+            bn[i] = [rs.rand(C).astype(np.float32) + 0.5,   # scale
+                     rs.randn(C).astype(np.float32) * 0.1,  # bias
+                     rs.randn(C).astype(np.float32) * 0.1,  # mean
+                     rs.rand(C).astype(np.float32) + 0.5]   # var
+
+        gw = pio.Writer()
+        gw.msg(1, _onnx_node("Conv", ["x", "w1"], ["c1"],
+                             kernel_shape=[3, 3], pads=[1, 1, 1, 1]))
+        gw.msg(1, _onnx_node("BatchNormalization",
+                             ["c1", "s1", "bb1", "m1", "v1"], ["b1"],
+                             epsilon=1e-5))
+        gw.msg(1, _onnx_node("Relu", ["b1"], ["r1"]))
+        gw.msg(1, _onnx_node("Conv", ["r1", "w2"], ["c2"],
+                             kernel_shape=[3, 3], pads=[1, 1, 1, 1]))
+        gw.msg(1, _onnx_node("BatchNormalization",
+                             ["c2", "s2", "bb2", "m2", "v2"], ["b2"],
+                             epsilon=1e-5))
+        gw.msg(1, _onnx_node("Add", ["b2", "x"], ["sum"]))
+        gw.msg(1, _onnx_node("Relu", ["sum"], ["r2"]))
+        gw.msg(1, _onnx_node("GlobalAveragePool", ["r2"], ["gap"]))
+        gw.msg(1, _onnx_node("Flatten", ["gap"], ["flat"]))
+        gw.msg(1, _onnx_node("Gemm", ["flat", "fcw", "fcb"], ["y"],
+                             transB=1, alpha=1.0, beta=1.0))
+        gw.str_(2, "resblock")
+        tensors = {"w1": w1, "w2": w2, "fcw": fc_w, "fcb": fc_b}
+        for i in (1, 2):
+            s, bb, m, v = bn[i]
+            tensors.update({f"s{i}": s, f"bb{i}": bb, f"m{i}": m,
+                            f"v{i}": v})
+        for name, arr in tensors.items():
+            gw.msg(5, _onnx_tensor(name, arr))
+        gw.msg(11, _onnx_vi("x", (B, C, side, side)))
+        gw.msg(12, _onnx_vi("y", (B, classes)))
+        data = pio.Writer().int_(1, 8).msg(7, gw).build()
+
+        imp = import_onnx_model(data)
+        x = rs.randn(B, C, side, side).astype(np.float32)
+        res = imp.output({"x": x}, ["y"])["y"].numpy()
+
+        with torch.no_grad():
+            t = torch.from_numpy(x)
+            h = F.conv2d(t, torch.from_numpy(w1), padding=1)
+            h = F.batch_norm(h, torch.from_numpy(bn[1][2]),
+                             torch.from_numpy(bn[1][3]),
+                             torch.from_numpy(bn[1][0]),
+                             torch.from_numpy(bn[1][1]), eps=1e-5)
+            h = F.relu(h)
+            h = F.conv2d(h, torch.from_numpy(w2), padding=1)
+            h = F.batch_norm(h, torch.from_numpy(bn[2][2]),
+                             torch.from_numpy(bn[2][3]),
+                             torch.from_numpy(bn[2][0]),
+                             torch.from_numpy(bn[2][1]), eps=1e-5)
+            h = F.relu(h + t)
+            h = h.mean(dim=(2, 3))
+            golden = (h @ torch.from_numpy(fc_w).T +
+                      torch.from_numpy(fc_b)).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-4)
+
+
+class TestOnnxGroupedConv:
+    def test_grouped_conv_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(0)
+        B, Cin, Cout, g, side = 2, 8, 12, 4, 9
+        w = (rs.randn(Cout, Cin // g, 3, 3) * 0.3).astype(np.float32)
+        b = rs.randn(Cout).astype(np.float32)
+        gw = pio.Writer()
+        gw.msg(1, _onnx_node("Conv", ["x", "w", "b"], ["y"],
+                             kernel_shape=[3, 3], group=g,
+                             pads=[1, 1, 1, 1]))
+        gw.str_(2, "gconv")
+        gw.msg(5, _onnx_tensor("w", w))
+        gw.msg(5, _onnx_tensor("b", b))
+        gw.msg(11, _onnx_vi("x", (B, Cin, side, side)))
+        gw.msg(12, _onnx_vi("y", (B, Cout, side, side)))
+        data = pio.Writer().int_(1, 8).msg(7, gw).build()
+        imp = import_onnx_model(data)
+        x = rs.randn(B, Cin, side, side).astype(np.float32)
+        res = imp.output({"x": x}, ["y"])["y"].numpy()
+        with torch.no_grad():
+            golden = torch.nn.functional.conv2d(
+                torch.from_numpy(x), torch.from_numpy(w),
+                torch.from_numpy(b), padding=1, groups=g).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-4)
+
+
 class TestTF1WhileImportEdgeCases:
     @pytest.fixture
     def _v1_control_flow(self):
@@ -601,6 +890,34 @@ class TestTF1WhileImportEdgeCases:
             yield
         finally:
             tf1.enable_control_flow_v2()
+
+    def test_nested_while_loops(self, _v1_control_flow):
+        """Nested TF1 frames lower innermost-first: sum_{i<3} sum_{j<i} j
+        computed with a while inside a while."""
+        g = tf.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [], name="x")
+
+            def outer_body(i, acc):
+                def inner_body(j, s):
+                    return tf.add(j, 1.0), tf.add(s, tf.multiply(j, x))
+
+                _, inner_sum = tf1.while_loop(
+                    lambda j, s: tf.less(j, i),
+                    inner_body, [tf.constant(0.0), tf.constant(0.0)])
+                return tf.add(i, 1.0), tf.add(acc, inner_sum)
+
+            _, total = tf1.while_loop(
+                lambda i, acc: tf.less(i, 4.0),
+                outer_body, [tf.constant(0.0), tf.constant(0.0)])
+            tf.identity(total, name="result")
+        pb = g.as_graph_def().SerializeToString()
+        with tf1.Session(graph=g) as sess:
+            golden = sess.run("result:0", {"x:0": 2.0})
+        imp = import_tf_graph(pb, input_shapes={"x": ()},
+                              outputs=["result"])
+        res = imp.output({"x": np.float32(2.0)}, ["result"])["result"]
+        np.testing.assert_allclose(res.numpy(), golden)  # == 2*(0+0+1+0+1+2)
 
     def test_loop_invariant_body_output(self, _v1_control_flow):
         """Regression: a loop var updated to a loop-invariant OUTER
