@@ -400,7 +400,8 @@ def from_pipeline_params(pp_params):
 def make_pipeline_train_step(config: BertConfig, mesh: Mesh,
                              n_microbatches: int,
                              learning_rate: float = 1e-4,
-                             remat: bool = True):
+                             remat: bool = True,
+                             schedule: str = "1f1b"):
     """BERT training with pipeline parallelism over the `pipe` mesh axis,
     composed with data parallelism over (data, fsdp).
 
@@ -410,10 +411,14 @@ def make_pipeline_train_step(config: BertConfig, mesh: Mesh,
     scored on the last stage (scalar psum — no activation broadcast), and
     per-microbatch remat gives the 1F1B memory profile under jax.grad.
 
+    schedule: "1f1b" (default — hand-scheduled interleaved backward,
+    activation memory bounded by n_stages) or "gpipe" (autodiff through the
+    scan; memory grows with n_microbatches).
+
     Use with `to_pipeline_params(init_params(...), n_stages)`.
     """
 
-    from ..parallel.pipeline import make_pipeline_loss
+    from ..parallel.pipeline import make_pipeline_loss, make_pipeline_loss_1f1b
     c = config
 
     def stage_fn(stage_layers, h):
@@ -445,8 +450,12 @@ def make_pipeline_train_step(config: BertConfig, mesh: Mesh,
         per_tok = jnp.where(valid, per_tok, 0.0)
         return jnp.sum(per_tok), jnp.sum(valid).astype(jnp.float32)
 
-    pipe_loss = make_pipeline_loss(stage_fn, head_fn, mesh, n_microbatches,
-                                   remat=remat)
+    if schedule == "1f1b":
+        pipe_loss = make_pipeline_loss_1f1b(stage_fn, head_fn, mesh,
+                                            n_microbatches)
+    else:
+        pipe_loss = make_pipeline_loss(stage_fn, head_fn, mesh,
+                                       n_microbatches, remat=remat)
 
     def loss_fn(params, batch):
         e = params["embeddings"]

@@ -178,6 +178,208 @@ def make_pipeline_loss(stage_fn: Callable, head_fn: Callable, mesh: Mesh,
     return loss
 
 
+# ---------------------------------------------------------------------------
+# 1F1B schedule: activation memory bounded by the STAGE count, not the
+# microbatch count. jax.grad over the GPipe scan above stashes one carry per
+# scan step (∝ n_micro); here the backward is hand-scheduled as a custom_vjp
+# whose bwd runs ONE interleaved scan — each step does a forward microbatch
+# (recompute, remat-style) and a backward microbatch, with a circular stash
+# of 2*n_stages stage-inputs per device. Per-microbatch FLOPs equal the
+# remat GPipe path (fwd + recompute + bwd); peak live activations drop from
+# O(n_micro) to O(n_stages).
+#
+# Schedule (stage s, step t, S stages): forward of microbatch j happens at
+# t = j + s; backward of microbatch u at t = u + 2(S-1) - s. On the last
+# stage forward and backward of the same microbatch share a step (the head
+# cotangent is produced and consumed immediately); cotangents hop backward
+# one stage per step over the reverse ppermute ring. In-flight stashes per
+# stage never exceed 2(S-1-s) + 1 entries.
+# ---------------------------------------------------------------------------
+
+def make_pipeline_loss_1f1b(stage_fn: Callable, head_fn: Callable,
+                            mesh: Mesh, n_microbatches: int,
+                            axis: str = PIPE, batch_axes=(DATA, FSDP)):
+    """Drop-in alternative to make_pipeline_loss with the 1F1B memory
+    profile. Same contract: returns loss(stacked_stage_params, head_params,
+    x, aux) -> (global loss sum, global weight), differentiable in the
+    stage params, head params, and x (aux gets symbolic-zero cotangents —
+    targets/masks are data, not parameters)."""
+    data_axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    data_spec = batch_axes if len(batch_axes) > 1 else batch_axes[0]
+
+    def local_fwd(stage_params, head_params, xm, auxm):
+        """Loss-only GPipe scan (cheap carry; nothing stashed)."""
+        stage_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        n_stages = lax.axis_size(axis)
+        stage = lax.axis_index(axis)
+        n_micro = n_microbatches
+        mb_shape = xm.shape[1:]
+
+        def step_body(carry, t):
+            incoming, loss_sum, wsum = carry
+            xin = jnp.where(stage == 0,
+                            xm[jnp.clip(t, 0, n_micro - 1)], incoming)
+            y = stage_fn(stage_params, xin)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            is_out = (t >= n_stages - 1) & (stage == n_stages - 1)
+            aux_mb = jax.tree_util.tree_map(lambda a: a[out_idx], auxm)
+            l, w = head_fn(head_params, y, aux_mb)
+            loss_sum = loss_sum + jnp.where(is_out, l, 0.0)
+            wsum = wsum + jnp.where(is_out, w, 0.0)
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            return (lax.ppermute(y, axis, perm), loss_sum, wsum), None
+
+        init = (jnp.zeros(mb_shape, xm.dtype), jnp.float32(0.0),
+                jnp.float32(0.0))
+        (_, loss_sum, wsum), _ = lax.scan(
+            step_body, init, jnp.arange(n_micro + lax.axis_size(axis) - 1))
+        for a in (axis,) + data_axes:
+            loss_sum = lax.psum(loss_sum, a)
+            wsum = lax.psum(wsum, a)
+        return loss_sum, wsum
+
+    def local_grads(stage_params, head_params, xm, auxm):
+        """The interleaved 1F1B fwd-recompute/bwd scan."""
+        stage_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        S = lax.axis_size(axis)
+        s = lax.axis_index(axis)
+        n_micro = n_microbatches
+        mb_shape = xm.shape[1:]
+        n_slots = 2 * S
+        total_steps = n_micro + 2 * (S - 1)
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+        zero_sg = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), stage_params)
+        zero_hg = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), head_params)
+
+        def masked_add(acc, delta, valid):
+            return jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(valid, d.astype(a.dtype), 0.0),
+                acc, delta)
+
+        def step_body(carry, t):
+            (inc_f, inc_b, stash, sg, hg, dxm, loss_sum, wsum) = carry
+            jf = t - s                      # fwd microbatch index, this stage
+            ju = t - 2 * (S - 1) + s        # bwd microbatch index, this stage
+            f_valid = (jf >= 0) & (jf < n_micro)
+            b_valid = (ju >= 0) & (ju < n_micro)
+
+            # -- forward microbatch jf --
+            xin = jnp.where(s == 0, xm[jnp.clip(jf, 0, n_micro - 1)], inc_f)
+            y = stage_fn(stage_params, xin)
+            # stash the stage input; slot by microbatch index (in-flight
+            # span < 2S, and pre-window garbage writes land in slots that
+            # are rewritten before their first read)
+            stash = lax.dynamic_update_index_in_dim(
+                stash, xin, jnp.mod(jnp.clip(jf, 0, None), n_slots), 0)
+
+            # -- last stage: head loss + the cotangent entering the bwd ring
+            aux_mb = jax.tree_util.tree_map(
+                lambda a: a[jnp.clip(jf, 0, n_micro - 1)], auxm)
+            (l, w), head_pull = jax.vjp(
+                lambda hp, yy: head_fn(hp, yy, aux_mb), head_params, y)
+            dhp, dy_head = head_pull((jnp.float32(1.0), jnp.float32(0.0)))
+            is_out = f_valid & (s == S - 1)
+            loss_sum = loss_sum + jnp.where(is_out, l, 0.0)
+            wsum = wsum + jnp.where(is_out, w, 0.0)
+            hg = masked_add(hg, dhp, is_out)
+
+            # -- backward microbatch ju --
+            g_in = jnp.where(s == S - 1, dy_head, inc_b)
+            x_st = lax.dynamic_index_in_dim(
+                stash, jnp.mod(jnp.clip(ju, 0, None), n_slots), 0,
+                keepdims=False)
+            _, stage_pull = jax.vjp(stage_fn, stage_params, x_st)
+            dparams, dx = stage_pull(g_in)
+            sg = masked_add(sg, dparams, b_valid)
+            upd = jnp.where(b_valid & (s == 0), dx.astype(dxm.dtype),
+                            lax.dynamic_index_in_dim(
+                                dxm, jnp.clip(ju, 0, n_micro - 1), 0,
+                                keepdims=False))
+            dxm = lax.dynamic_update_index_in_dim(
+                dxm, upd, jnp.clip(ju, 0, n_micro - 1), 0)
+
+            inc_f = lax.ppermute(y, axis, fwd_perm)
+            inc_b = lax.ppermute(dx, axis, bwd_perm)
+            return (inc_f, inc_b, stash, sg, hg, dxm, loss_sum, wsum), None
+
+        init = (jnp.zeros(mb_shape, xm.dtype),
+                jnp.zeros(mb_shape, xm.dtype),
+                jnp.zeros((n_slots,) + mb_shape, xm.dtype),
+                zero_sg, zero_hg,
+                jnp.zeros(xm.shape, xm.dtype),
+                jnp.float32(0.0), jnp.float32(0.0))
+        (_, _, _, sg, hg, dxm, loss_sum, wsum), _ = lax.scan(
+            step_body, init, jnp.arange(total_steps))
+
+        # grads sum over data shards; head grads live on the last stage and
+        # dx on stage 0 — psum over pipe broadcasts them (others hold zeros)
+        for a in data_axes:
+            sg = lax.psum(sg, a)
+        for a in (axis,) + data_axes:
+            hg = lax.psum(hg, a)
+        dxm = lax.psum(dxm, axis)
+        for a in (axis,) + data_axes:
+            loss_sum = lax.psum(loss_sum, a)
+            wsum = lax.psum(wsum, a)
+        sg = jax.tree_util.tree_map(lambda g: g[None], sg)  # re-stack stage
+        return sg, hg, dxm, loss_sum, wsum
+
+    def _microbatch(x, aux):
+        B = x.shape[0]
+        assert B % n_microbatches == 0
+        mb = B // n_microbatches
+        xm = x.reshape((n_microbatches, mb) + x.shape[1:])
+        auxm = jax.tree_util.tree_map(
+            lambda a: a.reshape((n_microbatches, mb) + a.shape[1:]), aux)
+        return xm, auxm
+
+    @jax.custom_vjp
+    def loss(stacked_stage_params, head_params, x, aux):
+        xm, auxm = _microbatch(x, aux)
+        param_spec = jax.tree_util.tree_map(lambda _: P(axis),
+                                            stacked_stage_params)
+        fn = shard_map(local_fwd, mesh=mesh,
+                       in_specs=(param_spec, P(),
+                                 P(None, data_spec), P(None, data_spec)),
+                       out_specs=(P(), P()), check_vma=False)
+        return fn(stacked_stage_params, head_params, xm, auxm)
+
+    def loss_fwd(stacked_stage_params, head_params, x, aux):
+        out = loss(stacked_stage_params, head_params, x, aux)
+        return out, (stacked_stage_params, head_params, x, aux)
+
+    def loss_bwd(res, g):
+        stacked_stage_params, head_params, x, aux = res
+        gl, _ = g          # wsum is a token count — not differentiated
+        xm, auxm = _microbatch(x, aux)
+        param_spec = jax.tree_util.tree_map(lambda _: P(axis),
+                                            stacked_stage_params)
+        fn = shard_map(local_grads, mesh=mesh,
+                       in_specs=(param_spec, P(),
+                                 P(None, data_spec), P(None, data_spec)),
+                       out_specs=(param_spec, P(), P(None, data_spec),
+                                  P(), P()),
+                       check_vma=False)
+        sg, hg, dxm, _, _ = fn(stacked_stage_params, head_params, xm, auxm)
+        scale = lambda t, ref: jax.tree_util.tree_map(
+            lambda gr, r: (gr * gl).astype(r.dtype), t, ref)
+        dx = (dxm * gl).astype(x.dtype).reshape(x.shape)
+        import numpy as _np
+        daux = jax.tree_util.tree_map(
+            lambda a: (jnp.zeros_like(a)
+                       if jnp.issubdtype(a.dtype, jnp.floating)
+                       else _np.zeros(a.shape, jax.dtypes.float0)), aux)
+        return (scale(sg, stacked_stage_params), scale(hg, head_params),
+                dx, daux)
+
+    loss.defvjp(loss_fwd, loss_bwd)
+    return loss
+
+
 def split_stages(items: Sequence, n_stages: int):
     """Split a layer list into n_stages contiguous groups (must divide)."""
     if len(items) % n_stages != 0:
