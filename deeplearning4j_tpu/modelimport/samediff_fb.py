@@ -1,0 +1,396 @@
+"""Reader for reference-produced SameDiff FlatBuffers graphs (``.fb``).
+
+The reference serializes SameDiff graphs as FlatBuffers ``FlatGraph`` tables
+(writer: ``nd4j/.../autodiff/samediff/SameDiff.java:5465-5727`` ``asFlatGraph``;
+schema: ``libnd4j/include/graph/scheme/graph.fbs`` / ``node.fbs`` /
+``variable.fbs`` / ``array.fbs``).  This module reads those files directly —
+no generated FlatBuffers classes, just the wire format walked with the
+``flatbuffers`` runtime ``Table`` — and rebuilds the graph as a native
+:class:`~deeplearning4j_tpu.autodiff.samediff.SameDiff`, so a ``.fb``
+exported from the JVM executes as one XLA program on TPU.
+
+Scope: inference graphs (variables + constants + placeholders + op nodes).
+Training metadata (updaterState, trainingConfig JSON) is surfaced on the
+returned object but not converted into an optimizer.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import flatbuffers.table
+from flatbuffers import number_types as _N
+
+from ..autodiff.samediff import SameDiff, SDVariable
+
+# ---------------------------------------------------------------------------
+# Low-level FlatBuffers walking.  Slot numbers are the field declaration
+# indices from the .fbs schemas (vtable offset = 4 + 2*slot).
+# ---------------------------------------------------------------------------
+
+
+def _tbl(buf: bytes, pos: int) -> flatbuffers.table.Table:
+    return flatbuffers.table.Table(buf, pos)
+
+
+def _root(buf: bytes) -> flatbuffers.table.Table:
+    (off,) = struct.unpack_from("<I", buf, 0)
+    return _tbl(buf, off)
+
+
+def _off(t, slot: int) -> int:
+    return t.Offset(4 + 2 * slot)
+
+
+def _i8(t, slot, default=0):
+    o = _off(t, slot)
+    return t.Get(_N.Int8Flags, t.Pos + o) if o else default
+
+
+def _i32(t, slot, default=0):
+    o = _off(t, slot)
+    return t.Get(_N.Int32Flags, t.Pos + o) if o else default
+
+
+def _i64(t, slot, default=0):
+    o = _off(t, slot)
+    return t.Get(_N.Int64Flags, t.Pos + o) if o else default
+
+
+def _string(t, slot) -> Optional[str]:
+    o = _off(t, slot)
+    return t.String(t.Pos + o).decode("utf-8") if o else None
+
+
+def _subtable(t, slot):
+    o = _off(t, slot)
+    return _tbl(t.Bytes, t.Indirect(t.Pos + o)) if o else None
+
+
+def _vec_len(t, slot) -> int:
+    o = _off(t, slot)
+    return t.VectorLen(o) if o else 0
+
+
+def _vec_table(t, slot, i):
+    o = _off(t, slot)
+    return _tbl(t.Bytes, t.Indirect(t.Vector(o) + i * 4))
+
+
+def _vec_scalar(t, slot, flags, width) -> list:
+    o = _off(t, slot)
+    if not o:
+        return []
+    v, n = t.Vector(o), t.VectorLen(o)
+    return [t.Get(flags, v + width * i) for i in range(n)]
+
+
+def _vec_i32(t, slot):
+    return _vec_scalar(t, slot, _N.Int32Flags, 4)
+
+
+def _vec_i64(t, slot):
+    return _vec_scalar(t, slot, _N.Int64Flags, 8)
+
+
+def _vec_f64(t, slot):
+    return _vec_scalar(t, slot, _N.Float64Flags, 8)
+
+
+def _vec_bool(t, slot):
+    return [bool(b) for b in _vec_scalar(t, slot, _N.BoolFlags, 1)]
+
+
+def _vec_str(t, slot) -> List[str]:
+    o = _off(t, slot)
+    if not o:
+        return []
+    v, n = t.Vector(o), t.VectorLen(o)
+    return [t.String(v + 4 * i).decode("utf-8") for i in range(n)]
+
+
+def _vec_bytes(t, slot) -> bytes:
+    o = _off(t, slot)
+    if not o:
+        return b""
+    v, n = t.Vector(o), t.VectorLen(o)
+    return bytes(t.Bytes[v:v + n])
+
+
+# --- DType enum (array.fbs) -> numpy -------------------------------------
+
+_DTYPES = {
+    1: np.bool_, 3: np.float16, 5: np.float32, 6: np.float64,
+    7: np.int8, 8: np.int16, 9: np.int32, 10: np.int64,
+    11: np.uint8, 12: np.uint16, 13: np.uint32, 14: np.uint64,
+}
+
+
+def _flat_array(t) -> np.ndarray:
+    """Decode a FlatArray table: nd4j shapeInfo + raw byte buffer.
+
+    shapeInfo layout (libnd4j ``shape.h``): ``[rank, *shape, *strides,
+    extras, elementWiseStride, order]`` — only rank/shape matter here since
+    buffers are written dense in the stated order.
+    """
+    info = _vec_i64(t, 0)
+    buf = _vec_bytes(t, 1)
+    dt = _i8(t, 2, 5)
+    order = _i8(t, 3, 0)  # ByteOrder: 0=LE, 1=BE
+    np_dt = _DTYPES.get(dt)
+    if np_dt is None:
+        raise ValueError(f"unsupported FlatArray dtype enum {dt}")
+    rank = int(info[0]) if info else 0
+    shape = tuple(int(d) for d in info[1:1 + rank])
+    arr = np.frombuffer(buf, dtype=np_dt)
+    if order == 1:
+        arr = arr.byteswap()
+    n = int(np.prod(shape)) if shape else 1
+    if arr.size < n:
+        raise ValueError(f"FlatArray buffer too small: {arr.size} < {n}")
+    return arr[:n].reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Schema-level records
+# ---------------------------------------------------------------------------
+
+_ALL_DIMS = 2147483647  # Integer.MAX_VALUE: reference marker for "all dims"
+
+
+class FlatNodeRec:
+    """One FlatNode (node.fbs) with the fields execution needs."""
+
+    def __init__(self, t):
+        self.id = _i32(t, 0)
+        self.name = _string(t, 1)
+        self.op_type = _i8(t, 2)
+        self.op_num = _i64(t, 3)
+        self.inputs: List[Tuple[int, int]] = []
+        for i in range(_vec_len(t, 6)):  # inputPaired
+            p = _vec_table(t, 6, i)
+            self.inputs.append((_i32(p, 0), _i32(p, 1)))
+        if not self.inputs:  # legacy `input:[int]` encoding
+            self.inputs = [(i, 0) for i in _vec_i32(t, 5)]
+        self.t_args = _vec_f64(t, 8)      # extraParams
+        self.i_args = _vec_i64(t, 9)      # extraInteger
+        self.b_args = _vec_bool(t, 10)    # extraBools
+        self.dimensions = _vec_i32(t, 11)
+        self.output_names = _vec_str(t, 15)
+        self.op_name = _string(t, 16)
+        sc = _subtable(t, 18)
+        self.scalar = _flat_array(sc) if sc is not None else None
+
+
+class FlatVariableRec:
+    """One FlatVariable (variable.fbs)."""
+
+    def __init__(self, t):
+        idp = _subtable(t, 0)
+        self.id = (_i32(idp, 0), _i32(idp, 1)) if idp is not None else (0, 0)
+        self.name = _string(t, 1)
+        self.dtype = _i8(t, 2, 5)
+        self.shape = _vec_i64(t, 3)
+        nd = _subtable(t, 4)
+        self.array = _flat_array(nd) if nd is not None else None
+        # VarType: 0=VARIABLE 1=CONSTANT 2=ARRAY 3=PLACEHOLDER
+        self.var_type = _i8(t, 6)
+
+
+class FlatGraphFile:
+    """Parsed FlatGraph (graph.fbs) — raw records before SameDiff rebuild."""
+
+    def __init__(self, data: bytes):
+        g = _root(data)
+        self.graph_id = _i64(g, 0)
+        self.variables = [FlatVariableRec(_vec_table(g, 1, i))
+                          for i in range(_vec_len(g, 1))]
+        self.nodes = [FlatNodeRec(_vec_table(g, 2, i))
+                      for i in range(_vec_len(g, 2))]
+        self.placeholders = _vec_str(g, 5)
+        self.loss_variables = _vec_str(g, 6)
+        self.training_config = _string(g, 7)
+
+
+# ---------------------------------------------------------------------------
+# Op conversion: FlatNode -> registered op + kwargs
+# ---------------------------------------------------------------------------
+
+def _dims_arg(node: FlatNodeRec) -> Optional[List[int]]:
+    dims = node.dimensions or [int(d) for d in node.i_args]
+    if not dims or _ALL_DIMS in dims:
+        return None
+    return list(dims)
+
+
+def _conv_matmul(node):
+    ia = list(node.i_args) + [0, 0, 0]
+    ta = list(node.t_args) + [1.0, 0.0]
+    kw = {}
+    if ia[0]:
+        kw["transpose_a"] = True
+    if ia[1]:
+        kw["transpose_b"] = True
+    if ta[0] != 1.0:
+        kw["alpha"] = float(ta[0])
+    return "matmul", kw
+
+
+def _conv_softmax(node):
+    axis = int(node.i_args[0]) if node.i_args else -1
+    return "softmax", {"axis": axis}
+
+
+def _reduction(op_name):
+    def conv(node):
+        kw: Dict[str, Any] = {}
+        d = _dims_arg(node)
+        if d is not None:
+            kw["dims"] = d
+        if node.b_args and node.b_args[0]:
+            kw["keep_dims"] = True
+        return op_name, kw
+    return conv
+
+
+# opName -> converter.  Anything absent falls back to a bare registry call
+# with no kwargs (correct for elementwise/pairwise ops, which is the long
+# tail of what asFlatGraph emits).
+_CONVERTERS = {
+    "matmul": _conv_matmul,
+    "mmul": _conv_matmul,
+    "softmax": _conv_softmax,
+    "log_softmax": _conv_softmax,
+    "reduce_mean": _reduction("reduce_mean"),
+    "mean": _reduction("reduce_mean"),
+    "reduce_sum": _reduction("reduce_sum"),
+    "sum": _reduction("reduce_sum"),
+    "reduce_max": _reduction("reduce_max"),
+    "max": _reduction("reduce_max"),
+    "reduce_min": _reduction("reduce_min"),
+    "min": _reduction("reduce_min"),
+    "reduce_prod": _reduction("reduce_prod"),
+    "norm2": _reduction("reduce_norm2"),
+    "argmax": _reduction("argmax"),
+    "argmin": _reduction("argmin"),
+}
+
+# Legacy nodes (opType != CUSTOM) sometimes omit opName; resolve the few
+# (opType, opNum) pairs the reference writer emits for them.
+# Sources: libnd4j legacy_ops.h op enumerations.
+_LEGACY_NAMES = {
+    (3, 29): "tanh", (3, 10): "sigmoid", (3, 35): "exp", (3, 36): "log",
+    (1, 12): "abs", (1, 6): "neg", (2, 0): "isnan",
+    (5, 0): "reduce_mean", (6, 0): "reduce_sum", (6, 3): "reduce_max",
+    (6, 4): "reduce_min", (6, 8): "reduce_prod",
+    (9, 0): "argmax", (9, 1): "argmin",
+}
+
+
+class SameDiffFbImport:
+    """Rebuild a native SameDiff from a parsed FlatGraph."""
+
+    def __init__(self, flat: FlatGraphFile):
+        self.flat = flat
+        self.sd = SameDiff()
+        # (node_id, out_idx) -> SDVariable
+        self._by_id: Dict[Tuple[int, int], SDVariable] = {}
+        self._by_name: Dict[str, SDVariable] = {}
+
+    def convert(self) -> SameDiff:
+        from ..ops.registry import OpRegistry
+        reg = OpRegistry.get()
+        ph = set(self.flat.placeholders)
+        node_ids = {n.id for n in self.flat.nodes}
+        for v in self.flat.variables:
+            if v.var_type == 2 or (v.id[0] in node_ids and v.array is None
+                                   and v.name not in ph):
+                continue  # ARRAY: produced by a node during conversion
+            if v.var_type == 3 or v.name in ph:
+                shape = tuple(int(s) for s in v.shape) if v.shape else None
+                var = self.sd.placeholder(v.name, shape=shape)
+            elif v.var_type == 1:
+                var = self.sd.constant(np.asarray(v.array), name=v.name)
+            elif v.var_type == 0:
+                if v.array is None:
+                    raise ValueError(f"VARIABLE '{v.name}' has no ndarray")
+                var = self.sd.var(v.name, value=np.asarray(v.array))
+            else:
+                continue
+            self._by_id[v.id] = var
+            self._by_name[v.name] = var
+
+        for node in self._topo_order():
+            ins = []
+            for key in node.inputs:
+                src = self._by_id.get(key)
+                if src is None:
+                    raise ValueError(
+                        f"node '{node.name}' input {key} unresolved "
+                        f"(cyclic or unsupported producer)")
+                ins.append(src)
+            op_name = node.op_name or _LEGACY_NAMES.get(
+                (node.op_type, node.op_num))
+            if op_name is None:
+                raise ValueError(
+                    f"node '{node.name}': no opName and unknown legacy pair "
+                    f"(opType={node.op_type}, opNum={node.op_num})")
+            conv = _CONVERTERS.get(op_name)
+            if conv is not None:
+                reg_name, kwargs = conv(node)
+            else:
+                reg_name, kwargs = op_name, {}
+            if not reg.has(reg_name):
+                raise ValueError(
+                    f"node '{node.name}': op '{reg_name}' not registered")
+            out_name = node.output_names[0] if node.output_names else node.name
+            if node.scalar is not None and not ins:
+                out = self.sd.constant(np.asarray(node.scalar), name=out_name)
+            else:
+                if node.scalar is not None:
+                    ins.append(self.sd.constant(np.asarray(node.scalar),
+                                                name=f"{node.name}_scalar"))
+                out = self.sd._record(reg_name, ins, out_name=out_name,
+                                      **kwargs)
+            self._by_id[(node.id, 0)] = out
+            self._by_name[out_name] = out
+        return self.sd
+
+    def _topo_order(self) -> List[FlatNodeRec]:
+        """Nodes in producer-before-consumer order (writer order is close
+        but not guaranteed — InferenceSession resolves lazily)."""
+        pending = {n.id: n for n in self.flat.nodes}
+        done = set(self._by_id)
+        order: List[FlatNodeRec] = []
+        while pending:
+            progressed = False
+            for nid in list(pending):
+                n = pending[nid]
+                if all(k in done or k[0] not in pending for k in n.inputs):
+                    order.append(n)
+                    done.add((nid, 0))
+                    del pending[nid]
+                    progressed = True
+            if not progressed:
+                raise ValueError(
+                    f"cyclic FlatGraph: unresolved nodes "
+                    f"{[pending[i].name for i in pending]}")
+        return order
+
+
+def load_samediff_fb(path: str) -> SameDiff:
+    """Load a reference-produced SameDiff ``.fb`` file as a native SameDiff.
+
+    The returned graph executes under jit via ``sd.output(...)``; loss
+    variables and placeholders from the file are preserved as
+    ``sd.fb_loss_variables`` / placeholder vars.
+    """
+    with open(path, "rb") as f:
+        data = f.read()
+    flat = FlatGraphFile(data)
+    sd = SameDiffFbImport(flat).convert()
+    sd.fb_loss_variables = list(flat.loss_variables)
+    sd.fb_training_config = flat.training_config
+    return sd

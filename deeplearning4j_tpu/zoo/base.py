@@ -25,9 +25,8 @@ class PretrainedType:
 
 
 #: Optional hook: (model_name, pretrained_type) -> local file path.
-#: The reference downloads from azure blob storage + md5-checks
-#: (ZooModel.java `initPretrained`); here the fetch transport is injectable
-#: so air-gapped installs can point at a mirror. Set via set_weights_fetcher.
+#: Takes precedence over the URL registry below, so air-gapped installs can
+#: point at a mirror without touching model classes.
 weights_fetcher: Optional[Callable[[str, str], str]] = None
 
 
@@ -37,12 +36,135 @@ def set_weights_fetcher(fn: Optional[Callable[[str, str], str]]) -> None:
     weights_fetcher = fn
 
 
+# -- pretrained artifact resolution (reference DL4JResources.java +
+#    ZooModel.java initPretrained: URL -> cache -> Adler32 check -> restore)
+
+#: Base URL for published artifacts; same default as the reference's
+#: DL4JResources.DL4J_DEFAULT_URL, overridable for mirrors
+#: (DL4JResources.java:43 / setBaseDownloadURL).
+def _norm_base(url: str) -> str:
+    return url if url.endswith("/") else url + "/"
+
+
+_base_download_url = _norm_base(os.environ.get(
+    "DL4J_RESOURCES_BASE_URL", "https://dl4jdata.blob.core.windows.net/"))
+
+
+def set_base_download_url(url: str) -> None:
+    global _base_download_url
+    _base_download_url = _norm_base(url)
+
+
+def get_url_string(relative: str) -> str:
+    """DL4JResources.getURLString: base + relative path."""
+    return _base_download_url + relative.lstrip("/")
+
+
+def cache_dir() -> str:
+    """Local artifact cache (reference: ~/.deeplearning4j/models)."""
+    root = os.environ.get("DL4J_TPU_HOME",
+                          os.path.join(os.path.expanduser("~"),
+                                       ".deeplearning4j_tpu"))
+    return os.path.join(root, "models")
+
+
+def adler32_file(path: str) -> int:
+    """Checksum matching the reference's FileUtils.checksum(file, new
+    Adler32()) in ZooModel.initPretrained (ZooModel.java:85)."""
+    import zlib
+    value = 1
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            value = zlib.adler32(chunk, value)
+    return value & 0xFFFFFFFF
+
+
 def _md5(path: str) -> str:
     h = hashlib.md5()
     with open(path, "rb") as f:
         for chunk in iter(lambda: f.read(1 << 20), b""):
             h.update(chunk)
     return h.hexdigest()
+
+
+#: Published artifacts: class name -> {ptype: (relative URL, Adler32)}.
+#: Values transcribed from the reference zoo classes' pretrainedUrl() /
+#: pretrainedChecksum() (deeplearning4j-zoo/.../zoo/model/*.java).
+PRETRAINED_REGISTRY = {
+    "LeNet": {"mnist": ("models/lenet_dl4j_mnist_inference.zip",
+                        1906861161)},
+    "ResNet50": {"imagenet": ("models/resnet50_dl4j_inference.v3.zip",
+                              3914447815)},
+    "VGG16": {"imagenet": ("models/vgg16_dl4j_inference.zip", 3501732770),
+              "cifar10": ("models/vgg16_dl4j_cifar10_inference.v1.zip",
+                          2192260131),
+              "vggface": ("models/vgg16_dl4j_vggface_inference.v1.zip",
+                          2706403553)},
+    "VGG19": {"imagenet": ("models/vgg19_dl4j_inference.zip", 2782932419)},
+    "SqueezeNet": {"imagenet": ("models/squeezenet_dl4j_inference.v2.zip",
+                                3711411239)},
+    "TinyYOLO": {"imagenet": ("models/tiny-yolo-voc_dl4j_inference.v2.zip",
+                              1256226465)},
+    "Darknet19": {"imagenet": ("models/darknet19_dl4j_inference.v2.zip",
+                               691100891)},
+    # Darknet19 at 448x448 input: reference switches artifact by inputShape
+    "Darknet19_448": {"imagenet": (
+        "models/darknet19_448_dl4j_inference.v2.zip", 1054319943)},
+    "UNet": {"segment": ("models/unet_dl4j_segment_inference.v1.zip",
+                         712347958)},
+    "Xception": {"imagenet": ("models/xception_dl4j_inference.v2.zip",
+                              3277876097)},
+    "YOLO2": {"imagenet": ("models/yolo2_dl4j_inference.v3.zip",
+                           3658373840)},
+}
+
+
+def download_to_cache(url: str, model_name: str, filename: str,
+                      expected_adler32: Optional[int] = None,
+                      force: bool = False) -> str:
+    """Fetch `url` into the model cache, Adler32-verified.
+
+    Mirrors ZooModel.initPretrained: reuse the cached file when its checksum
+    matches, re-download once on mismatch, and fail hard if the fresh copy
+    still fails verification. file:// URLs are supported for local mirrors.
+    """
+    import urllib.request
+    dest_dir = os.path.join(cache_dir(), model_name)
+    os.makedirs(dest_dir, exist_ok=True)
+    dest = os.path.join(dest_dir, filename)
+
+    def _fetch():
+        # pid-suffixed temp + atomic replace: concurrent downloaders (multi-
+        # host workers with a shared cache) never interleave into one file
+        tmp = f"{dest}.part{os.getpid()}"
+        try:
+            with urllib.request.urlopen(url, timeout=300) as r, \
+                    open(tmp, "wb") as f:
+                while True:
+                    chunk = r.read(1 << 20)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+            os.replace(tmp, dest)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+
+    fresh = force or not os.path.exists(dest)
+    if fresh:
+        _fetch()
+    if expected_adler32 is not None and adler32_file(dest) != expected_adler32:
+        if not fresh:
+            # stale cached copy: one re-download, like the reference; a copy
+            # we *just* fetched failing its checksum is a bad artifact —
+            # re-downloading it would only repeat the transfer
+            _fetch()
+        if adler32_file(dest) != expected_adler32:
+            os.remove(dest)
+            raise ValueError(
+                f"Pretrained model file failed checksum for {model_name}: "
+                f"{url} (expected adler32={expected_adler32})")
+    return dest
 
 
 @dataclasses.dataclass
@@ -55,8 +177,13 @@ class ZooModel:
     #: body on the MXU in bf16 with f32 masters — see nn config dtype)
     dtype: str = "float32"
 
-    #: md5 of the pretrained artifact, when one is published
+    #: md5 of the pretrained artifact, when one is published (local-path flow)
     pretrained_checksums: dict = dataclasses.field(default_factory=dict)
+    #: ptype -> path relative to the resources base URL
+    #: (reference pretrainedUrl(); values from the zoo model classes)
+    pretrained_urls: dict = dataclasses.field(default_factory=dict)
+    #: ptype -> Adler32 checksum (reference pretrainedChecksum())
+    pretrained_adler32: dict = dataclasses.field(default_factory=dict)
 
     def init_model(self):
         """Build + init the network (MultiLayerNetwork or ComputationGraph)."""
@@ -69,25 +196,55 @@ class ZooModel:
             conf.dtype = self.dtype
         return conf
 
+    def _registry_key(self) -> str:
+        name = type(self).__name__
+        if name == "Darknet19" and tuple(self.input_shape[1:]) == (448, 448):
+            return "Darknet19_448"
+        return name
+
+    def _published(self, ptype: str):
+        """(relative_url, adler32) — instance overrides, then the registry."""
+        if ptype in self.pretrained_urls:
+            return (self.pretrained_urls[ptype],
+                    self.pretrained_adler32.get(ptype))
+        entry = PRETRAINED_REGISTRY.get(self._registry_key(), {})
+        return entry.get(ptype, (None, None))
+
     def pretrained_available(self, ptype: str = PretrainedType.IMAGENET) -> bool:
-        return ptype in self.pretrained_checksums
+        return (ptype in self.pretrained_checksums
+                or self._published(ptype)[0] is not None)
+
+    def pretrained_url(self, ptype: str = PretrainedType.IMAGENET
+                       ) -> Optional[str]:
+        """Full artifact URL (reference ZooModel.pretrainedUrl)."""
+        rel = self._published(ptype)[0]
+        return get_url_string(rel) if rel else None
+
+    def pretrained_checksum(self, ptype: str = PretrainedType.IMAGENET
+                            ) -> Optional[int]:
+        """Adler32 of the published artifact (ZooModel.pretrainedChecksum)."""
+        return self._published(ptype)[1]
 
     def init_pretrained(self, ptype: str = PretrainedType.IMAGENET,
                         path: Optional[str] = None):
         """Load pretrained weights (reference ZooModel.initPretrained).
 
-        `path` points at a locally available artifact; otherwise the module
-        `weights_fetcher` hook is consulted. Checksum-verified when the model
-        publishes one.
+        Resolution order: explicit `path` → the `weights_fetcher` hook → the
+        model's published URL (downloaded into the local cache and
+        Adler32-verified exactly like ZooModel.java:62-95).
         """
         name = type(self).__name__
-        if path is None:
-            if weights_fetcher is None:
-                raise RuntimeError(
-                    f"No pretrained weights path given for {name} and no "
-                    "weights_fetcher registered (offline environment); pass "
-                    "path= to a locally downloaded artifact")
+        if path is None and weights_fetcher is not None:
             path = weights_fetcher(name, ptype)
+        if path is None:
+            url = self.pretrained_url(ptype)
+            if url is None:
+                raise RuntimeError(
+                    f"{name} publishes no pretrained weights for "
+                    f"'{ptype}'; pass path= to a local artifact")
+            path = download_to_cache(
+                url, name, url.rsplit("/", 1)[-1],
+                expected_adler32=self.pretrained_checksum(ptype))
         if not os.path.exists(path):
             raise FileNotFoundError(path)
         want = self.pretrained_checksums.get(ptype)

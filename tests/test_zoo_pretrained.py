@@ -1,0 +1,172 @@
+"""Zoo pretrained flow end-to-end: URL registry -> download -> Adler32
+verification -> cache -> DL4J-zip conversion -> inference.
+
+Matches reference ZooModel.initPretrained (ZooModel.java:62-95: copyURLToFile
++ FileUtils.checksum(file, new Adler32()) + one re-download on mismatch) and
+DL4JResources URL resolution. Artifacts are served from local file:// and
+http://127.0.0.1 mirrors — the environment has no egress, so the published
+blob-storage URLs themselves are registry-checked but not fetched.
+"""
+import os
+import threading
+from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.zoo import base as zoo_base
+from deeplearning4j_tpu.zoo import LeNet, ResNet50, VGG16, Darknet19
+from deeplearning4j_tpu.zoo.base import (
+    PretrainedType, adler32_file, download_to_cache)
+
+from test_dl4j_import import _act, _dl4j_zip, write_nd4j_array  # noqa: F401
+
+
+@pytest.fixture
+def cache_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_HOME", str(tmp_path / "home"))
+    return tmp_path
+
+
+def _make_mlp_zip(path, rs, n_in=6, n_hidden=8, n_out=3):
+    W1 = rs.randn(n_in, n_hidden).astype(np.float32)
+    b1 = rs.randn(n_hidden).astype(np.float32)
+    W2 = rs.randn(n_hidden, n_out).astype(np.float32)
+    b2 = rs.randn(n_out).astype(np.float32)
+    confs = [
+        {"layer": {
+            "@class": "org.deeplearning4j.nn.conf.layers.DenseLayer",
+            "nIn": n_in, "nOut": n_hidden,
+            "activationFn": _act("ActivationTanh")}},
+        {"layer": {
+            "@class": "org.deeplearning4j.nn.conf.layers.OutputLayer",
+            "nIn": n_hidden, "nOut": n_out,
+            "activationFn": _act("ActivationSoftmax"),
+            "lossFn": {"@class":
+                       "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}},
+    ]
+    coeff = np.concatenate([W1.ravel(order="F"), b1,
+                            W2.ravel(order="F"), b2])
+    _dl4j_zip(str(path), confs, coeff)
+    return (W1, b1, W2, b2)
+
+
+class TestRegistry:
+    def test_published_urls_match_reference(self):
+        """URL + Adler32 values transcribed from the reference zoo classes."""
+        assert LeNet().pretrained_url(PretrainedType.MNIST).endswith(
+            "models/lenet_dl4j_mnist_inference.zip")
+        assert LeNet().pretrained_checksum(PretrainedType.MNIST) == 1906861161
+        assert ResNet50().pretrained_url(PretrainedType.IMAGENET).endswith(
+            "models/resnet50_dl4j_inference.v3.zip")
+        assert ResNet50().pretrained_checksum(
+            PretrainedType.IMAGENET) == 3914447815
+        assert VGG16().pretrained_url(PretrainedType.VGGFACE).endswith(
+            "models/vgg16_dl4j_vggface_inference.v1.zip")
+        # Darknet19 switches artifact on 448x448 input like the reference
+        assert Darknet19().pretrained_checksum(
+            PretrainedType.IMAGENET) == 691100891
+        d448 = Darknet19(input_shape=(3, 448, 448))
+        assert d448.pretrained_checksum(PretrainedType.IMAGENET) == 1054319943
+        assert "448" in d448.pretrained_url(PretrainedType.IMAGENET)
+
+    def test_availability(self):
+        assert LeNet().pretrained_available(PretrainedType.MNIST)
+        assert not LeNet().pretrained_available(PretrainedType.IMAGENET)
+        assert ResNet50().pretrained_available(PretrainedType.IMAGENET)
+
+    def test_base_url_default_and_override(self, monkeypatch):
+        assert LeNet().pretrained_url(PretrainedType.MNIST).startswith(
+            "https://dl4jdata.blob.core.windows.net/")
+        monkeypatch.setattr(zoo_base, "_base_download_url",
+                            "https://mirror.example/dl4j/")
+        assert LeNet().pretrained_url(PretrainedType.MNIST).startswith(
+            "https://mirror.example/dl4j/")
+
+
+class TestDownloadVerifyRestore:
+    def test_file_url_checksum_and_inference(self, cache_home, monkeypatch):
+        """Full init_pretrained over a file:// mirror for two models."""
+        rs = np.random.RandomState(3)
+        results = {}
+        for cls, ptype, seed in ((LeNet, PretrainedType.MNIST, 3),
+                                 (VGG16, PretrainedType.IMAGENET, 4)):
+            rs = np.random.RandomState(seed)
+            art = cache_home / f"{cls.__name__}.zip"
+            W1, b1, W2, b2 = _make_mlp_zip(art, rs)
+            m = cls()
+            m.pretrained_urls = {ptype: f"{cls.__name__}.zip"}
+            m.pretrained_adler32 = {ptype: adler32_file(str(art))}
+            monkeypatch.setattr(zoo_base, "_base_download_url",
+                                cache_home.as_uri() + "/")
+            net = m.init_pretrained(ptype)
+            x = rs.randn(4, 6).astype(np.float32)
+            got = net.output(x).numpy()
+            h = np.tanh(x @ W1 + b1)
+            logits = h @ W2 + b2
+            e = np.exp(logits - logits.max(-1, keepdims=True))
+            np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
+                                       atol=1e-5)
+            results[cls.__name__] = net
+        assert set(results) == {"LeNet", "VGG16"}
+
+    def test_checksum_mismatch_raises_and_removes(self, cache_home):
+        art = cache_home / "m.zip"
+        _make_mlp_zip(art, np.random.RandomState(0))
+        with pytest.raises(ValueError, match="failed checksum"):
+            download_to_cache(art.as_uri(), "M", "m.zip",
+                              expected_adler32=12345)
+        assert not os.path.exists(
+            os.path.join(zoo_base.cache_dir(), "M", "m.zip"))
+
+    def test_cache_reused_without_refetch(self, cache_home):
+        art = cache_home / "c.zip"
+        _make_mlp_zip(art, np.random.RandomState(1))
+        want = adler32_file(str(art))
+        url = art.as_uri()
+        p1 = download_to_cache(url, "C", "c.zip", expected_adler32=want)
+        os.remove(art)  # source gone; cached copy must satisfy the checksum
+        p2 = download_to_cache(url, "C", "c.zip", expected_adler32=want)
+        assert p1 == p2 and os.path.exists(p2)
+
+    def test_corrupt_cache_refetched(self, cache_home):
+        art = cache_home / "r.zip"
+        _make_mlp_zip(art, np.random.RandomState(2))
+        want = adler32_file(str(art))
+        url = art.as_uri()
+        p = download_to_cache(url, "R", "r.zip", expected_adler32=want)
+        with open(p, "wb") as f:  # corrupt the cached copy
+            f.write(b"garbage")
+        p2 = download_to_cache(url, "R", "r.zip", expected_adler32=want)
+        assert adler32_file(p2) == want
+
+    def test_http_mirror(self, cache_home, monkeypatch):
+        """The transport also works over real HTTP (localhost mirror)."""
+        rs = np.random.RandomState(5)
+        art = cache_home / "h.zip"
+        W1, b1, W2, b2 = _make_mlp_zip(art, rs)
+
+        class Handler(SimpleHTTPRequestHandler):
+            def translate_path(self, path):
+                return str(cache_home / path.lstrip("/"))
+
+            def log_message(self, *a):
+                pass
+
+        srv = HTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            m = LeNet()
+            m.pretrained_urls = {PretrainedType.MNIST: "h.zip"}
+            m.pretrained_adler32 = {
+                PretrainedType.MNIST: adler32_file(str(art))}
+            monkeypatch.setattr(
+                zoo_base, "_base_download_url",
+                f"http://127.0.0.1:{srv.server_address[1]}/")
+            net = m.init_pretrained(PretrainedType.MNIST)
+            x = rs.randn(2, 6).astype(np.float32)
+            assert net.output(x).numpy().shape == (2, 3)
+        finally:
+            srv.shutdown()
+            srv.server_close()
