@@ -14,7 +14,9 @@ from typing import List, Optional, Sequence, Tuple, Union
 import jax.numpy as jnp
 
 from ...learning import Adam, IUpdater, Sgd
+from . import constraints as constraints_mod
 from . import layers as L
+from . import weightnoise as weightnoise_mod
 
 
 class InputType:
@@ -161,6 +163,11 @@ class MultiLayerConfiguration:
     gradient_normalization: Optional[str] = None  # None|clip_l2|clip_value
     gradient_clip: float = 1.0
     dtype: str = "float32"
+    #: [(target, constraint)] applied post-update; targets: weights|bias|all
+    #: (reference constrainWeights/constrainBias/constrainAllParameters)
+    constraints: list = dataclasses.field(default_factory=list)
+    #: network-default IWeightNoise applied pre-forward during training
+    weight_noise: Optional[object] = None
 
     def layer_input_types(self):
         """Per-layer input types after preprocessor application."""
@@ -184,6 +191,8 @@ class MultiLayerConfiguration:
                 v = getattr(layer, f.name)
                 if isinstance(v, L.Layer):
                     v = layer_dict(v)
+                elif f.name == "weight_noise" and v is not None:
+                    v = v.to_dict()
                 elif callable(v) and not isinstance(v, str):
                     v = getattr(v, "__name__", str(v))
                 d[f.name] = v
@@ -200,6 +209,9 @@ class MultiLayerConfiguration:
             "weight_decay": self.weight_decay,
             "gradient_normalization": self.gradient_normalization,
             "gradient_clip": self.gradient_clip, "dtype": self.dtype,
+            "constraints": constraints_mod.specs_to_json(self.constraints),
+            "weight_noise": (self.weight_noise.to_dict()
+                             if self.weight_noise is not None else None),
         }, indent=1, default=str)
 
     @staticmethod
@@ -210,7 +222,9 @@ class MultiLayerConfiguration:
             d = dict(d)
             cls = getattr(L, d.pop("@class"))
             for k, v in d.items():
-                if isinstance(v, dict) and "@class" in v:
+                if k == "weight_noise":
+                    d[k] = weightnoise_mod.weight_noise_from_dict(v)
+                elif isinstance(v, dict) and "@class" in v:
                     d[k] = mk_layer(v)
                 elif isinstance(v, list):
                     d[k] = tuple(v)
@@ -234,7 +248,11 @@ class MultiLayerConfiguration:
             l2=data.get("l2", 0.0), weight_decay=data.get("weight_decay", 0.0),
             gradient_normalization=data.get("gradient_normalization"),
             gradient_clip=data.get("gradient_clip", 1.0),
-            dtype=data.get("dtype", "float32"))
+            dtype=data.get("dtype", "float32"),
+            constraints=constraints_mod.specs_from_json(
+                data.get("constraints")),
+            weight_noise=weightnoise_mod.weight_noise_from_dict(
+                data.get("weight_noise")))
 
 
 class ListBuilder:
@@ -280,7 +298,8 @@ class ListBuilder:
             preprocessors=pres, updater=b._updater, seed=b._seed,
             l1=b._l1, l2=b._l2, weight_decay=b._weight_decay,
             gradient_normalization=b._grad_norm,
-            gradient_clip=b._grad_clip, dtype=b._dtype)
+            gradient_clip=b._grad_clip, dtype=b._dtype,
+            constraints=list(b._constraints), weight_noise=b._weight_noise)
 
 
 class NeuralNetConfigurationBuilder:
@@ -295,6 +314,8 @@ class NeuralNetConfigurationBuilder:
         self._grad_norm = None
         self._grad_clip = 1.0
         self._dtype = "float32"
+        self._constraints = []
+        self._weight_noise = None
 
     def seed(self, s: int):
         self._seed = int(s)
@@ -323,6 +344,25 @@ class NeuralNetConfigurationBuilder:
     def gradient_normalization(self, mode: str, clip: float = 1.0):
         self._grad_norm = mode
         self._grad_clip = clip
+        return self
+
+    # constraint hooks (reference NeuralNetConfiguration.Builder
+    # constrainWeights / constrainBias / constrainAllParameters)
+    def constrain_weights(self, *cs):
+        self._constraints += [("weights", c) for c in cs]
+        return self
+
+    def constrain_bias(self, *cs):
+        self._constraints += [("bias", c) for c in cs]
+        return self
+
+    def constrain_all_parameters(self, *cs):
+        self._constraints += [("all", c) for c in cs]
+        return self
+
+    def weight_noise(self, wn):
+        """Network-default weight noise (reference Builder.weightNoise)."""
+        self._weight_noise = wn
         return self
 
     def list(self) -> ListBuilder:

@@ -36,6 +36,9 @@ def _pair(v) -> Tuple[int, int]:
 class Layer:
     """Base layer config (reference nn/conf/layers/Layer.java)."""
     name: Optional[str] = None
+    #: per-layer IWeightNoise (reference BaseLayer.weightNoise); overrides
+    #: the network-level default from Builder.weight_noise()
+    weight_noise: Optional[object] = None
 
     def init_params(self, key, input_type):
         return {}

@@ -1,0 +1,95 @@
+"""Weight noise: stochastic parameter perturbation applied pre-forward.
+
+Reference: ``deeplearning4j-nn/.../nn/conf/weightnoise/`` — IWeightNoise
+(getParameter called per param per forward), DropConnect.java (bernoulli
+weight retention) and WeightNoise.java (additive/multiplicative noise from a
+distribution).
+
+TPU redesign: noise is a pure function of (key, params) applied to the layer
+param dict inside the traced forward pass, so it fuses into the train step
+and replays deterministically from the step RNG key. Train-time only, like
+the reference (getParameter's ``train`` flag).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .constraints import is_bias_param, is_weight_param
+
+
+@dataclasses.dataclass
+class DropConnect:
+    """Randomly drop individual weights each forward pass
+    (reference weightnoise/DropConnect.java).
+
+    ``weight_retain_prob``: probability a weight is kept. Kept weights are
+    scaled by 1/p (inverted form) so activation expectations match the
+    noise-free inference path.
+    """
+    weight_retain_prob: float = 0.5
+    apply_to_biases: bool = False
+
+    def _hits(self, key, param):
+        return (is_weight_param(key, param)
+                or (self.apply_to_biases and is_bias_param(key, param)))
+
+    def apply_tree(self, rng, pdict: dict) -> dict:
+        out = {}
+        for k in sorted(pdict):
+            p = pdict[k]
+            if self._hits(k, p):
+                rng, sub = jax.random.split(rng)
+                mask = jax.random.bernoulli(sub, self.weight_retain_prob,
+                                            p.shape)
+                out[k] = p * mask.astype(p.dtype) / self.weight_retain_prob
+            else:
+                out[k] = p
+        return out
+
+    def to_dict(self):
+        return {"@class": "DropConnect", **dataclasses.asdict(self)}
+
+
+@dataclasses.dataclass
+class WeightNoise:
+    """Additive or multiplicative gaussian noise on weights
+    (reference weightnoise/WeightNoise.java with a NormalDistribution).
+    """
+    mean: float = 0.0
+    stddev: float = 0.1
+    additive: bool = True
+    apply_to_bias: bool = False
+
+    def _hits(self, key, param):
+        return (is_weight_param(key, param)
+                or (self.apply_to_bias and is_bias_param(key, param)))
+
+    def apply_tree(self, rng, pdict: dict) -> dict:
+        out = {}
+        for k in sorted(pdict):
+            p = pdict[k]
+            if self._hits(k, p):
+                rng, sub = jax.random.split(rng)
+                noise = (self.mean + self.stddev *
+                         jax.random.normal(sub, p.shape)).astype(p.dtype)
+                out[k] = p + noise if self.additive else p * noise
+            else:
+                out[k] = p
+        return out
+
+    def to_dict(self):
+        return {"@class": "WeightNoise", **dataclasses.asdict(self)}
+
+
+_CLASSES = {"DropConnect": DropConnect, "WeightNoise": WeightNoise}
+
+
+def weight_noise_from_dict(d: Optional[dict]):
+    if not d:
+        return None
+    d = dict(d)
+    return _CLASSES[d.pop("@class")](**d)

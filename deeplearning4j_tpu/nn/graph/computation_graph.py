@@ -22,7 +22,9 @@ import numpy as np
 from ...datasets.dataset import DataSet, MultiDataSet
 from ...learning import IUpdater, Sgd
 from ...ndarray.ndarray import NDArray
+from ..conf import constraints as constraints_mod
 from ..conf import layers as L
+from ..conf import weightnoise as weightnoise_mod
 from ..conf.config import infer_preprocessor
 from ..fit_fastpath import FitFastPathMixin
 from .vertices import VERTEX_CLASSES, GraphVertex, PreprocessorVertex
@@ -79,6 +81,10 @@ class ComputationGraphConfiguration:
     gradient_normalization: Optional[str] = None
     gradient_clip: float = 1.0
     dtype: str = "float32"
+    #: [(target, constraint)] applied post-update (see conf/constraints.py)
+    constraints: list = dataclasses.field(default_factory=list)
+    #: network-default IWeightNoise applied pre-forward during training
+    weight_noise: Optional[Any] = None
 
     def topological_order(self) -> List[str]:
         """Kahn topological sort (reference ComputationGraph.java:484-515)."""
@@ -130,6 +136,8 @@ class ComputationGraphConfiguration:
                 fv = getattr(layer, f.name)
                 if isinstance(fv, L.Layer):
                     fv = layer_dict(fv)
+                elif f.name == "weight_noise" and fv is not None:
+                    fv = fv.to_dict()
                 elif callable(fv) and not isinstance(fv, str):
                     fv = getattr(fv, "__name__", str(fv))
                 d[f.name] = fv
@@ -161,6 +169,9 @@ class ComputationGraphConfiguration:
             "weight_decay": self.weight_decay,
             "gradient_normalization": self.gradient_normalization,
             "gradient_clip": self.gradient_clip, "dtype": self.dtype,
+            "constraints": constraints_mod.specs_to_json(self.constraints),
+            "weight_noise": (self.weight_noise.to_dict()
+                             if self.weight_noise is not None else None),
         }, indent=1, default=str)
 
     @staticmethod
@@ -172,7 +183,9 @@ class ComputationGraphConfiguration:
             d = dict(d)
             cls = getattr(L, d.pop("@class"))
             for k, v in d.items():
-                if isinstance(v, dict) and "@class" in v:
+                if k == "weight_noise":
+                    d[k] = weightnoise_mod.weight_noise_from_dict(v)
+                elif isinstance(v, dict) and "@class" in v:
                     d[k] = mk_layer(v)
                 elif isinstance(v, list):
                     d[k] = tuple(v)
@@ -219,7 +232,11 @@ class ComputationGraphConfiguration:
             l2=data.get("l2", 0.0), weight_decay=data.get("weight_decay", 0.0),
             gradient_normalization=data.get("gradient_normalization"),
             gradient_clip=data.get("gradient_clip", 1.0),
-            dtype=data.get("dtype", "float32"))
+            dtype=data.get("dtype", "float32"),
+            constraints=constraints_mod.specs_from_json(
+                data.get("constraints")),
+            weight_noise=weightnoise_mod.weight_noise_from_dict(
+                data.get("weight_noise")))
 
 
 class GraphBuilder:
@@ -272,6 +289,8 @@ class GraphBuilder:
             conf.gradient_normalization = b._grad_norm
             conf.gradient_clip = b._grad_clip
             conf.dtype = b._dtype
+            conf.constraints = list(b._constraints)
+            conf.weight_noise = b._weight_noise
         # auto-insert preprocessors from inferred types (reference
         # GraphBuilder.setInputTypes shape-inference pass)
         if self._input_types:
@@ -397,6 +416,11 @@ class ComputationGraph(FitFastPathMixin):
                 if pre is not None:
                     si = pre(si)
                 state_inputs[name] = si
+            wn = (getattr(getattr(v, "layer", None), "weight_noise", None)
+                  or getattr(self.conf, "weight_noise", None))
+            if wn is not None and training and key is not None and p:
+                key, nkey = jax.random.split(key)
+                p = wn.apply_tree(nkey, p)
             vkey = None
             if training and key is not None and v.needs_key():
                 key, vkey = jax.random.split(key)
@@ -546,6 +570,8 @@ class ComputationGraph(FitFastPathMixin):
                                                   iteration)
             new_trainable = jax.tree_util.tree_map(
                 lambda p, u: p - u.astype(p.dtype) - wd * p, trainable, update)
+            new_trainable = constraints_mod.apply_constraints(
+                getattr(self.conf, "constraints", None), new_trainable)
             return new_trainable, states, updater_state, loss
 
         return step

@@ -24,6 +24,7 @@ from ..datasets.dataset import DataSet
 from ..learning import IUpdater
 from ..ndarray.ndarray import NDArray
 from .conf.config import MultiLayerConfiguration
+from .conf.constraints import apply_constraints
 from .conf.layers import BatchNormalization, LossLayer, OutputLayer, RnnOutputLayer
 from .fit_fastpath import FitFastPathMixin
 
@@ -103,6 +104,15 @@ class MultiLayerNetwork(FitFastPathMixin):
     def _merge(self, params, trainable):
         return [{**p, **t} for p, t in zip(params, trainable)]
 
+    def _weight_noised(self, layer, p, key, training):
+        """Train-time weight noise (reference IWeightNoise.getParameter):
+        layer-level setting wins over the network default."""
+        wn = getattr(layer, "weight_noise", None) or self.conf.weight_noise
+        if wn is None or not training or key is None or not p:
+            return p, key
+        key, sub = jax.random.split(key)
+        return wn.apply_tree(sub, p), key
+
     # -- forward ---------------------------------------------------------
     def _forward(self, params, x, training: bool, key=None):
         cd = self._compute_dtype()
@@ -118,6 +128,7 @@ class MultiLayerNetwork(FitFastPathMixin):
                     h = self._cast_act(h, jnp.float32)
                 else:
                     p = self._cast_layer_params(p, cd)
+            p, key = self._weight_noised(layer, p, key, training)
             layer_key = None
             if training and key is not None and layer.needs_key():
                 key, layer_key = jax.random.split(key)
@@ -235,6 +246,10 @@ class MultiLayerNetwork(FitFastPathMixin):
         wd = self.conf.weight_decay
         new_trainable = jax.tree_util.tree_map(
             lambda p, u: p - u.astype(p.dtype) - wd * p, trainable, update)
+        # post-update constraint projection (reference BaseConstraint
+        # .applyConstraint, called from updater application)
+        new_trainable = apply_constraints(
+            getattr(self.conf, "constraints", None), new_trainable)
         return new_trainable, updater_state
 
     def _refresh_states(self, states, bn_inputs, y):
@@ -286,6 +301,7 @@ class MultiLayerNetwork(FitFastPathMixin):
                     p = self._cast_layer_params(p, cd)
             if hasattr(layer, "new_state"):
                 bn_inputs[i] = h
+            p, key = self._weight_noised(layer, p, key, training=True)
             layer_key = None
             if key is not None and layer.needs_key():
                 key, layer_key = jax.random.split(key)

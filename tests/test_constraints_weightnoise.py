@@ -1,0 +1,211 @@
+"""Layer constraints + weight noise (VERDICT r2 missing #3).
+
+Reference: deeplearning4j-nn/.../nn/conf/constraint/{MaxNorm,MinMaxNorm,
+NonNegative,UnitNorm}Constraint.java (applied post-update via
+applyConstraint) and .../conf/weightnoise/{DropConnect,WeightNoise}.java
+(applied pre-forward via getParameter(train=true)).
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.nn.conf import (
+    DropConnect, InputType, MaxNormConstraint, MinMaxNormConstraint,
+    NeuralNetConfiguration, NonNegativeConstraint, UnitNormConstraint,
+    WeightNoise)
+from deeplearning4j_tpu.nn.conf.constraints import apply_constraints
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _col_norms(W):
+    return np.sqrt((np.asarray(W) ** 2).sum(axis=0))
+
+
+class TestConstraintMath:
+    def test_max_norm_projects_only_violators(self):
+        W = jnp.asarray(np.array([[3.0, 0.1], [4.0, 0.2]]))  # norms 5, ~0.22
+        out = np.asarray(MaxNormConstraint(max_norm=1.0).apply(W))
+        np.testing.assert_allclose(_col_norms(out)[0], 1.0, atol=1e-4)
+        np.testing.assert_allclose(out[:, 1], np.asarray(W)[:, 1], atol=1e-5)
+
+    def test_unit_norm(self):
+        W = jnp.asarray(np.random.RandomState(0).randn(6, 4) * 3)
+        out = UnitNormConstraint().apply(W)
+        np.testing.assert_allclose(_col_norms(out), 1.0, atol=1e-4)
+
+    def test_non_negative(self):
+        W = jnp.asarray([[-1.0, 2.0], [3.0, -4.0]])
+        out = np.asarray(NonNegativeConstraint().apply(W))
+        assert (out >= 0).all()
+        np.testing.assert_allclose(out, [[0, 2], [3, 0]])
+
+    def test_min_max_norm_full_rate(self):
+        W = jnp.asarray(np.array([[0.1, 5.0], [0.0, 0.0]]))  # norms .1, 5
+        out = MinMaxNormConstraint(min_norm=0.5, max_norm=2.0,
+                                   rate=1.0).apply(W)
+        norms = _col_norms(out)
+        assert 0.45 <= norms[0] <= 0.55 and 1.95 <= norms[1] <= 2.05
+
+    def test_explicit_dimensions(self):
+        W = jnp.asarray(np.random.RandomState(1).randn(4, 3))
+        out = MaxNormConstraint(max_norm=1.0, dimensions=(1,)).apply(W)
+        row_norms = np.sqrt((np.asarray(out) ** 2).sum(axis=1))
+        assert (row_norms <= 1.0 + 1e-4).all()
+
+    def test_apply_constraints_targets(self):
+        params = [{"W": jnp.ones((3, 3)) * 5, "b": jnp.ones((3,)) * -2,
+                   "state_mean": jnp.ones((3,)) * -9}]
+        out = apply_constraints([("weights", UnitNormConstraint()),
+                                 ("bias", NonNegativeConstraint())], params)
+        np.testing.assert_allclose(_col_norms(out[0]["W"]), 1.0, atol=1e-4)
+        assert (np.asarray(out[0]["b"]) == 0).all()
+        # running stats never touched
+        np.testing.assert_allclose(out[0]["state_mean"], -9.0)
+
+
+def _net(constraints=None, weight_noise=None, lr=0.5):
+    b = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(lr)))
+    if constraints:
+        for target, c in constraints:
+            getattr(b, f"constrain_{target}")(c)
+    if weight_noise is not None:
+        b.weight_noise(weight_noise)
+    conf = (b.list()
+            .layer(DenseLayer(n_in=6, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batch(n=16, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 6).astype(np.float32)
+    y = np.zeros((n, 3), np.float32)
+    y[np.arange(n), rs.randint(0, 3, n)] = 1
+    return DataSet(x, y)
+
+
+class TestConstraintInTraining:
+    def test_max_norm_enforced_after_fit(self):
+        net = _net(constraints=[("weights", MaxNormConstraint(max_norm=0.7))],
+                   lr=1.0)  # big LR would push norms way past 0.7
+        for _ in range(3):
+            net.fit(_batch())
+        for i in (0, 1):
+            W = np.asarray(net.get_param_table(i)["W"].numpy())
+            assert (_col_norms(W) <= 0.7 + 1e-3).all()
+
+    def test_bias_constraint(self):
+        net = _net(constraints=[("bias", NonNegativeConstraint())], lr=1.0)
+        for _ in range(3):
+            net.fit(_batch())
+        for i in (0, 1):
+            b = np.asarray(net.get_param_table(i)["b"].numpy())
+            assert (b >= 0).all()
+
+    def test_constraint_under_mesh(self):
+        """Constraint honored when the net is distributed over a mesh."""
+        from deeplearning4j_tpu.parallel.mesh import MeshConfig, make_mesh
+        net = _net(constraints=[("weights", MaxNormConstraint(max_norm=0.5))],
+                   lr=1.0)
+        net.distribute(make_mesh(MeshConfig(data=4, tensor=2)))
+        for _ in range(2):
+            net.fit(_batch())
+        W = np.asarray(net.get_param_table(0)["W"].numpy())
+        assert (_col_norms(W) <= 0.5 + 1e-3).all()
+
+    def test_serde_round_trip(self):
+        net = _net(constraints=[("weights", MaxNormConstraint(max_norm=0.9)),
+                                ("bias", NonNegativeConstraint())])
+        s = net.conf.to_json()
+        from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+        conf2 = MultiLayerConfiguration.from_json(s)
+        assert len(conf2.constraints) == 2
+        t0, c0 = conf2.constraints[0]
+        assert t0 == "weights" and isinstance(c0, MaxNormConstraint)
+        assert c0.max_norm == 0.9
+        net2 = MultiLayerNetwork(conf2).init()
+        net2.fit(_batch())  # constraint live after round-trip
+        W = np.asarray(net2.get_param_table(0)["W"].numpy())
+        assert (_col_norms(W) <= 0.9 + 1e-3).all()
+
+
+class TestWeightNoise:
+    def test_dropconnect_identity_at_p1(self):
+        net_plain = _net()
+        net_dc = _net(weight_noise=DropConnect(weight_retain_prob=1.0))
+        net_dc.set_params(net_plain.params())
+        ds = _batch()
+        net_plain.fit(ds)
+        net_dc.fit(ds)
+        np.testing.assert_allclose(net_plain.params().numpy(),
+                                   net_dc.params().numpy(), atol=1e-5)
+
+    def test_weightnoise_zero_std_identity(self):
+        net_plain = _net()
+        net_wn = _net(weight_noise=WeightNoise(stddev=0.0))
+        net_wn.set_params(net_plain.params())
+        ds = _batch()
+        net_plain.fit(ds)
+        net_wn.fit(ds)
+        np.testing.assert_allclose(net_plain.params().numpy(),
+                                   net_wn.params().numpy(), atol=1e-5)
+
+    def test_dropconnect_changes_training_not_inference(self):
+        net = _net(weight_noise=DropConnect(weight_retain_prob=0.5))
+        x = _batch().features
+        o1 = net.output(x).numpy()
+        o2 = net.output(x).numpy()
+        np.testing.assert_allclose(o1, o2)  # inference path noise-free
+        p0 = net.params().numpy().copy()
+        net.fit(_batch())
+        assert not np.allclose(p0, net.params().numpy())
+
+    def test_noise_gradients_flow(self):
+        """Gradcheck: with a fixed key the noised loss is differentiable and
+        jax.grad matches finite differences."""
+        net = _net(weight_noise=WeightNoise(stddev=0.05))
+        ds = _batch(8)
+        x, y = ds.features.numpy(), ds.labels.numpy()
+        key = jax.random.key(42)
+        trainable = net._trainable(net._params)
+        states = net._states(net._params)
+
+        def loss_fn(tr):
+            return net._loss_with_bn(tr, states, x, y, key)[0]
+
+        g = jax.grad(loss_fn)(trainable)
+        # finite-difference spot-check on a few W entries
+        W = np.asarray(trainable[0]["W"])
+        eps = 1e-3
+        for (i, j) in [(0, 0), (2, 3)]:
+            pert = [dict(p) for p in trainable]
+            Wp = W.copy(); Wp[i, j] += eps
+            pert[0] = {**pert[0], "W": jnp.asarray(Wp)}
+            lp = float(loss_fn(pert))
+            Wm = W.copy(); Wm[i, j] -= eps
+            pert[0] = {**pert[0], "W": jnp.asarray(Wm)}
+            lm = float(loss_fn(pert))
+            fd = (lp - lm) / (2 * eps)
+            assert abs(fd - float(g[0]["W"][i, j])) < 5e-3
+
+    def test_per_layer_weight_noise_serde(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(DenseLayer(n_in=4, n_out=4,
+                                  weight_noise=DropConnect(0.8)))
+                .layer(OutputLayer(n_in=4, n_out=2))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        wn = conf2.layers[0].weight_noise
+        assert isinstance(wn, DropConnect)
+        assert wn.weight_retain_prob == 0.8
+        assert conf2.layers[1].weight_noise is None
