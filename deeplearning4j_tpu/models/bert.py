@@ -453,9 +453,12 @@ def make_pipeline_train_step(config: BertConfig, mesh: Mesh,
     if schedule == "1f1b":
         pipe_loss = make_pipeline_loss_1f1b(stage_fn, head_fn, mesh,
                                             n_microbatches)
-    else:
+    elif schedule == "gpipe":
         pipe_loss = make_pipeline_loss(stage_fn, head_fn, mesh,
                                        n_microbatches, remat=remat)
+    else:
+        raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                         "(expected '1f1b' or 'gpipe')")
 
     def loss_fn(params, batch):
         e = params["embeddings"]

@@ -238,8 +238,12 @@ def make_pipeline_loss_1f1b(stage_fn: Callable, head_fn: Callable,
             wsum = lax.psum(wsum, a)
         return loss_sum, wsum
 
-    def local_grads(stage_params, head_params, xm, auxm):
-        """The interleaved 1F1B fwd-recompute/bwd scan."""
+    def local_grads(stage_params, head_params, xm, auxm, gl, gw):
+        """The interleaved 1F1B fwd-recompute/bwd scan.
+
+        gl/gw are the caller's cotangents on (loss_sum, wsum); pulling the
+        head vjp with them directly makes every downstream gradient exact
+        even when wsum depends on params or activations."""
         stage_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
         S = lax.axis_size(axis)
         s = lax.axis_index(axis)
@@ -281,7 +285,7 @@ def make_pipeline_loss_1f1b(stage_fn: Callable, head_fn: Callable,
                 lambda a: a[jnp.clip(jf, 0, n_micro - 1)], auxm)
             (l, w), head_pull = jax.vjp(
                 lambda hp, yy: head_fn(hp, yy, aux_mb), head_params, y)
-            dhp, dy_head = head_pull((jnp.float32(1.0), jnp.float32(0.0)))
+            dhp, dy_head = head_pull((jnp.float32(gl), jnp.float32(gw)))
             is_out = f_valid & (s == S - 1)
             loss_sum = loss_sum + jnp.where(is_out, l, 0.0)
             wsum = wsum + jnp.where(is_out, w, 0.0)
@@ -354,26 +358,28 @@ def make_pipeline_loss_1f1b(stage_fn: Callable, head_fn: Callable,
 
     def loss_bwd(res, g):
         stacked_stage_params, head_params, x, aux = res
-        gl, _ = g          # wsum is a token count — not differentiated
+        gl, gw = g
         xm, auxm = _microbatch(x, aux)
         param_spec = jax.tree_util.tree_map(lambda _: P(axis),
                                             stacked_stage_params)
         fn = shard_map(local_grads, mesh=mesh,
                        in_specs=(param_spec, P(),
-                                 P(None, data_spec), P(None, data_spec)),
+                                 P(None, data_spec), P(None, data_spec),
+                                 P(), P()),
                        out_specs=(param_spec, P(), P(None, data_spec),
                                   P(), P()),
                        check_vma=False)
-        sg, hg, dxm, _, _ = fn(stacked_stage_params, head_params, xm, auxm)
-        scale = lambda t, ref: jax.tree_util.tree_map(
-            lambda gr, r: (gr * gl).astype(r.dtype), t, ref)
-        dx = (dxm * gl).astype(x.dtype).reshape(x.shape)
+        sg, hg, dxm, _, _ = fn(stacked_stage_params, head_params, xm, auxm,
+                               jnp.float32(gl), jnp.float32(gw))
+        cast = lambda t, ref: jax.tree_util.tree_map(
+            lambda gr, r: gr.astype(r.dtype), t, ref)
+        dx = dxm.astype(x.dtype).reshape(x.shape)
         import numpy as _np
         daux = jax.tree_util.tree_map(
             lambda a: (jnp.zeros_like(a)
                        if jnp.issubdtype(a.dtype, jnp.floating)
                        else _np.zeros(a.shape, jax.dtypes.float0)), aux)
-        return (scale(sg, stacked_stage_params), scale(hg, head_params),
+        return (cast(sg, stacked_stage_params), cast(hg, head_params),
                 dx, daux)
 
     loss.defvjp(loss_fwd, loss_bwd)

@@ -73,6 +73,38 @@ class Test1F1B:
             np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                        rtol=2e-4, atol=1e-6)
 
+    def test_param_dependent_weight_cotangent(self):
+        """wsum's cotangent must flow: with a head whose weight output
+        depends on params and activations, grad(s/w) through 1F1B must
+        still equal the GPipe autodiff backward (regression for the
+        hard-coded (1,0) head pull)."""
+        rs = np.random.RandomState(7)
+        stacked, head, x, aux = _setup(rs)
+        mesh = make_mesh(MeshConfig(data=2, pipe=4))
+
+        def head_w(hp, y, aux):
+            o = y @ hp["wo"]
+            d = (o - aux["target"]) ** 2
+            return jnp.sum(d), jnp.sum(jax.nn.sigmoid(o))
+
+        def scalar(loss_fn):
+            def f(sp, hp, xx):
+                s, w = loss_fn(sp, hp, xx, aux)
+                return s / w
+            return f
+
+        l_g = scalar(make_pipeline_loss(_stage_fn, head_w, mesh, 4))
+        l_1 = scalar(make_pipeline_loss_1f1b(_stage_fn, head_w, mesh, 4))
+        np.testing.assert_allclose(
+            float(l_1(stacked, head, x)), float(l_g(stacked, head, x)),
+            rtol=1e-6)
+        gg = jax.grad(l_g, argnums=(0, 1, 2))(stacked, head, x)
+        g1 = jax.grad(l_1, argnums=(0, 1, 2))(stacked, head, x)
+        for a, b in zip(jax.tree_util.tree_leaves(gg),
+                        jax.tree_util.tree_leaves(g1)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=2e-4, atol=1e-6)
+
     def test_uneven_bubble_microbatches(self):
         """n_micro > n_stages and n_micro == n_stages both stay exact."""
         rs = np.random.RandomState(2)
