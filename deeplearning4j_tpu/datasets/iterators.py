@@ -218,7 +218,8 @@ class NativeBatchDataSetIterator(DataSetIterator):
     executor threads)."""
 
     def __init__(self, features, labels, batch_size: int,
-                 shuffle: bool = True, seed: int = 0, n_slots: int = 4):
+                 shuffle: bool = True, seed: int = 0, n_slots: int = 4,
+                 drop_last: bool = False):
         import numpy as _np
         self._x = _np.asarray(features.numpy() if hasattr(features, "numpy")
                               else features, _np.float32)
@@ -228,6 +229,11 @@ class NativeBatchDataSetIterator(DataSetIterator):
         self.shuffle = shuffle
         self.seed = seed
         self.n_slots = n_slots
+        #: False (reference DataSetIterator contract): a trailing partial
+        #: batch is emitted. True restores fixed-shape batches — use when
+        #: feeding code jitted on a fixed batch dimension (e.g. to keep the
+        #: fit fast path's whole-epoch scan, which needs uniform shapes).
+        self.drop_last = drop_last
         self._epoch = 0
         self._it = None
         self.reset()
@@ -239,7 +245,7 @@ class NativeBatchDataSetIterator(DataSetIterator):
         self._it = native.NativeBatchIterator(
             self._x, self._y, self.batch_size, shuffle=self.shuffle,
             seed=self.seed + self._epoch, num_epochs=1,
-            n_slots=self.n_slots)
+            n_slots=self.n_slots, drop_last=self.drop_last)
         self._epoch += 1
 
     def __next__(self) -> DataSet:

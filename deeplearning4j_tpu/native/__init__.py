@@ -22,17 +22,31 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "src", "dl4jtpu_io.cpp")
-_LIB_PATH = os.path.join(_HERE, "libdl4jtpu_io.so")
 
 _lib = None
 _lib_lock = threading.Lock()
 _build_error: Optional[str] = None
 
 
-def _build() -> Optional[str]:
+def _lib_path() -> str:
+    """Build-cache path keyed by a hash of the source, so a changed .cpp can
+    never be shadowed by a stale binary (mtimes are unreliable after git
+    checkout — git does not preserve them)."""
+    import hashlib
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache = os.environ.get(
+        "DL4J_TPU_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "dl4jtpu"))
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, f"libdl4jtpu_io-{digest}.so")
+
+
+def _build(lib_path: str) -> Optional[str]:
     """Compile the shared library; returns an error string or None."""
+    tmp = lib_path + f".tmp{os.getpid()}"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", _LIB_PATH]
+           _SRC, "-o", tmp]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)
@@ -40,6 +54,7 @@ def _build() -> Optional[str]:
         return f"g++ unavailable: {e}"
     if proc.returncode != 0:
         return proc.stderr[-2000:]
+    os.replace(tmp, lib_path)  # atomic vs concurrent builders
     return None
 
 
@@ -48,14 +63,14 @@ def _load():
     with _lib_lock:
         if _lib is not None or _build_error is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) or \
-                os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
-            err = _build()
+        lib_path = _lib_path()
+        if not os.path.exists(lib_path):
+            err = _build(lib_path)
             if err is not None:
                 _build_error = err
                 return None
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = ctypes.CDLL(lib_path)
         except OSError as e:
             _build_error = str(e)
             return None
@@ -75,9 +90,9 @@ def _load():
         lib.idx_read_f32.restype = ctypes.c_int
         lib.ring_create.argtypes = [p_f32, p_f32, i64, i64, i64, i64,
                                     ctypes.c_int, ctypes.c_int,
-                                    ctypes.c_uint64, i64]
+                                    ctypes.c_uint64, i64, ctypes.c_int]
         lib.ring_create.restype = ctypes.c_void_p
-        lib.ring_next.argtypes = [ctypes.c_void_p, p_f32, p_f32]
+        lib.ring_next.argtypes = [ctypes.c_void_p, p_f32, p_f32, p_i64]
         lib.ring_next.restype = ctypes.c_int
         lib.ring_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
@@ -158,7 +173,8 @@ class NativeBatchIterator:
 
     def __init__(self, features: np.ndarray, labels: Optional[np.ndarray],
                  batch_size: int, shuffle: bool = True, seed: int = 0,
-                 num_epochs: int = 1, n_slots: int = 4):
+                 num_epochs: int = 1, n_slots: int = 4,
+                 drop_last: bool = False):
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native library unavailable: {_build_error}")
@@ -179,7 +195,7 @@ class NativeBatchIterator:
             _fptr(self.labels) if self.labels is not None
             else _fptr(self.features),
             n, self.xf, self.yf, self.batch, n_slots, 1 if shuffle else 0,
-            seed, num_epochs)
+            seed, num_epochs, 1 if drop_last else 0)
 
     def __iter__(self):
         return self
@@ -189,13 +205,16 @@ class NativeBatchIterator:
             raise StopIteration
         bx = np.empty((self.batch, self.xf), np.float32)
         by = np.empty((self.batch, max(self.yf, 1)), np.float32)
-        ok = self._lib.ring_next(self._handle, _fptr(bx), _fptr(by))
+        rows = ctypes.c_int64(0)
+        ok = self._lib.ring_next(self._handle, _fptr(bx), _fptr(by),
+                                 ctypes.byref(rows))
         if not ok:
             self.close()
             raise StopIteration
-        x = bx.reshape(self._x_shape)
+        r = int(rows.value)
+        x = bx[:r].reshape((r,) + self._x_shape[1:])
         if self.yf:
-            return x, by.reshape(self._y_shape)
+            return x, by[:r].reshape((r,) + self._y_shape[1:])
         return x, None
 
     def close(self):

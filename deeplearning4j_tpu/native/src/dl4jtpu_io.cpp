@@ -155,10 +155,13 @@ struct BatchRing {
   const float* y;
   int64_t n, xf, yf, batch;
   bool shuffle;
+  bool drop_last;  // false: emit the trailing partial batch (reference
+                   // DataSetIterator contract — a final smaller batch)
   uint64_t seed;
   int64_t epochs;  // -1 = infinite
 
   std::vector<std::vector<float>> slots_x, slots_y;
+  std::vector<int64_t> slot_rows;  // actual rows in each filled slot
   std::queue<int> ready;     // filled slot indices
   std::queue<int> free_;     // reusable slot indices
   std::mutex mu;
@@ -179,8 +182,10 @@ struct BatchRing {
           std::swap(order[i], order[d(rng)]);
         }
       }
-      for (int64_t start = 0; start + batch <= n && !stop.load();
+      int64_t limit = drop_last ? n - batch : n - 1;
+      for (int64_t start = 0; start <= limit && !stop.load();
            start += batch) {
+        int64_t rows = std::min(batch, n - start);
         int slot;
         {
           std::unique_lock<std::mutex> lk(mu);
@@ -191,7 +196,7 @@ struct BatchRing {
         }
         float* bx = slots_x[slot].data();
         float* by = slots_y[slot].data();
-        for (int64_t i = 0; i < batch; ++i) {
+        for (int64_t i = 0; i < rows; ++i) {
           int64_t src = order[start + i];
           std::memcpy(bx + i * xf, x + src * xf, sizeof(float) * xf);
           if (yf > 0)
@@ -199,6 +204,7 @@ struct BatchRing {
         }
         {
           std::lock_guard<std::mutex> lk(mu);
+          slot_rows[slot] = rows;
           ready.push(slot);
         }
         cv_ready.notify_one();
@@ -215,7 +221,7 @@ struct BatchRing {
 
 void* ring_create(const float* x, const float* y, int64_t n, int64_t xf,
                   int64_t yf, int64_t batch, int n_slots, int shuffle,
-                  uint64_t seed, int64_t epochs) {
+                  uint64_t seed, int64_t epochs, int drop_last) {
   auto* r = new BatchRing();
   r->x = x;
   r->y = y;
@@ -224,8 +230,10 @@ void* ring_create(const float* x, const float* y, int64_t n, int64_t xf,
   r->yf = yf;
   r->batch = batch;
   r->shuffle = shuffle != 0;
+  r->drop_last = drop_last != 0;
   r->seed = seed;
   r->epochs = epochs;
+  r->slot_rows.assign(n_slots, 0);
   for (int i = 0; i < n_slots; ++i) {
     r->slots_x.emplace_back(static_cast<size_t>(batch * xf));
     r->slots_y.emplace_back(static_cast<size_t>(batch * (yf > 0 ? yf : 1)));
@@ -235,9 +243,10 @@ void* ring_create(const float* x, const float* y, int64_t n, int64_t xf,
   return r;
 }
 
-// Pops the next batch into out_x/out_y. Returns 1 on success, 0 when the
-// ring is exhausted (all epochs emitted).
-int ring_next(void* handle, float* out_x, float* out_y) {
+// Pops the next batch into out_x/out_y, writing the row count (== batch
+// except for a trailing partial batch) to *out_rows. Returns 1 on success,
+// 0 when the ring is exhausted (all epochs emitted).
+int ring_next(void* handle, float* out_x, float* out_y, int64_t* out_rows) {
   auto* r = static_cast<BatchRing*>(handle);
   int slot;
   {
@@ -247,11 +256,13 @@ int ring_next(void* handle, float* out_x, float* out_y) {
     slot = r->ready.front();
     r->ready.pop();
   }
+  int64_t rows = r->slot_rows[slot];
   std::memcpy(out_x, r->slots_x[slot].data(),
-              sizeof(float) * r->batch * r->xf);
+              sizeof(float) * rows * r->xf);
   if (r->yf > 0)
     std::memcpy(out_y, r->slots_y[slot].data(),
-                sizeof(float) * r->batch * r->yf);
+                sizeof(float) * rows * r->yf);
+  if (out_rows) *out_rows = rows;
   {
     std::lock_guard<std::mutex> lk(r->mu);
     r->free_.push(slot);

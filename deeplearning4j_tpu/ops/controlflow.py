@@ -119,6 +119,14 @@ def switch(x, pred):
 
 @op("merge", "controlflow", aliases=("Merge",))
 def merge(a, b):
-    """Reference Merge: first-available input. Functional analog: sum of
-    the (mutually exclusive) switch outputs."""
+    """Reference Merge: first-available input.
+
+    Functional analog: sum of the two inputs — correct ONLY when both are
+    wired DIRECTLY to the two outputs of the same `switch` op (one side is
+    exactly zero). Do not place value-mapping ops (exp, cos, softmax, …)
+    between switch and merge: they turn the zeroed branch into nonzero
+    garbage that corrupts the sum. The TF importer never hits this — it
+    lowers Switch/Merge pairs to `jnp.where` selects on the predicate
+    (modelimport/tf/mappings.py) — but direct registry users must keep the
+    switch→merge wiring tight, or use `lax.cond`/the `cond` op instead."""
     return a + b

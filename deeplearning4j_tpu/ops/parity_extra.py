@@ -17,13 +17,59 @@ from .registry import op
 from .conv_ops import deconv2d
 
 
+_callback_support = None  # None = unprobed
+
+
+def _host_callbacks_supported() -> bool:
+    """Some PJRT backends (the axon TPU tunnel) reject host send/recv
+    callbacks with UNIMPLEMENTED at run time — probe once with a tiny jitted
+    callback so Assert only takes the checked path where it can execute."""
+    global _callback_support
+    if _callback_support is None:
+        try:
+            @jax.jit
+            def _probe(x):
+                jax.debug.callback(lambda v: None, x)
+                return x
+
+            # ensure_compile_time_eval: Assert is usually first hit while
+            # TRACING a user function — without it the probe would be
+            # staged into that outer trace instead of executing now
+            with jax.ensure_compile_time_eval():
+                jax.block_until_ready(_probe(jnp.asarray(0)))
+                # callback failures surface out-of-band on some backends —
+                # flush outstanding effects before declaring support
+                jax.effects_barrier()
+            _callback_support = True
+        except Exception:
+            _callback_support = False
+    return _callback_support
+
+
 @op("Assert", "parity", differentiable=False)
 def assert_op(condition, *data, message="assertion failed"):
-    """Host-checked assert (reference Assert). Under jit it becomes a
-    checkify-style no-op; eager it raises."""
+    """Host-checked assert (reference Assert).
+
+    Eager: raises AssertionError immediately. Under jit the condition is
+    routed through a host callback that raises when it is False at runtime,
+    so an imported graph keeps its checks when compiled, instead of
+    silently dropping them. On backends without host-callback support
+    (probed once) the jit path degrades to the old no-op with a warning."""
     try:
         ok = bool(jnp.all(condition))
     except jax.errors.TracerBoolConversionError:
+        if not _host_callbacks_supported():
+            import logging
+            logging.getLogger(__name__).warning(
+                "Assert under jit is a no-op: backend does not support "
+                "host callbacks")
+            return jnp.asarray(True)
+
+        def _host_check(ok_value):
+            if not bool(np.all(ok_value)):
+                raise AssertionError(message)
+
+        jax.debug.callback(_host_check, jnp.all(condition))
         return jnp.asarray(True)
     if not ok:
         raise AssertionError(message)

@@ -14,3 +14,32 @@ def test_native_dataset_iterator():
     it.reset()
     assert sum(1 for _ in it) == 4
     it.close()
+
+
+def test_native_iterator_trailing_partial_batch():
+    """Reference DataSetIterator contract: the final batch may be smaller;
+    every sample is seen exactly once per epoch."""
+    import deeplearning4j_tpu.native as native
+    import pytest
+    if not native.available():
+        pytest.skip("no native lib")
+    x = np.arange(22, dtype=np.float32).reshape(22, 1)
+    it = native.NativeBatchIterator(x, None, batch_size=8, shuffle=False,
+                                    num_epochs=1)
+    sizes, seen = [], []
+    for bx, _ in it:
+        sizes.append(bx.shape[0])
+        seen.extend(bx[:, 0].tolist())
+    assert sizes == [8, 8, 6]
+    assert sorted(seen) == list(range(22))
+
+
+def test_native_iterator_drop_last():
+    import deeplearning4j_tpu.native as native
+    import pytest
+    if not native.available():
+        pytest.skip("no native lib")
+    x = np.arange(22, dtype=np.float32).reshape(22, 1)
+    it = native.NativeBatchIterator(x, None, batch_size=8, shuffle=False,
+                                    num_epochs=1, drop_last=True)
+    assert [bx.shape[0] for bx, _ in it] == [8, 8]
