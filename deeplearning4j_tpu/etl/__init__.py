@@ -19,6 +19,16 @@ from .executor import (LocalTransformExecutor, analyze_local,
                        analyze_quality_local, DataAnalysis,
                        DataQualityAnalysis)
 from .join import Join, JoinType
+from .image_transforms import (ImageTransform, ImageTransformProcess,
+                               ResizeImageTransform, CropImageTransform,
+                               RandomCropTransform, FlipImageTransform,
+                               RotateImageTransform, ScaleImageTransform,
+                               BoxImageTransform, ColorConversionTransform,
+                               NormalizeImageTransform, MultiImageTransform,
+                               PipelineImageTransform)
+from .distributed import (ShardedTransformExecutor, shard_records,
+                          shard_files)
+from . import columnar
 from .records import (InputSplit, FileSplit, CollectionInputSplit, StringSplit,
                       RecordReader, CSVRecordReader, LineRecordReader,
                       CollectionRecordReader, JacksonLineRecordReader,

@@ -317,9 +317,14 @@ class ImageRecordReader(RecordReader):
     `runtime/` when built)."""
 
     def __init__(self, height: int, width: int, channels: int = 3,
-                 label_generator: Optional[ParentPathLabelGenerator] = None):
+                 label_generator: Optional[ParentPathLabelGenerator] = None,
+                 image_transform=None, seed: Optional[int] = None):
         self.height, self.width, self.channels = height, width, channels
         self.label_gen = label_generator
+        #: optional ImageTransform/ImageTransformProcess applied per image
+        #: (reference ImageRecordReader's imageTransform constructor arg)
+        self.image_transform = image_transform
+        self._rng = np.random.RandomState(seed)
         self._files: List[str] = []
         self._labels: List[str] = []
         self._i = 0
@@ -353,6 +358,10 @@ class ImageRecordReader(RecordReader):
             arr = arr[None, :, :]
         else:
             arr = arr.transpose(2, 0, 1)  # HWC -> CHW
+        if self.image_transform is not None:
+            tf = self.image_transform
+            arr = (tf.execute(arr, self._rng) if hasattr(tf, "execute")
+                   else tf.transform(arr, self._rng))
         rec = [arr]
         if self.label_gen is not None:
             rec.append(self._labels.index(

@@ -1,0 +1,217 @@
+"""DataVec data formats (VERDICT r2 missing #4): image-transform pipeline,
+columnar (arrow/parquet) readers, and the sharded multi-host ETL executor.
+
+Reference: datavec-data-image/.../image/transform/*.java, datavec-arrow,
+and datavec-spark SparkTransformExecutor.java:354.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.etl import (
+    BoxImageTransform, ColorConversionTransform, CropImageTransform,
+    FlipImageTransform, ImageTransformProcess, MultiImageTransform,
+    NormalizeImageTransform, PipelineImageTransform, RandomCropTransform,
+    ResizeImageTransform, RotateImageTransform, Schema,
+    ShardedTransformExecutor, TransformProcess, columnar, shard_files,
+    shard_records)
+
+
+def _img(c=3, h=32, w=32, seed=0):
+    return np.random.RandomState(seed).rand(c, h, w).astype(np.float32) * 255
+
+
+class TestImageTransforms:
+    def test_resize(self):
+        out = ResizeImageTransform(16, 24).transform(_img())
+        assert out.shape == (3, 16, 24)
+
+    def test_crop_margins(self):
+        out = CropImageTransform(2, 3, 4, 5).transform(_img())
+        assert out.shape == (3, 32 - 2 - 4, 32 - 3 - 5)
+
+    def test_random_crop_deterministic_with_rng(self):
+        img = _img()
+        a = RandomCropTransform(16, 16).transform(
+            img, np.random.RandomState(3))
+        b = RandomCropTransform(16, 16).transform(
+            img, np.random.RandomState(3))
+        assert a.shape == (3, 16, 16)
+        np.testing.assert_array_equal(a, b)
+
+    def test_flip_modes(self):
+        img = _img()
+        np.testing.assert_array_equal(
+            FlipImageTransform(1).transform(img), img[:, :, ::-1])
+        np.testing.assert_array_equal(
+            FlipImageTransform(0).transform(img), img[:, ::-1, :])
+        np.testing.assert_array_equal(
+            FlipImageTransform(-1).transform(img), img[:, ::-1, ::-1])
+
+    def test_rotate_180_equals_double_flip(self):
+        img = _img()
+        rot = RotateImageTransform(180).transform(img)
+        np.testing.assert_allclose(rot, img[:, ::-1, ::-1], atol=1e-3)
+
+    def test_box_pad_and_crop(self):
+        img = _img(h=10, w=10)
+        padded = BoxImageTransform(20, 20).transform(img)
+        assert padded.shape == (3, 20, 20)
+        np.testing.assert_array_equal(padded[:, 5:15, 5:15], img)
+        cropped = BoxImageTransform(6, 6).transform(img)
+        assert cropped.shape == (3, 6, 6)
+        np.testing.assert_array_equal(cropped, img[:, 2:8, 2:8])
+
+    def test_color_conversion_roundtrip_shapes(self):
+        gray = ColorConversionTransform("rgb2gray").transform(_img())
+        assert gray.shape == (1, 32, 32)
+        rgb = ColorConversionTransform("gray2rgb").transform(gray)
+        assert rgb.shape == (3, 32, 32)
+
+    def test_normalize(self):
+        out = NormalizeImageTransform(255.0, mean=[0.5, 0.5, 0.5],
+                                      std=[0.25, 0.25, 0.25]).transform(_img())
+        assert out.min() >= -2.0 - 1e-5 and out.max() <= 2.0 + 1e-5
+
+    def test_pipeline_probabilistic_and_process_builder(self):
+        proc = (ImageTransformProcess.builder()
+                .resize_image_transform(24, 24)
+                .flip_image_transform(1)
+                .normalize_image_transform(255.0)
+                .build())
+        out = proc.execute(_img())
+        assert out.shape == (3, 24, 24) and out.max() <= 1.0 + 1e-5
+        pipe = PipelineImageTransform(
+            [(FlipImageTransform(1), 0.0),
+             (ResizeImageTransform(8, 8), 1.0)], seed=0)
+        assert pipe.transform(_img()).shape == (3, 8, 8)
+
+    def test_multi_transform(self):
+        t = MultiImageTransform(ResizeImageTransform(16, 16),
+                                ColorConversionTransform("rgb2gray"))
+        assert t.transform(_img()).shape == (1, 16, 16)
+
+
+class TestImageReaderIntegration:
+    def test_reader_with_transform_feeds_network(self, tmp_path):
+        """ImageRecordReader + transform pipeline feeds a conv net
+        end-to-end (the 'feeds a zoo model' done-criterion at test scale)."""
+        from PIL import Image
+
+        from deeplearning4j_tpu.etl import (FileSplit, ImageRecordReader,
+                                            ParentPathLabelGenerator)
+
+        rs = np.random.RandomState(0)
+        for label in ("cat", "dog"):
+            os.makedirs(tmp_path / label, exist_ok=True)
+            for i in range(3):
+                arr = (rs.rand(40, 40, 3) * 255).astype(np.uint8)
+                Image.fromarray(arr).save(tmp_path / label / f"{i}.png")
+
+        proc = (ImageTransformProcess.builder()
+                .resize_image_transform(28, 28)
+                .normalize_image_transform(255.0)
+                .build())
+        rr = ImageRecordReader(40, 40, 3,
+                               ParentPathLabelGenerator(),
+                               image_transform=proc, seed=0)
+        rr.initialize(FileSplit(str(tmp_path), [".png"]))
+        xs, ys = [], []
+        while rr.has_next():
+            img, label = rr.next()
+            xs.append(img)
+            ys.append(label)
+        x = np.stack(xs)
+        assert x.shape == (6, 3, 28, 28) and x.max() <= 1.0 + 1e-5
+        assert sorted(set(ys)) == [0, 1]
+
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                                       OutputLayer,
+                                                       SubsamplingLayer)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        conf = (NeuralNetConfiguration.builder().updater(Adam(1e-3)).list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2)))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.convolutional(28, 28, 3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        y1h = np.eye(2, dtype=np.float32)[ys]
+        net.fit(DataSet(x, y1h))
+        assert np.isfinite(net.score())
+
+
+@pytest.mark.skipif(not columnar.available(), reason="pyarrow not present")
+class TestColumnar:
+    def _schema_records(self):
+        schema = (Schema.Builder()
+                  .add_column_string("name")
+                  .add_column_integer("count")
+                  .add_column_double("score").build())
+        records = [["a", 1, 0.5], ["b", 2, 1.5], ["c", 3, 2.5]]
+        return schema, records
+
+    def test_arrow_roundtrip(self, tmp_path):
+        schema, records = self._schema_records()
+        path = str(tmp_path / "t.arrow")
+        columnar.write_arrow(path, schema, records)
+        rr = columnar.ArrowRecordReader(path)
+        assert rr.schema.column_names() == ["name", "count", "score"]
+        got = [rr.next() for _ in iter(rr.has_next, False)]
+        assert got == records
+
+    def test_parquet_roundtrip_and_column_select(self, tmp_path):
+        schema, records = self._schema_records()
+        path = str(tmp_path / "t.parquet")
+        columnar.write_parquet(path, schema, records)
+        rr = columnar.ParquetRecordReader(path)
+        assert list(rr) == records
+        rr2 = columnar.ParquetRecordReader(path, columns=["count", "score"])
+        assert columnar.to_features(list(rr2)).shape == (3, 2)
+
+    def test_feeds_transform_process(self, tmp_path):
+        schema, records = self._schema_records()
+        path = str(tmp_path / "t.parquet")
+        columnar.write_parquet(path, schema, records)
+        rr = columnar.ParquetRecordReader(path)
+        tp = (TransformProcess.Builder(rr.schema)
+              .remove_columns("name").build())
+        out = ShardedTransformExecutor(0, 1).execute(list(rr), tp)
+        assert out == [[1, 0.5], [2, 1.5], [3, 2.5]]
+
+
+class TestShardedExecutor:
+    def test_shards_disjoint_and_complete(self):
+        records = [[i, float(i)] for i in range(11)]
+        shards = [shard_records(records, i, 4) for i in range(4)]
+        flat = sorted(sum(shards, []), key=lambda r: r[0])
+        assert flat == records
+        sizes = [len(s) for s in shards]
+        assert max(sizes) - min(sizes) <= 1
+
+    def test_deterministic_file_sharding_across_hosts(self):
+        files = [f"f{i:02d}.csv" for i in range(7)]
+        shuffled = list(reversed(files))  # hosts may enumerate differently
+        a = shard_files(files, 1, 3)
+        b = shard_files(shuffled, 1, 3)
+        assert a == b  # sorted() makes every host agree
+
+    def test_execute_matches_local_per_shard(self):
+        schema = (Schema.Builder().add_column_integer("x")
+                  .add_column_integer("y").build())
+        records = [[i, i * 10] for i in range(10)]
+        tp = (TransformProcess.Builder(schema)
+              .remove_columns("y").build())
+        ex = ShardedTransformExecutor(process_count=3, process_index=0)
+        all_out = ex.execute_all(records, tp)
+        assert len(all_out) == 3
+        merged = sorted(r[0] for shard in all_out for r in shard)
+        assert merged == list(range(10))
+        # host-0 view == execute() on host 0
+        assert all_out[0] == ShardedTransformExecutor(0, 3).execute(records,
+                                                                    tp)
