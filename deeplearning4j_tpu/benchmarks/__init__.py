@@ -1,0 +1,7 @@
+"""Benchmark harnesses: per-op microbenchmarks + regression accounting.
+
+Reference counterparts: ``contrib/benchmarking_nd4j`` (JMH op benches) and
+``contrib/performance/benchmarking/impl/FullBenchmarkSuit.cpp`` (C++ op
+sweep). Model-level numbers live in the repo-root ``bench.py``.
+"""
+from .opbench import run_opbench, compare_runs  # noqa: F401

@@ -1,0 +1,322 @@
+"""Per-op microbenchmark suite with run-over-run regression accounting.
+
+Role of the reference's JMH suite (``contrib/benchmarking_nd4j``) and
+``FullBenchmarkSuit.cpp``: time each registered op at a representative shape,
+eager and jitted, and persist a JSON table so a later run can be diffed —
+a >2x per-op slowdown fails the comparison. The model-level ``bench.py``
+cannot see a single op regressing inside an otherwise-fused program; this
+harness times ops in isolation.
+
+Usage::
+
+    python -m deeplearning4j_tpu.benchmarks.opbench --out ops.json
+    python -m deeplearning4j_tpu.benchmarks.opbench --compare ops.json
+
+Input synthesis: a category-keyed spec table provides argument factories;
+ops whose signature none of the candidate argument sets satisfies are
+reported as ``skipped`` (never silently dropped — the summary prints the
+count, matching the no-silent-caps rule).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _rng():
+    return np.random.RandomState(0)
+
+
+def _f32(*shape):
+    return _rng().randn(*shape).astype(np.float32)
+
+
+def _pos(*shape):
+    return np.abs(_rng().randn(*shape)).astype(np.float32) + 0.1
+
+
+def _unit(*shape):
+    return _rng().uniform(0.05, 0.95, shape).astype(np.float32)
+
+
+def _i32(*shape, hi=8):
+    return _rng().randint(0, hi, shape).astype(np.int32)
+
+
+def _bool(*shape):
+    return _rng().rand(*shape) > 0.5
+
+
+# Default benchmark shape: big enough that per-op device time dominates
+# dispatch, small enough that a 555-op sweep stays minutes not hours.
+N = 512
+
+
+def _candidate_sets(category: str) -> List[Tuple[tuple, dict]]:
+    """Ordered candidate (args, kwargs) per category; first that executes
+    wins. Shapes chosen per family like FullBenchmarkSuit's suites."""
+    x = _f32(N, N)
+    y = _f32(N, N)
+    v = _f32(N)
+    if category in ("transforms", "activations", "parity", "datatypes",
+                    "util", "compression"):
+        return [((_unit(N, N),), {}), ((x,), {}), ((x, y), {}),
+                ((_pos(N, N),), {})]
+    if category == "pairwise":
+        return [((x, y), {}), ((_pos(N, N), _pos(N, N)), {})]
+    if category in ("reduce", "indexreduce"):
+        return [((x,), {"dims": [1]}), ((x,), {}), ((x, [1]), {})]
+    if category == "reduce3":
+        return [((x, y), {"dims": [1]}), ((x, y), {})]
+    if category in ("blas", "linalg"):
+        return [((x, y), {}), ((x,), {}),
+                ((np.eye(N, dtype=np.float32) +
+                  0.1 * _f32(N, N) @ _f32(N, N).T,), {})]
+    if category == "shape":
+        # small inputs: shape ops are probed blind, and some (tile, repeat,
+        # meshgrid) produce outputs multiplicative in their operands — at
+        # 512x512 a mis-probed candidate can hang the sweep
+        s = _f32(64, 64)
+        s2 = _f32(64, 64)
+        return [((s,), {"shape": (64 * 64,)}), ((s,), {"axis": 0}),
+                ((s,), {}), ((s, s2), {}), (([s, s2],), {}),
+                ((s, (2, 2)), {}), ((s, 0), {})]
+    if category == "gather":
+        return [((x, _i32(64, hi=N)), {}), ((x, _i32(64, hi=N)),
+                                            {"axis": 0})]
+    if category == "scatter":
+        idx = _i32(64, 1, hi=N)
+        upd = _f32(64, N)
+        return [((x, idx, upd), {}), ((_i32(64, hi=N), upd, [N, N]), {})]
+    if category == "segment":
+        seg = np.sort(_i32(N, hi=16))
+        return [((v, seg), {"num_segments": 16}), ((_f32(N), seg, 16), {}),
+                ((v, seg), {})]
+    if category == "bitwise":
+        a = _rng().randint(0, 1 << 16, (N, N)).astype(np.int32)
+        b = _rng().randint(0, 16, (N, N)).astype(np.int32)
+        return [((a, b), {}), ((a,), {})]
+    if category == "activations":
+        return [((x,), {})]
+    if category == "loss":
+        labels = np.eye(N, dtype=np.float32)[_i32(64, hi=N)]
+        logits = _f32(64, N)
+        return [((labels, _unit(64, N)), {}), ((labels, logits), {}),
+                ((logits,), {"labels": labels}),
+                ((labels, logits, None), {}),
+                ((logits, None, labels), {})]
+    if category == "conv":
+        img = _f32(8, 32, 64, 64)         # NCHW
+        w = _f32(3, 3, 32, 64)            # HWIO (conv_ops convention)
+        vol = _f32(4, 8, 16, 16, 16)      # NCDHW
+        w3 = _f32(3, 3, 3, 8, 16)
+        seq = _f32(8, 32, 64)             # NCW
+        return [((img, w), {}),
+                ((seq, _f32(3, 32, 64)), {}),
+                ((vol, w3), {}),
+                ((img, _f32(3, 3, 32, 2)), {}),   # depthwise multiplier
+                ((img, _f32(3, 3, 32, 2), _f32(3, 3, 64, 128)), {}),
+                ((img, 3, 3), {}),                # im2col
+                ((img,), {}),
+                ((img, (1, 3, 3, 1), (1, 1, 1, 1), (1, 1, 1, 1)), {})]
+    if category == "pooling":
+        img = _f32(8, 32, 64, 64)
+        return [((img,), {"kernel": (2, 2)}), ((img, (2, 2)), {}),
+                ((img,), {})]
+    if category == "images":
+        img = _unit(8, 64, 64, 3)
+        return [((img,), {}), ((img, (32, 32)), {}),
+                ((img,), {"size": (32, 32)})]
+    if category == "recurrent":
+        B, T, F, H = 16, 32, 64, 64
+        seq = _f32(B, T, F)
+        xt = _f32(B, F)
+        return [
+            # lstmLayer(x, w_x, w_h, b) / static_rnn / gru-style
+            ((seq, _f32(F, 4 * H), _f32(H, 4 * H), _f32(4 * H)), {}),
+            ((seq, _f32(F, H), _f32(H, H), _f32(H)), {}),
+            # gru(x, h0, w_ru, w_c): gates packed [F+H, 2H] / [F+H, H]
+            ((seq, _f32(B, H), _f32(F + H, 2 * H), _f32(F + H, H)), {}),
+            # cells: (x_t, h_prev[, c_prev], weights...)
+            ((xt, _f32(B, H), _f32(B, H), _f32(F, 4 * H), _f32(H, 4 * H)),
+             {}),
+            ((xt, _f32(B, H), _f32(F + H, 2 * H), _f32(F + H, H)), {}),
+            # sru(x, c0, w[3F], b[2F])
+            ((seq, _f32(B, F), _f32(F, 3 * F), _f32(2 * F)), {}),
+            ((seq,), {}),
+        ]
+    if category == "random":
+        import jax as _jax
+        key = _jax.random.key(0)
+        return [((key, (N, N)), {}), ((key, x, 0.5), {}),
+                ((key, x), {}), (((N, N),), {}), ((), {})]
+    if category == "nn":
+        return [((x,), {}), ((x, v, v), {}), ((x, y), {})]
+    if category == "attention":
+        q = _f32(4, 64, 8, 32)
+        return [((q, q, q), {}), ((q,), {})]
+    if category == "updater":
+        return [((x, y), {"lr": 0.1}), ((x, y), {}), ((x, y, x), {})]
+    if category == "strings":
+        s = np.array(["alpha", "beta", "gamma"] * 32)
+        return [((s,), {}), ((s, " "), {})]
+    if category == "nlp":
+        vocab, dim, B = 1024, 64, 256
+        return [((_f32(vocab, dim), _f32(vocab, dim), _i32(B, hi=vocab),
+                  _i32(B, hi=vocab), _i32(B, 5, hi=vocab)), {})]
+    # controlflow / list / autodiff_bp / tsne / decoder: graph-level or
+    # bp-pair machinery, not meaningfully benchable as standalone array ops
+    return []
+
+
+#: categories excluded by design (not standalone array ops); reported, not
+#: silently dropped
+EXCLUDED_CATEGORIES = ("controlflow", "list", "autodiff_bp", "tsne",
+                       "decoder")
+
+
+def _time_fn(fn, n_iter: int, block) -> float:
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n_iter):
+        out = fn()
+    block(out)
+    return (time.perf_counter() - t0) / n_iter * 1e6  # us
+
+
+def run_opbench(filter_category: Optional[str] = None,
+                filter_name: Optional[str] = None,
+                n_iter: int = 20) -> Dict:
+    """Benchmark every registered op it can synthesize inputs for.
+
+    Returns {"results": {op: {eager_us, jit_us, category, args}},
+    "skipped": [...], "excluded": [...]}.
+    """
+    import jax
+
+    from ..ops.registry import OpRegistry
+
+    reg = OpRegistry.get()
+    results: Dict[str, Dict] = {}
+    skipped: List[str] = []
+    excluded: List[str] = []
+
+    for name in reg.names():
+        d = reg.lookup(name)
+        if filter_category and d.category != filter_category:
+            continue
+        if filter_name and filter_name not in name:
+            continue
+        if d.category in EXCLUDED_CATEGORIES or name.endswith("_bp"):
+            excluded.append(name)
+            continue
+        bench = None
+        for args, kwargs in _candidate_sets(d.category):
+            try:
+                jargs = [jax.numpy.asarray(a)
+                         if isinstance(a, np.ndarray)
+                         and a.dtype.kind not in ("U", "S", "O")
+                         else a for a in args]
+                out = d.fn(*jargs, **kwargs)
+                jax.block_until_ready(out)
+                if sum(np.size(o) for o in jax.tree_util.tree_leaves(out)
+                       if hasattr(o, "size")) > 64 * N * N:
+                    continue  # mis-probed candidate with explosive output
+                bench = (jargs, kwargs, out)
+                break
+            except Exception:
+                continue
+        if bench is None:
+            skipped.append(name)
+            continue
+        jargs, kwargs, _ = bench
+        try:
+            eager_us = _time_fn(lambda: d.fn(*jargs, **kwargs), n_iter,
+                                jax.block_until_ready)
+            jfn = jax.jit(lambda *a: d.fn(*a, **kwargs))
+            jax.block_until_ready(jfn(*jargs))  # compile
+            jit_us = _time_fn(lambda: jfn(*jargs), n_iter,
+                              jax.block_until_ready)
+        except Exception:
+            skipped.append(name)
+            continue
+        results[name] = {
+            "category": d.category,
+            "eager_us": round(eager_us, 2),
+            "jit_us": round(jit_us, 2),
+            "args": [list(np.shape(a)) for a in jargs],
+        }
+    return {"results": results, "skipped": sorted(skipped),
+            "excluded": sorted(excluded),
+            "platform": jax.devices()[0].platform,
+            "n_benched": len(results)}
+
+
+def compare_runs(baseline: Dict, current: Dict,
+                 threshold: float = 2.0,
+                 min_us: float = 50.0) -> List[Dict]:
+    """Regressions: ops whose jit time grew > threshold x vs baseline.
+
+    min_us floors out dispatch jitter — an op has to be slower than
+    `min_us` in the current run before it can count as a regression.
+    """
+    regressions = []
+    base = baseline.get("results", {})
+    cur = current.get("results", {})
+    for name, c in cur.items():
+        b = base.get(name)
+        if b is None:
+            continue
+        if c["jit_us"] > min_us and c["jit_us"] > threshold * b["jit_us"]:
+            regressions.append({"op": name, "baseline_us": b["jit_us"],
+                                "current_us": c["jit_us"],
+                                "ratio": round(c["jit_us"] / b["jit_us"], 2)})
+    return sorted(regressions, key=lambda r: -r["ratio"])
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", help="write results JSON here")
+    p.add_argument("--compare", help="baseline JSON; exit 1 on >2x "
+                                     "regressions")
+    p.add_argument("--category", help="bench only this category")
+    p.add_argument("--op", help="bench only ops containing this substring")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--threshold", type=float, default=2.0)
+    args = p.parse_args(argv)
+
+    out = run_opbench(filter_category=args.category, filter_name=args.op,
+                      n_iter=args.iters)
+    print(f"benched {out['n_benched']} ops "
+          f"({len(out['skipped'])} skipped, "
+          f"{len(out['excluded'])} excluded by design) "
+          f"on {out['platform']}")
+    worst = sorted(out["results"].items(),
+                   key=lambda kv: -kv[1]["jit_us"])[:10]
+    for name, r in worst:
+        print(f"  {name:32s} {r['jit_us']:10.1f}us jit "
+              f"{r['eager_us']:10.1f}us eager  [{r['category']}]")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {args.out}")
+    if args.compare:
+        with open(args.compare) as f:
+            baseline = json.load(f)
+        regs = compare_runs(baseline, out, threshold=args.threshold)
+        if regs:
+            print(f"REGRESSIONS ({len(regs)}):")
+            for r in regs:
+                print(f"  {r['op']}: {r['baseline_us']}us -> "
+                      f"{r['current_us']}us ({r['ratio']}x)")
+            return 1
+        print("no per-op regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
