@@ -128,6 +128,52 @@ def bench_resnet50(jax, jnp, tiny):
     return _fit_throughput(jax, net, batches, B, epochs=2 if tiny else 6)
 
 
+def bench_vgg16(jax, jnp, tiny):
+    """Layer-API VGG16 training throughput (BASELINE config 2, second
+    model)."""
+    from deeplearning4j_tpu.zoo import VGG16
+
+    num_classes = 10 if tiny else 1000
+    B = 4 if tiny else 64  # VGG16 activations are fatter than ResNet's
+    side = 32 if tiny else 224
+    net = VGG16(num_classes=num_classes, input_shape=(3, side, side),
+                dtype="bfloat16").init_model()
+    batches = _zoo_batches(np.random.RandomState(0), 2 if tiny else 4, B,
+                           (3, side, side), num_classes)
+    return _fit_throughput(jax, net, batches, B, epochs=2 if tiny else 6)
+
+
+def bench_seq2seq(jax, jnp, tiny):
+    """Seq2Seq LSTM teacher-forcing training samples/sec (BASELINE config 4,
+    second metric — reference deeplearning4j-nlp Seq2Seq LSTM)."""
+    import time as _t
+
+    from deeplearning4j_tpu.models import seq2seq
+
+    c = (seq2seq.Seq2SeqConfig.tiny() if tiny
+         else seq2seq.Seq2SeqConfig(vocab_size=8000, embed_dim=256,
+                                    hidden=512))
+    B, S = (8, 8) if tiny else (128, 32)
+    rng = np.random.RandomState(0)
+    src = jnp.asarray(rng.randint(2, c.vocab_size, (B, S)), jnp.int32)
+    tgt = jnp.asarray(rng.randint(2, c.vocab_size, (B, S)), jnp.int32)
+    batch = {"src": src,
+             "tgt_in": jnp.concatenate(
+                 [jnp.full((B, 1), c.bos_token, jnp.int32), tgt[:, :-1]], 1),
+             "tgt_out": tgt}
+    params = seq2seq.init_params(jax.random.key(0), c)
+    opt = seq2seq.init_opt_state(params)
+    step = seq2seq.make_train_step(c, learning_rate=1e-3)
+    params, opt, loss = step(params, opt, batch, 0)
+    jax.block_until_ready(loss)
+    iters = 3 if tiny else 30
+    t0 = _t.perf_counter()
+    for i in range(1, iters + 1):
+        params, opt, loss = step(params, opt, batch, i)
+    jax.block_until_ready(loss)
+    return iters * B / (_t.perf_counter() - t0)
+
+
 def bench_lenet(jax, jnp, tiny):
     from deeplearning4j_tpu.zoo import LeNet
 
@@ -262,29 +308,53 @@ def main():
         "flash_attn": r["variant"].get("use_flash", False),
     }
 
+    import gc
+
+    def _release():
+        # free HBM held by dead params + jit executable caches so later
+        # sections (flash S=2048 grad needs multi-GB live) never OOM
+        # against buffers leaked from earlier ones
+        gc.collect()
+        jax.clear_caches()
+
     if not skip_extras:
         extras = [
             ("resnet50_imgs_per_sec", lambda: bench_resnet50(jax, jnp, tiny)),
+            ("vgg16_imgs_per_sec", lambda: bench_vgg16(jax, jnp, tiny)),
             ("lenet_imgs_per_sec", lambda: bench_lenet(jax, jnp, tiny)),
             ("word2vec_words_per_sec",
              lambda: bench_word2vec(jax, jnp, tiny)),
+            ("seq2seq_samples_per_sec",
+             lambda: bench_seq2seq(jax, jnp, tiny)),
         ]
         for key, fn in extras:
             try:
                 out[key] = round(fn(), 2)
             except Exception as e:  # never let an extra kill the headline
                 out[key] = f"error: {type(e).__name__}"
+            _release()
         try:
             fwd, train = bench_flash_attention(jax, jnp, tiny)
             out["flash_attn_speedup_vs_xla"] = round(fwd, 3)
             out["flash_attn_train_speedup_vs_xla"] = round(train, 3)
         except Exception as e:
             out["flash_attn_speedup_vs_xla"] = f"error: {type(e).__name__}"
+        _release()
         try:
             out["flash_attn_s8192_train"] = bench_flash_longseq(jax, jnp,
                                                                 tiny)
         except Exception as e:
             out["flash_attn_s8192_train"] = f"error: {type(e).__name__}"
+
+    if os.environ.get("BENCH_OPS"):
+        # optional per-op microbench sweep (see benchmarks/opbench.py); off
+        # by default — it adds minutes and its output is a file, not a key
+        from deeplearning4j_tpu.benchmarks.opbench import run_opbench
+        _release()
+        ops = run_opbench(n_iter=5 if tiny else 20)
+        with open("OPBENCH.json", "w") as f:
+            json.dump(ops, f, indent=1)
+        out["opbench_n"] = ops["n_benched"]
 
     print(json.dumps(out))
 
