@@ -242,7 +242,7 @@ class SameDiff:
         result = out.eval({"x": batch})
     """
 
-    def __init__(self):
+    def __init__(self, eager: bool = False):
         self._vars: Dict[str, SDVariable] = {}
         self._arrays: Dict[str, jax.Array] = {}   # VARIABLE/CONSTANT values
         self._ops: Dict[str, SameDiffOp] = {}
@@ -256,11 +256,64 @@ class SameDiff:
         self._updater_state = None
         self._listeners: List[Any] = []
         self._rng_seed = 0
+        # eager mode (reference SameDiff.java eagerMode flag, :153,379):
+        # ops also execute immediately as they are recorded, using the
+        # arrays known at record time — a debugging aid; the compiled
+        # define-then-run path is unchanged
+        self._eager = bool(eager)
+        self._eager_vals: Dict[str, jax.Array] = {}
+        self._eager_key = None
 
     # ------------------------------------------------------------------
     @staticmethod
-    def create() -> "SameDiff":
-        return SameDiff()
+    def create(eager: bool = False) -> "SameDiff":
+        return SameDiff(eager=eager)
+
+    # -- eager mode ------------------------------------------------------
+    def enable_eager_mode(self):
+        """Execute each op as it is defined (reference enableEagerMode)."""
+        self._eager = True
+        return self
+
+    def is_eager_mode(self) -> bool:
+        return self._eager
+
+    def _try_eager(self, node: "SameDiffOp") -> None:
+        """Run a just-recorded node on concrete arrays if every input has
+        one (VARIABLE/CONSTANT initial values, placeholder arrays set via
+        set_array, or earlier eager results). Failures are non-fatal: the
+        graph records regardless; eval()/output() recompute properly."""
+        vals = []
+        for name in node.inputs:
+            if name is None:
+                vals.append(None)
+                continue
+            v = self._eager_vals.get(name)
+            if v is None:
+                v = self._arrays.get(name)
+            if v is None:
+                return  # e.g. placeholder with no array yet
+            vals.append(v)
+        kwargs = dict(node.kwargs)
+        if node.needs_key:
+            if self._eager_key is None:
+                self._eager_key = jax.random.key(self._rng_seed)
+            self._eager_key, sub = jax.random.split(self._eager_key)
+            kwargs["key"] = sub
+        try:
+            result = node.fn(*vals, **kwargs)
+        except Exception:
+            return
+        if len(node.outputs) == 1:
+            self._eager_vals[node.outputs[0]] = result
+        else:
+            for oname, r in zip(node.outputs, result):
+                self._eager_vals[oname] = r
+
+    def eager_arr(self, name: str) -> Optional[NDArray]:
+        """The eagerly computed value for a variable, if one exists."""
+        v = self._eager_vals.get(name)
+        return NDArray(v) if v is not None else None
 
     # -- naming ----------------------------------------------------------
     def _unique_name(self, base: str) -> str:
@@ -370,6 +423,8 @@ class SameDiff:
                           out_names, kwargs, needs_key=needs_key)
         self._ops[node_name] = node
         self._op_order.append(node_name)
+        if self._eager:
+            self._try_eager(node)
         return outs[0] if n_outputs == 1 else tuple(outs)
 
     # -- generic op invocation (sd.op("conv2d", x, w, ...)) --------------
@@ -477,6 +532,8 @@ class SameDiff:
     # -- array access ----------------------------------------------------
     def get_arr_for_var(self, name: str) -> Optional[NDArray]:
         arr = self._arrays.get(name)
+        if arr is None and self._eager:
+            arr = self._eager_vals.get(name)
         return NDArray(arr) if arr is not None else None
 
     def set_array(self, name: str, value):
@@ -505,6 +562,8 @@ class SameDiff:
         self._vars[new] = v
         if old in self._arrays:
             self._arrays[new] = self._arrays.pop(old)
+        if old in self._eager_vals:
+            self._eager_vals[new] = self._eager_vals.pop(old)
         if old in self._producer:
             self._producer[new] = self._producer.pop(old)
         for node in self._ops.values():

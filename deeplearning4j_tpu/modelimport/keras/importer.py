@@ -614,7 +614,79 @@ def _adapt_layer(class_name: str, cfg: Dict[str, Any],
     if class_name == "Softmax":
         return _Adapted(L.ActivationLayer(activation="softmax",
                                           name=cfg.get("name")))
+    if class_name == "Permute":
+        # Keras dims are 1-indexed over feature dims and stated in the
+        # NHWC-style layout; applied on our NCHW-ordered activations the
+        # same index permutation holds for the 3-D (RNN/2-D) cases we map
+        return _Adapted(LX.PermuteLayer(
+            dims=tuple(int(d) for d in cfg.get("dims", (1,))),
+            name=cfg.get("name")))
+    if class_name == "Reshape":
+        return _Adapted(LX.ReshapeLayer(
+            target_shape=tuple(int(s) for s in cfg.get("target_shape", ())),
+            name=cfg.get("name")))
+    if class_name == "Masking":
+        # imported as pass-through: downstream RNNs process every timestep.
+        # Matches keras ONLY when no input row equals mask_value — warn so
+        # padded-sequence users know outputs can diverge from the golden.
+        import logging
+        logging.getLogger(__name__).warning(
+            "Keras Masking(mask_value=%s) imported as identity: masked "
+            "timesteps are NOT skipped by downstream RNN layers; outputs "
+            "match keras only for inputs with no fully-masked timesteps",
+            cfg.get("mask_value", 0.0))
+        return _Adapted(LX.MaskLayer(name=cfg.get("name")))
+    if class_name == "LocallyConnected1D":
+        if cfg.get("padding", "valid") != "valid":
+            raise ImportException("LocallyConnected1D padding must be "
+                                  "'valid'")
+        ks = cfg.get("kernel_size", 3)
+        ks = int(ks[0]) if isinstance(ks, (list, tuple)) else int(ks)
+        st = cfg.get("strides", 1)
+        st = int(st[0]) if isinstance(st, (list, tuple)) else int(st)
+        layer = LX.LocallyConnected1D(
+            n_out=int(cfg["filters"]), kernel_size=ks, stride=st,
+            activation=_act(cfg.get("activation")),
+            has_bias=bool(cfg.get("use_bias", True)), name=cfg.get("name"))
+
+        def lc1d_weights(weights, in_type):
+            # keras kernel (ot, ks*F, o) flattens patches k-major/f-minor;
+            # our layer consumes conv_general_dilated_patches order
+            # (c-major, k-minor) — permute the middle axis accordingly
+            k = np.asarray(weights[0])
+            ot, kf, o = k.shape
+            f = kf // ks
+            k = k.reshape(ot, ks, f, o).transpose(0, 2, 1, 3).reshape(
+                ot, kf, o)
+            p = {"W": jnp.asarray(k)}
+            if layer.has_bias:
+                p["b"] = jnp.asarray(np.asarray(weights[1]))
+            return p
+
+        return _Adapted(layer, lc1d_weights)
+    if class_name == "SpaceToDepth":
+        return _Adapted(LX.SpaceToDepthLayer(
+            block_size=int(cfg.get("block_size", 2)), name=cfg.get("name")))
+    if class_name == "Lambda":
+        fn = _LAMBDA_REGISTRY.get(cfg.get("name"))
+        if fn is None:
+            raise ImportException(
+                f"Keras Lambda layer {cfg.get('name')!r} requires "
+                "register_lambda(name, layer) before import (reference "
+                "KerasLayer.registerLambdaLayer)")
+        return _Adapted(fn() if callable(fn) and not isinstance(fn, L.Layer)
+                        else fn)
     raise ImportException(f"unsupported Keras layer type {class_name!r}")
+
+
+#: name -> Layer (or zero-arg factory) for Lambda layers, mirroring the
+#: reference's KerasLayer.registerLambdaLayer custom-layer hook
+_LAMBDA_REGISTRY: Dict[str, Any] = {}
+
+
+def register_lambda(name: str, layer_or_factory) -> None:
+    """Register the implementation for a Keras Lambda layer by name."""
+    _LAMBDA_REGISTRY[name] = layer_or_factory
 
 
 # ---------------------------------------------------------------- h5 I/O
@@ -688,6 +760,20 @@ def _keras_out_shape(class_name, cfg, in_shape):
         return (in_shape[-1],)
     if class_name == "Flatten":
         return (int(np.prod(in_shape)),)
+    if class_name == "Reshape":
+        return tuple(int(s) for s in cfg.get("target_shape", ()))
+    if class_name == "Permute":
+        dims = tuple(int(d) for d in cfg.get("dims", ()))
+        return tuple(in_shape[d - 1] for d in dims)
+    if class_name == "Masking":
+        return tuple(in_shape)
+    if class_name == "LocallyConnected1D":
+        t = in_shape[0]
+        ks = cfg.get("kernel_size", 3)
+        ks = int(ks[0]) if isinstance(ks, (list, tuple)) else int(ks)
+        st = cfg.get("strides", 1)
+        st = int(st[0]) if isinstance(st, (list, tuple)) else int(st)
+        return ((t - ks) // st + 1, int(cfg["filters"]))
     if class_name == "Embedding":
         return tuple(in_shape) + (int(cfg["output_dim"]),)
     if class_name in ("LSTM", "GRU", "SimpleRNN"):
@@ -787,6 +873,14 @@ def _input_shape_of(entries) -> Optional[Tuple]:
     return None
 
 
+#: keras layers whose 2-D (T, F) output we hold as [B, F, T] on device
+_TEMPORAL_LAYERS = frozenset((
+    "Embedding", "LSTM", "GRU", "SimpleRNN", "Bidirectional", "Conv1D",
+    "MaxPooling1D", "AveragePooling1D", "UpSampling1D", "Cropping1D",
+    "ZeroPadding1D", "LocallyConnected1D", "SpatialDropout1D",
+    "TimeDistributed"))
+
+
 class KerasModelImport:
     """Entry points mirroring the reference KerasModelImport API."""
 
@@ -814,11 +908,30 @@ class KerasModelImport:
         adapted: List[Tuple[int, _Adapted, Tuple]] = []
         cur = tuple(keras_shape)
         conv_src = None  # pre-Flatten conv shape for Dense-kernel reordering
+        # True while our runtime layout is [B,F,T] against keras' [B,T,F]
+        # (every temporal layer); Reshape/Permute outputs are keras-identical
+        transposed = len(cur) == 2
         idx = 0
         for e in entries:
             cls, cfg = e["class_name"], e.get("config", {})
             if cls == "Flatten" and cur is not None and len(cur) in (3, 4):
                 conv_src = cur
+            if cls == "Flatten" and cur is not None and len(cur) == 2:
+                # keras flattens [B,T,F]; our tensor may be [B,F,T] — line
+                # the axes up first so element order matches the golden
+                if transposed:
+                    lb.layer(LX.PermuteLayer(dims=(2, 1)))
+                    idx += 1
+                lb.layer(LX.ReshapeLayer(
+                    target_shape=(int(np.prod(cur)),), name=cfg.get("name")))
+                idx += 1
+                cur = (int(np.prod(cur)),)
+                transposed = False
+                continue
+            if cls in ("Reshape", "Permute") and transposed:
+                raise ImportException(
+                    f"{cls} directly on a sequence tensor is unsupported "
+                    "(layout differs from keras); insert Flatten first")
             shape_for_adapter = conv_src if (cls == "Dense" and conv_src) \
                 else cur
             a = _adapt_layer(cls, cfg, shape_for_adapter)
@@ -829,6 +942,13 @@ class KerasModelImport:
                 adapted.append((idx, a, shape_for_adapter))
                 idx += 1
             cur = _keras_out_shape(cls, cfg, cur)
+            if cur is not None:
+                if len(cur) != 2:
+                    transposed = False
+                elif cls in ("Reshape", "Permute"):
+                    transposed = False
+                elif cls in _TEMPORAL_LAYERS:
+                    transposed = True
 
         conf = lb.build()
         net = MultiLayerNetwork(conf)
@@ -893,11 +1013,25 @@ class KerasModelImport:
             in_names = [alias.get(n, n) for n in inbound]
             in_shape = keras_shapes.get(inbound[0]) if inbound else None
             if cls == "Flatten":
+                if in_shape is not None and len(in_shape) == 2:
+                    # sequence tensors are held [B,F,T] vs keras [B,T,F];
+                    # flattening here would silently reorder elements (the
+                    # Sequential importer inserts a permute; the graph
+                    # builder has no layer slot for one yet)
+                    raise ImportException(
+                        "Flatten on a sequence tensor is unsupported in "
+                        "functional models; use GlobalPooling or reshape "
+                        "outside the graph")
                 alias[name] = in_names[0]  # vanishes; preprocessor handles
                 if in_shape is not None and len(in_shape) == 3:
                     unflattened[name] = in_shape
                 keras_shapes[name] = _keras_out_shape(cls, cfg, in_shape)
                 continue
+            if cls in ("Reshape", "Permute") and in_shape is not None \
+                    and len(in_shape) == 2:
+                raise ImportException(
+                    f"{cls} on a sequence tensor is unsupported in "
+                    "functional models (layout differs from keras)")
             if cls == "Dense" and inbound and inbound[0] in unflattened:
                 in_shape = unflattened[inbound[0]]
             if cls in ("Add", "Subtract", "Multiply", "Average", "Maximum",

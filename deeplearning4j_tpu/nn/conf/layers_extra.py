@@ -658,6 +658,40 @@ class DepthToSpaceLayer(Layer):
         return False
 
 
+@dataclasses.dataclass
+class PermuteLayer(Layer):
+    """Permute non-batch dims (reference keras layers/core/KerasPermute
+    role; dims are 1-indexed over the feature dims, Keras-style)."""
+    dims: tuple = (1,)
+
+    def forward(self, params, x, training=False, key=None):
+        return jnp.transpose(x, (0,) + tuple(int(d) for d in self.dims))
+
+    def output_type(self, input_type):
+        if input_type is None:
+            return None
+        return tuple(input_type[d - 1] for d in self.dims)
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class ReshapeLayer(Layer):
+    """Reshape the non-batch dims (reference KerasReshape role)."""
+    target_shape: tuple = ()
+
+    def forward(self, params, x, training=False, key=None):
+        return x.reshape((x.shape[0],) + tuple(int(s)
+                                               for s in self.target_shape))
+
+    def output_type(self, input_type):
+        return tuple(int(s) for s in self.target_shape)
+
+    def has_params(self):
+        return False
+
+
 # -- dropout/noise variants (reference conf/dropout/) ---------------------
 @dataclasses.dataclass
 class GaussianDropout(Layer):

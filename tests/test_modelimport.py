@@ -1044,3 +1044,90 @@ class TestTF1CondImport:
                                   "q": np.asarray(qv)},
                                  ["result"])["result"].numpy()
                 np.testing.assert_allclose(res, golden), (pv, qv)
+
+
+class TestKerasAdapterCompletion:
+    """Final adapter batch: Permute/Reshape/Masking/LocallyConnected1D +
+    the Lambda registration hook (reference KerasLayer.registerLambdaLayer)."""
+
+    def _roundtrip(self, m, x, tmp_path, name):
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_sequential_model_and_weights
+        golden = m.predict(x, verbose=0)
+        path = str(tmp_path / f"{name}.h5")
+        m.save(path)
+        net = import_keras_sequential_model_and_weights(path)
+        return net, golden
+
+    def test_reshape_permute(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(3)
+        m = keras.Sequential([
+            keras.Input((12,)),
+            layers.Dense(12, activation="relu", name="d0"),
+            layers.Reshape((3, 4), name="rs"),
+            layers.Permute((2, 1), name="pm"),
+            layers.Flatten(name="f"),
+            layers.Dense(5, name="d1"),
+        ])
+        x = rs.randn(2, 12).astype(np.float32)
+        net, golden = self._roundtrip(m, x, tmp_path, "reshape_permute")
+        np.testing.assert_allclose(net.output(x).numpy(), golden, atol=1e-5)
+
+    def test_locally_connected1d(self):
+        """keras 3 dropped LocallyConnected1D, so golden is a direct numpy
+        unshared-conv computed from keras' kernel layout
+        (output_length, kernel_size*in_dim, filters)."""
+        from deeplearning4j_tpu.modelimport.keras.importer import _adapt_layer
+        rs = np.random.RandomState(4)
+        T, F, filters, ks = 10, 6, 4, 3
+        ot = T - ks + 1
+        cfg = {"filters": filters, "kernel_size": [ks], "strides": [1],
+               "activation": "tanh", "use_bias": True, "name": "lc",
+               "padding": "valid"}
+        a = _adapt_layer("LocallyConnected1D", cfg, (T, F))
+        kernel = rs.randn(ot, ks * F, filters).astype(np.float32)
+        bias = rs.randn(ot, filters).astype(np.float32)
+        params = a.set_weights([kernel, bias], (T, F))
+        x = rs.randn(2, T, F).astype(np.float32)
+        # keras semantics: out[b,t,o] = tanh(sum_{k,f} x[b,t+k,f] *
+        #   kernel[t, k*F+f, o] + bias[t,o]) -- kernel patch order is
+        # (k, f) flattened row-major over channels-last input
+        golden = np.zeros((2, ot, filters), np.float32)
+        for t in range(ot):
+            patch = x[:, t:t + ks, :].reshape(2, ks * F)
+            golden[:, t, :] = patch @ kernel[t] + bias[t]
+        golden = np.tanh(golden)
+        out = np.asarray(a.layer.forward(params, x.transpose(0, 2, 1)))
+        np.testing.assert_allclose(out, golden.transpose(0, 2, 1),
+                                   atol=1e-5)
+
+    def test_masking_passthrough(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(5)
+        m = keras.Sequential([
+            keras.Input((4, 3)),
+            layers.Masking(mask_value=0.0, name="mk"),
+            layers.LSTM(5, name="l"),
+            layers.Dense(2, name="d"),
+        ])
+        # no masked timesteps -> masking is identity; golden must match
+        x = rs.randn(2, 4, 3).astype(np.float32) + 1.0
+        net, golden = self._roundtrip(m, x, tmp_path, "masking")
+        res = net.output(x.transpose(0, 2, 1)).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    def test_lambda_requires_registration(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.ir import ImportException
+        from deeplearning4j_tpu.modelimport.keras import register_lambda
+        from deeplearning4j_tpu.modelimport.keras.importer import (
+            _LAMBDA_REGISTRY, _adapt_layer)
+        from deeplearning4j_tpu.nn.conf import layers as L
+        with pytest.raises(ImportException, match="register_lambda"):
+            _adapt_layer("Lambda", {"name": "myfn"}, None)
+        register_lambda("myfn", L.ActivationLayer(activation="relu"))
+        try:
+            adapted = _adapt_layer("Lambda", {"name": "myfn"}, None)
+            assert isinstance(adapted.layer, L.ActivationLayer)
+        finally:
+            _LAMBDA_REGISTRY.clear()
