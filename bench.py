@@ -61,10 +61,8 @@ def bench_bert(jax, jnp, tiny):
     }
 
     best = None
-    for variant in ({"remat": False, "use_fused_xent": False},
-                    {"remat": False, "use_fused_xent": True},
-                    {"remat": False, "use_fused_xent": False,
-                     "use_flash": True}):
+    for variant in ({"remat": False},
+                    {"remat": False, "use_flash": True}):
         try:
             params = bert.init_params(jax.random.key(0), config)
             opt = bert.init_opt_state(params)
@@ -302,7 +300,6 @@ def main():
         "mfu": round(mfu, 4),
         "batch": r["B"], "seq_len": r["T"], "platform": platform,
         "loss": round(r["loss"], 4),
-        "fused_xent": r["variant"].get("use_fused_xent", False),
         "flash_attn": r["variant"].get("use_flash", False),
     }
 

@@ -3,16 +3,24 @@
 Role parity: the reference's per-op vendor kernels
 (`libnd4j/include/ops/declarable/platform/{cudnn,mkldnn}/`) — ops where
 letting the compiler lower naively leaves performance on the table. On TPU
-that list is short (XLA fuses most of the op library); the kernels here
-cover the two known gaps for the flagship workloads:
+that list is short (XLA fuses most of the op library); the kernel here
+covers the known gap for the flagship workloads:
 
-- `flash_attention`: online-softmax attention, no [S,S] HBM materialization
-- `fused_softmax_xent`: streaming vocab-tiled MLM loss (30k vocab)
+- `flash_attention`: online-softmax attention with a full Pallas backward —
+  no [S,S] HBM materialization in either direction. Measured on v5e at
+  B=4 S=2048 H=12 D=64: 1.27x XLA forward, 1.64x XLA training step; at
+  S=8192 the XLA path cannot compile on one chip while this trains.
 
-All kernels run `interpret=True` on CPU so the unit tests exercise the
+A fused vocab-tiled softmax-xent kernel lived here through round 3 and was
+deleted after honest tuning kept it behind XLA at the BERT headline shape
+(N=16384, V=30522, f32; best Pallas config tn=256 tv=2048): 0.93x forward,
+0.61x training vs XLA's 35.4ms/35.2ms. XLA's exp/reduce fusion already
+saturates this op; a kernel would need to fuse the producing matmul to win,
+which belongs to a future logits-never-materialized head design.
+
+The kernel runs `interpret=True` on CPU so the unit tests exercise the
 exact kernel code path hardware-free.
 """
 from .flash_attention import flash_attention
-from .softmax_xent import fused_softmax_xent
 
-__all__ = ["flash_attention", "fused_softmax_xent"]
+__all__ = ["flash_attention"]

@@ -623,7 +623,8 @@ def _adapt_layer(class_name: str, cfg: Dict[str, Any],
             name=cfg.get("name")))
     if class_name == "Reshape":
         return _Adapted(LX.ReshapeLayer(
-            target_shape=tuple(int(s) for s in cfg.get("target_shape", ())),
+            target_shape=_resolve_reshape(cfg.get("target_shape", ()),
+                                          keras_in_shape),
             name=cfg.get("name")))
     if class_name == "Masking":
         # imported as pass-through: downstream RNNs process every timestep.
@@ -731,6 +732,16 @@ def _layer_entries(model_cfg: Dict) -> List[Dict]:
     return cfg["layers"]
 
 
+def _resolve_reshape(target, in_shape):
+    """Resolve a keras Reshape target with one -1 against the input size."""
+    target = [int(s) for s in target]
+    if -1 in target and in_shape is not None:
+        known = int(np.prod([s for s in target if s != -1]))
+        total = int(np.prod(in_shape))
+        target[target.index(-1)] = total // max(known, 1)
+    return tuple(target)
+
+
 def _keras_out_shape(class_name, cfg, in_shape):
     """Track Keras-side (channels-last, batchless) shapes for weight fixups."""
     if in_shape is None:
@@ -761,7 +772,11 @@ def _keras_out_shape(class_name, cfg, in_shape):
     if class_name == "Flatten":
         return (int(np.prod(in_shape)),)
     if class_name == "Reshape":
-        return tuple(int(s) for s in cfg.get("target_shape", ()))
+        return _resolve_reshape(cfg.get("target_shape", ()), in_shape)
+    if class_name == "SpaceToDepth":
+        h, w, c = in_shape
+        s = int(cfg.get("block_size", 2))
+        return (h // s, w // s, c * s * s)
     if class_name == "Permute":
         dims = tuple(int(d) for d in cfg.get("dims", ()))
         return tuple(in_shape[d - 1] for d in dims)
@@ -878,7 +893,7 @@ _TEMPORAL_LAYERS = frozenset((
     "Embedding", "LSTM", "GRU", "SimpleRNN", "Bidirectional", "Conv1D",
     "MaxPooling1D", "AveragePooling1D", "UpSampling1D", "Cropping1D",
     "ZeroPadding1D", "LocallyConnected1D", "SpatialDropout1D",
-    "TimeDistributed"))
+    "TimeDistributed", "RepeatVector", "Masking"))
 
 
 class KerasModelImport:
@@ -928,10 +943,16 @@ class KerasModelImport:
                 cur = (int(np.prod(cur)),)
                 transposed = False
                 continue
-            if cls in ("Reshape", "Permute") and transposed:
+            if cls in ("Reshape", "Permute") and (
+                    transposed or (cur is not None and len(cur) >= 3)):
+                # sequence tensors are [B,F,T] vs keras [B,T,F]; conv
+                # activations are NCHW vs keras NHWC — in both cases a
+                # literal transpose/reshape would reorder different axes
+                # than keras did, so refuse rather than silently diverge
                 raise ImportException(
-                    f"{cls} directly on a sequence tensor is unsupported "
-                    "(layout differs from keras); insert Flatten first")
+                    f"{cls} on a sequence/conv tensor is unsupported "
+                    "(runtime layout differs from keras); insert Flatten "
+                    "or GlobalPooling first")
             shape_for_adapter = conv_src if (cls == "Dense" and conv_src) \
                 else cur
             a = _adapt_layer(cls, cfg, shape_for_adapter)
@@ -941,7 +962,15 @@ class KerasModelImport:
                 lb.layer(a.layer)
                 adapted.append((idx, a, shape_for_adapter))
                 idx += 1
-            cur = _keras_out_shape(cls, cfg, cur)
+            if cls == "Lambda" and a is not None:
+                # registered custom layers know their own output shape;
+                # the keras-side table cannot
+                try:
+                    cur = a.layer.output_type(cur)
+                except Exception:
+                    cur = None
+            else:
+                cur = _keras_out_shape(cls, cfg, cur)
             if cur is not None:
                 if len(cur) != 2:
                     transposed = False
@@ -1028,10 +1057,10 @@ class KerasModelImport:
                 keras_shapes[name] = _keras_out_shape(cls, cfg, in_shape)
                 continue
             if cls in ("Reshape", "Permute") and in_shape is not None \
-                    and len(in_shape) == 2:
+                    and len(in_shape) >= 2:
                 raise ImportException(
-                    f"{cls} on a sequence tensor is unsupported in "
-                    "functional models (layout differs from keras)")
+                    f"{cls} on a sequence/conv tensor is unsupported in "
+                    "functional models (runtime layout differs from keras)")
             if cls == "Dense" and inbound and inbound[0] in unflattened:
                 in_shape = unflattened[inbound[0]]
             if cls in ("Add", "Subtract", "Multiply", "Average", "Maximum",
@@ -1058,7 +1087,13 @@ class KerasModelImport:
                 continue
             builder.add_layer(name, a.layer, *in_names)
             adapted[name] = (a, in_shape)
-            keras_shapes[name] = _keras_out_shape(cls, cfg, in_shape)
+            if cls == "Lambda":
+                try:
+                    keras_shapes[name] = a.layer.output_type(in_shape)
+                except Exception:
+                    keras_shapes[name] = None
+            else:
+                keras_shapes[name] = _keras_out_shape(cls, cfg, in_shape)
 
         out_names = [alias.get(n, n)
                      for n in _ref_names(gcfg.get("output_layers", []))]

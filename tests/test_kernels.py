@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.kernels import flash_attention, fused_softmax_xent
+from deeplearning4j_tpu.kernels import flash_attention
 
 
 def _ref_attention(q, k, v, mask=None, causal=False):
@@ -127,33 +127,6 @@ class TestFlashAttention:
                                        atol=1e-4)
 
 
-class TestFusedSoftmaxXent:
-    def test_matches_reference(self):
-        rs = np.random.RandomState(0)
-        N, V = 16, 4096
-        logits = jnp.asarray(rs.randn(N, V).astype(np.float32))
-        labels = jnp.asarray(rs.randint(0, V, N).astype(np.int32))
-        loss = fused_softmax_xent(logits, labels, 8, 512)
-        ref = -jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
-                                   labels[:, None], axis=1)[:, 0]
-        np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
-                                   atol=1e-5)
-
-    def test_gradient_matches(self):
-        rs = np.random.RandomState(1)
-        N, V = 8, 1024
-        logits = jnp.asarray(rs.randn(N, V).astype(np.float32))
-        labels = jnp.asarray(rs.randint(0, V, N).astype(np.int32))
-
-        g = jax.grad(lambda x: jnp.mean(fused_softmax_xent(x, labels,
-                                                           8, 256)))(logits)
-        ref_g = jax.grad(lambda x: jnp.mean(
-            -jnp.take_along_axis(jax.nn.log_softmax(x, axis=-1),
-                                 labels[:, None], axis=1)[:, 0]))(logits)
-        np.testing.assert_allclose(np.asarray(g), np.asarray(ref_g),
-                                   atol=1e-6)
-
-
 class TestNonDivisibleShapes:
     """Regression: non-tile-multiple shapes must pad, not silently corrupt."""
 
@@ -184,19 +157,3 @@ class TestNonDivisibleShapes:
             _ref_attention(q, k, v, mask=jnp.asarray(mask)) ** 2))(q)
         np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=1e-4)
 
-    def test_fused_xent_odd_rows_and_vocab(self):
-        rs = np.random.RandomState(9)
-        N, V = 200, 1000   # neither divides the tiles
-        logits = jnp.asarray(rs.randn(N, V).astype(np.float32))
-        labels = jnp.asarray(rs.randint(0, V, N).astype(np.int32))
-        loss = fused_softmax_xent(logits, labels)
-        ref = -jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
-                                   labels[:, None], axis=1)[:, 0]
-        np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
-                                   atol=1e-5)
-        g = jax.grad(lambda x: jnp.mean(fused_softmax_xent(x, labels)))(
-            logits)
-        gr = jax.grad(lambda x: jnp.mean(
-            -jnp.take_along_axis(jax.nn.log_softmax(x, axis=-1),
-                                 labels[:, None], axis=1)[:, 0]))(logits)
-        np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=1e-6)

@@ -1131,3 +1131,59 @@ class TestKerasAdapterCompletion:
             assert isinstance(adapted.layer, L.ActivationLayer)
         finally:
             _LAMBDA_REGISTRY.clear()
+
+
+class TestKerasLayoutGuards:
+    """Layout-tracking fixes: conv-tensor Permute/Reshape refused,
+    RepeatVector marks the transposed layout, Reshape(-1) resolves."""
+
+    def _import(self, m, tmp_path, name):
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_sequential_model_and_weights
+        path = str(tmp_path / f"{name}.h5")
+        m.save(path)
+        return import_keras_sequential_model_and_weights(path)
+
+    def test_permute_after_conv_refused(self, tmp_path):
+        from keras import layers
+        from deeplearning4j_tpu.modelimport.ir import ImportException
+        m = keras.Sequential([
+            keras.Input((8, 8, 3)),
+            layers.Conv2D(4, 3, padding="same", name="c"),
+            layers.Permute((3, 1, 2), name="p"),
+            layers.Flatten(name="f"),
+            layers.Dense(2, name="d"),
+        ])
+        with pytest.raises(ImportException, match="sequence/conv"):
+            self._import(m, tmp_path, "perm_conv")
+
+    def test_repeat_vector_flatten_golden(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(6)
+        m = keras.Sequential([
+            keras.Input((5,)),
+            layers.Dense(6, activation="tanh", name="d0"),
+            layers.RepeatVector(4, name="rv"),
+            layers.Flatten(name="f"),
+            layers.Dense(3, name="d1"),
+        ])
+        x = rs.randn(2, 5).astype(np.float32)
+        golden = m.predict(x, verbose=0)
+        net = self._import(m, tmp_path, "repeat_flat")
+        np.testing.assert_allclose(net.output(x).numpy(), golden, atol=1e-5)
+
+    def test_reshape_minus_one_resolves(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(7)
+        m = keras.Sequential([
+            keras.Input((12,)),
+            layers.Reshape((-1, 3), name="rs"),
+            layers.Flatten(name="f"),
+            layers.Dense(2, name="d"),
+        ])
+        x = rs.randn(2, 12).astype(np.float32)
+        golden = m.predict(x, verbose=0)
+        net = self._import(m, tmp_path, "reshape_neg")
+        conf_layer = net.conf.layers[0]
+        assert -1 not in getattr(conf_layer, "target_shape", ())
+        np.testing.assert_allclose(net.output(x).numpy(), golden, atol=1e-5)
