@@ -1,12 +1,16 @@
-"""Foreign-runtime interop: run TF graphs / ONNX models on NDArrays.
+"""Foreign-runtime interop: run TF graphs / ONNX / TFLite models on NDArrays.
 
 Reference: `nd4j/nd4j-tensorflow` (`GraphRunner.java:52` — execute a TF
-GraphDef on INDArrays via libtensorflow), `nd4j-onnxruntime`. Here:
+GraphDef on INDArrays via libtensorflow), `nd4j-onnxruntime`, `nd4j-tvm`.
+Here:
 - `GraphRunner`: executes a frozen TF GraphDef through the tensorflow
   runtime when installed, else through this framework's own TF importer
   (same .pb, XLA execution) — so the API works in both environments.
 - `OnnxRunner`: executes ONNX models through the native importer.
+- `TfliteRunner`: executes float .tflite files directly (own FlatBuffers
+  wire reader, jitted XLA execution — no TFLite runtime needed).
 """
 from .graph_runner import GraphRunner, OnnxRunner
+from .tflite import TfliteRunner
 
-__all__ = ["GraphRunner", "OnnxRunner"]
+__all__ = ["GraphRunner", "OnnxRunner", "TfliteRunner"]

@@ -1,0 +1,390 @@
+"""TFLite model runner: execute ``.tflite`` files as jitted XLA programs.
+
+Reference counterpart: the foreign-runtime interop family (nd4j-tensorflow
+GraphRunner / nd4j-onnxruntime / nd4j-tvm) — running a model artifact from
+another ecosystem against NDArrays without that ecosystem's runtime. The
+``.tflite`` wire format is FlatBuffers (schema: tensorflow/lite/schema/
+schema.fbs); this reader walks it with the shared helpers in
+``modelimport/flatbuf.py``, maps the float builtin ops onto jax, and
+compiles the whole subgraph into one XLA computation.
+
+Scope: float32 inference graphs (the conversion default). Quantized models
+— including dynamic-range weight-only int8 — are rejected with a clear
+error. Supported builtins cover the classic vision/MLP conversion output:
+CONV_2D, DEPTHWISE_CONV_2D, FULLY_CONNECTED, the pooling pair, elementwise
+ADD/SUB/MUL/DIV with fused activations, RELU/RELU6/TANH/LOGISTIC, SOFTMAX,
+RESHAPE, CONCATENATION, MEAN, TRANSPOSE, PAD, SQUEEZE, MAX/MIN,
+SHAPE/PACK shape chains, and STRIDED_SLICE.
+
+Design note: this lowers ops directly rather than through the modelimport
+IR mapper registry. TFLite semantics are post-conversion (NHWC layouts,
+[out,in] FC weights, fused activation codes, declared-shape PACK quirks)
+and execution-oriented — a runner, not a graph importer; forcing them
+through the import IR would re-encode those quirks as pseudo-ops without
+reusing its constant folding, which tflite buffers already subsume.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..modelimport import flatbuf as fb
+from ..ndarray.ndarray import NDArray
+
+# -- schema enums (tensorflow/lite/schema/schema.fbs) ----------------------
+
+_TENSOR_TYPES = {0: np.float32, 1: np.float16, 2: np.int32, 3: np.uint8,
+                 4: np.int64, 6: np.bool_, 7: np.int16, 9: np.int8}
+
+# BuiltinOperator codes used below
+_OP_NAMES = {
+    0: "ADD", 1: "AVERAGE_POOL_2D", 2: "CONCATENATION", 3: "CONV_2D",
+    4: "DEPTHWISE_CONV_2D", 9: "FULLY_CONNECTED", 14: "LOGISTIC",
+    17: "MAX_POOL_2D", 18: "MUL", 19: "RELU", 21: "RELU6", 22: "RESHAPE",
+    25: "SOFTMAX", 28: "TANH", 34: "PAD", 39: "TRANSPOSE", 40: "MEAN",
+    41: "SUB", 42: "DIV", 43: "SQUEEZE", 45: "STRIDED_SLICE",
+    55: "MAXIMUM", 57: "MINIMUM", 77: "SHAPE", 83: "PACK",
+    99: "SQUARED_DIFFERENCE",
+}
+
+_FUSED_ACT = {0: None, 1: "relu", 2: "relu_n1_to_1", 3: "relu6", 4: "tanh",
+              5: "sign"}
+
+
+def _apply_fused(x, code):
+    act = _FUSED_ACT.get(code)
+    if act is None:
+        return x
+    if act == "relu":
+        return jax.nn.relu(x)
+    if act == "relu6":
+        return jnp.clip(x, 0.0, 6.0)
+    if act == "relu_n1_to_1":
+        return jnp.clip(x, -1.0, 1.0)
+    if act == "tanh":
+        return jnp.tanh(x)
+    raise ValueError(f"unsupported fused activation code {code}")
+
+
+def _padding(code: int) -> str:
+    return "SAME" if code == 0 else "VALID"
+
+
+class _Tensor:
+    __slots__ = ("shape", "dtype", "buffer_idx", "name", "quantized")
+
+    def __init__(self, t):
+        self.shape = fb.vec_i32(t, 0)
+        self.dtype = _TENSOR_TYPES.get(fb.i8(t, 1, 0), np.float32)
+        self.buffer_idx = fb.u32(t, 2)
+        self.name = fb.string(t, 3)
+        q = fb.subtable(t, 4)
+        # QuantizationParameters: scale vector at slot 2 non-empty => real
+        # quantization (float models carry an empty table)
+        self.quantized = q is not None and fb.vec_len(q, 2) > 0
+
+
+class _Op:
+    __slots__ = ("opcode_index", "inputs", "outputs", "options")
+
+    def __init__(self, t):
+        self.opcode_index = fb.u32(t, 0)
+        self.inputs = fb.vec_i32(t, 1)
+        self.outputs = fb.vec_i32(t, 2)
+        self.options = fb.union_table(t, 4)  # builtin_options union value
+
+
+class TfliteModel:
+    """Parsed .tflite: tensors, constant buffers, operator list."""
+
+    def __init__(self, data: bytes):
+        m = fb.root(data)
+        # Model: version(0) operator_codes(1) subgraphs(2) description(3)
+        # buffers(4)
+        self.version = fb.u32(m, 0)
+        self.opcodes: List[int] = []
+        for i in range(fb.vec_len(m, 1)):
+            oc = fb.vec_table(m, 1, i)
+            # OperatorCode: deprecated_builtin_code(0, int8),
+            # builtin_code(3, int32) — newer writers use slot 3
+            code = fb.i32(oc, 3, 0) or fb.i8(oc, 0, 0)
+            self.opcodes.append(int(code))
+        if fb.vec_len(m, 2) < 1:
+            raise ValueError("tflite model has no subgraph")
+        g = fb.vec_table(m, 2, 0)
+        # SubGraph: tensors(0) inputs(1) outputs(2) operators(3) name(4)
+        self.tensors = [_Tensor(fb.vec_table(g, 0, i))
+                        for i in range(fb.vec_len(g, 0))]
+        self.inputs = fb.vec_i32(g, 1)
+        self.outputs = fb.vec_i32(g, 2)
+        self.ops = [_Op(fb.vec_table(g, 3, i))
+                    for i in range(fb.vec_len(g, 3))]
+        self.buffers: List[bytes] = []
+        for i in range(fb.vec_len(m, 4)):
+            self.buffers.append(fb.vec_bytes(fb.vec_table(m, 4, i), 0))
+
+    def constant(self, tensor_idx: int) -> Optional[np.ndarray]:
+        t = self.tensors[tensor_idx]
+        raw = self.buffers[t.buffer_idx] if t.buffer_idx < len(self.buffers) \
+            else b""
+        if not raw:
+            return None
+        arr = np.frombuffer(raw, dtype=t.dtype)
+        return arr.reshape([int(s) for s in t.shape]) if t.shape else arr
+
+
+class TfliteRunner:
+    """Run a float .tflite model under jit (nd4j-tvm/tflite runner role).
+
+    Usage::
+
+        r = TfliteRunner("model.tflite")
+        out = r.run({"input": x})      # name-keyed, or positional list
+    """
+
+    def __init__(self, model_bytes_or_path):
+        if isinstance(model_bytes_or_path, (str,)):
+            with open(model_bytes_or_path, "rb") as f:
+                data = f.read()
+        else:
+            data = bytes(model_bytes_or_path)
+        try:
+            self.model = TfliteModel(data)
+        except Exception as e:
+            raise ValueError(
+                f"not a parseable .tflite flatbuffer: {e}") from e
+        # reject ANY quantized tensor — dynamic-range (weight-only int8)
+        # models keep float inputs/outputs, so checking io alone would let
+        # raw int8 weights through and silently produce garbage
+        for i, t in enumerate(self.model.tensors):
+            if t.quantized:
+                raise ValueError(
+                    f"quantized tflite models are unsupported (tensor "
+                    f"{t.name!r} carries quantization scales; convert "
+                    "without optimizations for float inference)")
+        self.input_names = [self.model.tensors[i].name
+                            for i in self.model.inputs]
+        self.output_names = [self.model.tensors[i].name
+                             for i in self.model.outputs]
+        self._jit = jax.jit(self._execute)
+
+    # -- op lowering ------------------------------------------------------
+    def _execute(self, *input_arrays):
+        m = self.model
+        env: Dict[int, Any] = {}
+        for idx, arr in zip(m.inputs, input_arrays):
+            env[idx] = arr
+
+        def val(i):
+            if i < 0:
+                return None  # optional tensor slot (-1)
+            if i not in env:
+                c = m.constant(i)
+                if c is None:
+                    raise ValueError(
+                        f"tensor {i} ({m.tensors[i].name!r}) has no value "
+                        "and no producer")
+                # kept as HOST numpy: jnp ops consume it directly, while
+                # shape-arithmetic consumers (RESHAPE/STRIDED_SLICE begin/
+                # end) need it concrete — jnp.asarray under trace would
+                # make it a tracer
+                env[i] = c
+            return env[i]
+
+        for op in m.ops:
+            code = m.opcodes[op.opcode_index]
+            name = _OP_NAMES.get(code)
+            if name is None:
+                raise ValueError(
+                    f"unsupported tflite builtin op code {code}")
+            outs = self._lower(name, op, val)
+            for o_idx, o_val in zip(op.outputs, outs):
+                env[o_idx] = o_val
+        return [env[i] for i in m.outputs]
+
+    def _lower(self, name, op, val):
+        o = op.options
+        if name in ("ADD", "SUB", "MUL", "DIV", "MAXIMUM", "MINIMUM",
+                    "SQUARED_DIFFERENCE"):
+            a, b = val(op.inputs[0]), val(op.inputs[1])
+            fn = {"ADD": jnp.add, "SUB": jnp.subtract, "MUL": jnp.multiply,
+                  "DIV": jnp.divide, "MAXIMUM": jnp.maximum,
+                  "MINIMUM": jnp.minimum,
+                  "SQUARED_DIFFERENCE": lambda x, y: (x - y) ** 2}[name]
+            out = fn(a, b)
+            fused = fb.i8(o, 0, 0) if o is not None and name in (
+                "ADD", "SUB", "MUL", "DIV") else 0
+            return [_apply_fused(out, fused)]
+        if name == "RELU":
+            return [jax.nn.relu(val(op.inputs[0]))]
+        if name == "RELU6":
+            return [jnp.clip(val(op.inputs[0]), 0.0, 6.0)]
+        if name == "TANH":
+            return [jnp.tanh(val(op.inputs[0]))]
+        if name == "LOGISTIC":
+            return [jax.nn.sigmoid(val(op.inputs[0]))]
+        if name == "SOFTMAX":
+            beta = fb.f32(o, 0, 1.0) if o is not None else 1.0
+            return [jax.nn.softmax(val(op.inputs[0]) * beta, axis=-1)]
+        if name == "FULLY_CONNECTED":
+            x, w = val(op.inputs[0]), val(op.inputs[1])
+            b = val(op.inputs[2]) if len(op.inputs) > 2 else None
+            lead = None
+            if x.ndim > 2:
+                # tflite semantics: collapse to [-1, in] and restore the
+                # leading dims (keras Dense on a sequence hits this)
+                lead = x.shape[:-1]
+                x = x.reshape((-1, w.shape[1]))
+            out = x @ w.T  # tflite FC weights are [out, in]
+            if b is not None:
+                out = out + b
+            if lead is not None:
+                out = out.reshape(tuple(lead) + (w.shape[0],))
+            fused = fb.i8(o, 0, 0) if o is not None else 0
+            return [_apply_fused(out, fused)]
+        if name in ("CONV_2D", "DEPTHWISE_CONV_2D"):
+            x, w = val(op.inputs[0]), val(op.inputs[1])
+            b = val(op.inputs[2]) if len(op.inputs) > 2 else None
+            if name == "CONV_2D":
+                # Conv2DOptions: padding(0) stride_w(1) stride_h(2)
+                # fused(3) dil_w(4) dil_h(5); weights [out, kh, kw, in]
+                pad = _padding(fb.i8(o, 0, 0))
+                sw, sh = fb.i32(o, 1, 1), fb.i32(o, 2, 1)
+                fused = fb.i8(o, 3, 0)
+                dw, dh = fb.i32(o, 4, 1) or 1, fb.i32(o, 5, 1) or 1
+                rhs = jnp.transpose(w, (1, 2, 3, 0))  # -> HWIO
+                groups = 1
+            else:
+                # DepthwiseConv2DOptions: padding(0) stride_w(1)
+                # stride_h(2) depth_multiplier(3) fused(4) dil_w(5)
+                # dil_h(6); weights [1, kh, kw, in*mult]
+                pad = _padding(fb.i8(o, 0, 0))
+                sw, sh = fb.i32(o, 1, 1), fb.i32(o, 2, 1)
+                mult = fb.i32(o, 3, 1) or 1
+                fused = fb.i8(o, 4, 0)
+                dw, dh = fb.i32(o, 5, 1) or 1, fb.i32(o, 6, 1) or 1
+                cin = x.shape[-1]
+                rhs = jnp.transpose(w, (1, 2, 0, 3)).reshape(
+                    w.shape[1], w.shape[2], 1, cin * mult)
+                groups = cin
+            dn = jax.lax.conv_dimension_numbers(
+                x.shape, rhs.shape, ("NHWC", "HWIO", "NHWC"))
+            out = jax.lax.conv_general_dilated(
+                x, rhs, window_strides=(sh, sw), padding=pad,
+                rhs_dilation=(dh, dw), dimension_numbers=dn,
+                feature_group_count=groups)
+            if b is not None:
+                out = out + b
+            return [_apply_fused(out, fused)]
+        if name in ("MAX_POOL_2D", "AVERAGE_POOL_2D"):
+            # Pool2DOptions: padding(0) stride_w(1) stride_h(2)
+            # filter_width(3) filter_height(4) fused(5)
+            x = val(op.inputs[0])
+            pad = _padding(fb.i8(o, 0, 0))
+            sw, sh = fb.i32(o, 1, 1), fb.i32(o, 2, 1)
+            fw, fh = fb.i32(o, 3, 1), fb.i32(o, 4, 1)
+            dims, strides = (1, fh, fw, 1), (1, sh, sw, 1)
+            if name == "MAX_POOL_2D":
+                out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims,
+                                            strides, pad)
+            else:
+                s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims,
+                                          strides, pad)
+                n = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                          dims, strides, pad)
+                out = s / n
+            return [_apply_fused(out, fb.i8(o, 5, 0))]
+        if name == "RESHAPE":
+            x = val(op.inputs[0])
+            if len(op.inputs) > 1 and op.inputs[1] >= 0:
+                shape = np.asarray(val(op.inputs[1])).astype(int).tolist()
+            else:
+                shape = fb.vec_i32(o, 0)
+            return [x.reshape([int(s) for s in shape])]
+        if name == "CONCATENATION":
+            axis = fb.i32(o, 0, 0) if o is not None else 0
+            parts = [val(i) for i in op.inputs]
+            out = jnp.concatenate(parts, axis=axis)
+            return [_apply_fused(out, fb.i8(o, 1, 0) if o is not None
+                                 else 0)]
+        if name == "MEAN":
+            x = val(op.inputs[0])
+            axes = tuple(int(a) for a in
+                         np.asarray(val(op.inputs[1])).reshape(-1))
+            keep = bool(fb.i8(o, 0, 0)) if o is not None else False
+            return [jnp.mean(x, axis=axes, keepdims=keep)]
+        if name == "PAD":
+            x = val(op.inputs[0])
+            pads = np.asarray(val(op.inputs[1])).astype(int)
+            return [jnp.pad(x, [(int(a), int(b)) for a, b in pads])]
+        if name == "TRANSPOSE":
+            x = val(op.inputs[0])
+            perm = [int(p) for p in np.asarray(val(op.inputs[1])).reshape(-1)]
+            return [jnp.transpose(x, perm)]
+        if name == "SQUEEZE":
+            x = val(op.inputs[0])
+            dims = fb.vec_i32(o, 0) if o is not None else []
+            return [jnp.squeeze(x, axis=tuple(dims) if dims else None)]
+        if name == "SHAPE":
+            # returned as HOST numpy so converter-emitted shape-arithmetic
+            # chains (SHAPE -> STRIDED_SLICE -> PACK -> RESHAPE) stay
+            # concrete under tracing — shapes are static in XLA anyway
+            return [np.asarray(val(op.inputs[0]).shape, np.int32)]
+        if name == "PACK":
+            # PackOptions: values_count(0) axis(1). Converter output mixes
+            # scalar and [1]-shaped element tensors; normalize every part
+            # to the declared element shape (output shape minus the axis)
+            axis = fb.i32(o, 1, 0) if o is not None else 0
+            parts = [val(i) for i in op.inputs]
+            out_shape = [int(s)
+                         for s in self.model.tensors[op.outputs[0]].shape]
+            elem = tuple(out_shape[:axis] + out_shape[axis + 1:])
+            np_mod = np if all(isinstance(p, (np.ndarray, np.generic,
+                                              int, float))
+                               for p in parts) else jnp
+            parts = [np_mod.reshape(p, elem) for p in parts]
+            return [np_mod.stack(parts, axis=axis)]
+        if name == "STRIDED_SLICE":
+            x = val(op.inputs[0])
+            begin = np.asarray(val(op.inputs[1])).astype(int)
+            end = np.asarray(val(op.inputs[2])).astype(int)
+            strides = np.asarray(val(op.inputs[3])).astype(int)
+            # StridedSliceOptions: begin_mask(0) end_mask(1) ellipsis(2)
+            # new_axis(3) shrink_axis(4)
+            bm = fb.i32(o, 0, 0) if o is not None else 0
+            em = fb.i32(o, 1, 0) if o is not None else 0
+            sm = fb.i32(o, 4, 0) if o is not None else 0
+            if o is not None and (fb.i32(o, 2, 0) or fb.i32(o, 3, 0)):
+                raise ValueError(
+                    "STRIDED_SLICE with ellipsis/new_axis masks is "
+                    "unsupported")
+            idx = []
+            for d in range(x.ndim):
+                b0 = None if (bm >> d) & 1 else int(begin[d])
+                e0 = None if (em >> d) & 1 else int(end[d])
+                if (sm >> d) & 1:
+                    idx.append(int(begin[d]))
+                else:
+                    idx.append(slice(b0, e0, int(strides[d])))
+            return [x[tuple(idx)]]
+        raise ValueError(f"unhandled tflite op {name}")
+
+    # -- public -----------------------------------------------------------
+    def run(self, inputs) -> Dict[str, NDArray]:
+        """inputs: dict keyed by tensor name, or a positional sequence."""
+        if isinstance(inputs, dict):
+            arrays = []
+            for n, idx in zip(self.input_names, self.model.inputs):
+                if n not in inputs:
+                    raise KeyError(f"missing input {n!r}; model inputs: "
+                                   f"{self.input_names}")
+                arrays.append(inputs[n])
+        else:
+            arrays = list(inputs)
+        arrays = [a.jax() if isinstance(a, NDArray) else jnp.asarray(a)
+                  for a in arrays]
+        outs = self._jit(*arrays)
+        return {n: NDArray(o) for n, o in zip(self.output_names, outs)}

@@ -1,0 +1,126 @@
+"""Minimal generic FlatBuffers table walking, shared by the wire-format
+readers (SameDiff ``.fb``, TFLite ``.tflite``).
+
+Slot numbers are the field declaration indices from the respective .fbs
+schemas (vtable offset = 4 + 2*slot); readers stay schema-less — no
+generated classes, just the ``flatbuffers`` runtime Table.
+"""
+from __future__ import annotations
+
+import struct
+from typing import List, Optional
+
+import flatbuffers.table
+from flatbuffers import number_types as N
+
+
+def tbl(buf: bytes, pos: int) -> flatbuffers.table.Table:
+    return flatbuffers.table.Table(buf, pos)
+
+
+def root(buf: bytes) -> flatbuffers.table.Table:
+    (off,) = struct.unpack_from("<I", buf, 0)
+    return tbl(buf, off)
+
+
+def off(t, slot: int) -> int:
+    return t.Offset(4 + 2 * slot)
+
+
+def i8(t, slot, default=0):
+    o = off(t, slot)
+    return t.Get(N.Int8Flags, t.Pos + o) if o else default
+
+
+def i32(t, slot, default=0):
+    o = off(t, slot)
+    return t.Get(N.Int32Flags, t.Pos + o) if o else default
+
+
+def u32(t, slot, default=0):
+    o = off(t, slot)
+    return t.Get(N.Uint32Flags, t.Pos + o) if o else default
+
+
+def i64(t, slot, default=0):
+    o = off(t, slot)
+    return t.Get(N.Int64Flags, t.Pos + o) if o else default
+
+
+def f32(t, slot, default=0.0):
+    o = off(t, slot)
+    return t.Get(N.Float32Flags, t.Pos + o) if o else default
+
+
+def f64(t, slot, default=0.0):
+    o = off(t, slot)
+    return t.Get(N.Float64Flags, t.Pos + o) if o else default
+
+
+def string(t, slot) -> Optional[str]:
+    o = off(t, slot)
+    return t.String(t.Pos + o).decode("utf-8") if o else None
+
+
+def subtable(t, slot):
+    o = off(t, slot)
+    return tbl(t.Bytes, t.Indirect(t.Pos + o)) if o else None
+
+
+def union_table(t, slot):
+    """A union value field: same indirection as a subtable."""
+    return subtable(t, slot)
+
+
+def vec_len(t, slot) -> int:
+    o = off(t, slot)
+    return t.VectorLen(o) if o else 0
+
+
+def vec_table(t, slot, i):
+    o = off(t, slot)
+    return tbl(t.Bytes, t.Indirect(t.Vector(o) + i * 4))
+
+
+def vec_scalar(t, slot, flags, width) -> list:
+    o = off(t, slot)
+    if not o:
+        return []
+    v, n = t.Vector(o), t.VectorLen(o)
+    return [t.Get(flags, v + width * i) for i in range(n)]
+
+
+def vec_i32(t, slot):
+    return vec_scalar(t, slot, N.Int32Flags, 4)
+
+
+def vec_i64(t, slot):
+    return vec_scalar(t, slot, N.Int64Flags, 8)
+
+
+def vec_f32(t, slot):
+    return vec_scalar(t, slot, N.Float32Flags, 4)
+
+
+def vec_f64(t, slot):
+    return vec_scalar(t, slot, N.Float64Flags, 8)
+
+
+def vec_bool(t, slot):
+    return [bool(b) for b in vec_scalar(t, slot, N.BoolFlags, 1)]
+
+
+def vec_str(t, slot) -> List[str]:
+    o = off(t, slot)
+    if not o:
+        return []
+    v, n = t.Vector(o), t.VectorLen(o)
+    return [t.String(v + 4 * i).decode("utf-8") for i in range(n)]
+
+
+def vec_bytes(t, slot) -> bytes:
+    o = off(t, slot)
+    if not o:
+        return b""
+    v, n = t.Vector(o), t.VectorLen(o)
+    return bytes(t.Bytes[v:v + n])

@@ -15,108 +15,17 @@ returned object but not converted into an optimizer.
 """
 from __future__ import annotations
 
-import struct
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-import flatbuffers.table
-from flatbuffers import number_types as _N
-
 from ..autodiff.samediff import SameDiff, SDVariable
-
-# ---------------------------------------------------------------------------
-# Low-level FlatBuffers walking.  Slot numbers are the field declaration
-# indices from the .fbs schemas (vtable offset = 4 + 2*slot).
-# ---------------------------------------------------------------------------
-
-
-def _tbl(buf: bytes, pos: int) -> flatbuffers.table.Table:
-    return flatbuffers.table.Table(buf, pos)
-
-
-def _root(buf: bytes) -> flatbuffers.table.Table:
-    (off,) = struct.unpack_from("<I", buf, 0)
-    return _tbl(buf, off)
-
-
-def _off(t, slot: int) -> int:
-    return t.Offset(4 + 2 * slot)
-
-
-def _i8(t, slot, default=0):
-    o = _off(t, slot)
-    return t.Get(_N.Int8Flags, t.Pos + o) if o else default
-
-
-def _i32(t, slot, default=0):
-    o = _off(t, slot)
-    return t.Get(_N.Int32Flags, t.Pos + o) if o else default
-
-
-def _i64(t, slot, default=0):
-    o = _off(t, slot)
-    return t.Get(_N.Int64Flags, t.Pos + o) if o else default
-
-
-def _string(t, slot) -> Optional[str]:
-    o = _off(t, slot)
-    return t.String(t.Pos + o).decode("utf-8") if o else None
-
-
-def _subtable(t, slot):
-    o = _off(t, slot)
-    return _tbl(t.Bytes, t.Indirect(t.Pos + o)) if o else None
-
-
-def _vec_len(t, slot) -> int:
-    o = _off(t, slot)
-    return t.VectorLen(o) if o else 0
-
-
-def _vec_table(t, slot, i):
-    o = _off(t, slot)
-    return _tbl(t.Bytes, t.Indirect(t.Vector(o) + i * 4))
-
-
-def _vec_scalar(t, slot, flags, width) -> list:
-    o = _off(t, slot)
-    if not o:
-        return []
-    v, n = t.Vector(o), t.VectorLen(o)
-    return [t.Get(flags, v + width * i) for i in range(n)]
-
-
-def _vec_i32(t, slot):
-    return _vec_scalar(t, slot, _N.Int32Flags, 4)
-
-
-def _vec_i64(t, slot):
-    return _vec_scalar(t, slot, _N.Int64Flags, 8)
-
-
-def _vec_f64(t, slot):
-    return _vec_scalar(t, slot, _N.Float64Flags, 8)
-
-
-def _vec_bool(t, slot):
-    return [bool(b) for b in _vec_scalar(t, slot, _N.BoolFlags, 1)]
-
-
-def _vec_str(t, slot) -> List[str]:
-    o = _off(t, slot)
-    if not o:
-        return []
-    v, n = t.Vector(o), t.VectorLen(o)
-    return [t.String(v + 4 * i).decode("utf-8") for i in range(n)]
-
-
-def _vec_bytes(t, slot) -> bytes:
-    o = _off(t, slot)
-    if not o:
-        return b""
-    v, n = t.Vector(o), t.VectorLen(o)
-    return bytes(t.Bytes[v:v + n])
+from .flatbuf import (i8 as _i8, i32 as _i32, i64 as _i64, root as _root,
+                      string as _string, subtable as _subtable,
+                      vec_bool as _vec_bool, vec_bytes as _vec_bytes,
+                      vec_f64 as _vec_f64, vec_i32 as _vec_i32,
+                      vec_i64 as _vec_i64, vec_len as _vec_len,
+                      vec_str as _vec_str, vec_table as _vec_table)
 
 
 # --- DType enum (array.fbs) -> numpy -------------------------------------

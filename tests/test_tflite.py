@@ -1,0 +1,156 @@
+"""TfliteRunner: execute real converter-produced .tflite files, golden-
+checked against TF's own tflite Interpreter (the independent runtime).
+
+Reference role: nd4j-tvm / foreign-runtime interop (VERDICT r2 partial #29).
+"""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.interop import TfliteRunner  # noqa: E402
+
+
+def _convert(model):
+    conv = tf.lite.TFLiteConverter.from_keras_model(model)
+    return conv.convert()
+
+
+def _interp_golden(flat, inputs):
+    it = tf.lite.Interpreter(model_content=flat)
+    in_det = it.get_input_details()
+    for d, x in zip(in_det, inputs):
+        it.resize_tensor_input(d["index"], x.shape)
+    it.allocate_tensors()
+    in_det = it.get_input_details()
+    for d, x in zip(in_det, inputs):
+        it.set_tensor(d["index"], x)
+    it.invoke()
+    return [it.get_tensor(d["index"]) for d in it.get_output_details()]
+
+
+def _run_both(model, inputs, atol=1e-5):
+    flat = _convert(model)
+    golden = _interp_golden(flat, inputs)
+    runner = TfliteRunner(flat)
+    res = runner.run(list(inputs))
+    got = [res[n].numpy() for n in runner.output_names]
+    assert len(got) == len(golden)
+    for g, w in zip(got, golden):
+        np.testing.assert_allclose(g, w, atol=atol, rtol=1e-4)
+    return runner
+
+
+class TestTfliteRunner:
+    def test_mlp(self):
+        rs = np.random.RandomState(0)
+        m = tf.keras.Sequential([
+            tf.keras.Input((12,)),
+            tf.keras.layers.Dense(16, activation="relu"),
+            tf.keras.layers.Dense(4, activation="softmax"),
+        ])
+        x = rs.randn(3, 12).astype(np.float32)
+        runner = _run_both(m, [x])
+        assert len(runner.input_names) == 1
+
+    def test_cnn(self):
+        rs = np.random.RandomState(1)
+        m = tf.keras.Sequential([
+            tf.keras.Input((16, 16, 3)),
+            tf.keras.layers.Conv2D(8, 3, padding="same",
+                                   activation="relu"),
+            tf.keras.layers.MaxPooling2D(2),
+            tf.keras.layers.DepthwiseConv2D(3, padding="valid"),
+            tf.keras.layers.AveragePooling2D(2),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(5),
+        ])
+        x = rs.randn(2, 16, 16, 3).astype(np.float32)
+        _run_both(m, [x], atol=1e-4)
+
+    def test_elementwise_and_concat(self):
+        rs = np.random.RandomState(2)
+        a = tf.keras.Input((8,))
+        b = tf.keras.Input((8,))
+        s = tf.keras.layers.Add()([a, b])
+        d = tf.keras.layers.Subtract()([a, b])
+        m1 = tf.keras.layers.Multiply()([s, d])
+        cat = tf.keras.layers.Concatenate()([s, m1])
+        out = tf.keras.layers.Activation("tanh")(cat)
+        m = tf.keras.Model([a, b], out)
+        xs = [rs.randn(2, 8).astype(np.float32) for _ in range(2)]
+        _run_both(m, xs)
+
+    def test_mean_and_reshape(self):
+        rs = np.random.RandomState(3)
+        inp = tf.keras.Input((6, 4))
+        r = tf.keras.layers.Reshape((12, 2))(inp)
+        g = tf.keras.layers.GlobalAveragePooling1D()(r)
+        m = tf.keras.Model(inp, g)
+        x = rs.randn(2, 6, 4).astype(np.float32)
+        _run_both(m, [x])
+
+    def test_named_dict_inputs_and_missing_raises(self):
+        rs = np.random.RandomState(4)
+        m = tf.keras.Sequential([
+            tf.keras.Input((5,)),
+            tf.keras.layers.Dense(2),
+        ])
+        flat = _convert(m)
+        runner = TfliteRunner(flat)
+        x = rs.randn(1, 5).astype(np.float32)
+        out = runner.run({runner.input_names[0]: x})
+        assert out[runner.output_names[0]].numpy().shape == (1, 2)
+        with pytest.raises(KeyError, match="missing input"):
+            runner.run({"nope": x})
+
+    def test_quantized_rejected(self):
+        m = tf.keras.Sequential([
+            tf.keras.Input((4,)),
+            tf.keras.layers.Dense(2),
+        ])
+        conv = tf.lite.TFLiteConverter.from_keras_model(m)
+        conv.optimizations = [tf.lite.Optimize.DEFAULT]
+
+        def rep():
+            for _ in range(4):
+                yield [np.random.rand(1, 4).astype(np.float32)]
+
+        conv.representative_dataset = rep
+        conv.target_spec.supported_ops = [
+            tf.lite.OpsSet.TFLITE_BUILTINS_INT8]
+        conv.inference_input_type = tf.uint8
+        conv.inference_output_type = tf.uint8
+        try:
+            flat = conv.convert()
+        except Exception:
+            pytest.skip("full-int8 conversion unavailable in this TF build")
+        with pytest.raises(ValueError, match="quantized"):
+            TfliteRunner(flat)
+
+
+class TestTfliteReviewFixes:
+    def test_dense_on_sequence_rank3(self):
+        """FULLY_CONNECTED on a rank-3 tensor keeps the leading dims
+        (tflite collapses to [-1, in], not [batch, -1])."""
+        rs = np.random.RandomState(5)
+        m = tf.keras.Sequential([
+            tf.keras.Input((4, 6)),
+            tf.keras.layers.Dense(3, activation="relu"),
+        ])
+        x = rs.randn(2, 4, 6).astype(np.float32)
+        runner = _run_both(m, [x])
+        out = runner.run([x])
+        assert out[runner.output_names[0]].numpy().shape == (2, 4, 3)
+
+    def test_dynamic_range_quantized_rejected(self):
+        """Weight-only int8 keeps float io; it must still be refused."""
+        m = tf.keras.Sequential([
+            tf.keras.Input((64,)),
+            tf.keras.layers.Dense(64),
+        ])
+        conv = tf.lite.TFLiteConverter.from_keras_model(m)
+        conv.optimizations = [tf.lite.Optimize.DEFAULT]
+        flat = conv.convert()
+        with pytest.raises(ValueError, match="quantiz"):
+            TfliteRunner(flat)
