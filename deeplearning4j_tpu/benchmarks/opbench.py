@@ -108,7 +108,8 @@ def _candidate_sets(category: str) -> List[Tuple[tuple, dict]]:
         return [((labels, _unit(64, N)), {}), ((labels, logits), {}),
                 ((logits,), {"labels": labels}),
                 ((labels, logits, None), {}),
-                ((logits, None, labels), {})]
+                ((logits, None, labels), {}),
+                ((logits,), {})]
     if category == "conv":
         img = _f32(8, 32, 64, 64)         # NCHW
         w = _f32(3, 3, 32, 64)            # HWIO (conv_ops convention)
@@ -120,7 +121,9 @@ def _candidate_sets(category: str) -> List[Tuple[tuple, dict]]:
                 ((vol, w3), {}),
                 ((img, _f32(3, 3, 32, 2)), {}),   # depthwise multiplier
                 ((img, _f32(3, 3, 32, 2), _f32(3, 3, 64, 128)), {}),
+                ((img, _f32(3, 3, 64, 32)), {}),  # deconv HWOI
                 ((img, 3, 3), {}),                # im2col
+                ((_f32(4, 8, 3, 3, 30, 30),), {"h": 32, "w": 32}),
                 ((img,), {}),
                 ((img, (1, 3, 3, 1), (1, 1, 1, 1), (1, 1, 1, 1)), {})]
     if category == "pooling":
@@ -147,13 +150,18 @@ def _candidate_sets(category: str) -> List[Tuple[tuple, dict]]:
             ((xt, _f32(B, H), _f32(F + H, 2 * H), _f32(F + H, H)), {}),
             # sru(x, c0, w[3F], b[2F])
             ((seq, _f32(B, F), _f32(F, 3 * F), _f32(2 * F)), {}),
+            # lstmBlock(x[T,B,F] time-major, h0, c0, w[(F+H),4H], b[4H])
+            ((_f32(T, B, F), _f32(B, H), _f32(B, H),
+              _f32(F + H, 4 * H), _f32(4 * H)), {}),
             ((seq,), {}),
         ]
     if category == "random":
         import jax as _jax
         key = _jax.random.key(0)
         return [((key, (N, N)), {}), ((key, x, 0.5), {}),
-                ((key, x), {}), (((N, N),), {}), ((), {})]
+                ((key, x), {}), ((key, x, (64, 64)), {}),
+                ((key, (N, N), 2.0), {}), ((key, x, 8), {}),
+                (((N, N),), {}), ((), {}), ((1234,), {})]
     if category == "nn":
         return [((x,), {}), ((x, v, v), {}), ((x, y), {})]
     if category == "attention":
@@ -173,10 +181,11 @@ def _candidate_sets(category: str) -> List[Tuple[tuple, dict]]:
     return []
 
 
-#: categories excluded by design (not standalone array ops); reported, not
+#: categories excluded by design (not standalone numeric array ops —
+#: graph machinery, bp pairs, or host-side string ops); reported, not
 #: silently dropped
 EXCLUDED_CATEGORIES = ("controlflow", "list", "autodiff_bp", "tsne",
-                       "decoder")
+                       "decoder", "strings")
 
 
 def _time_fn(fn, n_iter: int, block) -> float:

@@ -74,11 +74,13 @@ def _padding(code: int) -> str:
 
 
 class _Tensor:
-    __slots__ = ("shape", "dtype", "buffer_idx", "name", "quantized")
+    __slots__ = ("shape", "dtype", "type_code", "buffer_idx", "name",
+                 "quantized")
 
     def __init__(self, t):
         self.shape = fb.vec_i32(t, 0)
-        self.dtype = _TENSOR_TYPES.get(fb.i8(t, 1, 0), np.float32)
+        self.type_code = fb.i8(t, 1, 0)
+        self.dtype = _TENSOR_TYPES.get(self.type_code)
         self.buffer_idx = fb.u32(t, 2)
         self.name = fb.string(t, 3)
         q = fb.subtable(t, 4)
@@ -132,6 +134,10 @@ class TfliteModel:
             else b""
         if not raw:
             return None
+        if t.dtype is None:
+            raise ValueError(
+                f"unsupported tflite tensor type code {t.type_code} "
+                f"for {t.name!r}")
         arr = np.frombuffer(raw, dtype=t.dtype)
         return arr.reshape([int(s) for s in t.shape]) if t.shape else arr
 
@@ -146,7 +152,8 @@ class TfliteRunner:
     """
 
     def __init__(self, model_bytes_or_path):
-        if isinstance(model_bytes_or_path, (str,)):
+        import os as _os
+        if isinstance(model_bytes_or_path, (str, _os.PathLike)):
             with open(model_bytes_or_path, "rb") as f:
                 data = f.read()
         else:
@@ -234,17 +241,24 @@ class TfliteRunner:
             b = val(op.inputs[2]) if len(op.inputs) > 2 else None
             lead = None
             if x.ndim > 2:
-                # tflite semantics: collapse to [-1, in] and restore the
-                # leading dims (keras Dense on a sequence hits this)
+                # tflite semantics: collapse to [-1, in]; leading dims are
+                # restored only when keep_num_dims is set
+                # (FullyConnectedOptions slot 2) — keras Dense conversions
+                # set it, raw matmul collapses keep the 2-D result
                 lead = x.shape[:-1]
                 x = x.reshape((-1, w.shape[1]))
             out = x @ w.T  # tflite FC weights are [out, in]
             if b is not None:
                 out = out + b
-            if lead is not None:
+            keep_dims = bool(fb.i8(o, 2, 0)) if o is not None else False
+            if lead is not None and keep_dims:
                 out = out.reshape(tuple(lead) + (w.shape[0],))
             fused = fb.i8(o, 0, 0) if o is not None else 0
             return [_apply_fused(out, fused)]
+        if name in ("CONV_2D", "DEPTHWISE_CONV_2D",
+                    "MAX_POOL_2D", "AVERAGE_POOL_2D") and o is None:
+            raise ValueError(f"{name} without builtin options is "
+                             "unsupported (stride/padding unknown)")
         if name in ("CONV_2D", "DEPTHWISE_CONV_2D"):
             x, w = val(op.inputs[0]), val(op.inputs[1])
             b = val(op.inputs[2]) if len(op.inputs) > 2 else None
@@ -301,8 +315,10 @@ class TfliteRunner:
             x = val(op.inputs[0])
             if len(op.inputs) > 1 and op.inputs[1] >= 0:
                 shape = np.asarray(val(op.inputs[1])).astype(int).tolist()
-            else:
+            elif o is not None:
                 shape = fb.vec_i32(o, 0)
+            else:
+                raise ValueError("RESHAPE without shape input or options")
             return [x.reshape([int(s) for s in shape])]
         if name == "CONCATENATION":
             axis = fb.i32(o, 0, 0) if o is not None else 0
@@ -384,6 +400,10 @@ class TfliteRunner:
                 arrays.append(inputs[n])
         else:
             arrays = list(inputs)
+        if len(arrays) != len(self.model.inputs):
+            raise ValueError(
+                f"model takes {len(self.model.inputs)} inputs "
+                f"({self.input_names}), got {len(arrays)}")
         arrays = [a.jax() if isinstance(a, NDArray) else jnp.asarray(a)
                   for a in arrays]
         outs = self._jit(*arrays)

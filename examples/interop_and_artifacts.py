@@ -1,0 +1,73 @@
+"""Round-3 surfaces: TFLite execution, SameDiff .fb loading, pretrained
+zoo artifacts, eager-mode debugging.
+
+Run: python examples/interop_and_artifacts.py
+"""
+import os
+
+import numpy as np
+
+
+def demo_tflite():
+    """Run a converter-produced .tflite without the TFLite runtime."""
+    try:
+        import tensorflow as tf
+    except ImportError:
+        print("tensorflow not installed — skipping tflite demo")
+        return
+    m = tf.keras.Sequential([
+        tf.keras.Input((8,)),
+        tf.keras.layers.Dense(16, activation="relu"),
+        tf.keras.layers.Dense(3, activation="softmax"),
+    ])
+    flat = tf.lite.TFLiteConverter.from_keras_model(m).convert()
+
+    from deeplearning4j_tpu.interop import TfliteRunner
+    runner = TfliteRunner(flat)
+    x = np.random.rand(2, 8).astype(np.float32)
+    out = runner.run({runner.input_names[0]: x})
+    print("tflite:", out[runner.output_names[0]].numpy())
+
+
+def demo_samediff_fb():
+    """Load a reference-produced SameDiff FlatBuffers graph."""
+    fixture = "/root/reference/sameDiffExampleInference.fb"
+    if not os.path.exists(fixture):
+        print("no .fb fixture present — skipping")
+        return
+    from deeplearning4j_tpu.modelimport.samediff_fb import load_samediff_fb
+    sd = load_samediff_fb(fixture)
+    x = np.random.rand(2, 784).astype(np.float32)
+    lbl = np.zeros((2, 10), np.float32)
+    out = sd.output({"input": x, "label": lbl}, ["prediction"])
+    print(".fb graph prediction shape:", out["prediction"].numpy().shape)
+
+
+def demo_pretrained():
+    """Checksum-verified pretrained artifact resolution (reference
+    ZooModel.initPretrained). Shows the published URL; the download needs
+    network access or a mirror via set_base_download_url."""
+    from deeplearning4j_tpu.zoo import ResNet50
+    from deeplearning4j_tpu.zoo.base import PretrainedType
+    m = ResNet50()
+    print("ResNet50 imagenet artifact:",
+          m.pretrained_url(PretrainedType.IMAGENET),
+          "adler32:", m.pretrained_checksum(PretrainedType.IMAGENET))
+
+
+def demo_eager():
+    """Eager mode: values observable while defining the graph."""
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+    sd = SameDiff.create(eager=True)
+    x = sd.var("x", np.asarray([[1.0, 2.0]], np.float32))
+    y = x * 3.0 + 1.0
+    print("eager value at definition:", y.get_arr().numpy())
+    # the same graph still compiles define-then-run
+    print("compiled:", sd.output({}, [y.name])[y.name].numpy())
+
+
+if __name__ == "__main__":
+    demo_eager()
+    demo_pretrained()
+    demo_samediff_fb()
+    demo_tflite()
