@@ -169,15 +169,12 @@ def _candidate_sets(category: str) -> List[Tuple[tuple, dict]]:
         return [((q, q, q), {}), ((q,), {})]
     if category == "updater":
         return [((x, y), {"lr": 0.1}), ((x, y), {}), ((x, y, x), {})]
-    if category == "strings":
-        s = np.array(["alpha", "beta", "gamma"] * 32)
-        return [((s,), {}), ((s, " "), {})]
     if category == "nlp":
         vocab, dim, B = 1024, 64, 256
         return [((_f32(vocab, dim), _f32(vocab, dim), _i32(B, hi=vocab),
                   _i32(B, hi=vocab), _i32(B, 5, hi=vocab)), {})]
-    # controlflow / list / autodiff_bp / tsne / decoder: graph-level or
-    # bp-pair machinery, not meaningfully benchable as standalone array ops
+    # remaining categories are in EXCLUDED_CATEGORIES (graph machinery,
+    # bp pairs, host-side string ops) and never reach here
     return []
 
 
