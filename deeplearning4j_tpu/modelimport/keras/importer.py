@@ -1027,6 +1027,9 @@ class KerasModelImport:
         adapted: Dict[str, Tuple[_Adapted, Tuple]] = {}
         alias: Dict[str, str] = {}  # keras layer name -> vertex name used
         unflattened: Dict[str, Tuple] = {}  # Flatten name -> conv shape
+        # keras names whose runtime tensor is [B,F,T] against keras' [B,T,F]
+        # (temporal producers); Reshape/Permute outputs are keras-identical
+        transposed: Dict[str, bool] = {}
 
         input_names = _ref_names(gcfg.get("input_layers", []))
         builder.add_inputs(*input_names)
@@ -1038,23 +1041,51 @@ class KerasModelImport:
             if cls == "InputLayer":
                 shape = cfg.get("batch_shape") or cfg.get("batch_input_shape")
                 keras_shapes[name] = tuple(shape[1:]) if shape else None
+                # RNN-style inputs are fed [B,F,T] by our conventions
+                transposed[name] = (keras_shapes[name] is not None
+                                    and len(keras_shapes[name]) == 2)
                 continue
             in_names = [alias.get(n, n) for n in inbound]
             in_shape = keras_shapes.get(inbound[0]) if inbound else None
+
+            def _mark_layout(out_shape):
+                if out_shape is not None and len(out_shape) == 2:
+                    if cls in _TEMPORAL_LAYERS:
+                        transposed[name] = True
+                    elif cls in ("Reshape", "Permute"):
+                        transposed[name] = False
+                    else:  # layout-preserving (dropout/activation/merge...)
+                        transposed[name] = bool(
+                            transposed.get(inbound[0])) if inbound else False
+                else:
+                    transposed[name] = False
             if cls == "Flatten":
                 if in_shape is not None and len(in_shape) == 2:
-                    # sequence tensors are held [B,F,T] vs keras [B,T,F];
-                    # flattening here would silently reorder elements (the
-                    # Sequential importer inserts a permute; the graph
-                    # builder has no layer slot for one yet)
-                    raise ImportException(
-                        "Flatten on a sequence tensor is unsupported in "
-                        "functional models; use GlobalPooling or reshape "
-                        "outside the graph")
+                    if any(s is None for s in in_shape):
+                        raise ImportException(
+                            "Flatten on a variable-length sequence is "
+                            "unsupported; fix the timestep dimension")
+                    # when the producer is temporal our tensor is [B,F,T]
+                    # vs keras [B,T,F]: line the axes up before flattening
+                    # (same treatment the Sequential importer applies)
+                    total = int(np.prod(in_shape))
+                    src = in_names[0]
+                    if transposed.get(inbound[0]):
+                        builder.add_layer(f"{name}_permute",
+                                          LX.PermuteLayer(dims=(2, 1)),
+                                          src)
+                        src = f"{name}_permute"
+                    builder.add_layer(name,
+                                      LX.ReshapeLayer(target_shape=(total,)),
+                                      src)
+                    keras_shapes[name] = (total,)
+                    transposed[name] = False
+                    continue
                 alias[name] = in_names[0]  # vanishes; preprocessor handles
                 if in_shape is not None and len(in_shape) == 3:
                     unflattened[name] = in_shape
                 keras_shapes[name] = _keras_out_shape(cls, cfg, in_shape)
+                _mark_layout(keras_shapes[name])
                 continue
             if cls in ("Reshape", "Permute") and in_shape is not None \
                     and len(in_shape) >= 2:
@@ -1070,6 +1101,7 @@ class KerasModelImport:
                       "Minimum": "min"}[cls]
                 builder.add_vertex(name, ElementWiseVertex(op=op), *in_names)
                 keras_shapes[name] = in_shape
+                _mark_layout(in_shape)
                 continue
             if cls == "Concatenate":
                 builder.add_vertex(name, MergeVertex(), *in_names)
@@ -1079,11 +1111,13 @@ class KerasModelImport:
                     merged = list(in_shape)
                     merged[-1] = sum(s[-1] for s in shapes)
                     keras_shapes[name] = tuple(merged)
+                _mark_layout(keras_shapes.get(name))
                 continue
             a = _adapt_layer(cls, cfg, in_shape)
             if a is None:
                 alias[name] = in_names[0] if in_names else name
                 keras_shapes[name] = _keras_out_shape(cls, cfg, in_shape)
+                _mark_layout(keras_shapes[name])
                 continue
             builder.add_layer(name, a.layer, *in_names)
             adapted[name] = (a, in_shape)
@@ -1094,6 +1128,7 @@ class KerasModelImport:
                     keras_shapes[name] = None
             else:
                 keras_shapes[name] = _keras_out_shape(cls, cfg, in_shape)
+            _mark_layout(keras_shapes.get(name))
 
         out_names = [alias.get(n, n)
                      for n in _ref_names(gcfg.get("output_layers", []))]

@@ -1187,3 +1187,49 @@ class TestKerasLayoutGuards:
         conf_layer = net.conf.layers[0]
         assert -1 not in getattr(conf_layer, "target_shape", ())
         np.testing.assert_allclose(net.output(x).numpy(), golden, atol=1e-5)
+
+
+class TestKerasFunctionalSequenceFlatten:
+    def test_lstm_seq_flatten_dense_golden(self, tmp_path):
+        """Functional model: LSTM(return_sequences) -> Flatten -> Dense.
+        The graph importer inserts the axis-aligning permute before the
+        reshape, so the flattened order matches the keras-trained kernel."""
+        from keras import layers
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_model_and_weights
+        rs = np.random.RandomState(11)
+        inp = keras.Input((6, 4), name="in1")
+        seq = layers.LSTM(5, return_sequences=True, name="l")(inp)
+        flat = layers.Flatten(name="f")(seq)
+        out = layers.Dense(3, name="d")(flat)
+        m = keras.Model(inp, out)
+        x = rs.randn(2, 6, 4).astype(np.float32)
+        golden = m.predict(x, verbose=0)
+        path = str(tmp_path / "seqflat.h5")
+        m.save(path)
+        net = import_keras_model_and_weights(path)
+        res = net.output(x.transpose(0, 2, 1))
+        res = (res[0] if isinstance(res, (list, tuple)) else res).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    def test_ff_origin_flatten_no_permute(self, tmp_path):
+        """Functional Reshape-from-FF -> Flatten: the tensor is keras-
+        identical, so NO aligning permute may be inserted (regression for
+        the unconditional-permute review finding)."""
+        from keras import layers
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_model_and_weights
+        rs = np.random.RandomState(12)
+        inp = keras.Input((12,), name="in1")
+        r = layers.Reshape((3, 4), name="rs")(inp)
+        f = layers.Flatten(name="f")(r)
+        out = layers.Dense(2, name="d")(f)
+        m = keras.Model(inp, out)
+        x = rs.randn(2, 12).astype(np.float32)
+        golden = m.predict(x, verbose=0)
+        path = str(tmp_path / "ff_flat.h5")
+        m.save(path)
+        net = import_keras_model_and_weights(path)
+        res = net.output(x)
+        res = (res[0] if isinstance(res, (list, tuple)) else res).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)

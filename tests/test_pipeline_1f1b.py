@@ -173,21 +173,21 @@ class TestBertLargeDepth1F1B:
                 np.where(rs.rand(B, T) < 0.2,
                          rs.randint(0, 97, (B, T)), -100), jnp.int32),
         }
+        # apples-to-apples: remat=True on the GPipe baseline too (matches
+        # test_peak_memory_below_gpipe) so the comparison isolates the
+        # schedule, not rematerialization
         gpipe_step = bert.make_pipeline_train_step(
-            c, mesh, n_microbatches=8, remat=False, schedule="gpipe")
+            c, mesh, n_microbatches=8, remat=True, schedule="gpipe")
         mems = {}
         for name, fn in (("1f1b", step), ("gpipe", gpipe_step)):
-            try:
-                mem = fn.lower(params, opt, batch, 0).compile() \
-                        .memory_analysis()
-            except Exception:
-                mem = None
-            if mem is not None and hasattr(mem, "temp_size_in_bytes"):
-                mems[name] = mem.temp_size_in_bytes
-        if len(mems) == 2:
-            # the property this test exists for: activation memory bounded
-            # by stage count, not microbatch count
-            assert mems["1f1b"] < mems["gpipe"], mems
+            mem = fn.lower(params, opt, batch, 0).compile() \
+                    .memory_analysis()
+            if mem is None or not hasattr(mem, "temp_size_in_bytes"):
+                pytest.skip("memory_analysis unsupported on this backend")
+            mems[name] = mem.temp_size_in_bytes
+        # the property this test exists for: activation memory bounded
+        # by stage count, not microbatch count
+        assert mems["1f1b"] < mems["gpipe"], mems
         params, opt, loss = step(params, opt, batch, 0)
         jax.block_until_ready(loss)
         assert np.isfinite(float(loss))
