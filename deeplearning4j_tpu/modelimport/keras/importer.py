@@ -453,9 +453,13 @@ def _cropping_tuple(val, n):
 
 def _simple_rnn_adapter(cfg):
     units = int(cfg["units"])
-    layer = L.SimpleRnn(n_out=units,
+    inner = L.SimpleRnn(n_out=units,
                         activation=_act(cfg.get("activation", "tanh")),
                         name=cfg.get("name"))
+    # keras return_sequences=False -> last timestep only (same wrapping the
+    # GRU adapter applies)
+    layer = inner if bool(cfg.get("return_sequences", False)) \
+        else LX.LastTimeStep(underlying=inner, name=cfg.get("name"))
 
     def set_weights(weights, in_type):
         kernel, rec, bias = [np.asarray(a) for a in weights[:3]]
@@ -943,16 +947,34 @@ class KerasModelImport:
                 cur = (int(np.prod(cur)),)
                 transposed = False
                 continue
-            if cls in ("Reshape", "Permute") and (
-                    transposed or (cur is not None and len(cur) >= 3)):
-                # sequence tensors are [B,F,T] vs keras [B,T,F]; conv
-                # activations are NCHW vs keras NHWC — in both cases a
-                # literal transpose/reshape would reorder different axes
-                # than keras did, so refuse rather than silently diverge
-                raise ImportException(
-                    f"{cls} on a sequence/conv tensor is unsupported "
-                    "(runtime layout differs from keras); insert Flatten "
-                    "or GlobalPooling first")
+            if cls in ("Reshape", "Permute"):
+                if cur is None:
+                    raise ImportException(
+                        f"{cls} with unknown input shape is unsupported")
+                if len(cur) >= 3:
+                    # conv activations are NCHW vs keras NHWC — a literal
+                    # transpose/reshape would reorder different axes than
+                    # keras did, so refuse rather than silently diverge
+                    raise ImportException(
+                        f"{cls} on a conv tensor is unsupported (runtime "
+                        "layout differs from keras); insert Flatten or "
+                        "GlobalPooling first")
+                if transposed:
+                    # align the [B,F,T] runtime tensor with keras' [B,T,F]
+                    # before applying the keras-specified transform; the
+                    # result is then keras-identical layout
+                    lb.layer(LX.PermuteLayer(dims=(2, 1)))
+                    idx += 1
+                    transposed = False
+            if cls in _TEMPORAL_LAYERS and cur is not None \
+                    and len(cur) == 2 and not transposed:
+                # a temporal consumer expects [B,F,T] but the tensor is in
+                # keras-identical [B,T,F] layout (e.g. produced by Reshape)
+                # — re-align before it, or the RNN silently reads features
+                # as timesteps
+                lb.layer(LX.PermuteLayer(dims=(2, 1)))
+                idx += 1
+                transposed = True
             shape_for_adapter = conv_src if (cls == "Dense" and conv_src) \
                 else cur
             a = _adapt_layer(cls, cfg, shape_for_adapter)
@@ -1087,11 +1109,29 @@ class KerasModelImport:
                 keras_shapes[name] = _keras_out_shape(cls, cfg, in_shape)
                 _mark_layout(keras_shapes[name])
                 continue
-            if cls in ("Reshape", "Permute") and in_shape is not None \
-                    and len(in_shape) >= 2:
-                raise ImportException(
-                    f"{cls} on a sequence/conv tensor is unsupported in "
-                    "functional models (runtime layout differs from keras)")
+            if cls in ("Reshape", "Permute"):
+                if in_shape is None:
+                    raise ImportException(
+                        f"{cls} with unknown input shape is unsupported")
+                if len(in_shape) >= 3:
+                    raise ImportException(
+                        f"{cls} on a conv tensor is unsupported (runtime "
+                        "layout differs from keras); insert Flatten or "
+                        "GlobalPooling first")
+                if len(in_shape) == 2 and transposed.get(inbound[0]):
+                    # align [B,F,T] -> keras [B,T,F] before the transform
+                    builder.add_layer(f"{name}_align",
+                                      LX.PermuteLayer(dims=(2, 1)),
+                                      in_names[0])
+                    in_names = [f"{name}_align"]
+            elif cls in _TEMPORAL_LAYERS and in_shape is not None \
+                    and len(in_shape) == 2 and inbound \
+                    and not transposed.get(inbound[0], False):
+                # temporal consumer on a keras-layout tensor: re-align to
+                # [B,F,T] first (mirror of the Sequential treatment)
+                builder.add_layer(f"{name}_align",
+                                  LX.PermuteLayer(dims=(2, 1)), in_names[0])
+                in_names = [f"{name}_align"]
             if cls == "Dense" and inbound and inbound[0] in unflattened:
                 in_shape = unflattened[inbound[0]]
             if cls in ("Add", "Subtract", "Multiply", "Average", "Maximum",

@@ -1154,7 +1154,7 @@ class TestKerasLayoutGuards:
             layers.Flatten(name="f"),
             layers.Dense(2, name="d"),
         ])
-        with pytest.raises(ImportException, match="sequence/conv"):
+        with pytest.raises(ImportException, match="conv tensor"):
             self._import(m, tmp_path, "perm_conv")
 
     def test_repeat_vector_flatten_golden(self, tmp_path):
@@ -1232,4 +1232,95 @@ class TestKerasFunctionalSequenceFlatten:
         net = import_keras_model_and_weights(path)
         res = net.output(x)
         res = (res[0] if isinstance(res, (list, tuple)) else res).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    def test_sequential_reshape_on_sequence(self, tmp_path):
+        """Sequential Reshape directly on an RNN sequence output: the
+        importer aligns the layout first, then reshapes — golden-exact
+        (previously rejected)."""
+        from keras import layers
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_sequential_model_and_weights
+        rs = np.random.RandomState(13)
+        m = keras.Sequential([
+            keras.Input((6, 4)),
+            layers.SimpleRNN(4, return_sequences=True, name="r"),
+            layers.Reshape((12, 2), name="rs"),
+            layers.Flatten(name="f"),
+            layers.Dense(3, name="d"),
+        ])
+        x = rs.randn(2, 6, 4).astype(np.float32)
+        golden = m.predict(x, verbose=0)
+        path = str(tmp_path / "seq_reshape.h5")
+        m.save(path)
+        net = import_keras_sequential_model_and_weights(path)
+        res = net.output(x.transpose(0, 2, 1)).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    def test_sequential_reshape_then_rnn(self, tmp_path):
+        """The reviewer's repro: Reshape output (keras layout) feeding a
+        temporal layer must be re-aligned to [B,F,T] — previously imported
+        with silently wrong numbers (0.106 max diff)."""
+        from keras import layers
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_sequential_model_and_weights
+        rs = np.random.RandomState(14)
+        m = keras.Sequential([
+            keras.Input((4, 4)),
+            layers.SimpleRNN(4, return_sequences=True, name="r"),
+            layers.Reshape((4, 4), name="rs"),
+            layers.LSTM(3, name="l"),
+            layers.Dense(2, name="d"),
+        ])
+        x = rs.randn(2, 4, 4).astype(np.float32)
+        golden = m.predict(x, verbose=0)
+        path = str(tmp_path / "reshape_rnn.h5")
+        m.save(path)
+        net = import_keras_sequential_model_and_weights(path)
+        res = net.output(x.transpose(0, 2, 1)).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    def test_functional_reshape_on_sequence(self, tmp_path):
+        """Functional parity with the Sequential Reshape-on-sequence
+        treatment."""
+        from keras import layers
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_model_and_weights
+        rs = np.random.RandomState(15)
+        inp = keras.Input((6, 4), name="in1")
+        seq = layers.SimpleRNN(4, return_sequences=True, name="r")(inp)
+        rsh = layers.Reshape((12, 2), name="rs")(seq)
+        flat = layers.Flatten(name="f")(rsh)
+        out = layers.Dense(3, name="d")(flat)
+        m = keras.Model(inp, out)
+        x = rs.randn(2, 6, 4).astype(np.float32)
+        golden = m.predict(x, verbose=0)
+        path = str(tmp_path / "func_reshape_seq.h5")
+        m.save(path)
+        net = import_keras_model_and_weights(path)
+        res = net.output(x.transpose(0, 2, 1))
+        res = (res[0] if isinstance(res, (list, tuple)) else res).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    def test_simple_rnn_last_step_and_temporal_consumer(self, tmp_path):
+        """SimpleRNN(return_sequences=False) takes the last timestep (was
+        unwrapped — every downstream shape silently broke), and a
+        Reshape-fed SimpleRNN realigns its input layout."""
+        from keras import layers
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_sequential_model_and_weights
+        rs = np.random.RandomState(16)
+        m = keras.Sequential([
+            keras.Input((8, 3)),
+            layers.GRU(6, return_sequences=True, name="g"),
+            layers.Reshape((16, 3), name="rs"),
+            layers.SimpleRNN(5, name="sr"),
+            layers.Dense(2, activation="softmax", name="d"),
+        ])
+        x = rs.randn(2, 8, 3).astype(np.float32)
+        golden = m.predict(x, verbose=0)
+        path = str(tmp_path / "rnn_last.h5")
+        m.save(path)
+        net = import_keras_sequential_model_and_weights(path)
+        res = net.output(x.transpose(0, 2, 1)).numpy()
         np.testing.assert_allclose(res, golden, atol=1e-5)
