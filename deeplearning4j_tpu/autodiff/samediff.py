@@ -304,11 +304,29 @@ class SameDiff:
             result = node.fn(*vals, **kwargs)
         except Exception:
             return
+        self._bind_outputs(node, result, self._eager_vals)
+
+    @staticmethod
+    def _bind_outputs(node: "SameDiffOp", result, env: Dict[str, Any]):
+        """Store an op's result under its declared output names, raising on
+        any arity mismatch (a silent zip would slice rows instead)."""
         if len(node.outputs) == 1:
-            self._eager_vals[node.outputs[0]] = result
+            if isinstance(result, (tuple, list)):
+                raise ValueError(
+                    f"op '{node.name}' ({node.op_name}) declares 1 output "
+                    f"but returned {len(result)} values; record it with "
+                    f"n_outputs={len(result)}")
+            env[node.outputs[0]] = result
         else:
+            if (not isinstance(result, (tuple, list))
+                    or len(result) != len(node.outputs)):
+                got = (len(result) if isinstance(result, (tuple, list))
+                       else f"a single {type(result).__name__}")
+                raise ValueError(
+                    f"op '{node.name}' ({node.op_name}) declares "
+                    f"{len(node.outputs)} outputs but returned {got}")
             for oname, r in zip(node.outputs, result):
-                self._eager_vals[oname] = r
+                env[oname] = r
 
     def eager_arr(self, name: str) -> Optional[NDArray]:
         """The eagerly computed value for a variable, if one exists."""
@@ -392,32 +410,44 @@ class SameDiff:
         return self.constant(x)
 
     def _record(self, op_name: str, inputs: Sequence[SDVariable],
-                n_outputs: int = 1, out_name: str = None, **kwargs) -> Union[
+                n_outputs: int = 1, out_name: str = None,
+                out_names: Sequence[str] = None, **kwargs) -> Union[
                     SDVariable, Tuple[SDVariable, ...]]:
         """Record a registered op as a graph node."""
         opdef = OpRegistry.get().lookup(op_name)
         OpRegistry.get().mark_executed(opdef.name)
         return self._record_fn(opdef.fn, inputs, label=op_name,
-                               n_outputs=n_outputs, out_name=out_name, **kwargs)
+                               n_outputs=n_outputs, out_name=out_name,
+                               out_names=out_names, **kwargs)
 
     def _record_fn(self, fn: Callable, inputs: Sequence[SDVariable],
                    label: str = "fn", n_outputs: int = 1, out_name: str = None,
-                   needs_key: bool = False, **kwargs):
+                   out_names: Sequence[str] = None, needs_key: bool = False,
+                   **kwargs):
         node_name = self._unique_name(label)
-        out_names = []
+        if out_names is not None:
+            if len(out_names) != n_outputs:
+                raise ValueError(
+                    f"out_names has {len(out_names)} entries for "
+                    f"n_outputs={n_outputs}")
+            bases = list(out_names)
+        else:
+            bases = [out_name if (out_name and n_outputs == 1) else
+                     (f"{out_name}_{i}" if out_name else
+                      (node_name if n_outputs == 1 else f"{node_name}:{i}"))
+                     for i in range(n_outputs)]
+        names = []
         outs = []
-        for i in range(n_outputs):
-            base = out_name if (out_name and n_outputs == 1) else \
-                (f"{out_name}_{i}" if out_name else
-                 (node_name if n_outputs == 1 else f"{node_name}:{i}"))
+        for i, base in enumerate(bases):
             oname = self._unique_name(base) if base in self._vars else base
             if oname in self._vars:
                 oname = self._unique_name(base)
             v = SDVariable(self, oname, VariableType.ARRAY)
             self._vars[oname] = v
             self._producer[oname] = (node_name, i)
-            out_names.append(oname)
+            names.append(oname)
             outs.append(v)
+        out_names = names
         node = SameDiffOp(node_name, label, fn,
                           [v.name if v is not None else None for v in inputs],
                           out_names, kwargs, needs_key=needs_key)
@@ -461,11 +491,7 @@ class SameDiff:
                 key, sub = jax.random.split(key)
                 kwargs["key"] = sub
             result = node.fn(*args, **kwargs)
-            if len(node.outputs) == 1:
-                env[node.outputs[0]] = result
-            else:
-                for oname, r in zip(node.outputs, result):
-                    env[oname] = r
+            self._bind_outputs(node, result, env)
         return [env[r] for r in requested]
 
     def _dependencies(self, requested: Sequence[str],

@@ -219,7 +219,7 @@ class NativeBatchDataSetIterator(DataSetIterator):
 
     def __init__(self, features, labels, batch_size: int,
                  shuffle: bool = True, seed: int = 0, n_slots: int = 4,
-                 drop_last: bool = False):
+                 drop_last: bool = True):
         import numpy as _np
         self._x = _np.asarray(features.numpy() if hasattr(features, "numpy")
                               else features, _np.float32)
@@ -229,11 +229,19 @@ class NativeBatchDataSetIterator(DataSetIterator):
         self.shuffle = shuffle
         self.seed = seed
         self.n_slots = n_slots
-        #: False (reference DataSetIterator contract): a trailing partial
-        #: batch is emitted. True restores fixed-shape batches — use when
-        #: feeding code jitted on a fixed batch dimension (e.g. to keep the
-        #: fit fast path's whole-epoch scan, which needs uniform shapes).
+        #: True (default): every batch has exactly ``batch_size`` rows —
+        #: required by code jitted on a fixed batch dimension (the fit fast
+        #: path's whole-epoch scan needs uniform shapes). Pass False to opt
+        #: into the reference DataSetIterator contract, which emits a
+        #: trailing partial batch (expect a one-off recompile on the ragged
+        #: shape). Default flipped False->True in r4 — see MIGRATING.md.
         self.drop_last = drop_last
+        if drop_last and self._x.shape[0] < self.batch_size:
+            raise ValueError(
+                f"dataset has {self._x.shape[0]} rows < batch_size="
+                f"{self.batch_size}: with drop_last=True (the default) the "
+                f"iterator would yield zero batches; lower batch_size or "
+                f"pass drop_last=False")
         self._epoch = 0
         self._it = None
         self.reset()

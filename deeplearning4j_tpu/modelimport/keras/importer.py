@@ -936,6 +936,10 @@ class KerasModelImport:
             if cls == "Flatten" and cur is not None and len(cur) in (3, 4):
                 conv_src = cur
             if cls == "Flatten" and cur is not None and len(cur) == 2:
+                if any(s is None for s in cur):
+                    raise ImportException(
+                        "Flatten on a variable-length sequence is "
+                        "unsupported (timestep dim is None)")
                 # keras flattens [B,T,F]; our tensor may be [B,F,T] — line
                 # the axes up first so element order matches the golden
                 if transposed:

@@ -41,13 +41,17 @@ def _flat_array(t) -> np.ndarray:
     """Decode a FlatArray table: nd4j shapeInfo + raw byte buffer.
 
     shapeInfo layout (libnd4j ``shape.h``): ``[rank, *shape, *strides,
-    extras, elementWiseStride, order]`` — only rank/shape matter here since
-    buffers are written dense in the stated order.
+    extras, elementWiseStride, order]``.  The reference writes the raw
+    buffer in the array's own ordering (``BaseNDArray.toFlatArray`` dups
+    with ``this.ordering()``), so the trailing order char (99='c',
+    102='f') decides how the dense buffer maps onto the shape.
     """
-    info = _vec_i64(t, 0)
-    buf = _vec_bytes(t, 1)
-    dt = _i8(t, 2, 5)
-    order = _i8(t, 3, 0)  # ByteOrder: 0=LE, 1=BE
+    return _decode_flat_array(_vec_i64(t, 0), _vec_bytes(t, 1),
+                              _i8(t, 2, 5), _i8(t, 3, 0))
+
+
+def _decode_flat_array(info, buf, dt, order) -> np.ndarray:
+    """Pure decode: (shapeInfo, buffer, DType enum, ByteOrder) -> ndarray."""
     np_dt = _DTYPES.get(dt)
     if np_dt is None:
         raise ValueError(f"unsupported FlatArray dtype enum {dt}")
@@ -59,7 +63,16 @@ def _flat_array(t) -> np.ndarray:
     n = int(np.prod(shape)) if shape else 1
     if arr.size < n:
         raise ValueError(f"FlatArray buffer too small: {arr.size} < {n}")
-    return arr[:n].reshape(shape)
+    mem_order = "C"
+    if rank > 1 and len(info) >= 2 * rank + 4:
+        order_char = int(info[-1])
+        if order_char == 102:
+            mem_order = "F"
+        elif order_char not in (99, 0):
+            raise ValueError(
+                f"unrecognized shapeInfo order char {order_char} "
+                f"(expected 99 'c' or 102 'f')")
+    return np.asarray(arr[:n].reshape(shape, order=mem_order), order="C")
 
 
 # ---------------------------------------------------------------------------
@@ -206,7 +219,6 @@ class SameDiffFbImport:
         self.sd = SameDiff()
         # (node_id, out_idx) -> SDVariable
         self._by_id: Dict[Tuple[int, int], SDVariable] = {}
-        self._by_name: Dict[str, SDVariable] = {}
 
     def convert(self) -> SameDiff:
         from ..ops.registry import OpRegistry
@@ -229,7 +241,6 @@ class SameDiffFbImport:
             else:
                 continue
             self._by_id[v.id] = var
-            self._by_name[v.name] = var
 
         for node in self._topo_order():
             ins = []
@@ -254,17 +265,21 @@ class SameDiffFbImport:
             if not reg.has(reg_name):
                 raise ValueError(
                     f"node '{node.name}': op '{reg_name}' not registered")
-            out_name = node.output_names[0] if node.output_names else node.name
+            out_names = list(node.output_names) or [node.name]
             if node.scalar is not None and not ins:
-                out = self.sd.constant(np.asarray(node.scalar), name=out_name)
+                out = self.sd.constant(np.asarray(node.scalar),
+                                       name=out_names[0])
+                outs = (out,)
             else:
                 if node.scalar is not None:
                     ins.append(self.sd.constant(np.asarray(node.scalar),
                                                 name=f"{node.name}_scalar"))
-                out = self.sd._record(reg_name, ins, out_name=out_name,
-                                      **kwargs)
-            self._by_id[(node.id, 0)] = out
-            self._by_name[out_name] = out
+                out = self.sd._record(reg_name, ins,
+                                      n_outputs=len(out_names),
+                                      out_names=out_names, **kwargs)
+                outs = out if isinstance(out, tuple) else (out,)
+            for i, v in enumerate(outs):
+                self._by_id[(node.id, i)] = v
         return self.sd
 
     def _topo_order(self) -> List[FlatNodeRec]:

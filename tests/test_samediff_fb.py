@@ -79,3 +79,91 @@ def test_trainable_variables_preserved():
     sd = load_samediff_fb(FIXTURE)
     trainable = {v.name for v in sd.trainable_variables()}
     assert {"w0", "w1"} <= trainable
+
+
+# --- decode + multi-output paths not exercised by the reference fixture ----
+
+def test_flat_array_f_order():
+    """shapeInfo order char 102 ('f'): buffer is Fortran-laid-out.
+
+    The reference writes the raw buffer in the array's own ordering
+    (BaseNDArray.toFlatArray), so an 'f'-ordered VARIABLE must decode to the
+    same logical values as its 'c'-ordered twin."""
+    from deeplearning4j_tpu.modelimport.samediff_fb import _decode_flat_array
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    # [rank, *shape, *strides, extras, ews, order]
+    info_c = [2, 2, 3, 3, 1, 0, 1, 99]
+    info_f = [2, 2, 3, 1, 2, 0, 1, 102]
+    got_c = _decode_flat_array(info_c, a.tobytes(order="C"), 5, 0)
+    got_f = _decode_flat_array(info_f, a.tobytes(order="F"), 5, 0)
+    np.testing.assert_array_equal(got_c, a)
+    np.testing.assert_array_equal(got_f, a)
+    assert got_f.flags["C_CONTIGUOUS"]
+    with pytest.raises(ValueError, match="order char"):
+        _decode_flat_array([2, 2, 3, 3, 1, 0, 1, 77],
+                           a.tobytes(order="C"), 5, 0)
+
+
+def _synthetic_graph(nodes, variables, placeholders):
+    from deeplearning4j_tpu.modelimport.samediff_fb import FlatGraphFile
+    g = FlatGraphFile.__new__(FlatGraphFile)
+    g.graph_id = 0
+    g.variables = variables
+    g.nodes = nodes
+    g.placeholders = placeholders
+    g.loss_variables = []
+    g.training_config = None
+    return g
+
+
+def _node(nid, name, op_name, inputs, output_names):
+    from deeplearning4j_tpu.modelimport.samediff_fb import FlatNodeRec
+    n = FlatNodeRec.__new__(FlatNodeRec)
+    n.id, n.name, n.op_type, n.op_num = nid, name, 0, 0
+    n.inputs = inputs
+    n.t_args, n.i_args, n.b_args, n.dimensions = [], [], [], []
+    n.output_names = output_names
+    n.op_name = op_name
+    n.scalar = None
+    return n
+
+
+def _var(vid, name, var_type, array=None, shape=None):
+    from deeplearning4j_tpu.modelimport.samediff_fb import FlatVariableRec
+    v = FlatVariableRec.__new__(FlatVariableRec)
+    v.id, v.name, v.dtype = (vid, 0), name, 5
+    v.shape = list(shape or (array.shape if array is not None else ()))
+    v.array = array
+    v.var_type = var_type
+    return v
+
+
+def test_multi_output_node_all_indices_consumable():
+    """A two-output op ('moments') registers (id,0) AND (id,1); a downstream
+    node can consume output index 1, and output names come from the file."""
+    from deeplearning4j_tpu.modelimport.samediff_fb import SameDiffFbImport
+    nodes = [
+        _node(2, "mom", "moments", [(1, 0)], ["mom_mean", "mom_var"]),
+        _node(3, "out", "sqrt", [(2, 1)], ["std"]),
+    ]
+    variables = [_var(1, "x", 3, shape=(2, 3))]
+    sd = SameDiffFbImport(_synthetic_graph(nodes, variables, ["x"])).convert()
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = sd.output({"x": x}, ["mom_mean", "mom_var", "std"])
+    np.testing.assert_allclose(float(out["mom_mean"].numpy()), x.mean(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(out["mom_var"].numpy()), x.var(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(out["std"].numpy()), x.std(), rtol=1e-6)
+
+
+def test_multi_output_arity_mismatch_is_loud():
+    """A node claiming 2 outputs from a 1-output op fails with a clear
+    error instead of silently slicing rows."""
+    from deeplearning4j_tpu.modelimport.samediff_fb import SameDiffFbImport
+    nodes = [_node(2, "t", "tanh", [(1, 0)], ["t0", "t1"])]
+    variables = [_var(1, "x", 3, shape=(2, 3))]
+    sd = SameDiffFbImport(_synthetic_graph(nodes, variables, ["x"])).convert()
+    x = np.ones((2, 3), np.float32)
+    with pytest.raises(ValueError, match="declares 2 outputs"):
+        sd.output({"x": x}, ["t0"])
