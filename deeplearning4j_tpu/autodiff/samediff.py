@@ -548,8 +548,15 @@ class SameDiff:
         out_names = [o.name if isinstance(o, SDVariable) else o for o in outputs]
         ph = {k: (v.jax() if isinstance(v, NDArray) else jnp.asarray(v))
               for k, v in (placeholders or {}).items()}
-        fn = self.make_function(out_names, tuple(sorted(ph)))
-        results = fn(self._arrays, ph)
+        if any(op.needs_key for op in self._ops.values()):
+            fn = self.make_function(out_names, tuple(sorted(ph)),
+                                    with_rng=True)
+            self._rng_calls = getattr(self, "_rng_calls", 0) + 1
+            results = fn(self._arrays, ph,
+                         jax.random.key(self._rng_seed + self._rng_calls))
+        else:
+            fn = self.make_function(out_names, tuple(sorted(ph)))
+            results = fn(self._arrays, ph)
         return {n: NDArray(r) for n, r in zip(out_names, results)}
 
     def batch_output(self, placeholders=None, outputs=None):

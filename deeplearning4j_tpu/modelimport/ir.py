@@ -74,6 +74,20 @@ def get_mapper(framework: str, op_type: str) -> Optional[Callable]:
     return _MAPPERS.get(framework, {}).get(op_type)
 
 
+def unmapped_error(framework: str, unmapped) -> "ImportException":
+    """Unmapped-op error, annotated with documented exemption reasons."""
+    unmapped = sorted(unmapped)
+    try:
+        from .coverage import ONNX_EXEMPT, TF_EXEMPT
+        exempt = TF_EXEMPT if framework == "tensorflow" else ONNX_EXEMPT
+    except Exception:
+        exempt = {}
+    notes = [f"{t}: {exempt[t]}" for t in unmapped if t in exempt]
+    return ImportException(
+        f"no {framework} mapping rule for op type(s): {unmapped}"
+        + ("".join(f"\n  - {n}" for n in notes) if notes else ""))
+
+
 def supported_ops(framework: str) -> List[str]:
     return sorted(_MAPPERS.get(framework, {}))
 
@@ -207,8 +221,7 @@ def run_import(graph: IRGraph, sd: Optional[SameDiff] = None,
     unmapped = sorted({n.op_type for n in graph.nodes
                        if get_mapper(graph.framework, n.op_type) is None})
     if unmapped:
-        raise ImportException(
-            f"no {graph.framework} mapping rule for op type(s): {unmapped}")
+        raise unmapped_error(graph.framework, unmapped)
     for node in graph.nodes:
         fn = get_mapper(graph.framework, node.op_type)
         fn(node, ctx)

@@ -14,6 +14,7 @@ from ...autodiff.samediff import SameDiff
 from ..ir import ImportContext, ImportException, get_mapper
 from ..tf.importer import ImportedGraph, _toposort
 from . import mappings  # noqa: F401 — registers the mapping rules
+from . import mappings_extra  # noqa: F401 — long-tail ruleset coverage
 from .parser import parse_model
 
 
@@ -33,8 +34,8 @@ class OnnxImporter:
         unmapped = sorted({n.op_type for n in g.nodes
                            if get_mapper("onnx", n.op_type) is None})
         if unmapped:
-            raise ImportException(
-                f"no onnx mapping rule for op type(s): {unmapped}")
+            from ..ir import unmapped_error
+            raise unmapped_error("onnx", unmapped)
         ctx = ImportContext(g, sd, import_weights_as_variables)
         inputs = {}
         for name, (shape, dtype) in g.inputs.items():

@@ -197,6 +197,10 @@ _CONVERTERS = {
     "norm2": _reduction("reduce_norm2"),
     "argmax": _reduction("argmax"),
     "argmin": _reduction("argmin"),
+    # reference gruCell declares 4 outputs (r, u, c, h); the 1-output
+    # registry 'gruCell' is the h-only convenience, so route to the
+    # full-output port
+    "gruCell": lambda node: ("gru_block_cell", {}),
 }
 
 # Legacy nodes (opType != CUSTOM) sometimes omit opName; resolve the few

@@ -21,6 +21,7 @@ from ...autodiff.samediff import SameDiff
 from ...ndarray.ndarray import NDArray
 from ..ir import (IRGraph, IRNode, ImportContext, ImportException, get_mapper)
 from . import mappings  # noqa: F401 — registers the mapping rules
+from . import mappings_extra  # noqa: F401 — long-tail ruleset coverage
 from .parser import parse_graphdef, _np_dtype
 from .slicing import build_index_spec, apply_spec_np
 
@@ -197,8 +198,8 @@ class TFGraphImporter:
                            and n.op_type not in _FOLD
                            and n.op_type != "_TF1WhileFrame"})
         if unmapped:
-            raise ImportException(
-                f"no tensorflow mapping rule for op type(s): {unmapped}")
+            from ..ir import unmapped_error
+            raise unmapped_error("tensorflow", unmapped)
         ctx = ImportContext(g, sd, import_weights_as_variables)
         inputs = {}
         for name, (shape, dtype) in g.inputs.items():

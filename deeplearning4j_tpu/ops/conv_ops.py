@@ -160,27 +160,35 @@ def deconv3d(x, weights, bias=None, strides=(1, 1, 1), padding="SAME",
 
 @op("dilation2d", "conv")
 def dilation2d(x, weights, strides=(1, 1), rates=(1, 1), padding="SAME"):
-    """Grayscale morphological dilation (NHWC, weights [kH,kW,C])."""
+    """Grayscale morphological dilation (NHWC, weights [kH,kW,C]).
+
+    TF SAME padding with strides: out = ceil(in/s), pad_total =
+    max((out-1)*s + effective_k - in, 0), pad_lo = pad_total // 2, where
+    effective_k = (k-1)*rate + 1 — NOT the stride-1 total subsampled.
+    """
     kh, kw, c = weights.shape
-    pads = ((0, 0), (kh // 2, kh // 2), (kw // 2, kw // 2), (0, 0)) \
-        if padding.upper() == "SAME" else ((0, 0),) * 4
-    init = -jnp.inf
-
-    def reducer(acc, v):
-        return jnp.maximum(acc, v)
-
-    padded = jnp.pad(x, pads, constant_values=init)
-    outs = []
+    sh, sw = strides
+    ekh = (kh - 1) * rates[0] + 1
+    ekw = (kw - 1) * rates[1] + 1
+    H, W = x.shape[1], x.shape[2]
+    if padding.upper() == "SAME":
+        oh, ow = -(-H // sh), -(-W // sw)
+        pth = max((oh - 1) * sh + ekh - H, 0)
+        ptw = max((ow - 1) * sw + ekw - W, 0)
+        pads = ((0, 0), (pth // 2, pth - pth // 2),
+                (ptw // 2, ptw - ptw // 2), (0, 0))
+    else:
+        oh, ow = (H - ekh) // sh + 1, (W - ekw) // sw + 1
+        pads = ((0, 0),) * 4
+    padded = jnp.pad(x, pads, constant_values=-jnp.inf)
+    out = None
     for i in range(kh):
         for j in range(kw):
-            sl = padded[:, i * rates[0]:, j * rates[1]:, :]
-            sl = sl[:, :x.shape[1] if padding.upper() == "SAME" else x.shape[1] - kh + 1,
-                    :x.shape[2] if padding.upper() == "SAME" else x.shape[2] - kw + 1, :]
-            outs.append(sl + weights[i, j])
-    out = outs[0]
-    for o in outs[1:]:
-        out = jnp.maximum(out, o)
-    return out[:, ::strides[0], ::strides[1], :]
+            r0, c0 = i * rates[0], j * rates[1]
+            sl = padded[:, r0:r0 + (oh - 1) * sh + 1:sh,
+                        c0:c0 + (ow - 1) * sw + 1:sw, :] + weights[i, j]
+            out = sl if out is None else jnp.maximum(out, sl)
+    return out
 
 
 # -- pooling ------------------------------------------------------------

@@ -94,12 +94,16 @@ def fake_quant_with_min_max_vars(x, min_val, max_val, num_bits=8,
     mn = jnp.asarray(min_val, x.dtype)
     mx = jnp.asarray(max_val, x.dtype)
     scale = (mx - mn) / (qmax - qmin)
-    zero = qmin - mn / scale
+    # zero point via inv-scale multiply, not division: XLA lowers x/s to
+    # x * (1/s) whose reciprocal rounding can push an exact half-integer
+    # (e.g. 127.5 for [-1.5, 1.5]) off the round-to-even nudge TF computes
+    inv_scale = (qmax - qmin) / (mx - mn)
+    zero = qmin - mn * inv_scale
     zero = jnp.clip(jnp.round(zero), qmin, qmax)
     nudged_min = (qmin - zero) * scale
     nudged_max = (qmax - zero) * scale
     clipped = jnp.clip(x, nudged_min, nudged_max)
-    q = jnp.round((clipped - nudged_min) / scale)
+    q = jnp.round((clipped - nudged_min) * inv_scale)
     return q * scale + nudged_min
 
 

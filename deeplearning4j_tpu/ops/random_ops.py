@@ -48,8 +48,11 @@ def random_poisson(key, shape, lam, dtype=jnp.int32):
 
 @op("random_multinomial", "random", differentiable=False)
 def random_multinomial(key, logits, num_samples, dtype=jnp.int32):
-    return jax.random.categorical(key, logits, axis=-1,
-                                  shape=(logits.shape[0], int(num_samples))).astype(dtype)
+    # categorical's `shape` must broadcast with logits' batch dims, so
+    # give each of the num_samples draws a singleton axis to fill
+    return jax.random.categorical(
+        key, logits[:, None, :], axis=-1,
+        shape=(logits.shape[0], int(num_samples))).astype(dtype)
 
 
 @op("random_shuffle", "random", differentiable=False)

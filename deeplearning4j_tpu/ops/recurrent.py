@@ -105,6 +105,25 @@ def gru_cell(x, h_prev, w_ru, w_c, b_ru=None, b_c=None):
     return u * h_prev + (1.0 - u) * c
 
 
+@op("gru_block_cell", "recurrent")
+def gru_block_cell(x, h_prev, w_ru, w_c, b_ru=None, b_c=None):
+    """gruCell with all four reference outputs (r, u, c, h) — the TF
+    GRUBlockCell port layout (reference gruCell declares 4 outputs)."""
+    xh = jnp.concatenate([x, h_prev], axis=-1)
+    ru = jnp.matmul(xh, w_ru)
+    if b_ru is not None:
+        ru = ru + b_ru
+    H = h_prev.shape[-1]
+    r = jax.nn.sigmoid(ru[..., :H])
+    u = jax.nn.sigmoid(ru[..., H:])
+    xrh = jnp.concatenate([x, r * h_prev], axis=-1)
+    c = jnp.matmul(xrh, w_c)
+    if b_c is not None:
+        c = c + b_c
+    c = jnp.tanh(c)
+    return r, u, c, u * h_prev + (1.0 - u) * c
+
+
 @op("gru", "recurrent")
 def gru(x, h0, w_ru, w_c, b_ru=None, b_c=None, time_major=False):
     if not time_major:

@@ -147,9 +147,13 @@ def histogram(x, bins):
 
 @op("histogram_fixed_width", "reduce", differentiable=False)
 def histogram_fixed_width(x, value_range, nbins=100):
-    h, _ = jnp.histogram(x, bins=int(nbins),
-                         range=(float(value_range[0]), float(value_range[1])))
-    return h
+    # TF semantics: out-of-range values clamp into the edge bins
+    # (jnp.histogram would drop them)
+    lo, hi = float(value_range[0]), float(value_range[1])
+    nbins = int(nbins)
+    idx = jnp.floor((x.ravel() - lo) / (hi - lo) * nbins).astype(jnp.int32)
+    idx = jnp.clip(idx, 0, nbins - 1)
+    return jnp.zeros(nbins, jnp.int32).at[idx].add(1)
 
 
 # -- reduce3 (pairwise distance reductions) -----------------------------

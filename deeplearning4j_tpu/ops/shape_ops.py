@@ -146,6 +146,16 @@ def scatter_nd_update(ref, indices, updates):
     return ref.at[tuple(jnp.moveaxis(indices, -1, 0))].set(updates)
 
 
+@op("scatter_nd_max", "scatter")
+def scatter_nd_max(ref, indices, updates):
+    return ref.at[tuple(jnp.moveaxis(indices, -1, 0))].max(updates)
+
+
+@op("scatter_nd_min", "scatter")
+def scatter_nd_min(ref, indices, updates):
+    return ref.at[tuple(jnp.moveaxis(indices, -1, 0))].min(updates)
+
+
 # -- slicing ------------------------------------------------------------
 @op("slice", "shape")
 def slice_op(x, begin, size):

@@ -52,6 +52,9 @@ op("atanh", "transforms")(jnp.arctanh)
 op("tf_atan2", "transforms", aliases=("atan2",))(jnp.arctan2)
 
 # -- special ------------------------------------------------------------
+op("isnan", "transforms", differentiable=False)(jnp.isnan)
+op("isinf", "transforms", differentiable=False)(jnp.isinf)
+op("isfinite", "transforms", differentiable=False)(jnp.isfinite)
 op("erf", "transforms")(jax.scipy.special.erf)
 op("erfc", "transforms")(jax.scipy.special.erfc)
 op("lgamma", "transforms")(jax.scipy.special.gammaln)

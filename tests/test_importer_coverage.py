@@ -1,0 +1,68 @@
+"""Importer coverage accounting vs the reference mapping rulesets.
+
+The `tests/test_op_parity.py` pattern applied to the importers: every
+`inputFrameworkOpName` in the reference's declarative rulesets must be
+mapped, handled structurally, or carry a documented exemption — and the
+covered fraction is enforced so mapper regressions fail loudly.
+
+Reference rulesets:
+  nd4j/samediff-import/samediff-import-tensorflow/src/main/resources/
+    tensorflow-mapping-ruleset.pbtxt (306 unique framework ops)
+  nd4j/samediff-import/samediff-import-onnx/src/main/resources/
+    onnx-mapping-ruleset.pbtxt (121 unique framework ops)
+"""
+import os
+
+import pytest
+
+from deeplearning4j_tpu.modelimport import coverage
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(coverage.TF_RULESET),
+    reason="reference rulesets not present")
+
+
+class TestTFCoverage:
+    def test_every_ruleset_op_accounted(self):
+        r = coverage.report("tensorflow")
+        print(f"\nTF ruleset coverage: {r['covered_pct']}% mapped/"
+              f"structural, {r['accounted_pct']}% accounted "
+              f"({len(r['mapped'])} mapped, {len(r['structural'])} "
+              f"structural, {len(r['exempt'])} exempt of "
+              f"{r['ruleset_total']})")
+        assert not r["missing"], (
+            f"unaccounted TF ruleset ops (map them or add a documented "
+            f"exemption in modelimport/coverage.py): {r['missing']}")
+
+    def test_covered_fraction_enforced(self):
+        r = coverage.report("tensorflow")
+        assert r["covered_pct"] >= 85.0, r["covered_pct"]
+        assert r["accounted_pct"] == 100.0
+
+    def test_exemptions_are_bounded_and_reasoned(self):
+        r = coverage.report("tensorflow")
+        # exemptions must stay a small, explained tail — not a dumping
+        # ground (TensorArray family alone is 20 names)
+        assert len(r["exempt"]) <= 35
+        assert all(len(reason) > 10 for reason in r["exempt"].values())
+
+
+class TestOnnxCoverage:
+    def test_every_ruleset_op_accounted(self):
+        r = coverage.report("onnx")
+        print(f"\nONNX ruleset coverage: {r['covered_pct']}% mapped/"
+              f"structural, {r['accounted_pct']}% accounted "
+              f"({len(r['mapped'])} mapped, {len(r['exempt'])} exempt of "
+              f"{r['ruleset_total']})")
+        assert not r["missing"], (
+            f"unaccounted ONNX ruleset ops: {r['missing']}")
+
+    def test_covered_fraction_enforced(self):
+        r = coverage.report("onnx")
+        assert r["covered_pct"] >= 85.0, r["covered_pct"]
+        assert r["accounted_pct"] == 100.0
+
+    def test_exemptions_are_bounded_and_reasoned(self):
+        r = coverage.report("onnx")
+        assert len(r["exempt"]) <= 12
+        assert all(len(reason) > 10 for reason in r["exempt"].values())

@@ -157,6 +157,36 @@ def test_multi_output_node_all_indices_consumable():
     np.testing.assert_allclose(float(out["std"].numpy()), x.std(), rtol=1e-6)
 
 
+def test_gru_cell_four_outputs():
+    """Reference gruCell declares 4 outputs (r, u, c, h); the converter
+    must route to the full-output gru_block_cell, not the h-only port."""
+    from deeplearning4j_tpu.modelimport.samediff_fb import SameDiffFbImport
+    In, H, B = 3, 4, 2
+    rs = np.random.RandomState(3)
+    nodes = [_node(5, "g", "gruCell", [(1, 0), (2, 0), (3, 0), (4, 0)],
+                   ["g_r", "g_u", "g_c", "g_h"])]
+    w_ru = rs.randn(In + H, 2 * H).astype(np.float32)
+    w_c = rs.randn(In + H, H).astype(np.float32)
+    variables = [_var(1, "x", 3, shape=(B, In)),
+                 _var(2, "h0", 3, shape=(B, H)),
+                 _var(3, "w_ru", 1, array=w_ru),
+                 _var(4, "w_c", 1, array=w_c)]
+    sd = SameDiffFbImport(
+        _synthetic_graph(nodes, variables, ["x", "h0"])).convert()
+    x = rs.randn(B, In).astype(np.float32)
+    h0 = np.zeros((B, H), np.float32)
+    out = sd.output({"x": x, "h0": h0}, ["g_r", "g_u", "g_c", "g_h"])
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    ru = np.concatenate([x, h0], -1) @ w_ru
+    r, u = sig(ru[:, :H]), sig(ru[:, H:])
+    c = np.tanh(np.concatenate([x, r * h0], -1) @ w_c)
+    h = u * h0 + (1 - u) * c
+    np.testing.assert_allclose(np.asarray(out["g_r"].numpy()), r, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["g_u"].numpy()), u, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["g_c"].numpy()), c, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["g_h"].numpy()), h, atol=1e-5)
+
+
 def test_multi_output_arity_mismatch_is_loud():
     """A node claiming 2 outputs from a 1-output op fails with a clear
     error instead of silently slicing rows."""

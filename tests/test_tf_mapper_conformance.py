@@ -1,0 +1,510 @@
+"""Golden conformance sweep for the long-tail TF mappers.
+
+Each case builds a tiny TF1 graph with `tf.raw_ops.*` (pinning the exact
+node op type the mapper registers for), runs it under TF for the golden,
+imports the frozen GraphDef, and compares — the `run-keras-tests.sh` /
+TFGraphTestAllSameDiff role (reference platform-tests) for the r4 mapper
+additions.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport import import_tf_graph
+
+tf = pytest.importorskip("tensorflow")
+tf1 = tf.compat.v1
+
+RS = np.random.RandomState(42)
+
+
+def run_case(build, inputs, atol=1e-5, rtol=1e-5, n_outputs=1,
+             input_dtypes=None, check=None):
+    """build(*placeholders) -> tensor or [tensors]; golden-compare all."""
+    g = tf.Graph()
+    with g.as_default():
+        phs = []
+        for i, arr in enumerate(inputs):
+            dt = (input_dtypes[i] if input_dtypes
+                  else tf.as_dtype(arr.dtype))
+            phs.append(tf1.placeholder(dt, arr.shape, name=f"x{i}"))
+        out = build(*phs)
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        outs = [tf.identity(o, name=f"out{i}") for i, o in enumerate(outs)]
+    pb = g.as_graph_def().SerializeToString()
+    feeds = {f"x{i}:0": a for i, a in enumerate(inputs)}
+    with tf1.Session(graph=g) as s:
+        golden = s.run([f"out{i}:0" for i in range(len(outs))], feeds)
+    imp = import_tf_graph(
+        pb, input_shapes={f"x{i}": a.shape for i, a in enumerate(inputs)},
+        outputs=[f"out{i}" for i in range(len(outs))])
+    res = imp.output({f"x{i}": a for i, a in enumerate(inputs)},
+                     [f"out{i}" for i in range(len(outs))])
+    for i, gold in enumerate(golden):
+        got = np.asarray(res[f"out{i}"].numpy())
+        if check is not None:
+            check(i, got, gold)
+        else:
+            np.testing.assert_allclose(got, gold, atol=atol, rtol=rtol,
+                                       err_msg=f"output {i}")
+
+
+F = lambda *shape: RS.randn(*shape).astype(np.float32)
+I32 = lambda *shape: RS.randint(0, 7, shape).astype(np.int32)
+
+
+class TestBitwisePredicates:
+    def test_bitwise(self):
+        a, b = I32(6), I32(6) + 1
+        run_case(lambda x, y: [tf.raw_ops.BitwiseAnd(x=x, y=y),
+                               tf.raw_ops.BitwiseOr(x=x, y=y),
+                               tf.raw_ops.BitwiseXor(x=x, y=y),
+                               tf.raw_ops.Invert(x=x)], [a, b])
+
+    def test_shifts(self):
+        a, s = I32(5), (I32(5) % 3)
+        run_case(lambda x, y: [tf.raw_ops.LeftShift(x=x, y=y),
+                               tf.raw_ops.RightShift(x=x, y=y)], [a, s])
+
+    def test_float_predicates(self):
+        x = np.array([1.0, np.inf, -np.inf, np.nan, 0.0], np.float32)
+        run_case(lambda v: [tf.raw_ops.IsFinite(x=v),
+                            tf.raw_ops.IsInf(x=v),
+                            tf.raw_ops.IsNan(x=v)], [x])
+
+    def test_approximate_equal(self):
+        a = F(8)
+        b = a + np.float32(1e-7)
+        b[:3] += 1.0
+        run_case(lambda x, y: tf.raw_ops.ApproximateEqual(
+            x=x, y=y, tolerance=1e-4), [a, b])
+
+    def test_clip_by_value(self):
+        run_case(lambda x, lo, hi: tf.raw_ops.ClipByValue(
+            t=x, clip_value_min=lo, clip_value_max=hi),
+            [F(4, 3), np.float32(-0.5), np.float32(0.5)])
+
+
+class TestLinalg:
+    def test_cholesky_inverse_det(self):
+        a = F(4, 4)
+        spd = (a @ a.T + 4 * np.eye(4)).astype(np.float32)
+        run_case(lambda x: [tf.raw_ops.Cholesky(input=x),
+                            tf.raw_ops.MatrixInverse(input=x),
+                            tf.raw_ops.MatrixDeterminant(input=x)],
+                 [spd], atol=1e-3, rtol=1e-3)
+
+    def test_log_matrix_determinant(self):
+        a = F(3, 3) + 3 * np.eye(3, dtype=np.float32)
+        run_case(lambda x: list(tf.raw_ops.LogMatrixDeterminant(input=x)),
+                 [a], atol=1e-4, rtol=1e-4)
+
+    def test_diag_family(self):
+        run_case(lambda x: [tf.raw_ops.MatrixDiag(diagonal=x),
+                            tf.raw_ops.Diag(diagonal=x)], [F(4)])
+        run_case(lambda x: tf.raw_ops.MatrixDiagPart(input=x), [F(4, 4)])
+
+    def test_matrix_set_diag_band_part(self):
+        run_case(lambda x, d: tf.raw_ops.MatrixSetDiag(
+            input=x, diagonal=d), [F(4, 4), F(4)])
+        run_case(lambda x: tf.raw_ops.MatrixBandPart(
+            input=x, num_lower=1, num_upper=1),
+            [F(5, 5)])
+
+    def test_solves(self):
+        a = F(3, 3) + 3 * np.eye(3, dtype=np.float32)
+        b = F(3, 2)
+        tril = np.tril(a)
+        run_case(lambda m, r: tf.raw_ops.MatrixSolve(
+            matrix=m, rhs=r, adjoint=False), [a, b], atol=1e-4)
+        run_case(lambda m, r: tf.raw_ops.MatrixTriangularSolve(
+            matrix=m, rhs=r, lower=True, adjoint=False), [tril, b],
+            atol=1e-4)
+
+    def test_svd_singular_values(self):
+        x = F(4, 3)
+
+        def chk(i, got, gold):
+            if i == 0:  # singular values: directly comparable
+                np.testing.assert_allclose(got, gold, atol=1e-4)
+            else:  # u/v: sign-ambiguous per column
+                np.testing.assert_allclose(np.abs(got), np.abs(gold),
+                                           atol=1e-4)
+
+        run_case(lambda v: list(tf.raw_ops.Svd(
+            input=v, compute_uv=True, full_matrices=False)), [x],
+            n_outputs=3, check=chk)
+
+    def test_cross(self):
+        run_case(lambda x, y: tf.raw_ops.Cross(a=x, b=y),
+                 [F(5, 3), F(5, 3)])
+
+    def test_special_functions(self):
+        a = np.abs(F(6)) + 0.5
+        b = np.abs(F(6)) + 0.5
+        x = np.clip(np.abs(F(6)), 0.1, 0.9).astype(np.float32)
+        run_case(lambda p, q, v: [tf.raw_ops.Igamma(a=p, x=q),
+                                  tf.raw_ops.Igammac(a=p, x=q),
+                                  tf.raw_ops.Betainc(a=p, b=q, x=v)],
+                 [a, b, x], atol=1e-4, rtol=1e-3)
+        run_case(lambda q: tf.raw_ops.Zeta(x=q + 2.0, q=q),
+                 [np.abs(F(5)).astype(np.float32) + 1.0], atol=1e-3,
+                 rtol=1e-3)
+
+
+class TestShapeOps:
+    def test_broadcast_to(self):
+        run_case(lambda x: tf.raw_ops.BroadcastTo(
+            input=x, shape=tf.constant([3, 4, 5])), [F(4, 1)])
+
+    def test_broadcast_args(self):
+        run_case(lambda: tf.raw_ops.BroadcastArgs(
+            s0=tf.constant([4, 1]), s1=tf.constant([3, 4, 5])), [])
+
+    def test_shape_n(self):
+        run_case(lambda a, b: list(tf.raw_ops.ShapeN(input=[a, b])),
+                 [F(2, 3), F(4,)])
+
+    def test_reverse_roll(self):
+        run_case(lambda x: tf.raw_ops.ReverseV2(
+            tensor=x, axis=tf.constant([0, 2])), [F(2, 3, 4)])
+        run_case(lambda x: tf.raw_ops.Roll(
+            input=x, shift=tf.constant([2]), axis=tf.constant([1])),
+            [F(3, 5)])
+
+    def test_reverse_sequence(self):
+        lens = np.array([1, 3, 2], np.int32)
+        run_case(lambda x, l: tf.raw_ops.ReverseSequence(
+            input=x, seq_lengths=l, seq_dim=1, batch_dim=0),
+            [F(3, 4, 2), lens])
+
+    def test_cumprod(self):
+        run_case(lambda x: tf.raw_ops.Cumprod(
+            x=x, axis=tf.constant(1), exclusive=True, reverse=False),
+            [F(3, 5)])
+
+    def test_depth_space(self):
+        x = F(2, 4, 4, 8)
+        run_case(lambda v: tf.raw_ops.DepthToSpace(
+            input=v, block_size=2), [x])
+        run_case(lambda v: tf.raw_ops.SpaceToDepth(
+            input=v, block_size=2), [x])
+
+    def test_batch_space_nd(self):
+        x = F(4, 2, 2, 3)
+        run_case(lambda v: tf.raw_ops.BatchToSpaceND(
+            input=v, block_shape=tf.constant([2, 2]),
+            crops=tf.constant([[0, 0], [0, 0]])), [x])
+        run_case(lambda v: tf.raw_ops.SpaceToBatchND(
+            input=v, block_shape=tf.constant([2, 2]),
+            paddings=tf.constant([[0, 0], [0, 0]])), [x])
+
+    def test_lin_space_bincount_histogram(self):
+        run_case(lambda: tf.raw_ops.LinSpace(
+            start=tf.constant(0.0), stop=tf.constant(1.0),
+            num=tf.constant(5)), [])
+        v = I32(10) % 5
+        run_case(lambda x: tf.raw_ops.Bincount(
+            arr=x, size=tf.constant(5),
+            weights=tf.constant([], tf.int32)), [v])
+        run_case(lambda x: tf.raw_ops.HistogramFixedWidth(
+            values=x, value_range=tf.constant([-2.0, 2.0]),
+            nbins=tf.constant(8)), [F(30)])
+
+    def test_bitcast(self):
+        run_case(lambda x: tf.raw_ops.Bitcast(
+            input=x, type=tf.int32), [F(6)])
+
+
+class TestScatterSegment:
+    def test_scatter_nd(self):
+        idx = np.array([[0], [2]], np.int32)
+        upd = F(2, 3)
+        run_case(lambda i, u: tf.raw_ops.ScatterNd(
+            indices=i, updates=u, shape=tf.constant([4, 3])), [idx, upd])
+
+    def test_tensor_scatter(self):
+        t = F(5, 3)
+        idx = np.array([[0], [3]], np.int32)
+        upd = F(2, 3)
+        run_case(lambda d, i, u: [
+            tf.raw_ops.TensorScatterAdd(tensor=d, indices=i, updates=u),
+            tf.raw_ops.TensorScatterSub(tensor=d, indices=i, updates=u),
+            tf.raw_ops.TensorScatterUpdate(tensor=d, indices=i, updates=u),
+            tf.raw_ops.TensorScatterMax(tensor=d, indices=i, updates=u),
+            tf.raw_ops.TensorScatterMin(tensor=d, indices=i, updates=u)],
+            [t, idx, upd])
+
+    def test_segment_ops(self):
+        # sorted Segment* output shape is data-dependent — the mapper
+        # requires constant ids, the usual shape in real exports
+        data = F(6, 3)
+        ids = np.array([0, 0, 1, 1, 1, 2], np.int32)
+        run_case(lambda d: [
+            tf.raw_ops.SegmentSum(data=d, segment_ids=tf.constant(ids)),
+            tf.raw_ops.SegmentMean(data=d, segment_ids=tf.constant(ids)),
+            tf.raw_ops.SegmentMax(data=d, segment_ids=tf.constant(ids)),
+            tf.raw_ops.SegmentMin(data=d, segment_ids=tf.constant(ids)),
+            tf.raw_ops.SegmentProd(data=d, segment_ids=tf.constant(ids))],
+            [data])
+
+    def test_unsorted_segment_ops(self):
+        data = F(6, 2)
+        ids = np.array([2, 0, 1, 0, 2, 1], np.int32)
+        run_case(lambda d, i: [
+            tf.raw_ops.UnsortedSegmentSum(
+                data=d, segment_ids=i, num_segments=tf.constant(3)),
+            tf.raw_ops.UnsortedSegmentMax(
+                data=d, segment_ids=i, num_segments=tf.constant(3)),
+            tf.raw_ops.UnsortedSegmentMin(
+                data=d, segment_ids=i, num_segments=tf.constant(3)),
+            tf.raw_ops.UnsortedSegmentProd(
+                data=d, segment_ids=i, num_segments=tf.constant(3))],
+            [data, ids])
+
+    def test_dynamic_partition_stitch(self):
+        # partition sizes are data-dependent — mapper requires const parts
+        data = F(6)
+        parts = np.array([0, 1, 0, 1, 0, 1], np.int32)
+        run_case(lambda d: list(tf.raw_ops.DynamicPartition(
+            data=d, partitions=tf.constant(parts), num_partitions=2)),
+            [data])
+        i0 = np.array([0, 2], np.int32)
+        i1 = np.array([1, 3], np.int32)
+        d0, d1 = F(2, 2), F(2, 2)
+        run_case(lambda a, b, c, d: tf.raw_ops.DynamicStitch(
+            indices=[a, b], data=[c, d]), [i0, i1, d0, d1])
+
+
+class TestImageOps:
+    def test_resize_bilinear_nearest(self):
+        x = F(1, 4, 4, 2)
+        run_case(lambda v: tf.raw_ops.ResizeBilinear(
+            images=v, size=tf.constant([8, 8]),
+            half_pixel_centers=True), [x], atol=1e-4)
+        run_case(lambda v: tf.raw_ops.ResizeNearestNeighbor(
+            images=v, size=tf.constant([8, 8]),
+            half_pixel_centers=True), [x])
+
+    def test_crop_and_resize(self):
+        img = F(1, 8, 8, 2)
+        boxes = np.array([[0.1, 0.1, 0.8, 0.9]], np.float32)
+        bi = np.array([0], np.int32)
+        run_case(lambda i, b, n: tf.raw_ops.CropAndResize(
+            image=i, boxes=b, box_ind=n, crop_size=tf.constant([4, 4])),
+            [img, boxes, bi], atol=1e-4)
+
+    def test_extract_image_patches(self):
+        run_case(lambda v: tf.raw_ops.ExtractImagePatches(
+            images=v, ksizes=[1, 2, 2, 1], strides=[1, 2, 2, 1],
+            rates=[1, 1, 1, 1], padding="VALID"), [F(1, 4, 4, 3)])
+
+    def test_color_ops(self):
+        x = np.clip(np.abs(F(1, 4, 4, 3)), 0, 1).astype(np.float32)
+        run_case(lambda v: tf.raw_ops.RGBToHSV(images=v), [x], atol=1e-4)
+        run_case(lambda v: tf.raw_ops.HSVToRGB(images=v), [x], atol=1e-4)
+        run_case(lambda v: [
+            tf.raw_ops.AdjustContrastv2(
+                images=v, contrast_factor=tf.constant(1.5)),
+            tf.raw_ops.AdjustSaturation(
+                images=v, scale=tf.constant(0.7)),
+            tf.raw_ops.AdjustHue(images=v, delta=tf.constant(0.1))],
+            [x], atol=1e-4)
+
+    def test_nms_v3_valid_prefix(self):
+        boxes = np.array([[0, 0, 1, 1], [0, 0, 1.05, 1.05],
+                          [0, 2, 1, 3], [0, 4, 1, 5]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
+
+        def chk(i, got, gold):
+            np.testing.assert_array_equal(got[:len(gold)], gold)
+            assert all(v == -1 for v in got[len(gold):])
+
+        run_case(lambda b, s: tf.raw_ops.NonMaxSuppressionV3(
+            boxes=b, scores=s, max_output_size=tf.constant(4),
+            iou_threshold=tf.constant(0.5),
+            score_threshold=tf.constant(0.0)),
+            [boxes, scores], check=chk)
+
+    def test_nms_v4_padded(self):
+        boxes = np.array([[0, 0, 1, 1], [0, 0, 1.05, 1.05],
+                          [0, 2, 1, 3]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        run_case(lambda b, s: list(tf.raw_ops.NonMaxSuppressionV4(
+            boxes=b, scores=s, max_output_size=tf.constant(3),
+            iou_threshold=tf.constant(0.5),
+            score_threshold=tf.constant(0.0),
+            pad_to_max_output_size=True))[:2],
+            [boxes, scores],
+            check=lambda i, got, gold: np.testing.assert_array_equal(
+                np.where(np.asarray(got) < 0, 0, got)
+                if i == 0 else got, gold))
+
+
+class TestQuantSelection:
+    def test_fake_quant(self):
+        x = F(4, 3) * 3
+        run_case(lambda v: tf.raw_ops.FakeQuantWithMinMaxArgs(
+            inputs=v, min=-2.0, max=2.0, num_bits=8), [x], atol=1e-5)
+        # frozen graphs carry min/max as consts — the static-nudge path
+        run_case(lambda v: tf.raw_ops.FakeQuantWithMinMaxVars(
+            inputs=v, min=tf.constant(-1.5), max=tf.constant(1.5),
+            num_bits=8), [x], atol=1e-5)
+
+    def test_top_k(self):
+        run_case(lambda v: list(tf.raw_ops.TopKV2(
+            input=v, k=tf.constant(3), sorted=True)), [F(2, 6)])
+
+    def test_in_top_k(self):
+        pred = F(4, 5)
+        targ = np.array([0, 1, 2, 3], np.int32)
+        run_case(lambda p, t: tf.raw_ops.InTopKV2(
+            predictions=p, targets=t, k=tf.constant(2)), [pred, targ],
+            input_dtypes=[tf.float32, tf.int32])
+
+    def test_nth_element(self):
+        run_case(lambda v: tf.raw_ops.NthElement(
+            input=v, n=tf.constant(2), reverse=False), [F(3, 6)])
+
+
+class TestNNOps:
+    def test_conv3d_pools(self):
+        x = F(1, 6, 6, 6, 2)
+        w = F(2, 2, 2, 2, 3)
+        run_case(lambda v, k: tf.raw_ops.Conv3D(
+            input=v, filter=k, strides=[1, 1, 1, 1, 1], padding="SAME"),
+            [x, w], atol=1e-4)
+        run_case(lambda v: [
+            tf.raw_ops.MaxPool3D(input=v, ksize=[1, 2, 2, 2, 1],
+                                 strides=[1, 2, 2, 2, 1], padding="VALID"),
+            tf.raw_ops.AvgPool3D(input=v, ksize=[1, 2, 2, 2, 1],
+                                 strides=[1, 2, 2, 2, 1], padding="VALID")],
+            [x])
+
+    def test_maxpool_v2_argmax(self):
+        x = F(1, 4, 4, 2)
+        run_case(lambda v: tf.raw_ops.MaxPoolV2(
+            input=v, ksize=tf.constant([1, 2, 2, 1]),
+            strides=tf.constant([1, 2, 2, 1]), padding="VALID"), [x])
+        # values golden; index flattening convention checked separately
+        run_case(lambda v: list(tf.raw_ops.MaxPoolWithArgmax(
+            input=v, ksize=[1, 2, 2, 1], strides=[1, 2, 2, 1],
+            padding="VALID"))[:1], [x])
+
+    def test_conv2d_backprop_input(self):
+        w = F(2, 2, 3, 4)
+        g = F(1, 4, 4, 4)
+        run_case(lambda k, dy: tf.raw_ops.Conv2DBackpropInput(
+            input_sizes=tf.constant([1, 8, 8, 3]), filter=k,
+            out_backprop=dy, strides=[1, 2, 2, 1], padding="SAME"),
+            [w, g], atol=1e-4)
+
+    def test_dilation2d(self):
+        run_case(lambda v, k: tf.raw_ops.Dilation2D(
+            input=v, filter=k, strides=[1, 1, 1, 1], rates=[1, 1, 1, 1],
+            padding="SAME"), [F(1, 5, 5, 2), F(2, 2, 2)], atol=1e-5)
+        # strided SAME: pad_total = (ceil(in/s)-1)*s + ek - in, not the
+        # stride-1 total subsampled
+        run_case(lambda v, k: tf.raw_ops.Dilation2D(
+            input=v, filter=k, strides=[1, 2, 2, 1], rates=[1, 1, 1, 1],
+            padding="SAME"), [F(1, 6, 6, 2), F(3, 3, 2)], atol=1e-5)
+        run_case(lambda v, k: tf.raw_ops.Dilation2D(
+            input=v, filter=k, strides=[1, 2, 2, 1], rates=[1, 2, 2, 1],
+            padding="SAME"), [F(1, 8, 8, 2), F(3, 3, 2)], atol=1e-5)
+        run_case(lambda v, k: tf.raw_ops.Dilation2D(
+            input=v, filter=k, strides=[1, 2, 2, 1], rates=[1, 1, 1, 1],
+            padding="VALID"), [F(1, 7, 7, 2), F(3, 3, 2)], atol=1e-5)
+
+    def test_lrn(self):
+        run_case(lambda v: tf.raw_ops.LRN(
+            input=v, depth_radius=2, bias=1.0, alpha=1e-3, beta=0.75),
+            [F(1, 3, 3, 8)], atol=1e-5)
+
+    def test_softmax_xent(self):
+        logits = F(4, 5)
+        labels = np.eye(4, 5, dtype=np.float32)
+        run_case(lambda lg, lb: list(
+            tf.raw_ops.SoftmaxCrossEntropyWithLogits(
+                features=lg, labels=lb)), [logits, labels], atol=1e-5)
+
+    def test_sparse_softmax_xent(self):
+        logits = F(4, 5)
+        labels = np.array([0, 2, 4, 1], np.int32)
+        run_case(lambda lg, lb: list(
+            tf.raw_ops.SparseSoftmaxCrossEntropyWithLogits(
+                features=lg, labels=lb)), [logits, labels],
+            input_dtypes=[tf.float32, tf.int32], atol=1e-5)
+
+
+class TestBlockRNN:
+    def test_lstm_block_cell(self):
+        B, In, H = 2, 3, 4
+        x, h, c = F(B, In), F(B, H), F(B, H)
+        w = F(In + H, 4 * H)
+        b = np.zeros(4 * H, np.float32)
+        wc = np.zeros(H, np.float32)
+        run_case(lambda xx, cc, hh, ww, bb: list(tf.raw_ops.LSTMBlockCell(
+            x=xx, cs_prev=cc, h_prev=hh, w=ww, wci=tf.constant(wc),
+            wcf=tf.constant(wc), wco=tf.constant(wc), b=bb,
+            forget_bias=1.0, cell_clip=-1.0, use_peephole=False)),
+            [x, c, h, w, b], atol=1e-5)
+
+    def test_block_lstm_h_sequence(self):
+        T, B, In, H = 5, 2, 3, 4
+        x = F(T, B, In)
+        h0, c0 = np.zeros((B, H), np.float32), np.zeros((B, H), np.float32)
+        w = F(In + H, 4 * H)
+        b = np.zeros(4 * H, np.float32)
+        wc = np.zeros(H, np.float32)
+        run_case(lambda xx, cc, hh, ww, bb: [tf.raw_ops.BlockLSTM(
+            seq_len_max=tf.constant(np.int64(T)), x=xx, cs_prev=cc,
+            h_prev=hh, w=ww, wci=tf.constant(wc), wcf=tf.constant(wc),
+            wco=tf.constant(wc), b=bb, forget_bias=1.0, cell_clip=-1.0,
+            use_peephole=False)[6]], [x, c0, h0, w, b], atol=1e-5)
+
+    def test_gru_block_cell(self):
+        B, In, H = 2, 3, 4
+        x, h = F(B, In), F(B, H)
+        w_ru, w_c = F(In + H, 2 * H), F(In + H, H)
+        b_ru, b_c = np.zeros(2 * H, np.float32), np.zeros(H, np.float32)
+        run_case(lambda xx, hh, wr, wc_, br, bc: list(
+            tf.raw_ops.GRUBlockCell(x=xx, h_prev=hh, w_ru=wr, w_c=wc_,
+                                    b_ru=br, b_c=bc)),
+            [x, h, w_ru, w_c, b_ru, b_c], atol=1e-5)
+
+
+class TestRandomOps:
+    """Random ops: distribution/shape checks (values are backend PRNG)."""
+
+    def test_random_uniform_normal_shapes(self):
+        g = tf.Graph()
+        with g.as_default():
+            u = tf.raw_ops.RandomUniform(
+                shape=tf.constant([64, 8]), dtype=tf.float32, name="u")
+            n = tf.raw_ops.RandomStandardNormal(
+                shape=tf.constant([64, 8]), dtype=tf.float32, name="n")
+            tf.identity(u, name="out0")
+            tf.identity(n, name="out1")
+        pb = g.as_graph_def().SerializeToString()
+        imp = import_tf_graph(pb, input_shapes={}, outputs=["out0", "out1"])
+        res = imp.output({}, ["out0", "out1"])
+        u_ = np.asarray(res["out0"].numpy())
+        n_ = np.asarray(res["out1"].numpy())
+        assert u_.shape == (64, 8) and n_.shape == (64, 8)
+        assert 0.0 <= u_.min() and u_.max() <= 1.0
+        assert 0.3 < u_.mean() < 0.7
+        assert abs(n_.mean()) < 0.3 and 0.7 < n_.std() < 1.3
+
+    def test_multinomial_range(self):
+        g = tf.Graph()
+        with g.as_default():
+            logits = tf1.placeholder(tf.float32, [2, 5], name="x0")
+            m = tf.raw_ops.Multinomial(
+                logits=logits, num_samples=tf.constant(16))
+            tf.identity(m, name="out0")
+        pb = g.as_graph_def().SerializeToString()
+        imp = import_tf_graph(pb, input_shapes={"x0": (2, 5)},
+                              outputs=["out0"])
+        res = imp.output({"x0": F(2, 5)}, ["out0"])
+        got = np.asarray(res["out0"].numpy())
+        assert got.shape == (2, 16)
+        assert got.min() >= 0 and got.max() < 5
