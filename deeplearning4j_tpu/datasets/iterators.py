@@ -219,7 +219,7 @@ class NativeBatchDataSetIterator(DataSetIterator):
 
     def __init__(self, features, labels, batch_size: int,
                  shuffle: bool = True, seed: int = 0, n_slots: int = 4,
-                 drop_last: bool = True):
+                 drop_last=None):
         import numpy as _np
         self._x = _np.asarray(features.numpy() if hasattr(features, "numpy")
                               else features, _np.float32)
@@ -235,8 +235,20 @@ class NativeBatchDataSetIterator(DataSetIterator):
         #: into the reference DataSetIterator contract, which emits a
         #: trailing partial batch (expect a one-off recompile on the ragged
         #: shape). Default flipped False->True in r4 — see MIGRATING.md.
-        self.drop_last = drop_last
-        if drop_last and self._x.shape[0] < self.batch_size:
+        defaulted = drop_last is None
+        self.drop_last = True if defaulted else drop_last
+        if (defaulted and self.drop_last
+                and self._x.shape[0] >= self.batch_size
+                and self._x.shape[0] % self.batch_size != 0):
+            import warnings
+            warnings.warn(
+                f"NativeBatchIterator: {self._x.shape[0] % self.batch_size} "
+                f"trailing rows (of {self._x.shape[0]}) are dropped per "
+                f"epoch under the drop_last=True default (differs from the "
+                f"reference DataSetIterator contract); pass drop_last=False "
+                f"to keep the partial batch, or drop_last=True to silence",
+                stacklevel=2)
+        if self.drop_last and self._x.shape[0] < self.batch_size:
             raise ValueError(
                 f"dataset has {self._x.shape[0]} rows < batch_size="
                 f"{self.batch_size}: with drop_last=True (the default) the "

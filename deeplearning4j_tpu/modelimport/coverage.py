@@ -18,15 +18,19 @@ Three buckets:
 """
 from __future__ import annotations
 
+import os
 import re
 from typing import Dict, List, Set
 
-TF_RULESET = ("/root/reference/nd4j/samediff-import/"
-              "samediff-import-tensorflow/src/main/resources/"
-              "tensorflow-mapping-ruleset.pbtxt")
-ONNX_RULESET = ("/root/reference/nd4j/samediff-import/"
-                "samediff-import-onnx/src/main/resources/"
-                "onnx-mapping-ruleset.pbtxt")
+# Reference checkout root: overridable so coverage accounting works on
+# any layout, not just this build image.
+REFERENCE_ROOT = os.environ.get("REFERENCE_ROOT", "/root/reference")
+TF_RULESET = os.path.join(
+    REFERENCE_ROOT, "nd4j/samediff-import/samediff-import-tensorflow/"
+    "src/main/resources/tensorflow-mapping-ruleset.pbtxt")
+ONNX_RULESET = os.path.join(
+    REFERENCE_ROOT, "nd4j/samediff-import/samediff-import-onnx/"
+    "src/main/resources/onnx-mapping-ruleset.pbtxt")
 
 # Handled below the mapping-rule layer.
 TF_STRUCTURAL: Dict[str, str] = {

@@ -80,7 +80,9 @@ def unmapped_error(framework: str, unmapped) -> "ImportException":
     try:
         from .coverage import ONNX_EXEMPT, TF_EXEMPT
         exempt = TF_EXEMPT if framework == "tensorflow" else ONNX_EXEMPT
-    except Exception:
+    except Exception as e:  # annotations are garnish; never mask the
+        import warnings      # unmapped-ops diagnostic — but don't be silent
+        warnings.warn(f"coverage exemption annotations unavailable: {e!r}")
         exempt = {}
     notes = [f"{t}: {exempt[t]}" for t in unmapped if t in exempt]
     return ImportException(

@@ -237,13 +237,31 @@ def _lin_space(node, ctx):
 
 @mapper(TF, "Bincount")
 def _bincount(node, ctx):
-    # Bincount(arr, size, weights): output length == size (static const)
+    # Bincount(arr, size, weights): output length == size (static const).
+    # Weights may be a runtime tensor; only *emptiness* must be static.
     arr = ctx.get(node.inputs[0])
     size = _const_i(ctx, node.inputs[1])
-    w = ctx.maybe_const(node.inputs[2]) if len(node.inputs) > 2 else None
     ins = [arr]
-    if w is not None and np.asarray(w).size > 0:
-        ins.append(ctx.get(node.inputs[2]))
+    out_dtype = None
+    if len(node.inputs) > 2:
+        w_aval = ctx.aval(node.inputs[2])
+        if w_aval is None:
+            raise ImportException(
+                "Bincount: cannot determine statically whether the weights "
+                f"input {node.inputs[2]!r} is empty (unknown shape); TF "
+                "treats empty weights as unweighted, which changes semantics")
+        if int(np.prod(w_aval.shape)) > 0:
+            ins.append(ctx.get(node.inputs[2]))
+        else:
+            # empty weights: unweighted counting, but the output dtype
+            # still follows T (the weights dtype)
+            out_dtype = np.dtype(w_aval.dtype).name
+    if out_dtype is not None and not np.issubdtype(
+            np.dtype(out_dtype), np.integer):
+        cnt = ctx.emit("bincount", ins, f"{node.name}__counts",
+                       minlength=size, maxlength=size)
+        ctx.emit("cast", [cnt], node.outputs[0], dtype=out_dtype)
+        return
     ctx.emit("bincount", ins, node.outputs[0], minlength=size,
              maxlength=size)
 
@@ -506,13 +524,21 @@ def _nms_v4(node, ctx):
     max_out = _const_i(ctx, node.inputs[2])
     iou = _const_f(ctx, node.inputs[3])
     score = _const_f(ctx, node.inputs[4]) if len(node.inputs) > 4 else -np.inf
-    idx = ctx.emit("non_max_suppression", [boxes, scores], node.outputs[0],
+    idx = ctx.emit("non_max_suppression", [boxes, scores],
+                   f"{node.name}__rawidx",
                    max_output_size=max_out, iou_threshold=iou,
                    score_threshold=score)
+    import jax as _jax
     zero = ctx.sd.constant(np.int32(0), f"{node.name}__zero")
+    # register the scalar's aval so downstream emits keep static shapes
+    ctx.bind(f"{node.name}__zero", zero,
+             aval=_jax.ShapeDtypeStruct((), np.int32))
     valid = ctx.emit("greater_equal", [idx, zero], f"{node.name}__valid")
     vi = ctx.emit("cast", [valid], f"{node.name}__vi", dtype="int32")
     ctx.emit("reduce_sum", [vi], f"{node.name}:1")
+    # TF pads with 0, not -1 (gather with padded indices must hit row 0,
+    # not wrap to the last row as a negative index would under JAX)
+    ctx.emit("maximum", [idx, zero], node.outputs[0])
 
 
 @mapper(TF, "NonMaxSuppressionWithOverlaps")
@@ -537,8 +563,10 @@ def _nudged_range(mn, mx, num_bits, narrow_range):
     mn, mx = np.float32(mn), np.float32(mx)
     scale = (mx - mn) / (qmax - qmin)
     zp = qmin - mn / scale
+    # std::round = half-away-from-zero (zp >= qmin >= 0 here), NOT
+    # numpy's round-half-to-even
     nzp = np.float32(qmin if zp < qmin else qmax if zp > qmax
-                     else np.round(zp))
+                     else np.floor(zp + np.float32(0.5)))
     return ((qmin - nzp) * scale, (qmax - nzp) * scale, scale)
 
 

@@ -96,10 +96,12 @@ def fake_quant_with_min_max_vars(x, min_val, max_val, num_bits=8,
     scale = (mx - mn) / (qmax - qmin)
     # zero point via inv-scale multiply, not division: XLA lowers x/s to
     # x * (1/s) whose reciprocal rounding can push an exact half-integer
-    # (e.g. 127.5 for [-1.5, 1.5]) off the round-to-even nudge TF computes
+    # (e.g. 127.5 for [-1.5, 1.5]) off the std::round nudge TF computes
     inv_scale = (qmax - qmin) / (mx - mn)
     zero = qmin - mn * inv_scale
-    zero = jnp.clip(jnp.round(zero), qmin, qmax)
+    # std::round semantics (half-away-from-zero; zero >= qmin >= 0 after
+    # clip), not jnp.round's half-to-even
+    zero = jnp.clip(jnp.floor(zero + 0.5), qmin, qmax)
     nudged_min = (qmin - zero) * scale
     nudged_max = (qmax - zero) * scale
     clipped = jnp.clip(x, nudged_min, nudged_max)
