@@ -37,7 +37,101 @@ def _peak_flops(dev) -> float:
     return 0.0
 
 
-def bench_bert(jax, jnp, tiny):
+# Hard ceiling on believable MFU for the headline: nothing this code can
+# do runs the chip past ~80% of bf16 peak; any measurement above it is an
+# artifact (the axon tunnel replaying repeated executes from cache
+# produced BENCH_r04's 2,989% "MFU"), never a speedup.
+BERT_MFU_CEILING = 0.8
+
+
+def check_bert_sanity(losses, mfu, max_mfu=BERT_MFU_CEILING):
+    """(ok, reason): hard gates a BERT measurement must pass to be judged.
+
+    - implied MFU must be physically possible (<= max_mfu of chip peak)
+    - every timed dispatch's loss trajectory must be finite and strictly
+      changing (a flat trajectory means the device never actually
+      stepped — stale replay or a dead train step)
+    - no two dispatches may return identical trajectories: a repeated
+      execute served from the tunnel's replay cache returns the previous
+      dispatch's arrays verbatim, with a near-zero wall time that would
+      otherwise poison the median (the BENCH_r04 failure mode)
+
+    ``losses``: one trajectory [n_steps] or a stack of per-dispatch
+    trajectories [n_runs, n_steps].
+    """
+    if mfu > max_mfu:
+        return False, (f"implied MFU {mfu:.4f} > ceiling {max_mfu}: "
+                       "physically impossible, measurement artifact "
+                       "(tunnel replay?)")
+    arr = np.asarray(losses, np.float64)
+    trajs = arr[None, :] if arr.ndim == 1 else arr
+    for i, l in enumerate(trajs):
+        if l.size and not np.all(np.isfinite(l)):
+            return False, (f"non-finite loss in chained-step trajectory "
+                           f"(dispatch {i})")
+        if l.size >= 2 and not np.all(np.diff(l) != 0.0):
+            return False, ("loss not strictly changing across chained "
+                           f"steps (dispatch {i}): training did not "
+                           "actually advance")
+    for i in range(len(trajs)):
+        for j in range(i + 1, len(trajs)):
+            if trajs[i].size and np.array_equal(trajs[i], trajs[j]):
+                return False, (f"dispatches {i} and {j} returned identical "
+                               "loss trajectories: replayed from cache, "
+                               "not re-executed")
+    return True, "ok"
+
+
+def select_headline(variants):
+    """Best *sane* variant wins the headline; no sane variant -> fail
+    loudly rather than emit an unfalsifiable record."""
+    sane = {k: v for k, v in variants.items() if v["sane"]}
+    if not sane:
+        raise RuntimeError(
+            "no BERT variant passed the sanity gates; refusing to emit a "
+            "judged record from insane measurements: "
+            + "; ".join(f"{k}: {v['reason']}" for k, v in variants.items()))
+    name = max(sane, key=lambda k: sane[k]["samples_per_sec"])
+    return name, sane[name]
+
+
+def _measure_bert_variant(jax, jnp, bert, config, batch, B, T, n_steps,
+                          kw, fpt, peak):
+    """Median-of-3 scan-chained timing for one train-step variant, with
+    one remeasure retry if the sanity gate rejects the first attempt."""
+    params = bert.init_params(jax.random.key(0), config)
+    opt = bert.init_opt_state(params)
+    step = bert.make_scanned_train_step(config, n_steps, mesh=None,
+                                        learning_rate=1e-4, **kw)
+    params, opt, losses = step(params, opt, batch, 0)  # compile + warm
+    jax.block_until_ready(losses)
+    it = n_steps
+    for attempt in range(2):
+        runs, trajs = [], []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            params, opt, losses = step(params, opt, batch, it)
+            jax.block_until_ready(losses)
+            runs.append(time.perf_counter() - t0)
+            trajs.append(np.asarray(losses, np.float64))
+            it += n_steps
+        runs.sort()
+        dt = runs[1]  # median of 3
+        sps = n_steps * B / dt
+        mfu = sps * T * fpt / peak if peak else 0.0
+        ok, reason = check_bert_sanity(np.stack(trajs), mfu)
+        if ok or attempt == 1:
+            del params, opt
+            return {
+                "samples_per_sec": sps, "mfu": mfu, "sane": ok,
+                "reason": reason, "variant": kw,
+                "loss_first": float(trajs[0][0]),
+                "loss_last": float(trajs[-1][-1]),
+                "spread_pct": round(100.0 * (runs[-1] - runs[0]) / dt, 2),
+            }
+
+
+def bench_bert(jax, jnp, tiny, peak):
     from deeplearning4j_tpu.models import bert
 
     if tiny:
@@ -48,6 +142,7 @@ def bench_bert(jax, jnp, tiny):
         # B=128 without remat fits single-chip HBM and maximizes MXU
         # occupancy (measured: 59% MFU vs 40% at B=32+remat)
         B, T = 128, 128
+    n_steps = 5 if tiny else 20
 
     rng = np.random.RandomState(0)
     batch = {
@@ -60,31 +155,19 @@ def bench_bert(jax, jnp, tiny):
         "attention_mask": jnp.ones((B, T), jnp.int32),
     }
 
-    best = None
-    for variant in ({"remat": False},
-                    {"remat": False, "use_flash": True}):
+    fpt = bert.flops_per_token(config)
+    variants = {}
+    for name, kw in (("xla", {"remat": False}),
+                     ("flash", {"remat": False, "use_flash": True})):
         try:
-            params = bert.init_params(jax.random.key(0), config)
-            opt = bert.init_opt_state(params)
-            step = bert.make_train_step(config, mesh=None,
-                                        learning_rate=1e-4, **variant)
-            params, opt, loss = step(params, opt, batch, 0)
-            jax.block_until_ready(loss)
-            iters = 20
-            t0 = time.perf_counter()
-            for i in range(1, iters + 1):
-                params, opt, loss = step(params, opt, batch, i)
-            jax.block_until_ready(loss)
-            dt = time.perf_counter() - t0
-            sps = iters * B / dt
-            if best is None or sps > best[0]:
-                best = (sps, float(loss), variant)
-            del params, opt
-        except Exception:
-            continue
-    sps, loss, variant = best
-    return {"samples_per_sec": sps, "loss": loss, "B": B, "T": T,
-            "config": config, "variant": variant}
+            variants[name] = _measure_bert_variant(
+                jax, jnp, bert, config, batch, B, T, n_steps, kw, fpt, peak)
+        except Exception as e:
+            variants[name] = {"sane": False, "samples_per_sec": 0.0,
+                              "mfu": 0.0, "variant": kw,
+                              "reason": f"error: {type(e).__name__}: {e}"}
+    return {"B": B, "T": T, "config": config, "n_chained": n_steps,
+            "flops_per_token": fpt, "variants": variants}
 
 
 def _zoo_batches(rng, n, B, in_shape, num_classes):
@@ -297,29 +380,33 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from deeplearning4j_tpu.models import bert
-
     dev = jax.devices()[0]
     platform = dev.platform
     tiny = bool(os.environ.get("BENCH_TINY"))
     skip_extras = bool(os.environ.get("BENCH_SKIP_EXTRAS"))
 
-    r = bench_bert(jax, jnp, tiny)
-    samples_per_sec = r["samples_per_sec"]
-    tokens_per_sec = samples_per_sec * r["T"]
-    model_flops = bert.flops_per_token(r["config"]) * tokens_per_sec
     peak = _peak_flops(dev)
-    mfu = model_flops / peak if peak else 0.0
+    r = bench_bert(jax, jnp, tiny, peak)
+    name, rec = select_headline(r["variants"])  # raises if none sane
 
     out = {
         "metric": "bert_base_mlm_train_samples_per_sec_per_chip",
-        "value": round(samples_per_sec, 2),
+        "value": round(rec["samples_per_sec"], 2),
         "unit": "samples/sec/chip",
-        "vs_baseline": round(mfu / 0.35, 4),  # north star: 35% MFU == 1.0
-        "mfu": round(mfu, 4),
+        "vs_baseline": round(rec["mfu"] / 0.35, 4),  # 35% MFU == 1.0
+        "mfu": round(rec["mfu"], 4),
         "batch": r["B"], "seq_len": r["T"], "platform": platform,
-        "loss": round(r["loss"], 4),
-        "flash_attn": r["variant"].get("use_flash", False),
+        "loss": round(rec["loss_last"], 4),
+        "flash_attn": rec["variant"].get("use_flash", False),
+        # measurement methodology: one jitted lax.scan of n_chained steps
+        # per dispatch, median of 3 dispatches, spread = (max-min)/median
+        "n_chained_steps": r["n_chained"],
+        "time_spread_pct": rec["spread_pct"],
+        "bert_variants": {
+            k: {"samples_per_sec": round(v["samples_per_sec"], 2),
+                "mfu": round(v["mfu"], 4), "sane": v["sane"],
+                "reason": v["reason"]}
+            for k, v in r["variants"].items()},
     }
 
     import gc

@@ -261,32 +261,23 @@ def mlm_loss(params, batch, config: BertConfig, mesh=None,
 
 # -- training step ------------------------------------------------------
 
-def make_train_step(config: BertConfig, mesh: Optional[Mesh] = None,
-                    learning_rate: float = 1e-4, seq_parallel: bool = False,
-                    remat: bool = True, use_flash: bool = False):
-    """Single jitted train step: fwd+bwd+Adam, donated params/state.
-
-    With a mesh: params placed per param_specs (TP/FSDP), batch sharded over
-    (data, fsdp), sequence over seq when seq_parallel — XLA emits all ICI
-    collectives (the entire reference PS stack, §2.5).
-    use_flash selects the Pallas flash-attention kernel.
-    """
+def _make_loss_fn(config, mesh, seq_parallel, remat, use_flash):
     loss_fn = functools.partial(mlm_loss, config=config, mesh=mesh,
                                 seq_parallel=seq_parallel,
                                 use_flash=use_flash)
     if remat:
         # rematerialize the encoder to trade FLOPs for HBM (checkpointing)
         loss_fn = jax.checkpoint(loss_fn)
+    return loss_fn
 
-    def step(params, opt_state, batch, iteration):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        new_params, opt_state = _optim.adam_apply(
-            params, grads, opt_state, learning_rate, iteration)
-        return new_params, opt_state, loss
 
+def _jit_step(fn, config, mesh, seq_parallel):
+    """jit a ``(params, opt_state, batch, scalar) -> (params, opt_state,
+    aux)`` step with donated params/state and, when a mesh is given, the
+    TP/FSDP/SP shardings from param_specs."""
     donate = (0, 1)
     if mesh is None:
-        return jax.jit(step, donate_argnums=donate)
+        return jax.jit(fn, donate_argnums=donate)
     specs = param_specs(config)
     param_sh = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), specs,
@@ -300,9 +291,66 @@ def make_train_step(config: BertConfig, mesh: Optional[Mesh] = None,
     # batch_sh is a pytree *prefix*: it applies to every entry of the batch
     # dict, whatever keys the caller provides (token_type_ids included)
     return jax.jit(
-        step, donate_argnums=donate,
+        fn, donate_argnums=donate,
         in_shardings=(param_sh, opt_sh, batch_sh, None),
         out_shardings=(param_sh, opt_sh, None))
+
+
+def make_train_step(config: BertConfig, mesh: Optional[Mesh] = None,
+                    learning_rate: float = 1e-4, seq_parallel: bool = False,
+                    remat: bool = True, use_flash: bool = False):
+    """Single jitted train step: fwd+bwd+Adam, donated params/state.
+
+    With a mesh: params placed per param_specs (TP/FSDP), batch sharded over
+    (data, fsdp), sequence over seq when seq_parallel — XLA emits all ICI
+    collectives (the entire reference PS stack, §2.5).
+    use_flash selects the Pallas flash-attention kernel.
+    """
+    loss_fn = _make_loss_fn(config, mesh, seq_parallel, remat, use_flash)
+
+    def step(params, opt_state, batch, iteration):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        new_params, opt_state = _optim.adam_apply(
+            params, grads, opt_state, learning_rate, iteration)
+        return new_params, opt_state, loss
+
+    return _jit_step(step, config, mesh, seq_parallel)
+
+
+def make_scanned_train_step(config: BertConfig, n_steps: int,
+                            mesh: Optional[Mesh] = None,
+                            learning_rate: float = 1e-4,
+                            seq_parallel: bool = False, remat: bool = True,
+                            use_flash: bool = False):
+    """``n_steps`` chained train steps in ONE dispatch (jitted lax.scan).
+
+    Benchmarks MUST time this, never N separate calls of make_train_step's
+    output: per-call wall timing through the axon tunnel is unreliable —
+    repeated identical executes are replayed from cache, which produced the
+    physically impossible BENCH_r04 headline (2,989% implied MFU). One scan
+    is one execute whose wall time necessarily covers all ``n_steps`` of
+    device work; the returned loss trajectory lets the caller verify that
+    training actually stepped (losses must change step to step).
+
+    Signature: ``(params, opt_state, batch, start_iteration) ->
+    (params, opt_state, losses[n_steps])`` with params/opt donated.
+    """
+    loss_fn = _make_loss_fn(config, mesh, seq_parallel, remat, use_flash)
+
+    def scanned(params, opt_state, batch, start_iteration):
+        def body(carry, it):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            params, opt_state = _optim.adam_apply(
+                params, grads, opt_state, learning_rate, it)
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state),
+            start_iteration + jnp.arange(n_steps, dtype=jnp.int32))
+        return params, opt_state, losses
+
+    return _jit_step(scanned, config, mesh, seq_parallel)
 
 
 # -- SQuAD-style QA fine-tune head (BASELINE config 3) -------------------

@@ -1,0 +1,140 @@
+"""The bench sanity gate must reject physically impossible measurements.
+
+BENCH_r04's judged headline was 69,690 samples/s/chip — 2,989% implied
+MFU, ~30x chip peak — produced by the axon tunnel replaying repeated
+identical executes from cache. These tests pin the gate that keeps such
+an artifact out of the judged record (VERDICT r4 directive #1).
+"""
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import bench  # noqa: E402  (repo-root module)
+
+
+def _bert_base_mfu(samples_per_sec, T=128, peak=197e12):
+    from deeplearning4j_tpu.models import bert
+    fpt = bert.flops_per_token(bert.BertConfig.base())
+    return samples_per_sec * T * fpt / peak
+
+
+DECREASING = np.linspace(10.4, 9.7, 20)
+
+
+class TestCheckBertSanity:
+    def test_rejects_the_r04_artifact(self):
+        # the exact invalid judged number: 69,690 samples/s on a v5e
+        mfu = _bert_base_mfu(69690.0)
+        assert mfu > 10  # ~30x peak — sanity of the test itself
+        ok, reason = bench.check_bert_sanity(DECREASING, mfu)
+        assert not ok
+        assert "impossible" in reason or "ceiling" in reason
+
+    def test_rejects_anything_over_ceiling(self):
+        ok, _ = bench.check_bert_sanity(DECREASING, 0.81)
+        assert not ok
+        ok, _ = bench.check_bert_sanity(DECREASING, bench.BERT_MFU_CEILING
+                                        + 1e-6)
+        assert not ok
+
+    def test_accepts_credible_measurement(self):
+        # r3's trustworthy headline: 1,427 samples/s ~= 60.6% MFU
+        mfu = _bert_base_mfu(1427.0)
+        assert 0.4 < mfu < bench.BERT_MFU_CEILING
+        ok, reason = bench.check_bert_sanity(DECREASING, mfu)
+        assert ok, reason
+
+    def test_rejects_flat_loss_trajectory(self):
+        # device never stepped: same loss replayed N times
+        ok, reason = bench.check_bert_sanity(np.full(20, 10.38), 0.5)
+        assert not ok
+        assert "not strictly changing" in reason
+
+    def test_rejects_partially_stuck_trajectory(self):
+        l = DECREASING.copy()
+        l[7] = l[6]  # one stale step is enough to distrust the timing
+        ok, _ = bench.check_bert_sanity(l, 0.5)
+        assert not ok
+
+    def test_rejects_nonfinite_loss(self):
+        l = DECREASING.copy()
+        l[3] = np.nan
+        ok, reason = bench.check_bert_sanity(l, 0.5)
+        assert not ok
+        assert "finite" in reason
+
+    def test_rejects_replayed_dispatch(self):
+        # two of three dispatches return byte-identical trajectories:
+        # the tunnel served a cached execute instead of running the scan
+        t1 = DECREASING
+        t3 = DECREASING - 0.8
+        ok, reason = bench.check_bert_sanity(np.stack([t1, t1, t3]), 0.5)
+        assert not ok
+        assert "replayed" in reason
+
+    def test_accepts_distinct_dispatches(self):
+        stack = np.stack([DECREASING, DECREASING - 0.7, DECREASING - 1.4])
+        ok, reason = bench.check_bert_sanity(stack, 0.5)
+        assert ok, reason
+
+
+class TestSelectHeadline:
+    def test_insane_variant_never_wins(self):
+        variants = {
+            "flash": {"samples_per_sec": 69690.0, "mfu": 29.6, "sane": False,
+                      "reason": "implied MFU 29.6 > ceiling"},
+            "xla": {"samples_per_sec": 1427.0, "mfu": 0.606, "sane": True,
+                    "reason": "ok"},
+        }
+        name, rec = bench.select_headline(variants)
+        assert name == "xla"
+        assert rec["samples_per_sec"] == 1427.0
+
+    def test_all_insane_fails_loudly(self):
+        variants = {
+            "flash": {"samples_per_sec": 69690.0, "mfu": 29.6, "sane": False,
+                      "reason": "implied MFU 29.6 > ceiling"},
+        }
+        with pytest.raises(RuntimeError, match="refusing to emit"):
+            bench.select_headline(variants)
+
+    def test_fastest_sane_wins(self):
+        variants = {
+            "a": {"samples_per_sec": 1000.0, "sane": True, "reason": "ok"},
+            "b": {"samples_per_sec": 1400.0, "sane": True, "reason": "ok"},
+        }
+        name, _ = bench.select_headline(variants)
+        assert name == "b"
+
+
+class TestScannedStepEndToEnd:
+    def test_tiny_scan_chain_produces_sane_record(self):
+        """The full measurement path on CPU: scanned step, median-of-3,
+        gate evaluation — the losses must strictly change."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.models import bert
+
+        config = bert.BertConfig.tiny()
+        B, T = 4, 16
+        rng = np.random.RandomState(0)
+        batch = {
+            "input_ids": jnp.asarray(
+                rng.randint(0, config.vocab_size, (B, T)), jnp.int32),
+            "labels": jnp.asarray(
+                np.where(rng.rand(B, T) < 0.15,
+                         rng.randint(0, config.vocab_size, (B, T)), -100),
+                jnp.int32),
+            "attention_mask": jnp.ones((B, T), jnp.int32),
+        }
+        fpt = bert.flops_per_token(config)
+        rec = bench._measure_bert_variant(
+            jax, jnp, bert, config, batch, B, T, 4, {"remat": False},
+            fpt, peak=0.0)
+        assert rec["sane"], rec["reason"]
+        assert rec["loss_last"] < rec["loss_first"]
+        assert rec["samples_per_sec"] > 0
