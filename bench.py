@@ -359,6 +359,46 @@ def bench_flash_attention(jax, jnp, tiny):
     return fwd, train
 
 
+def bench_ring_flash(jax, jnp, tiny):
+    """Single-chip ring(flash)-vs-monolithic-flash overhead ratio.
+
+    On a 1-device seq mesh the ring path degenerates to one scan step
+    around the same Pallas kernel, so the ratio isolates what the SP
+    wrapper (shard_map + scan + merge) costs over calling the kernel
+    directly. ~1.0 means composing flash into the ring is free on-chip;
+    the multi-chip win comes from the ppermute overlap the dryrun checks.
+    """
+    from deeplearning4j_tpu.kernels import flash_attention
+    from deeplearning4j_tpu.parallel.mesh import MeshConfig, make_mesh
+    from deeplearning4j_tpu.parallel.ring_attention import ring_attention
+
+    B, S, H, D = (1, 256, 2, 32) if tiny else (4, 2048, 12, 64)
+    N = 3 if tiny else 20
+    mesh = make_mesh(MeshConfig(data=1, seq=1), devices=jax.devices()[:1])
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+
+    def timed(fn):
+        @jax.jit
+        def many(q):
+            out, _ = jax.lax.scan(lambda c, _: (fn(c), ()), q, None,
+                                  length=N)
+            return jnp.sum(out)
+
+        float(many(q))  # compile + warm
+        runs = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(many(q))
+            runs.append((time.perf_counter() - t0) / N)
+        return sorted(runs)[1]
+
+    t_mono = timed(lambda c: flash_attention(c, k, v))
+    t_ring = timed(lambda c: ring_attention(c, k, v, mesh, use_flash=True))
+    return t_mono / t_ring
+
+
 def bench_flash_longseq(jax, jnp, tiny):
     """S=8192 attention training step: the XLA path cannot even compile on
     one chip (the [B,H,S,S] f32 score tensor is 12.9 GB / blows scoped
@@ -440,6 +480,12 @@ def main():
             out["flash_attn_train_speedup_vs_xla"] = round(train, 3)
         except Exception as e:
             out["flash_attn_speedup_vs_xla"] = f"error: {type(e).__name__}"
+        _release()
+        try:
+            out["ring_flash_fwd_vs_monolithic"] = round(
+                bench_ring_flash(jax, jnp, tiny), 3)
+        except Exception as e:
+            out["ring_flash_fwd_vs_monolithic"] = f"error: {type(e).__name__}"
         _release()
         try:
             out["flash_attn_s8192_train"] = bench_flash_longseq(jax, jnp,

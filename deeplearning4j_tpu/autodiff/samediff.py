@@ -798,3 +798,10 @@ class SameDiff:
     def load(path) -> "SameDiff":
         from .serialization import load as _load
         return _load(path)
+
+    def save_flatbuffers(self, path, save_updater_state: bool = False):
+        """Write the reference FlatBuffers format (SameDiff.asFlatBuffers,
+        `SameDiff.java:5465-5727`) — loadable by the JVM reference and by
+        `modelimport.samediff_fb.load_samediff_fb`."""
+        from .serialization import save_flatbuffers as _save_fb
+        _save_fb(self, path, save_updater_state)

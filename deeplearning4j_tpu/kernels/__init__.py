@@ -21,6 +21,6 @@ which belongs to a future logits-never-materialized head design.
 The kernel runs `interpret=True` on CPU so the unit tests exercise the
 exact kernel code path hardware-free.
 """
-from .flash_attention import flash_attention
+from .flash_attention import flash_attention, flash_attention_with_lse
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse"]

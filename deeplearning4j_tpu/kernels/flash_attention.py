@@ -218,7 +218,8 @@ def _dkv_kernel_nomask(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_sc, dv_sc, **kw)
 
 
-def _flash_bwd(q, k, v, mask, o, lse, g, scale, causal, tile_q, tile_k):
+def _flash_bwd(q, k, v, mask, o, lse, g, scale, causal, tile_q, tile_k,
+               lse_cot=None):
     BH, S, D = q.shape
     # the bwd kernels hold three [TQ, TK] f32 tiles live (p, dp, ds); cap
     # tiles at 512 so long-seq fwd tiles (2048) don't blow the 16MB VMEM
@@ -229,6 +230,11 @@ def _flash_bwd(q, k, v, mask, o, lse, g, scale, causal, tile_q, tile_k):
     n_q, n_k = S // tile_q, S // tile_k
     delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32), axis=-1,
                     keepdims=True)  # [BH, S, 1]
+    if lse_cot is not None:
+        # d lse_j / d s_jk = p_jk, so an lse cotangent enters ds as
+        # p * g_lse — algebraically delta' = delta - g_lse with zero
+        # kernel changes (ds = p * (dp - delta'))
+        delta = delta - lse_cot.astype(jnp.float32)
 
     def qspec(f):
         return pl.BlockSpec((1, tile_q, D), f)
@@ -339,6 +345,32 @@ def _flash_masked_b(scale, causal, tile_q, tile_k, res, g):
 _flash_masked.defvjp(_flash_masked_f, _flash_masked_b)
 
 
+# (o, lse)-returning variant: the ring/SP path needs the per-block lse to
+# merge block outputs exactly; both outputs are differentiable (the lse
+# cotangent rides the delta term, see _flash_bwd).
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_lse_masked(q, k, v, mask, scale, causal, tile_q, tile_k):
+    o, lse = _flash_fwd(q, k, v, mask, scale, causal, tile_q, tile_k)
+    return o, lse
+
+
+def _flash_lse_masked_f(q, k, v, mask, scale, causal, tile_q, tile_k):
+    o, lse = _flash_fwd(q, k, v, mask, scale, causal, tile_q, tile_k)
+    return (o, lse), (q, k, v, mask, o, lse)
+
+
+def _flash_lse_masked_b(scale, causal, tile_q, tile_k, res, g):
+    q, k, v, mask, o, lse = res
+    g_o, g_lse = g
+    dq, dk, dv = _flash_bwd(q, k, v, mask, o, lse, g_o, scale, causal,
+                            tile_q, tile_k, lse_cot=g_lse)
+    return dq, dk, dv, None
+
+
+_flash_lse_masked.defvjp(_flash_lse_masked_f, _flash_lse_masked_b)
+
+
 def _fit_tile(want, s_pad):
     """Largest multiple of 128 ≤ want that divides s_pad (s_pad is a
     multiple of 128)."""
@@ -349,20 +381,10 @@ def _fit_tile(want, s_pad):
     return t
 
 
-def flash_attention(q, k, v, mask=None, causal: bool = False,
-                    scale: float = None, tile_q: int = None,
-                    tile_k: int = None):
-    """Flash attention over [B, S, H, D] (BTHD, the framework convention).
-
-    mask: optional [B, S] key validity (1 = attend). Differentiable in
-    q/k/v; O(S) HBM in both forward and backward (the probability matrix
-    only ever exists as [tile_q, tile_k] VMEM tiles).
-    Any S is accepted: inputs are zero-padded to the tile boundary (padded
-    keys masked off; padded query rows sliced away).
-
-    Default tiles are tuned on v5e at S=2048, D=64 (tq=2048/tk=512:
-    fwd 4.7ms vs XLA 8.8/7.1ms f32/bf16; train 5.8-6.1ms vs 13.5/7.5ms);
-    they shrink to divisors of the padded length for other shapes."""
+def _prep(q, k, v, mask, scale, tile_q, tile_k):
+    """Resolve tiles, zero-pad S to the tile boundary, flatten to the
+    kernels' [B*H, S_pad, D] layout. Returns (qf, kf, vf, mf, scale,
+    tile_q, tile_k, S, S_pad, B, H, D)."""
     B, S, H, D = q.shape
     scale = scale if scale is not None else D ** -0.5
     if tile_q is None or tile_k is None:
@@ -389,10 +411,52 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
     qf = jnp.moveaxis(q, 2, 1).reshape(B * H, S_pad, D)
     kf = jnp.moveaxis(k, 2, 1).reshape(B * H, S_pad, D)
     vf = jnp.moveaxis(v, 2, 1).reshape(B * H, S_pad, D)
-    if mask is not None:
-        mf = jnp.repeat(mask.astype(jnp.int32), H, axis=0)[..., None]
+    mf = (jnp.repeat(mask.astype(jnp.int32), H, axis=0)[..., None]
+          if mask is not None else None)
+    return qf, kf, vf, mf, scale, tile_q, tile_k, S, S_pad, B, H, D
+
+
+def flash_attention(q, k, v, mask=None, causal: bool = False,
+                    scale: float = None, tile_q: int = None,
+                    tile_k: int = None):
+    """Flash attention over [B, S, H, D] (BTHD, the framework convention).
+
+    mask: optional [B, S] key validity (1 = attend). Differentiable in
+    q/k/v; O(S) HBM in both forward and backward (the probability matrix
+    only ever exists as [tile_q, tile_k] VMEM tiles).
+    Any S is accepted: inputs are zero-padded to the tile boundary (padded
+    keys masked off; padded query rows sliced away).
+
+    Default tiles are tuned on v5e at S=2048, D=64 (tq=2048/tk=512:
+    fwd 4.7ms vs XLA 8.8/7.1ms f32/bf16; train 5.8-6.1ms vs 13.5/7.5ms);
+    they shrink to divisors of the padded length for other shapes."""
+    (qf, kf, vf, mf, scale, tile_q, tile_k,
+     S, S_pad, B, H, D) = _prep(q, k, v, mask, scale, tile_q, tile_k)
+    if mf is not None:
         out = _flash_masked(qf, kf, vf, mf, scale, causal, tile_q, tile_k)
     else:
         out = _flash(qf, kf, vf, scale, causal, tile_q, tile_k)
     out = jnp.moveaxis(out.reshape(B, H, S_pad, D), 1, 2)
     return out[:, :S] if S_pad != S else out
+
+
+def flash_attention_with_lse(q, k, v, mask=None, causal: bool = False,
+                             scale: float = None, tile_q: int = None,
+                             tile_k: int = None):
+    """flash_attention that also returns the log-sum-exp of the scores.
+
+    Returns (out [B, S, H, D], lse [B, H, S] f32). The lse is what a
+    sequence-parallel caller (parallel/ring_attention.py) needs to merge
+    per-KV-block outputs into the exact global softmax; it is
+    differentiable alongside out (the lse cotangent folds into the
+    backward kernels' delta term).
+    """
+    (qf, kf, vf, mf, scale, tile_q, tile_k,
+     S, S_pad, B, H, D) = _prep(q, k, v, mask, scale, tile_q, tile_k)
+    out, lse = _flash_lse_masked(qf, kf, vf, mf, scale, causal,
+                                 tile_q, tile_k)
+    out = jnp.moveaxis(out.reshape(B, H, S_pad, D), 1, 2)
+    lse = lse.reshape(B, H, S_pad)
+    if S_pad != S:
+        out, lse = out[:, :S], lse[:, :, :S]
+    return out, lse

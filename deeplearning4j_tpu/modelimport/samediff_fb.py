@@ -35,6 +35,11 @@ _DTYPES = {
     7: np.int8, 8: np.int16, 9: np.int32, 10: np.int64,
     11: np.uint8, 12: np.uint16, 13: np.uint32, 14: np.uint64,
 }
+try:  # BFLOAT16 = 17 (array.fbs); ml_dtypes ships with jax
+    import ml_dtypes as _mld
+    _DTYPES[17] = _mld.bfloat16
+except ImportError:  # pragma: no cover
+    pass
 
 
 def _flat_array(t) -> np.ndarray:
@@ -121,6 +126,16 @@ class FlatVariableRec:
         self.var_type = _i8(t, 6)
 
 
+class UpdaterStateRec:
+    """One UpdaterState (graph.fbs): per-parameter optimizer state."""
+
+    def __init__(self, t):
+        self.param_name = _string(t, 0)
+        self.keys = _vec_str(t, 1)
+        self.values = [_flat_array(_vec_table(t, 2, i))
+                       for i in range(_vec_len(t, 2))]
+
+
 class FlatGraphFile:
     """Parsed FlatGraph (graph.fbs) — raw records before SameDiff rebuild."""
 
@@ -134,6 +149,8 @@ class FlatGraphFile:
         self.placeholders = _vec_str(g, 5)
         self.loss_variables = _vec_str(g, 6)
         self.training_config = _string(g, 7)
+        self.updater_state = [UpdaterStateRec(_vec_table(g, 8, i))
+                              for i in range(_vec_len(g, 8))]
 
 
 # ---------------------------------------------------------------------------
@@ -162,7 +179,9 @@ def _conv_matmul(node):
 
 def _conv_softmax(node):
     axis = int(node.i_args[0]) if node.i_args else -1
-    return "softmax", {"axis": axis}
+    # keep the node's own op (softmax vs log_softmax) — only the axis
+    # arg needs decoding
+    return node.op_name or "softmax", {"axis": axis}
 
 
 def _reduction(op_name):
@@ -234,8 +253,11 @@ class SameDiffFbImport:
                                    and v.name not in ph):
                 continue  # ARRAY: produced by a node during conversion
             if v.var_type == 3 or v.name in ph:
-                shape = tuple(int(s) for s in v.shape) if v.shape else None
-                var = self.sd.placeholder(v.name, shape=shape)
+                shape = (tuple(None if s < 0 else int(s) for s in v.shape)
+                         if v.shape else None)
+                dt = _DTYPES.get(v.dtype, np.float32)
+                var = self.sd.placeholder(v.name, shape=shape,
+                                          dtype=np.dtype(dt).name)
             elif v.var_type == 1:
                 var = self.sd.constant(np.asarray(v.array), name=v.name)
             elif v.var_type == 0:
@@ -320,5 +342,17 @@ def load_samediff_fb(path: str) -> SameDiff:
     flat = FlatGraphFile(data)
     sd = SameDiffFbImport(flat).convert()
     sd.fb_loss_variables = list(flat.loss_variables)
+    sd._loss_variables = list(flat.loss_variables)
     sd.fb_training_config = flat.training_config
+    if flat.updater_state:
+        # rebuild the native layout {state_key: {param: array}} so a
+        # restored graph resumes training exactly where it stopped
+        state: Dict[str, Dict[str, Any]] = {}
+        for rec in flat.updater_state:
+            for key, arr in zip(rec.keys, rec.values):
+                state.setdefault(key, {})[rec.param_name] = arr
+        sd._updater_state = state
+        sd.fb_updater_state = {
+            rec.param_name: dict(zip(rec.keys, rec.values))
+            for rec in flat.updater_state}
     return sd

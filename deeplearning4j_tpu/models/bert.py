@@ -158,13 +158,26 @@ def _ln(x, g, b, eps):
 
 def _attention(layer_params, h, attention_mask, config: BertConfig,
                mesh: Optional[Mesh], seq_parallel: bool,
-               use_flash: bool = False):
+               use_flash: bool = False, tp_axis: Optional[str] = None):
+    """Multi-head attention. tp_axis: when running INSIDE a shard_map with
+    head-sharded weights (the pipeline's Megatron-TP stages), names the
+    mesh axis for the explicit f/g collectives (tp_copy before QKV,
+    tp_reduce after the output projection); None means replicated weights
+    or GSPMD-annotated sharding (XLA inserts the collectives)."""
     a = layer_params["attn"]
-    q = jnp.einsum("bte,ehd->bthd", h, a["wq"]) + a["bq"]
-    k = jnp.einsum("bte,ehd->bthd", h, a["wk"]) + a["bk"]
-    v = jnp.einsum("bte,ehd->bthd", h, a["wv"]) + a["bv"]
+    if tp_axis is not None:
+        from ..parallel.pipeline import tp_copy
+        h_in = tp_copy(h, tp_axis)
+    else:
+        h_in = h
+    q = jnp.einsum("bte,ehd->bthd", h_in, a["wq"]) + a["bq"]
+    k = jnp.einsum("bte,ehd->bthd", h_in, a["wk"]) + a["bk"]
+    v = jnp.einsum("bte,ehd->bthd", h_in, a["wv"]) + a["bv"]
     if seq_parallel and mesh is not None:
-        ctx = ring_attention(q, k, v, mesh, mask=attention_mask, causal=False)
+        # use_flash composes with SP: the Pallas kernel computes each
+        # K/V block inside the ring (VERDICT r4 #4 / SURVEY §5)
+        ctx = ring_attention(q, k, v, mesh, mask=attention_mask,
+                             causal=False, use_flash=use_flash)
     elif use_flash:
         from ..kernels import flash_attention
         ctx = flash_attention(q, k, v, mask=attention_mask)
@@ -178,8 +191,11 @@ def _attention(layer_params, h, attention_mask, config: BertConfig,
                                logits, big_neg)
         probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-    out = jnp.einsum("bqhd,hde->bqe", ctx, a["wo"]) + a["bo"]
-    return out
+    out = jnp.einsum("bqhd,hde->bqe", ctx, a["wo"])
+    if tp_axis is not None:
+        from ..parallel.pipeline import tp_reduce
+        out = tp_reduce(out, tp_axis)
+    return out + a["bo"]
 
 
 def encode(params, input_ids, token_type_ids=None, attention_mask=None, *,
@@ -439,13 +455,40 @@ def from_pipeline_params(pp_params):
     }
 
 
+def pipeline_stage_specs(stages, tensor_parallel: bool = False):
+    """Per-leaf PartitionSpecs for stage-stacked params: every leaf sharded
+    over `pipe` on the stage dim; with tensor_parallel, attention heads and
+    MLP intermediate additionally sharded over `tensor` (Megatron layout,
+    the dp x tp x pp 3-axis composition)."""
+    if not tensor_parallel:
+        return jax.tree_util.tree_map(lambda _: P(PIPE), stages)
+    attn = {"wq": P(PIPE, None, TENSOR, None),
+            "wk": P(PIPE, None, TENSOR, None),
+            "wv": P(PIPE, None, TENSOR, None),
+            "bq": P(PIPE, TENSOR, None),
+            "bk": P(PIPE, TENSOR, None),
+            "bv": P(PIPE, TENSOR, None),
+            "wo": P(PIPE, TENSOR, None, None),
+            "bo": P(PIPE)}
+    mlp = {"w1": P(PIPE, None, TENSOR), "b1": P(PIPE, TENSOR),
+           "w2": P(PIPE, TENSOR, None), "b2": P(PIPE)}
+    layer = {"attn": attn, "mlp": mlp, "ln1_g": P(PIPE), "ln1_b": P(PIPE),
+             "ln2_g": P(PIPE), "ln2_b": P(PIPE)}
+    return [layer for _ in stages]
+
+
 def make_pipeline_train_step(config: BertConfig, mesh: Mesh,
                              n_microbatches: int,
                              learning_rate: float = 1e-4,
                              remat: bool = True,
-                             schedule: str = "1f1b"):
+                             schedule: str = "1f1b",
+                             tensor_parallel: bool = False):
     """BERT training with pipeline parallelism over the `pipe` mesh axis,
-    composed with data parallelism over (data, fsdp).
+    composed with data parallelism over (data, fsdp) and, with
+    tensor_parallel=True, Megatron TP over `tensor` inside each stage
+    (heads/intermediate sharded; psum after the row-parallel matmuls,
+    tp_copy marking the activation fan-out) — the full dp x tp x pp
+    3-axis composition.
 
     The reference has no PP at all (SURVEY §2.4) — this is the TPU-first
     differentiator: embed/head are the heterogeneous ends outside the loop,
@@ -460,19 +503,32 @@ def make_pipeline_train_step(config: BertConfig, mesh: Mesh,
     Use with `to_pipeline_params(init_params(...), n_stages)`.
     """
 
-    from ..parallel.pipeline import make_pipeline_loss, make_pipeline_loss_1f1b
+    from ..parallel.pipeline import (make_pipeline_loss,
+                                     make_pipeline_loss_1f1b, tp_copy,
+                                     tp_reduce)
     c = config
+    tp = mesh.shape.get(TENSOR, 1) if tensor_parallel else 1
+
+    tp_axis = TENSOR if tp > 1 else None
 
     def stage_fn(stage_layers, h):
-        # stage_layers: list of layer dicts (this stage's slice)
+        # stage_layers: list of layer dicts (this stage's slice); with
+        # tp > 1 the attn/mlp leaves are the local TENSOR shard and the
+        # math is Megatron column->row parallel per block (explicit f/g
+        # collectives via tp_copy/tp_reduce)
         for layer in stage_layers:
-            attn_out = _attention(layer, h, None, c, None, False)
+            attn_out = _attention(layer, h, None, c, None, False,
+                                  tp_axis=tp_axis)
             h = _ln(h + attn_out, layer["ln1_g"], layer["ln1_b"],
                     c.layer_norm_eps)
             mlp = layer["mlp"]
-            inter = jax.nn.gelu(jnp.einsum("bte,ef->btf", h, mlp["w1"])
+            hin = tp_copy(h, TENSOR) if tp > 1 else h
+            inter = jax.nn.gelu(jnp.einsum("bte,ef->btf", hin, mlp["w1"])
                                 + mlp["b1"])
-            mlp_out = jnp.einsum("btf,fe->bte", inter, mlp["w2"]) + mlp["b2"]
+            part = jnp.einsum("btf,fe->bte", inter, mlp["w2"])
+            if tp > 1:
+                part = tp_reduce(part, TENSOR)
+            mlp_out = part + mlp["b2"]
             h = _ln(h + mlp_out, layer["ln2_g"], layer["ln2_b"],
                     c.layer_norm_eps)
         return h
@@ -492,12 +548,21 @@ def make_pipeline_train_step(config: BertConfig, mesh: Mesh,
         per_tok = jnp.where(valid, per_tok, 0.0)
         return jnp.sum(per_tok), jnp.sum(valid).astype(jnp.float32)
 
+    # per-leaf specs only needed for tp; the default P(pipe) blanket
+    # otherwise (spec trees act as pytree prefixes of the stage params)
+    n_stages = max(mesh.shape.get(PIPE, 1), 1)
+    per_stage = max(c.num_layers // n_stages, 1)
+    specs = (pipeline_stage_specs(range(per_stage), tensor_parallel=True)
+             if tp > 1 else None)
+
     if schedule == "1f1b":
         pipe_loss = make_pipeline_loss_1f1b(stage_fn, head_fn, mesh,
-                                            n_microbatches)
+                                            n_microbatches,
+                                            param_specs=specs)
     elif schedule == "gpipe":
         pipe_loss = make_pipeline_loss(stage_fn, head_fn, mesh,
-                                       n_microbatches, remat=remat)
+                                       n_microbatches, remat=remat,
+                                       param_specs=specs)
     else:
         raise ValueError(f"unknown pipeline schedule {schedule!r} "
                          "(expected '1f1b' or 'gpipe')")
@@ -522,21 +587,31 @@ def make_pipeline_train_step(config: BertConfig, mesh: Mesh,
             params, grads, opt_state, learning_rate, iteration)
         return new_params, opt_state, loss
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    step = jax.jit(step, donate_argnums=(0, 1))
+    step.loss_fn = loss_fn  # exposed for grad-level parity tests
+    return step
 
 
-def place_pipeline_params(pipe_params, mesh: Mesh):
-    """Stage-stacked leaves sharded over pipe; embed/head replicated."""
-    def place(path_is_stage, tree):
-        spec = P(PIPE) if path_is_stage else P()
+def place_pipeline_params(pipe_params, mesh: Mesh,
+                          tensor_parallel: bool = False):
+    """Stage-stacked leaves sharded over pipe (and tensor when
+    tensor_parallel); embed/head replicated."""
+    def repl(tree):
         return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, NamedSharding(mesh, spec)), tree)
+            lambda x: jax.device_put(x, NamedSharding(mesh, P())), tree)
+
+    stage_specs = pipeline_stage_specs(pipe_params["stages"],
+                                       tensor_parallel)
+    stages = jax.tree_util.tree_map(
+        lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+        pipe_params["stages"], stage_specs,
+        is_leaf=lambda x: isinstance(x, P) or isinstance(x, jax.Array))
 
     return {
-        "embeddings": place(False, pipe_params["embeddings"]),
-        "stages": place(True, pipe_params["stages"]),
-        "mlm": place(False, pipe_params["mlm"]),
-        "pooler": place(False, pipe_params["pooler"]),
+        "embeddings": repl(pipe_params["embeddings"]),
+        "stages": stages,
+        "mlm": repl(pipe_params["mlm"]),
+        "pooler": repl(pipe_params["pooler"]),
     }
 
 
@@ -544,6 +619,19 @@ def init_opt_state(params):
     flat = jax.tree_util.tree_leaves(params)
     zeros = [jnp.zeros(p.shape, jnp.float32) for p in flat]
     return (zeros, [jnp.zeros(p.shape, jnp.float32) for p in flat])
+
+
+def place_opt_state(opt_state, config: BertConfig, mesh: Mesh):
+    """Shard an Adam state (u_list, m_list) onto the mesh with the same
+    per-param specs the train step pins (needed when restoring committed
+    arrays, e.g. an orbax checkpoint, into the jitted step)."""
+    specs = param_specs(config)
+    flat_specs = [NamedSharding(mesh, s) for s in
+                  jax.tree_util.tree_leaves(
+                      specs, is_leaf=lambda x: isinstance(x, P))]
+    u, m = opt_state
+    return ([jax.device_put(a, s) for a, s in zip(u, flat_specs)],
+            [jax.device_put(a, s) for a, s in zip(m, flat_specs)])
 
 
 def place_params(params, config: BertConfig, mesh: Mesh):

@@ -99,6 +99,51 @@ def stack_stage_params(per_stage_params: Sequence):
                                   *per_stage_params)
 
 
+def tp_copy(x, axis: str):
+    """Megatron's *f* operator: identity forward, psum backward.
+
+    Marks the point where a replicated activation fans out into per-shard
+    tensor-parallel compute inside shard_map — each shard's backward
+    produces only its slice's contribution to dx, and the psum restores
+    the full gradient."""
+    @jax.custom_vjp
+    def f(v):
+        return v
+
+    def fwd(v):
+        return v, None
+
+    def bwd(_, g):
+        return (lax.psum(g, axis),)
+
+    f.defvjp(fwd, bwd)
+    return f(x)
+
+
+def tp_reduce(x, axis: str):
+    """Megatron's *g* operator: psum forward, identity backward.
+
+    The row-parallel matmul's reduction. MUST be used instead of a raw
+    lax.psum anywhere the stage body is differentiated *inside* shard_map
+    (the 1F1B hand-scheduled backward calls jax.vjp in the body): raw
+    psum's transpose under that trace is another psum, double-counting
+    the cotangent by the axis size. The true linear transpose is the
+    identity — the summed output's cotangent is replicated, and each
+    shard's partial receives it as-is."""
+    @jax.custom_vjp
+    def g(v):
+        return lax.psum(v, axis)
+
+    def fwd(v):
+        return lax.psum(v, axis), None
+
+    def bwd(_, ct):
+        return (ct,)
+
+    g.defvjp(fwd, bwd)
+    return g(x)
+
+
 # ---------------------------------------------------------------------------
 # v2: production pipeline — heterogeneous embed/head outside the loop, loss
 # computed ON the last stage (scalar psum, no full-output broadcast), per-
@@ -108,7 +153,8 @@ def stack_stage_params(per_stage_params: Sequence):
 
 def make_pipeline_loss(stage_fn: Callable, head_fn: Callable, mesh: Mesh,
                        n_microbatches: int, axis: str = PIPE,
-                       batch_axes=(DATA, FSDP), remat: bool = True):
+                       batch_axes=(DATA, FSDP), remat: bool = True,
+                       param_specs=None):
     """Build loss(stacked_stage_params, head_params, x, aux) -> (sum, count).
 
     - stage_fn(stage_params, x) -> y: the uniform repeated block (shapes
@@ -119,6 +165,9 @@ def make_pipeline_loss(stage_fn: Callable, head_fn: Callable, mesh: Mesh,
       (labels, masks), microbatched on its leading dim.
     - x: [B, ...] embedded activations (computed by the caller outside the
       loop — the heterogeneous embed component).
+    - param_specs: optional per-leaf PartitionSpec tree for the stacked
+      stage params (e.g. heads/intermediate sharded over `tensor` for
+      pp x tp composition); defaults to P(pipe) on every leaf.
     Returns GLOBAL (psum over pipe+data) scalar loss sum and weight; divide
     for the mean. Differentiable end-to-end (ppermute transposes).
     """
@@ -167,8 +216,9 @@ def make_pipeline_loss(stage_fn: Callable, head_fn: Callable, mesh: Mesh,
         xm = x.reshape((n_microbatches, mb) + x.shape[1:])
         auxm = jax.tree_util.tree_map(
             lambda a: a.reshape((n_microbatches, mb) + a.shape[1:]), aux)
-        param_spec = jax.tree_util.tree_map(lambda _: P(axis),
-                                            stacked_stage_params)
+        param_spec = (param_specs if param_specs is not None else
+                      jax.tree_util.tree_map(lambda _: P(axis),
+                                             stacked_stage_params))
         fn = shard_map(local, mesh=mesh,
                        in_specs=(param_spec, P(),
                                  P(None, data_spec), P(None, data_spec)),
@@ -198,7 +248,8 @@ def make_pipeline_loss(stage_fn: Callable, head_fn: Callable, mesh: Mesh,
 
 def make_pipeline_loss_1f1b(stage_fn: Callable, head_fn: Callable,
                             mesh: Mesh, n_microbatches: int,
-                            axis: str = PIPE, batch_axes=(DATA, FSDP)):
+                            axis: str = PIPE, batch_axes=(DATA, FSDP),
+                            param_specs=None):
     """Drop-in alternative to make_pipeline_loss with the 1F1B memory
     profile. Same contract: returns loss(stacked_stage_params, head_params,
     x, aux) -> (global loss sum, global weight), differentiable in the
@@ -341,11 +392,15 @@ def make_pipeline_loss_1f1b(stage_fn: Callable, head_fn: Callable,
             lambda a: a.reshape((n_microbatches, mb) + a.shape[1:]), aux)
         return xm, auxm
 
+    def _param_spec(stacked_stage_params):
+        return (param_specs if param_specs is not None else
+                jax.tree_util.tree_map(lambda _: P(axis),
+                                       stacked_stage_params))
+
     @jax.custom_vjp
     def loss(stacked_stage_params, head_params, x, aux):
         xm, auxm = _microbatch(x, aux)
-        param_spec = jax.tree_util.tree_map(lambda _: P(axis),
-                                            stacked_stage_params)
+        param_spec = _param_spec(stacked_stage_params)
         fn = shard_map(local_fwd, mesh=mesh,
                        in_specs=(param_spec, P(),
                                  P(None, data_spec), P(None, data_spec)),
@@ -360,8 +415,7 @@ def make_pipeline_loss_1f1b(stage_fn: Callable, head_fn: Callable,
         stacked_stage_params, head_params, x, aux = res
         gl, gw = g
         xm, auxm = _microbatch(x, aux)
-        param_spec = jax.tree_util.tree_map(lambda _: P(axis),
-                                            stacked_stage_params)
+        param_spec = _param_spec(stacked_stage_params)
         fn = shard_map(local_grads, mesh=mesh,
                        in_specs=(param_spec, P(),
                                  P(None, data_spec), P(None, data_spec),

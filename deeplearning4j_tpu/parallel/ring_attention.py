@@ -89,15 +89,99 @@ def _ring_attention_local(q, k, v, kv_mask, *, axis: str, causal: bool,
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
 
 
+def _merge_block(o, l, m, o_blk, lse_blk):
+    """Fold a *normalized* attention block (o_blk [B,Tq,H,D] with its lse
+    [B,H,Tq]) into the running (o, l, m) accumulator — the flash-merge:
+    a block behaves like one pseudo-element of weight exp(lse)."""
+    m_new = jnp.maximum(m, lse_blk)
+    corr = jnp.exp(jnp.where(jnp.isfinite(m), m - m_new, -jnp.inf))
+    corr = jnp.where(jnp.isfinite(corr), corr, 0.0)
+    w = jnp.exp(jnp.where(jnp.isfinite(lse_blk), lse_blk - m_new, -jnp.inf))
+    w = jnp.where(jnp.isfinite(w), w, 0.0)
+    l_new = l * corr + w
+    cT = jnp.transpose(corr, (0, 2, 1))[..., None]   # [B,Tq,H,1]
+    wT = jnp.transpose(w, (0, 2, 1))[..., None]
+    o_new = o * cT + o_blk.astype(jnp.float32) * wT
+    return o_new, l_new, m_new
+
+
+def _ring_flash_local(q, k, v, kv_mask, *, axis: str, causal: bool,
+                      scale: float):
+    """Ring body that computes each K/V block with the Pallas flash kernel
+    (SURVEY §5: "Pallas splash/ring attention kernel over ICI neighbors").
+
+    Per ring step the local Q attends to the currently-held K/V shard via
+    ``flash_attention_with_lse``; blocks merge through the exact
+    flash-merge, so the result is identical to ``_ring_attention_local``.
+    Causality is resolved at block granularity: shards strictly below the
+    diagonal run unmasked, the diagonal shard runs the kernel's causal
+    path (local offsets align), shards above contribute nothing — the
+    lax.switch executes exactly one branch per step.
+    """
+    from ..kernels import flash_attention_with_lse
+
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+
+    def full_block(k_cur, v_cur, mask_cur):
+        o, lse = flash_attention_with_lse(
+            q, k_cur, v_cur, mask_cur.astype(jnp.int32), causal=False,
+            scale=scale)
+        return o.astype(jnp.float32), lse
+
+    def diag_block(k_cur, v_cur, mask_cur):
+        o, lse = flash_attention_with_lse(
+            q, k_cur, v_cur, mask_cur.astype(jnp.int32), causal=True,
+            scale=scale)
+        return o.astype(jnp.float32), lse
+
+    def skip_block(k_cur, v_cur, mask_cur):
+        return (jnp.zeros((B, Tq, H, D), jnp.float32),
+                jnp.full((B, H, Tq), -jnp.inf, jnp.float32))
+
+    o = jnp.zeros((B, Tq, H, D), jnp.float32)
+    l = jnp.zeros((B, H, Tq), jnp.float32)
+    m = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
+
+    def body(carry, step):
+        o, l, m, k_cur, v_cur, mask_cur = carry
+        src = (my - step) % n  # whose K/V shard we hold this step
+        if causal:
+            # 0: src < my (full), 1: src == my (diagonal), 2: src > my (skip)
+            branch = jnp.int32(0) + (src == my) + 2 * (src > my)
+            o_blk, lse_blk = lax.switch(
+                branch, (full_block, diag_block, skip_block),
+                k_cur, v_cur, mask_cur)
+        else:
+            o_blk, lse_blk = full_block(k_cur, v_cur, mask_cur)
+        o, l, m = _merge_block(o, l, m, o_blk, lse_blk)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_next = lax.ppermute(k_cur, axis, perm)
+        v_next = lax.ppermute(v_cur, axis, perm)
+        mask_next = lax.ppermute(mask_cur, axis, perm)
+        return (o, l, m, k_next, v_next, mask_next), None
+
+    (o, l, m, _, _, _), _ = lax.scan(body, (o, l, m, k, v, kv_mask),
+                                     jnp.arange(n))
+    lT = jnp.transpose(l, (0, 2, 1))[..., None]      # [B,Tq,H,1]
+    out = o / jnp.maximum(lT, 1e-30)
+    return out.astype(q.dtype)
+
+
 def ring_attention(q, k, v, mesh: Mesh, *, mask=None, causal: bool = False,
                    scale: Optional[float] = None, axis: str = SEQ,
-                   batch_axes=(DATA, FSDP), head_axis: str = TENSOR):
+                   batch_axes=(DATA, FSDP), head_axis: str = TENSOR,
+                   use_flash: bool = False):
     """Sequence-parallel attention over `mesh`.
 
     q, k, v: [B, T, H, D] logically; physically sharded
     [B/dp, T/sp, H/tp, D] — heads stay sharded over `head_axis` so TP+SP
     compose without redundant attention compute. mask: optional [B, T] bool
     key-side padding mask (True = attend).
+    use_flash: compute each K/V block with the Pallas flash kernel instead
+    of XLA online-softmax (identical math, faster on the real chip).
     Returns [B, T, H, D] with the same sharding.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
@@ -107,9 +191,9 @@ def ring_attention(q, k, v, mesh: Mesh, *, mask=None, causal: bool = False,
         mask = mask.astype(bool)
     spec = P(batch_axes, axis, head_axis, None)
     mask_spec = P(batch_axes, axis)
+    local = _ring_flash_local if use_flash else _ring_attention_local
     fn = shard_map(
-        functools.partial(_ring_attention_local, axis=axis, causal=causal,
-                          scale=scale),
+        functools.partial(local, axis=axis, causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec, mask_spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v, mask)
