@@ -631,16 +631,13 @@ def _adapt_layer(class_name: str, cfg: Dict[str, Any],
                                           keras_in_shape),
             name=cfg.get("name")))
     if class_name == "Masking":
-        # imported as pass-through: downstream RNNs process every timestep.
-        # Matches keras ONLY when no input row equals mask_value — warn so
-        # padded-sequence users know outputs can diverge from the golden.
-        import logging
-        logging.getLogger(__name__).warning(
-            "Keras Masking(mask_value=%s) imported as identity: masked "
-            "timesteps are NOT skipped by downstream RNN layers; outputs "
-            "match keras only for inputs with no fully-masked timesteps",
-            cfg.get("mask_value", 0.0))
-        return _Adapted(LX.MaskLayer(name=cfg.get("name")))
+        # emits the timestep keep-mask; MultiLayerNetwork threads it into
+        # downstream RNN layers (Keras semantics: masked steps carry state
+        # and repeat the previous output) and a temporal loss head —
+        # reference KerasMasking.java + per-layer mask propagation
+        return _Adapted(LX.MaskLayer(
+            mask_value=float(cfg.get("mask_value", 0.0)),
+            name=cfg.get("name")))
     if class_name == "LocallyConnected1D":
         if cfg.get("padding", "valid") != "valid":
             raise ImportException("LocallyConnected1D padding must be "
@@ -931,8 +928,23 @@ class KerasModelImport:
         # (every temporal layer); Reshape/Permute outputs are keras-identical
         transposed = len(cur) == 2
         idx = 0
+        mask_alive = False  # a Masking layer's keep-mask is in flight
         for e in entries:
             cls, cfg = e["class_name"], e.get("config", {})
+            if cls == "Masking":
+                mask_alive = True
+            elif mask_alive:
+                if cls in ("GlobalAveragePooling1D", "GlobalMaxPooling1D"):
+                    # keras pooling CONSUMES the mask (masked steps
+                    # excluded); our pooling layers don't — refuse rather
+                    # than silently diverge from the golden
+                    raise ImportException(
+                        f"{cls} downstream of Masking consumes the "
+                        "timestep mask in keras; mask threading covers RNN "
+                        "layers only — pool after an RNN with "
+                        "return_sequences=False, or drop the Masking layer")
+                if cls in ("LSTM", "GRU", "SimpleRNN")                         and not cfg.get("return_sequences", False):
+                    mask_alive = False  # consumed by last-step selection
             if cls == "Flatten" and cur is not None and len(cur) in (3, 4):
                 conv_src = cur
             if cls == "Flatten" and cur is not None and len(cur) == 2:
@@ -1157,6 +1169,12 @@ class KerasModelImport:
                     keras_shapes[name] = tuple(merged)
                 _mark_layout(keras_shapes.get(name))
                 continue
+            if cls == "Masking":
+                raise ImportException(
+                    "Masking in functional (ComputationGraph) models is "
+                    "unsupported: mask threading is implemented for the "
+                    "Sequential/MultiLayerNetwork path only; re-export as "
+                    "Sequential")
             a = _adapt_layer(cls, cfg, in_shape)
             if a is None:
                 alias[name] = in_names[0] if in_names else name

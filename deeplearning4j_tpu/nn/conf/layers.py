@@ -374,10 +374,12 @@ class LSTM(Layer):
                                    self.weight_init),
                 "b": b}
 
-    def forward(self, params, x, training=False, key=None):
+    accepts_mask = True
+
+    def forward(self, params, x, training=False, key=None, mask=None):
         xt = jnp.swapaxes(x, 1, 2)  # [B, T, F]
         h_seq, h_last, _ = recurrent.lstm_layer(xt, params["Wx"], params["Wh"],
-                                                params["b"])
+                                                params["b"], mask=mask)
         if self.return_sequence:
             return jnp.swapaxes(h_seq, 1, 2)  # back to [B, n_out, T]
         return h_last
@@ -403,11 +405,24 @@ class Bidirectional(Layer):
         return {"fwd": self.fwd.init_params(k1, input_type),
                 "bwd": self.fwd.init_params(k2, input_type)}
 
-    def forward(self, params, x, training=False, key=None):
-        out_f = self.fwd.forward(params["fwd"], x, training, key)
+    @property
+    def accepts_mask(self):
+        return getattr(self.fwd, "accepts_mask", False)
+
+    def forward(self, params, x, training=False, key=None, mask=None):
+        mk = {"mask": mask} if mask is not None else {}
+        out_f = self.fwd.forward(params["fwd"], x, training, key, **mk)
         x_rev = jnp.flip(x, axis=-1)
-        out_b = self.fwd.forward(params["bwd"], x_rev, training, key)
+        mk_b = ({"mask": jnp.flip(mask, axis=-1)} if mask is not None
+                else {})
+        out_b = self.fwd.forward(params["bwd"], x_rev, training, key, **mk_b)
         out_b = jnp.flip(out_b, axis=-1)
+        if mask is not None and out_f.ndim == 3:
+            # Keras zero_output_for_mask: Bidirectional zeroes masked
+            # positions in BOTH halves so fwd/bwd sequences stay aligned
+            keep = mask[:, None, :].astype(out_f.dtype)
+            out_f = out_f * keep
+            out_b = out_b * keep
         if self.mode == "concat":
             return jnp.concatenate([out_f, out_b], axis=1)
         if self.mode == "add":

@@ -276,11 +276,14 @@ class SimpleRnn(Layer):
                                    self.weight_init),
                 "b": jnp.zeros((self.n_out,))}
 
-    def forward(self, params, x, training=False, key=None):
+    accepts_mask = True
+
+    def forward(self, params, x, training=False, key=None, mask=None):
         xt = jnp.swapaxes(x, 1, 2)
         h_seq, _ = recurrent.simple_rnn(xt, params["Wx"], params["Wh"],
                                         params["b"],
-                                        activation=get_activation(self.activation))
+                                        activation=get_activation(self.activation),
+                                        mask=mask)
         return jnp.swapaxes(h_seq, 1, 2)
 
     def output_type(self, input_type):
@@ -305,11 +308,13 @@ class GRU(Layer):
                 "bru": jnp.zeros((2 * self.n_out,)),
                 "bc": jnp.zeros((self.n_out,))}
 
-    def forward(self, params, x, training=False, key=None):
+    accepts_mask = True
+
+    def forward(self, params, x, training=False, key=None, mask=None):
         xt = jnp.swapaxes(x, 1, 2)
         h0 = jnp.zeros((x.shape[0], self.n_out), x.dtype)
         h_seq, _ = recurrent.gru(xt, h0, params["Wru"], params["Wc"],
-                                 params["bru"], params["bc"])
+                                 params["bru"], params["bc"], mask=mask)
         return jnp.swapaxes(h_seq, 1, 2)
 
     def output_type(self, input_type):
@@ -336,11 +341,13 @@ class GRUResetAfter(Layer):
                                   self.weight_init),
                 "b": jnp.zeros((6 * self.n_out,))}
 
-    def forward(self, params, x, training=False, key=None):
+    accepts_mask = True
+
+    def forward(self, params, x, training=False, key=None, mask=None):
         xt = jnp.swapaxes(x, 1, 2)  # [B, T, F]
         h_seq, _ = recurrent.gru_onnx(xt, params["W"], params["R"],
                                       params["b"], linear_before_reset=1,
-                                      time_major=False)
+                                      time_major=False, mask=mask)
         return jnp.swapaxes(h_seq, 1, 2)
 
     def output_type(self, input_type):
@@ -398,14 +405,25 @@ class LayerNormalizationLayer(Layer):
 @dataclasses.dataclass
 class LastTimeStep(Layer):
     """Wrapper: last time step of an RNN layer's [B, F, T] output
-    (reference conf/layers/recurrent/LastTimeStep.java)."""
+    (reference conf/layers/recurrent/LastTimeStep.java). With a mask, the
+    underlying RNN carries state through masked steps, so [:, :, -1] IS
+    the last VALID step's output (Keras return_sequences=False)."""
     underlying: Layer = None
+    return_sequence = False
+
+    @property
+    def accepts_mask(self):
+        return getattr(self.underlying, "accepts_mask", False)
 
     def init_params(self, key, input_type):
         return self.underlying.init_params(key, input_type)
 
-    def forward(self, params, x, training=False, key=None):
-        out = self.underlying.forward(params, x, training, key)
+    def forward(self, params, x, training=False, key=None, mask=None):
+        if mask is not None:
+            out = self.underlying.forward(params, x, training, key,
+                                          mask=mask)
+        else:
+            out = self.underlying.forward(params, x, training, key)
         return out[:, :, -1]
 
     def output_type(self, input_type):
@@ -607,11 +625,23 @@ class RepeatVector(Layer):
 
 @dataclasses.dataclass
 class MaskLayer(Layer):
-    """Pass-through that applies the feature mask (reference util/MaskLayer.java).
-    With masks threaded functionally, this is identity."""
+    """Keras ``Masking`` / reference util/MaskLayer.java analog.
+
+    Identity on activations, but EMITS the timestep keep-mask (True where
+    any feature differs from ``mask_value``): MultiLayerNetwork threads it
+    into downstream mask-aware RNN layers (``accepts_mask``), which skip
+    masked steps Keras-style — state carries through, the emitted output
+    repeats the previous valid step, last-step selection lands on the
+    last valid step — and into a temporal loss head."""
+    mask_value: float = 0.0
+    emits_mask = True
 
     def forward(self, params, x, training=False, key=None):
         return x
+
+    def compute_mask(self, x):
+        """[B, F, T] activations -> [B, T] keep-mask."""
+        return jnp.any(x != self.mask_value, axis=1)
 
     def has_params(self):
         return False

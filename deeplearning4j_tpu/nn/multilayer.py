@@ -115,9 +115,29 @@ class MultiLayerNetwork(FitFastPathMixin):
 
     # -- forward ---------------------------------------------------------
     def _forward(self, params, x, training: bool, key=None):
+        return self._forward_core(params, x, training, key)[0]
+
+    def _forward_mask(self, params, x, training: bool, key=None):
+        h, mask, _ = self._forward_core(params, x, training, key)
+        return h, mask
+
+    def _forward_core(self, params, x, training: bool, key=None,
+                      collect_bn: bool = False):
+        """THE per-layer forward loop (single copy: inference, train step,
+        and score all route here).
+
+        Threads the timestep keep-mask: a layer with ``emits_mask``
+        (MaskLayer — Keras Masking) computes it from its input; layers
+        with ``accepts_mask`` (RNNs and their wrappers) consume it; it
+        dies when the time axis does (return_sequence False). With
+        collect_bn, each stateful layer's input is captured so the train
+        step can refresh running stats without a second pass.
+        Returns (activations, mask-or-None, bn_inputs)."""
         cd = self._compute_dtype()
         last = len(self.layers) - 1
         h = self._cast_act(x, cd) if cd is not None else x
+        mask = None
+        bn_inputs = {}
         for i, layer in enumerate(self.layers):
             pre = self.conf.preprocessors.get(i)
             if pre is not None:
@@ -128,12 +148,22 @@ class MultiLayerNetwork(FitFastPathMixin):
                     h = self._cast_act(h, jnp.float32)
                 else:
                     p = self._cast_layer_params(p, cd)
+            if collect_bn and hasattr(layer, "new_state"):
+                bn_inputs[i] = h
             p, key = self._weight_noised(layer, p, key, training)
             layer_key = None
             if training and key is not None and layer.needs_key():
                 key, layer_key = jax.random.split(key)
-            h = layer.forward(p, h, training=training, key=layer_key)
-        return h
+            if getattr(layer, "emits_mask", False):
+                mask = layer.compute_mask(h)
+            if mask is not None and getattr(layer, "accepts_mask", False):
+                h = layer.forward(p, h, training=training, key=layer_key,
+                                  mask=mask)
+                if not getattr(layer, "return_sequence", True):
+                    mask = None  # time axis consumed
+            else:
+                h = layer.forward(p, h, training=training, key=layer_key)
+        return h, mask, bn_inputs
 
     def output(self, x, training: bool = False) -> NDArray:
         """Inference forward pass (reference MultiLayerNetwork.output)."""
@@ -155,11 +185,20 @@ class MultiLayerNetwork(FitFastPathMixin):
         self._check_init()
         h = _unwrap(x)
         acts = [NDArray(h)]
+        mask = None
         for i, layer in enumerate(self.layers):
             pre = self.conf.preprocessors.get(i)
             if pre is not None:
                 h = pre(h)
-            h = layer.forward(self._params[i], h, training=training)
+            if getattr(layer, "emits_mask", False):
+                mask = layer.compute_mask(h)
+            if mask is not None and getattr(layer, "accepts_mask", False):
+                h = layer.forward(self._params[i], h, training=training,
+                                  mask=mask)
+                if not getattr(layer, "return_sequence", True):
+                    mask = None
+            else:
+                h = layer.forward(self._params[i], h, training=training)
             acts.append(NDArray(h))
         return acts
 
@@ -178,11 +217,15 @@ class MultiLayerNetwork(FitFastPathMixin):
         params = self._merge(self._params, trainable)
         ll = self._loss_layer()
         li = len(self.layers) - 1
+        out, kmask, coll = self._forward_core(params, x, training=True,
+                                              key=key, collect_bn=True)
+        if mask is None and kmask is not None and isinstance(
+                ll, RnnOutputLayer):
+            # Keras-Masking-derived mask applies to a temporal head
+            mask = kmask
         if hasattr(ll, "compute_loss_ext"):
-            out, coll = self._forward_collect_bn(params, x, key)
             loss = ll.compute_loss_ext(params[li], y, out, coll.get(li), mask)
         else:
-            out = self._forward(params, x, training=True, key=key)
             loss = ll.compute_loss(y, out, mask)
         # L1/L2/weight-decay regularization (reference BaseLayer.calcRegularizationScore)
         if self.conf.l2 > 0 or self.conf.l1 > 0:
@@ -207,14 +250,18 @@ class MultiLayerNetwork(FitFastPathMixin):
     def _loss_with_bn(self, trainable, states, x, y, key):
         """Loss + collected stateful-layer inputs (the train-step loss)."""
         params = self._merge_states(trainable, states)
-        out, bn_inputs = self._forward_collect_bn(params, x, key)
+        out, kmask, bn_inputs = self._forward_core(params, x, training=True,
+                                                   key=key, collect_bn=True)
         ll = self._loss_layer()
         li = len(self.layers) - 1
+        # a live Keras-Masking mask masks the temporal training loss too
+        mask = kmask if (kmask is not None
+                         and isinstance(ll, RnnOutputLayer)) else None
         if hasattr(ll, "compute_loss_ext"):
             loss = ll.compute_loss_ext(params[li], y, out,
-                                       bn_inputs.get(li))
+                                       bn_inputs.get(li), mask)
         else:
-            loss = ll.compute_loss(y, out)
+            loss = ll.compute_loss(y, out, mask)
         if self.conf.l2 > 0 or self.conf.l1 > 0:
             for p in trainable:
                 for v in p.values():
@@ -282,30 +329,8 @@ class MultiLayerNetwork(FitFastPathMixin):
         return [{**t, **s} for t, s in zip(trainable, states)]
 
     def _forward_collect_bn(self, params, x, key):
-        """Forward pass that also returns each BatchNormalization layer's
-        input, so the train step can refresh running stats without a second
-        forward pass (has_aux path)."""
-        cd = self._compute_dtype()
-        last = len(self.layers) - 1
-        h = self._cast_act(x, cd) if cd is not None else x
-        bn_inputs = {}
-        for i, layer in enumerate(self.layers):
-            pre = self.conf.preprocessors.get(i)
-            if pre is not None:
-                h = pre(h)
-            p = params[i]
-            if cd is not None:
-                if i == last:
-                    h = self._cast_act(h, jnp.float32)
-                else:
-                    p = self._cast_layer_params(p, cd)
-            if hasattr(layer, "new_state"):
-                bn_inputs[i] = h
-            p, key = self._weight_noised(layer, p, key, training=True)
-            layer_key = None
-            if key is not None and layer.needs_key():
-                key, layer_key = jax.random.split(key)
-            h = layer.forward(p, h, training=True, key=layer_key)
+        h, _, bn_inputs = self._forward_core(params, x, training=True,
+                                             key=key, collect_bn=True)
         return h, bn_inputs
 
     def _states(self, params):

@@ -40,12 +40,24 @@ def lstm_cell(x, h_prev, c_prev, w_x, w_h, b=None, forget_bias=0.0):
     return h, c
 
 
+def _mask_tm(mask, x_tm):
+    """[B, T] keep-mask -> [T, B, 1] aligned with time-major x."""
+    if mask.shape[::-1] != x_tm.shape[:2]:
+        raise ValueError(
+            f"mask shape {mask.shape} does not match sequence [B, T] = "
+            f"{x_tm.shape[:2][::-1]}")
+    return jnp.swapaxes(mask, 0, 1)[..., None].astype(bool)
+
+
 @op("lstmLayer", "recurrent", aliases=("lstm",))
 def lstm_layer(x, w_x, w_h, b=None, h0=None, c0=None, forget_bias=0.0,
-               time_major=False, return_sequence=True):
+               time_major=False, return_sequence=True, mask=None):
     """Full-sequence LSTM via lax.scan.
 
     x: [B, T, In] (or [T, B, In] when time_major); returns (h_seq, h_T, c_T).
+    mask: optional [B, T] keep-mask (Keras Masking semantics): masked steps
+    carry h/c through unchanged, so the emitted output repeats the previous
+    valid step's output and h_T/c_T are the last VALID step's state.
     """
     if not time_major:
         x = jnp.swapaxes(x, 0, 1)  # [T, B, In]
@@ -54,12 +66,24 @@ def lstm_layer(x, w_x, w_h, b=None, h0=None, c0=None, forget_bias=0.0,
     h0 = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
     c0 = c0 if c0 is not None else jnp.zeros((B, H), x.dtype)
 
-    def step(carry, x_t):
-        h, c = carry
-        h, c = lstm_cell(x_t, h, c, w_x, w_h, b, forget_bias)
-        return (h, c), h
+    if mask is None:
+        def step(carry, x_t):
+            h, c = carry
+            h, c = lstm_cell(x_t, h, c, w_x, w_h, b, forget_bias)
+            return (h, c), h
 
-    (h_last, c_last), h_seq = lax.scan(step, (h0, c0), x)
+        (h_last, c_last), h_seq = lax.scan(step, (h0, c0), x)
+    else:
+        def step(carry, inp):
+            h, c = carry
+            x_t, m_t = inp
+            h_new, c_new = lstm_cell(x_t, h, c, w_x, w_h, b, forget_bias)
+            h_new = jnp.where(m_t, h_new, h)
+            c_new = jnp.where(m_t, c_new, c)
+            return (h_new, c_new), h_new
+
+        (h_last, c_last), h_seq = lax.scan(step, (h0, c0),
+                                           (x, _mask_tm(mask, x)))
     if not time_major:
         h_seq = jnp.swapaxes(h_seq, 0, 1)
     if return_sequence:
@@ -125,15 +149,24 @@ def gru_block_cell(x, h_prev, w_ru, w_c, b_ru=None, b_c=None):
 
 
 @op("gru", "recurrent")
-def gru(x, h0, w_ru, w_c, b_ru=None, b_c=None, time_major=False):
+def gru(x, h0, w_ru, w_c, b_ru=None, b_c=None, time_major=False, mask=None):
     if not time_major:
         x = jnp.swapaxes(x, 0, 1)
 
-    def step(h, x_t):
-        h = gru_cell(x_t, h, w_ru, w_c, b_ru, b_c)
-        return h, h
+    if mask is None:
+        def step(h, x_t):
+            h = gru_cell(x_t, h, w_ru, w_c, b_ru, b_c)
+            return h, h
 
-    h_last, h_seq = lax.scan(step, h0, x)
+        h_last, h_seq = lax.scan(step, h0, x)
+    else:
+        def step(h, inp):
+            x_t, m_t = inp
+            h_new = gru_cell(x_t, h, w_ru, w_c, b_ru, b_c)
+            h_new = jnp.where(m_t, h_new, h)
+            return h_new, h_new
+
+        h_last, h_seq = lax.scan(step, h0, (x, _mask_tm(mask, x)))
     if not time_major:
         h_seq = jnp.swapaxes(h_seq, 0, 1)
     return h_seq, h_last
@@ -141,7 +174,7 @@ def gru(x, h0, w_ru, w_c, b_ru=None, b_c=None, time_major=False):
 
 @op("gru_onnx", "recurrent")
 def gru_onnx(x, w, r, b=None, h0=None, linear_before_reset=0,
-             time_major=True):
+             time_major=True, mask=None):
     """GRU with the ONNX weight layout and both candidate conventions
     (reference gruCell kernel: `libnd4j/include/ops/declarable/headers/
     recurrent.h` gruCell; the ONNX importer needs linear_before_reset=1,
@@ -163,17 +196,28 @@ def gru_onnx(x, w, r, b=None, h0=None, linear_before_reset=0,
     if h0 is None:
         h0 = jnp.zeros((x.shape[1], H), x.dtype)
 
-    def step(h, x_t):
+    def cell(h, x_t):
         z = jax.nn.sigmoid(x_t @ wz.T + h @ rz.T + wbz + rbz)
         g = jax.nn.sigmoid(x_t @ wr.T + h @ rr.T + wbr + rbr)
         if linear_before_reset:
             hh = jnp.tanh(x_t @ wh.T + g * (h @ rh.T + rbh) + wbh)
         else:
             hh = jnp.tanh(x_t @ wh.T + (g * h) @ rh.T + rbh + wbh)
-        h = z * h + (1.0 - z) * hh
-        return h, h
+        return z * h + (1.0 - z) * hh
 
-    h_last, h_seq = lax.scan(step, h0, x)
+    if mask is None:
+        def step(h, x_t):
+            h = cell(h, x_t)
+            return h, h
+
+        h_last, h_seq = lax.scan(step, h0, x)
+    else:
+        def step(h, inp):
+            x_t, m_t = inp
+            h_new = jnp.where(m_t, cell(h, x_t), h)
+            return h_new, h_new
+
+        h_last, h_seq = lax.scan(step, h0, (x, _mask_tm(mask, x)))
     if not time_major:
         h_seq = jnp.swapaxes(h_seq, 0, 1)
     return h_seq, h_last
@@ -211,21 +255,32 @@ def sru(x, c0, w, b, time_major=False):
 
 @op("static_rnn", "recurrent", aliases=("dynamic_rnn",))
 def simple_rnn(x, w_x, w_h, b=None, h0=None, activation=jnp.tanh,
-               time_major=False):
+               time_major=False, mask=None):
     if not time_major:
         x = jnp.swapaxes(x, 0, 1)
     B = x.shape[1]
     H = w_h.shape[0]
     h0 = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
 
-    def step(h, x_t):
-        z = jnp.matmul(x_t, w_x) + jnp.matmul(h, w_h)
-        if b is not None:
-            z = z + b
-        h = activation(z)
-        return h, h
+    if mask is None:
+        def step(h, x_t):
+            z = jnp.matmul(x_t, w_x) + jnp.matmul(h, w_h)
+            if b is not None:
+                z = z + b
+            h = activation(z)
+            return h, h
 
-    h_last, h_seq = lax.scan(step, h0, x)
+        h_last, h_seq = lax.scan(step, h0, x)
+    else:
+        def step(h, inp):
+            x_t, m_t = inp
+            z = jnp.matmul(x_t, w_x) + jnp.matmul(h, w_h)
+            if b is not None:
+                z = z + b
+            h_new = jnp.where(m_t, activation(z), h)
+            return h_new, h_new
+
+        h_last, h_seq = lax.scan(step, h0, (x, _mask_tm(mask, x)))
     if not time_major:
         h_seq = jnp.swapaxes(h_seq, 0, 1)
     return h_seq, h_last

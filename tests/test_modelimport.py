@@ -1117,6 +1117,207 @@ class TestKerasAdapterCompletion:
         res = net.output(x.transpose(0, 2, 1)).numpy()
         np.testing.assert_allclose(res, golden, atol=1e-5)
 
+def _padded_seqs(rs, B=3, T=6, F=4):
+    """Float sequences with Keras-style zero padding: leading, middle,
+    and trailing fully-masked timesteps across the batch."""
+    x = rs.randn(B, T, F).astype(np.float32) + 0.5
+    x[0, 4:, :] = 0.0          # trailing padding
+    x[1, 0, :] = 0.0           # leading masked step
+    x[2, 2, :] = 0.0           # interior masked step
+    return x
+
+
+class TestKerasMasking:
+    """Masking semantics threaded end-to-end (VERDICT r4 #3): masked
+    timesteps carry RNN state, repeat the previous output in sequences,
+    last-step selection lands on the last VALID step — golden vs TF
+    including padded timesteps (reference KerasMasking.java)."""
+
+    def _roundtrip(self, m, x, tmp_path, name):
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_sequential_model_and_weights
+        golden = m.predict(x, verbose=0)
+        path = str(tmp_path / f"{name}.h5")
+        m.save(path)
+        net = import_keras_sequential_model_and_weights(path)
+        return net, golden
+
+    def test_masking_lstm_last_step(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(0)
+        m = keras.Sequential([
+            keras.Input((6, 4)),
+            layers.Masking(mask_value=0.0, name="mk"),
+            layers.LSTM(5, name="l"),
+            layers.Dense(2, name="d"),
+        ])
+        x = _padded_seqs(rs)
+        net, golden = self._roundtrip(m, x, tmp_path, "mask_lstm_last")
+        res = net.output(x.transpose(0, 2, 1)).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    def test_masking_lstm_sequences(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(1)
+        m = keras.Sequential([
+            keras.Input((6, 4)),
+            layers.Masking(mask_value=0.0, name="mk"),
+            layers.LSTM(5, return_sequences=True, name="l"),
+        ])
+        x = _padded_seqs(rs)
+        net, golden = self._roundtrip(m, x, tmp_path, "mask_lstm_seq")
+        res = net.output(x.transpose(0, 2, 1)).numpy()
+        # ours is [B, H, T]; keras [B, T, H]
+        np.testing.assert_allclose(res.transpose(0, 2, 1), golden,
+                                   atol=1e-5)
+        # masked positions repeat the previous valid output
+        np.testing.assert_allclose(golden[0, 4], golden[0, 3], atol=1e-6)
+
+    def test_masking_stacked_lstm(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(2)
+        m = keras.Sequential([
+            keras.Input((6, 4)),
+            layers.Masking(mask_value=0.0, name="mk"),
+            layers.LSTM(5, return_sequences=True, name="l1"),
+            layers.LSTM(3, name="l2"),
+            layers.Dense(2, name="d"),
+        ])
+        x = _padded_seqs(rs)
+        net, golden = self._roundtrip(m, x, tmp_path, "mask_stack")
+        res = net.output(x.transpose(0, 2, 1)).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    @pytest.mark.parametrize("reset_after", [True, False])
+    def test_masking_gru(self, tmp_path, reset_after):
+        from keras import layers
+        rs = np.random.RandomState(3)
+        m = keras.Sequential([
+            keras.Input((6, 4)),
+            layers.Masking(mask_value=0.0, name="mk"),
+            layers.GRU(5, reset_after=reset_after, name="g"),
+            layers.Dense(2, name="d"),
+        ])
+        x = _padded_seqs(rs)
+        net, golden = self._roundtrip(m, x, tmp_path,
+                                      f"mask_gru{int(reset_after)}")
+        res = net.output(x.transpose(0, 2, 1)).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+    def test_masking_simple_rnn_sequences(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(4)
+        m = keras.Sequential([
+            keras.Input((6, 4)),
+            layers.Masking(mask_value=0.0, name="mk"),
+            layers.SimpleRNN(5, return_sequences=True, name="r"),
+        ])
+        x = _padded_seqs(rs)
+        net, golden = self._roundtrip(m, x, tmp_path, "mask_srnn")
+        res = net.output(x.transpose(0, 2, 1)).numpy()
+        np.testing.assert_allclose(res.transpose(0, 2, 1), golden,
+                                   atol=1e-5)
+
+    def test_masking_bidirectional(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(5)
+        m = keras.Sequential([
+            keras.Input((6, 4)),
+            layers.Masking(mask_value=0.0, name="mk"),
+            layers.Bidirectional(layers.LSTM(4, return_sequences=True),
+                                 name="bi"),
+        ])
+        x = _padded_seqs(rs)
+        net, golden = self._roundtrip(m, x, tmp_path, "mask_bi")
+        res = net.output(x.transpose(0, 2, 1)).numpy()
+        np.testing.assert_allclose(res.transpose(0, 2, 1), golden,
+                                   atol=1e-5)
+
+    def test_masked_loss_in_fit_and_score(self):
+        """The TRAIN path masks a temporal loss: padded timesteps
+        contribute nothing to fit()'s loss (score == hand-masked loss)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.conf import layers_extra as LX
+        from deeplearning4j_tpu.learning import Sgd
+
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Sgd(0.0))  # lr 0: fit() computes loss, no update
+                .list()
+                .layer(LX.MaskLayer(mask_value=0.0))
+                .layer(L.LSTM(n_in=3, n_out=4, return_sequence=True))
+                .layer(L.RnnOutputLayer(n_in=4, n_out=2, loss="mse",
+                                        activation="identity"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 3, 5).astype(np.float32)
+        x[:, :, 3:] = 0.0  # last two timesteps padded
+        y = rs.randn(2, 2, 5).astype(np.float32)
+        net.fit(DataSet(x, y))
+        fit_loss = float(net.score_value)
+
+        # hand-masked reference: only the 3 valid timesteps count
+        out = np.asarray(net.output(x).numpy())  # [B, 2, T]
+        valid = slice(0, 3)
+        want = float(np.mean((out[:, :, valid] - y[:, :, valid]) ** 2))
+        np.testing.assert_allclose(fit_loss, want, rtol=1e-4)
+        # and score() agrees with fit()
+        np.testing.assert_allclose(float(net.score(DataSet(x, y))),
+                                   fit_loss, rtol=1e-4)
+
+    def test_masked_pooling_refused(self, tmp_path):
+        from keras import layers
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_sequential_model_and_weights
+        from deeplearning4j_tpu.modelimport.ir import ImportException
+        m = keras.Sequential([
+            keras.Input((6, 4)),
+            layers.Masking(mask_value=0.0, name="mk"),
+            layers.LSTM(5, return_sequences=True, name="l"),
+            layers.GlobalAveragePooling1D(name="gap"),
+        ])
+        path = str(tmp_path / "mask_gap.h5")
+        m.save(path)
+        with pytest.raises(ImportException, match="consumes the"):
+            import_keras_sequential_model_and_weights(path)
+
+    def test_masking_in_functional_refused(self, tmp_path):
+        from keras import layers
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_model_and_weights
+        from deeplearning4j_tpu.modelimport.ir import ImportException
+        inp = keras.Input((6, 4))
+        h = layers.Masking(mask_value=0.0, name="mk")(inp)
+        h = layers.LSTM(5, name="l")(h)
+        m = keras.Model(inp, h)
+        path = str(tmp_path / "mask_func.h5")
+        m.save(path)
+        # loud refusal either way: the explicit functional-Masking guard
+        # (keras-2-style configs) or the unsupported mask-op layer keras 3
+        # serializes the functional mask computation into
+        with pytest.raises(ImportException,
+                           match="functional|unsupported Keras layer"):
+            import_keras_model_and_weights(path)
+
+    def test_nonzero_mask_value(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(6)
+        m = keras.Sequential([
+            keras.Input((5, 3)),
+            layers.Masking(mask_value=-1.0, name="mk"),
+            layers.LSTM(4, name="l"),
+        ])
+        x = rs.randn(2, 5, 3).astype(np.float32)
+        x[0, 3:, :] = -1.0
+        net, golden = self._roundtrip(m, x, tmp_path, "mask_neg1")
+        res = net.output(x.transpose(0, 2, 1)).numpy()
+        np.testing.assert_allclose(res, golden, atol=1e-5)
+
+
+class TestKerasLambdaHook:
     def test_lambda_requires_registration(self, tmp_path):
         from deeplearning4j_tpu.modelimport.ir import ImportException
         from deeplearning4j_tpu.modelimport.keras import register_lambda
