@@ -178,11 +178,177 @@ def _candidate_sets(category: str) -> List[Tuple[tuple, dict]]:
     return []
 
 
+def _op_overrides() -> Dict[str, List[Tuple[tuple, dict]]]:
+    """Per-op argument candidates for ops whose category candidates can't
+    satisfy their signatures (shape/index/seed/state-specific args) —
+    VERDICT r4 #8. Tried before the category sets."""
+    import jax as _jax
+    key = _jax.random.key(0)
+    x = _f32(N, N)
+    v = _f32(N)
+    img = _unit(8, 64, 64, 3)                     # NHWC
+    vol = _f32(4, 8, 16, 16, 16)                  # NCDHW
+    B, T, F, H = 16, 32, 64, 64
+    seq = _f32(B, T, F)
+    pad22 = np.array([[2, 2], [2, 2]], np.int32)
+    return {
+        "Where": [((_bool(N, N), x, _f32(N, N)), {})],
+        "alpha_dropout": [((x, 0.3, key), {})],
+        "dropout": [((x, 0.3, key), {})],
+        "gaussian_dropout": [((x, 0.3, key), {})],
+        "gaussian_noise": [((x, 0.1, key), {})],
+        "ams_grad_updater": [((x, _pos(N, N), _f32(N, N), _pos(N, N)), {})],
+        "avgpool3dnew": [((vol,), {"kernel": (2, 2, 2)}), ((vol,), {})],
+        "batch_to_space": [((_f32(16, 16, 16, 8), [2, 2],
+                             [[0, 0], [0, 0]]), {})],
+        "extract_image_patches": [((img, (3, 3), (1, 1), (1, 1)), {})],
+        "space_to_batch": [((_f32(4, 32, 32, 8), [2, 2],
+                             [[0, 0], [0, 0]]), {})],
+        "betainc": [((_unit(N, N) * 4 + 0.5, _unit(N, N) * 4 + 0.5,
+                      _unit(N, N)), {})],
+        "bincount": [((_i32(N * N, hi=64),), {"minlength": 64})],
+        "boolean_not": [((_bool(N, N),), {})],
+        "broadcast_to": [((v, (N, N)), {})],
+        "cbow": [((_f32(1024, 64), _f32(1024, 64),
+                   _i32(256, 8, hi=1024),
+                   np.ones((256, 8), np.float32),
+                   _i32(256, hi=1024), _i32(256, 5, hi=1024)), {})],
+        "clipbyvalue": [((x, -0.5, 0.5), {})],
+        "confusion_matrix": [((_i32(N, hi=16), _i32(N, hi=16)),
+                              {"num_classes": 16})],
+        "create": [(((N, N),), {})],
+        "crop_and_resize": [((img, _unit(16, 4), _i32(16, hi=8),
+                              (16, 16)), {})],
+        "cross": [((_f32(N, 3), _f32(N, 3)), {})],
+        "cross_batched": [((_f32(N, 3), _f32(N, 3)), {})],
+        "ctc_loss": [((_i32(8, 20, hi=30) + 1, _f32(8, 64, 32),
+                       np.full(8, 20, np.int32),
+                       np.full(8, 64, np.int32)), {})],
+        "deconv2d_tf": [((np.array([8, 64, 64, 32], np.int32),
+                          _f32(3, 3, 32, 64), _f32(8, 32, 32, 64)),
+                         {"strides": (2, 2)})],
+        "deconv3d": [((vol, _f32(3, 3, 3, 8, 8)), {}),
+                     ((vol, _f32(3, 3, 3, 8, 16)), {})],
+        "depth_to_space": [((_f32(8, 32, 32, 64), 2), {})],
+        "dilation2d": [((img, _f32(3, 3, 3)), {})],
+        "draw_bounding_boxes": [((img, _unit(8, 4, 4)), {})],
+        "dynamic_stitch": [(([_i32(64, hi=128), _i32(64, hi=128)],
+                             [_f32(64), _f32(64)]), {})],
+        "einsum": [((x, _f32(N, N)), {"equation": "ij,jk->ik"})],
+        "eye": [((N,), {})],
+        "fake_quant_with_min_max_vars": [((x, -1.0, 1.0), {})],
+        "fake_quant_with_min_max_vars_per_channel": [
+            ((x, -_pos(N), _pos(N)), {})],
+        "fill": [(((N, N), 3.0), {})],
+        "gather_nd": [((x, _i32(64, 2, hi=N)), {})],
+        "gru_onnx": [((_f32(T, B, F), _f32(3 * H, F), _f32(3 * H, H),
+                       _f32(6 * H)), {})],
+        "histogram": [((v, 32), {})],
+        "histogram_fixed_width": [((v, (-2.0, 2.0), 32), {})],
+        "im2col": [((_f32(8, 32, 64, 64), 3, 3), {})],
+        "image_resize": [((img, (32, 32)), {})],
+        "in_top_k": [((_f32(64, N), _i32(64, hi=N), 5), {})],
+        "invert_permutation": [((np.random.RandomState(0)
+                                 .permutation(N).astype(np.int32),), {})],
+        "knn_mindistance": [((v, v - 1.0, v + 1.0), {})],
+        "lin_space": [((0.0, 1.0, N), {})],
+        "lstmBlockCell": [((_f32(B, F), _f32(B, H), _f32(B, H),
+                            _f32(F + H, 4 * H), _f32(4 * H)), {})],
+        "lstmLayer_bidirectional": [((seq, _f32(F, 4 * H), _f32(H, 4 * H),
+                                      _f32(4 * H), _f32(F, 4 * H),
+                                      _f32(H, 4 * H), _f32(4 * H)), {})],
+        "matrix_band_part": [((x, 2, 2), {})],
+        "matrix_set_diag": [((x, v), {})],
+        "meshgrid": [((v, _f32(64)), {})],
+        "mirror_pad": [((x, [[2, 2], [2, 2]]), {})],
+        "multi_head_dot_product_attention": [
+            ((_f32(4, 64, 64), _f32(4, 64, 64), _f32(4, 64, 64),
+              _f32(64, 8, 32), _f32(64, 8, 32), _f32(64, 8, 32),
+              _f32(8 * 32, 64)), {})],
+        "non_max_suppression": [((_unit(64, 4), _unit(64), 16), {})],
+        "non_max_suppression_overlaps": [((_unit(64, 64), _unit(64), 16),
+                                          {})],
+        "normalize_moments": [((np.float32(N), v * N, _pos(N) * N), {})],
+        "onehot": [((_i32(N, hi=N), N), {})],
+        "pad": [((x, [[2, 2], [2, 2]]), {})],
+        "percentile": [((x, 50.0), {})],
+        "permute": [((x, (1, 0)), {})],
+        "polygamma": [((np.ones((N, N), np.int32), _pos(N, N)), {})],
+        "random_bernoulli": [((key, (N, N)), {})],
+        "random_crop": [((key, x, (64, 64)), {})],
+        "random_exponential": [((key, (N, N)), {})],
+        "random_gamma": [((key, (N, N), 2.0), {})],
+        "random_multinomial": [((key, _f32(64, 32), 16), {})],
+        "random_normal": [((key, (N, N)), {})],
+        "random_poisson": [((key, (N, N), 3.0), {})],
+        "randomuniform": [((key, (N, N)), {})],
+        "range": [((0, N, 1), {})],
+        "reduce_dot": [((x, _f32(N, N)), {"dims": [1]})],
+        "repeat": [((x, 2), {"axis": 0})],
+        "resize_area": [((img,), {"size": (32, 32)})],
+        "resize_bicubic": [((img,), {"size": (32, 32)})],
+        "resize_bilinear": [((img,), {"size": (32, 32)})],
+        "resize_nearest_neighbor": [((img,), {"size": (32, 32)})],
+        "reverse_sequence": [((seq, _i32(B, hi=T) + 1), {})],
+        "scatter_add": [((x, _i32(64, hi=N), _f32(64, N)), {})],
+        "scatter_div": [((x, _i32(64, hi=N), _pos(64, N)), {})],
+        "scatter_max": [((x, _i32(64, hi=N), _f32(64, N)), {})],
+        "scatter_min": [((x, _i32(64, hi=N), _f32(64, N)), {})],
+        "scatter_mul": [((x, _i32(64, hi=N), _f32(64, N)), {})],
+        "scatter_sub": [((x, _i32(64, hi=N), _f32(64, N)), {})],
+        "scatter_upd": [((x, _i32(64, hi=N), _f32(64, N)), {})],
+        "scatter_nd": [((_i32(64, 1, hi=N), _f32(64, N), [N, N]), {})],
+        "select": [((_bool(N, N), x, _f32(N, N)), {})],
+        "sequence_mask": [((_i32(N, hi=64) + 1,), {"maxlen": 64})],
+        "size_at": [((x, 0), {})],
+        "slice": [((x, (0, 0), (64, 64)), {})],
+        "space_to_depth": [((_f32(8, 64, 64, 16), 2), {})],
+        "sparse_softmax_cross_entropy_loss_with_logits": [
+            ((_i32(64, hi=N), _f32(64, N)), {})],
+        "split": [((x, 4), {"axis": 0})],
+        "split_v": [((x, [128, 128, 256]), {"axis": 0})],
+        "sru_bi": [((seq, _f32(F, 3 * F), _f32(2 * F), _f32(F, 3 * F),
+                     _f32(2 * F)), {})],
+        "sruCell": [((_f32(B, F), _f32(B, F), _f32(F, 3 * F),
+                      _f32(2 * F)), {})],
+        "static_bidirectional_rnn": [((seq, _f32(F, H), _f32(H, H),
+                                       _f32(H), _f32(F, H), _f32(H, H),
+                                       _f32(H)), {})],
+        "strided_slice": [((x, (0, 0), (N, N), (2, 2)), {})],
+        "tensormmul": [((x, _f32(N, N), [1], [0]), {})],
+        "tf_strided_slice": [((x, ((0, N, 2), (0, N, 2))), {}),
+                             ((x, [(0, N, 2), (0, N, 2)]), {})],
+        "tile": [((_f32(64, 64), (2, 2)), {})],
+        "tile_to_shape": [((_f32(64, 64), (8, 64, 64)), {})],
+        "top_k": [((x, 8), {})],
+        "tri": [((N,), {})],
+        "upsampling2d": [((_f32(8, 32, 32, 32),), {})],
+        "upsampling3d": [((vol,), {})],
+        "weighted_cross_entropy_with_logits": [((_unit(64, N),
+                                                 _f32(64, N), 2.0), {})],
+    }
+
+
 #: categories excluded by design (not standalone numeric array ops —
 #: graph machinery, bp pairs, or host-side string ops); reported, not
 #: silently dropped
 EXCLUDED_CATEGORIES = ("controlflow", "list", "autodiff_bp", "tsne",
                        "decoder", "strings")
+
+#: individually excluded ops, with reasons: shape-inference helpers that
+#: run on host values, and ops whose output shape is data-dependent (not
+#: expressible as one fixed-shape XLA program — same exemption class as
+#: the importer's Unique/Where accounting)
+EXCLUDED_OPS = {
+    "broadcast_dynamic_shape": "host-side shape inference (returns a shape)",
+    "broadcastgradientargs": "host-side shape inference (returns axes)",
+    "evaluate_reduction_shape": "host-side shape inference (returns a shape)",
+    "hashcode": "host-side scalar hash of concrete values",
+    "choose": "data-dependent output shape (boolean filter)",
+    "dynamic_partition": "data-dependent partition sizes",
+    "listdiff": "data-dependent output shape (set difference)",
+    "set_seed": "host-side RNG state mutation, no array output",
+}
 
 
 def _time_fn(fn, n_iter: int, block) -> float:
@@ -209,7 +375,9 @@ def run_opbench(filter_category: Optional[str] = None,
     reg = OpRegistry.get()
     results: Dict[str, Dict] = {}
     skipped: List[str] = []
+    skip_reasons: Dict[str, str] = {}
     excluded: List[str] = []
+    overrides = _op_overrides()
 
     for name in reg.names():
         d = reg.lookup(name)
@@ -217,11 +385,14 @@ def run_opbench(filter_category: Optional[str] = None,
             continue
         if filter_name and filter_name not in name:
             continue
-        if d.category in EXCLUDED_CATEGORIES or name.endswith("_bp"):
+        if d.category in EXCLUDED_CATEGORIES or name.endswith("_bp") \
+                or name in EXCLUDED_OPS:
             excluded.append(name)
             continue
         bench = None
-        for args, kwargs in _candidate_sets(d.category):
+        last_err = "no candidate argument set for category"
+        for args, kwargs in (overrides.get(name, [])
+                             + _candidate_sets(d.category)):
             try:
                 jargs = [jax.numpy.asarray(a)
                          if isinstance(a, np.ndarray)
@@ -231,24 +402,41 @@ def run_opbench(filter_category: Optional[str] = None,
                 jax.block_until_ready(out)
                 if sum(np.size(o) for o in jax.tree_util.tree_leaves(out)
                        if hasattr(o, "size")) > 64 * N * N:
+                    last_err = "candidate output explosively large"
                     continue  # mis-probed candidate with explosive output
                 bench = (jargs, kwargs, out)
                 break
-            except Exception:
+            except Exception as e:
+                last_err = f"{type(e).__name__}: {str(e)[:120]}"
                 continue
         if bench is None:
             skipped.append(name)
+            skip_reasons[name] = last_err
             continue
         jargs, kwargs, _ = bench
         try:
             eager_us = _time_fn(lambda: d.fn(*jargs, **kwargs), n_iter,
                                 jax.block_until_ready)
-            jfn = jax.jit(lambda *a: d.fn(*a, **kwargs))
-            jax.block_until_ready(jfn(*jargs))  # compile
-            jit_us = _time_fn(lambda: jfn(*jargs), n_iter,
+            # only ARRAY args are traced; shape/axis/int args stay static
+            # (closed over) so shape-consuming ops compile
+            arr_idx = [i for i, a in enumerate(jargs)
+                       if hasattr(a, "shape") and hasattr(a, "dtype")]
+
+            def jfn_base(*arrs):
+                full = list(jargs)
+                for i, a in zip(arr_idx, arrs):
+                    full[i] = a
+                return d.fn(*full, **kwargs)
+
+            jfn = jax.jit(jfn_base)
+            arrs = [jargs[i] for i in arr_idx]
+            jax.block_until_ready(jfn(*arrs))  # compile
+            jit_us = _time_fn(lambda: jfn(*arrs), n_iter,
                               jax.block_until_ready)
-        except Exception:
+        except Exception as e:
             skipped.append(name)
+            skip_reasons[name] = (f"timing failed: {type(e).__name__}: "
+                                  f"{str(e)[:120]}")
             continue
         results[name] = {
             "category": d.category,
@@ -257,6 +445,7 @@ def run_opbench(filter_category: Optional[str] = None,
             "args": [list(np.shape(a)) for a in jargs],
         }
     return {"results": results, "skipped": sorted(skipped),
+            "skip_reasons": {k: skip_reasons[k] for k in sorted(skip_reasons)},
             "excluded": sorted(excluded),
             "platform": jax.devices()[0].platform,
             "n_benched": len(results)}
@@ -301,6 +490,8 @@ def main(argv=None):
           f"({len(out['skipped'])} skipped, "
           f"{len(out['excluded'])} excluded by design) "
           f"on {out['platform']}")
+    for op in out["skipped"]:
+        print(f"  SKIP {op}: {out['skip_reasons'].get(op, '?')}")
     worst = sorted(out["results"].items(),
                    key=lambda kv: -kv[1]["jit_us"])[:10]
     for name, r in worst:

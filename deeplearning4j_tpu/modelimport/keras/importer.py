@@ -626,10 +626,22 @@ def _adapt_layer(class_name: str, cfg: Dict[str, Any],
             dims=tuple(int(d) for d in cfg.get("dims", (1,))),
             name=cfg.get("name")))
     if class_name == "Reshape":
-        return _Adapted(LX.ReshapeLayer(
-            target_shape=_resolve_reshape(cfg.get("target_shape", ()),
-                                          keras_in_shape),
-            name=cfg.get("name")))
+        target = _resolve_reshape(cfg.get("target_shape", ()),
+                                  keras_in_shape)
+        if len(target) == 3:
+            h, w, c = target
+            if h == 1 and w == 1:
+                # keras (1, 1, C) is NHWC; the runtime is NCHW. With 1x1
+                # spatial dims the element order is identical, so the
+                # SE-block pattern (GlobalPool -> Reshape -> 1x1 Conv)
+                # maps exactly
+                target = (c, 1, 1)
+            else:
+                raise ImportException(
+                    "Reshape to a conv tensor with non-1x1 spatial dims "
+                    "is unsupported (NHWC/NCHW element order differs)")
+        return _Adapted(LX.ReshapeLayer(target_shape=target,
+                                        name=cfg.get("name")))
     if class_name == "Masking":
         # emits the timestep keep-mask; MultiLayerNetwork threads it into
         # downstream RNN layers (Keras semantics: masked steps carry state
@@ -669,6 +681,32 @@ def _adapt_layer(class_name: str, cfg: Dict[str, Any],
     if class_name == "SpaceToDepth":
         return _Adapted(LX.SpaceToDepthLayer(
             block_size=int(cfg.get("block_size", 2)), name=cfg.get("name")))
+    if class_name == "Rescaling":
+        sc, off = cfg.get("scale", 1.0), cfg.get("offset", 0.0)
+        if isinstance(sc, (list, tuple)) or isinstance(off, (list, tuple)):
+            raise ImportException(
+                "Rescaling with per-element scale/offset is unsupported "
+                "(NHWC->NCHW broadcast would need layout tracking)")
+        return _Adapted(LX.RescaleLayer(scale=float(sc), offset=float(off),
+                                        name=cfg.get("name")))
+    if class_name == "Normalization":
+        if cfg.get("invert"):
+            raise ImportException("Normalization(invert=True) unsupported")
+        axis = cfg.get("axis")
+        axis = list(axis) if isinstance(axis, (list, tuple)) else [axis]
+        if axis not in ([3], [-1]):
+            raise ImportException(
+                f"Normalization over axis {axis} unsupported (only the "
+                f"channels axis)")
+        layer = LX.ChannelNormalizationLayer(name=cfg.get("name"))
+
+        def norm_weights(weights, in_type):
+            # h5 weights: [mean (C,), variance (C,), count ()]
+            return {"mean": jnp.asarray(np.asarray(weights[0]).ravel()),
+                    "variance": jnp.asarray(
+                        np.asarray(weights[1]).ravel())}
+
+        return _Adapted(layer, norm_weights)
     if class_name == "Lambda":
         fn = _LAMBDA_REGISTRY.get(cfg.get("name"))
         if fn is None:
@@ -782,6 +820,8 @@ def _keras_out_shape(class_name, cfg, in_shape):
         dims = tuple(int(d) for d in cfg.get("dims", ()))
         return tuple(in_shape[d - 1] for d in dims)
     if class_name == "Masking":
+        return tuple(in_shape)
+    if class_name in ("Rescaling", "Normalization"):
         return tuple(in_shape)
     if class_name == "LocallyConnected1D":
         t = in_shape[0]
@@ -943,7 +983,8 @@ class KerasModelImport:
                         "timestep mask in keras; mask threading covers RNN "
                         "layers only — pool after an RNN with "
                         "return_sequences=False, or drop the Masking layer")
-                if cls in ("LSTM", "GRU", "SimpleRNN")                         and not cfg.get("return_sequences", False):
+                if (cls in ("LSTM", "GRU", "SimpleRNN")
+                        and not cfg.get("return_sequences", False)):
                     mask_alive = False  # consumed by last-step selection
             if cls == "Flatten" and cur is not None and len(cur) in (3, 4):
                 conv_src = cur
@@ -1152,9 +1193,9 @@ class KerasModelImport:
                 in_shape = unflattened[inbound[0]]
             if cls in ("Add", "Subtract", "Multiply", "Average", "Maximum",
                        "Minimum"):
-                op = {"Add": "add", "Subtract": "sub", "Multiply": "mul",
-                      "Average": "ave", "Maximum": "max",
-                      "Minimum": "min"}[cls]
+                op = {"Add": "add", "Subtract": "subtract",
+                      "Multiply": "product", "Average": "average",
+                      "Maximum": "max", "Minimum": "min"}[cls]
                 builder.add_vertex(name, ElementWiseVertex(op=op), *in_names)
                 keras_shapes[name] = in_shape
                 _mark_layout(in_shape)

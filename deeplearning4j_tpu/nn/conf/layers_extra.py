@@ -637,7 +637,10 @@ class MaskLayer(Layer):
     emits_mask = True
 
     def forward(self, params, x, training=False, key=None):
-        return x
+        # Keras Masking ZEROES masked timesteps in its output (visible to
+        # non-mask-aware consumers); for mask_value=0 this is an identity
+        keep = jnp.any(x != self.mask_value, axis=1, keepdims=True)
+        return x * keep.astype(x.dtype)
 
     def compute_mask(self, x):
         """[B, F, T] activations -> [B, T] keep-mask."""
@@ -645,6 +648,38 @@ class MaskLayer(Layer):
 
     def has_params(self):
         return False
+
+
+@dataclasses.dataclass
+class RescaleLayer(Layer):
+    """y = x * scale + offset (keras preprocessing Rescaling)."""
+    scale: float = 1.0
+    offset: float = 0.0
+
+    def forward(self, params, x, training=False, key=None):
+        return x * self.scale + self.offset
+
+    def has_params(self):
+        return False
+
+
+@dataclasses.dataclass
+class ChannelNormalizationLayer(Layer):
+    """Per-channel feature normalization (keras preprocessing
+    Normalization with axis=channels): y = (x - mean) / max(sqrt(var),
+    eps). mean/variance arrive as imported weights over channel axis 1
+    (NHWC h5 weights adapted to the NCHW runtime layout)."""
+
+    def init_params(self, key, input_type):
+        c = input_type[0] if input_type else 1
+        return {"mean": jnp.zeros((c,)), "variance": jnp.ones((c,))}
+
+    def forward(self, params, x, training=False, key=None):
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        mean = params["mean"].reshape(shape)
+        std = jnp.maximum(jnp.sqrt(params["variance"].reshape(shape)),
+                          1e-7)
+        return (x - mean) / std
 
 
 @dataclasses.dataclass

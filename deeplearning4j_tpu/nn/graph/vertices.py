@@ -88,6 +88,11 @@ class ElementWiseVertex(GraphVertex):
             for x in inputs[1:]:
                 out = jnp.maximum(out, x)
             return out
+        if self.op == "min":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.minimum(out, x)
+            return out
         raise ValueError(f"unknown op {self.op}")
 
 

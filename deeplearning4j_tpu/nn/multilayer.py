@@ -117,10 +117,6 @@ class MultiLayerNetwork(FitFastPathMixin):
     def _forward(self, params, x, training: bool, key=None):
         return self._forward_core(params, x, training, key)[0]
 
-    def _forward_mask(self, params, x, training: bool, key=None):
-        h, mask, _ = self._forward_core(params, x, training, key)
-        return h, mask
-
     def _forward_core(self, params, x, training: bool, key=None,
                       collect_bn: bool = False):
         """THE per-layer forward loop (single copy: inference, train step,

@@ -335,9 +335,16 @@ def lstm_block(x, h0, c0, w, b, wci=None, wcf=None, wco=None,
 @op("sru_bi", "recurrent")
 def sru_bi(x, w_f, b_f, w_b, b_b, c0_f=None, c0_b=None, time_major=False):
     """Bidirectional SRU (reference sru_bi): fwd + reversed bwd, concat."""
-    fwd, cf = sru(x, w_f, b_f, c0_f, time_major=time_major)
+    B = x.shape[0] if not time_major else x.shape[1]
+    H = w_f.shape[1] // 3
+    if c0_f is None:
+        c0_f = jnp.zeros((B, H), x.dtype)
+    if c0_b is None:
+        c0_b = jnp.zeros((B, H), x.dtype)
+    # sru signature is (x, c0, w, b)
+    fwd, cf = sru(x, c0_f, w_f, b_f, time_major=time_major)
     axis = 0 if time_major else 1
-    bwd, cb = sru(jnp.flip(x, axis=axis), w_b, b_b, c0_b,
+    bwd, cb = sru(jnp.flip(x, axis=axis), c0_b, w_b, b_b,
                   time_major=time_major)
     bwd = jnp.flip(bwd, axis=axis)
     return jnp.concatenate([fwd, bwd], axis=-1), cf, cb

@@ -32,7 +32,9 @@ op("concat", "shape")(lambda *xs, axis=0: jnp.concatenate(xs, axis=axis))
 op("stack", "shape", aliases=("parallel_stack",))(lambda *xs, axis=0: jnp.stack(xs, axis=axis))
 op("unstack", "shape")(lambda x, axis=0: [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)])
 op("split", "shape")(lambda x, num, axis=0: jnp.split(x, num, axis=axis))
-op("split_v", "shape")(lambda x, sizes, axis=0: jnp.split(x, jnp.cumsum(jnp.asarray(sizes))[:-1].tolist(), axis=axis))
+# sizes are static shape metadata: keep the cumsum on host (numpy) so the
+# op stays jittable with traced x
+op("split_v", "shape")(lambda x, sizes, axis=0: jnp.split(x, np.cumsum(np.asarray(sizes))[:-1].tolist(), axis=axis))
 op("tear", "shape")(lambda x, axis=0: [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)])
 op("reverse", "shape")(lambda x, dims=None: jnp.flip(x, axis=tuple(dims) if dims is not None else None))
 op("roll", "shape")(lambda x, shift, axis=None: jnp.roll(x, shift, axis=axis))

@@ -1302,6 +1302,25 @@ class TestKerasMasking:
                            match="functional|unsupported Keras layer"):
             import_keras_model_and_weights(path)
 
+    def test_nonzero_mask_value_zeroes_output(self, tmp_path):
+        """Keras Masking ZEROES masked timesteps in its own output, so a
+        non-mask-aware consumer (TimeDistributed Dense) must see zeros,
+        not the raw mask_value rows."""
+        from keras import layers
+        rs = np.random.RandomState(7)
+        m = keras.Sequential([
+            keras.Input((5, 3)),
+            layers.Masking(mask_value=2.0, name="mk"),
+            layers.TimeDistributed(layers.Dense(4, activation="tanh"),
+                                   name="td"),
+        ])
+        x = rs.randn(2, 5, 3).astype(np.float32)
+        x[0, 3:, :] = 2.0
+        net, golden = self._roundtrip(m, x, tmp_path, "mask_td")
+        res = net.output(x.transpose(0, 2, 1)).numpy()
+        np.testing.assert_allclose(np.asarray(res).transpose(0, 2, 1),
+                                   golden, atol=1e-5)
+
     def test_nonzero_mask_value(self, tmp_path):
         from keras import layers
         rs = np.random.RandomState(6)
