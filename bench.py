@@ -108,7 +108,8 @@ def _measure_bert_variant(jax, jnp, bert, config, batch, B, T, n_steps,
     it = n_steps
     for attempt in range(2):
         runs, trajs = [], []
-        for _ in range(3):
+        n_runs = 5  # median over 5: one tunnel hiccup cannot shift it
+        for _ in range(n_runs):
             t0 = time.perf_counter()
             params, opt, losses = step(params, opt, batch, it)
             jax.block_until_ready(losses)
@@ -116,7 +117,7 @@ def _measure_bert_variant(jax, jnp, bert, config, batch, B, T, n_steps,
             trajs.append(np.asarray(losses, np.float64))
             it += n_steps
         runs.sort()
-        dt = runs[1]  # median of 3
+        dt = runs[n_runs // 2]
         sps = n_steps * B / dt
         mfu = sps * T * fpt / peak if peak else 0.0
         ok, reason = check_bert_sanity(np.stack(trajs), mfu)
