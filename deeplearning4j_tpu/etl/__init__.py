@@ -29,6 +29,8 @@ from .image_transforms import (ImageTransform, ImageTransformProcess,
 from .distributed import (ShardedTransformExecutor, shard_records,
                           shard_files)
 from . import columnar
+from .excel import ExcelRecordReader, ExcelRecordWriter
+from .jdbc import JDBCRecordReader, RecordMetaDataJdbc
 from .records import (InputSplit, FileSplit, CollectionInputSplit, StringSplit,
                       RecordReader, CSVRecordReader, LineRecordReader,
                       CollectionRecordReader, JacksonLineRecordReader,
