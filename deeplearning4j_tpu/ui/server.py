@@ -24,49 +24,180 @@ _PAGE = """<!DOCTYPE html>
 <html><head><title>DL4J-TPU Training UI</title>
 <style>
  body { font-family: sans-serif; margin: 20px; background: #fafafa; }
- h1 { font-size: 20px; } h2 { font-size: 15px; color: #444; }
- .row { display: flex; gap: 24px; flex-wrap: wrap; }
+ h1 { font-size: 20px; } h2 { font-size: 14px; color: #444; margin: 4px 0; }
+ .row { display: flex; gap: 22px; flex-wrap: wrap; }
  canvas { background: #fff; border: 1px solid #ccc; }
- #meta { color: #666; font-size: 13px; }
+ #meta { color: #666; font-size: 13px; margin-bottom: 10px; }
+ select { font-size: 13px; margin: 0 8px 8px 0; }
+ table { border-collapse: collapse; font-size: 12px; background: #fff; }
+ th, td { border: 1px solid #ddd; padding: 3px 8px; text-align: left; }
+ th { background: #eee; }
+ .legend { font-size: 11px; }
+ .legend span { margin-right: 10px; }
 </style></head>
 <body>
 <h1>Training Dashboard</h1>
+<div>
+ session <select id="sess"></select>
+ layer <select id="layersel"></select>
+ param <select id="paramsel"></select>
+</div>
 <div id="meta"></div>
 <div class="row">
- <div><h2>Score vs Iteration</h2><canvas id="score" width="460" height="260"></canvas></div>
- <div><h2>Update : Param Ratio (log10)</h2><canvas id="ratio" width="460" height="260"></canvas></div>
+ <div><h2>Score vs Iteration</h2><canvas id="score" width="440" height="240"></canvas></div>
+ <div><h2>Update : Param Ratio (log10)</h2><canvas id="ratio" width="440" height="240"></canvas></div>
+ <div><h2>Iteration Time (s)</h2><canvas id="itertime" width="440" height="240"></canvas></div>
+ <div><h2>Device Memory (MB)</h2><canvas id="mem" width="440" height="240"></canvas></div>
+</div>
+<div class="row">
+ <div><h2>Per-layer Mean |W| (log10)</h2>
+  <canvas id="layers" width="440" height="240"></canvas>
+  <div id="layerlegend" class="legend"></div></div>
+ <div><h2>Parameter Histogram (latest)</h2>
+  <canvas id="hist" width="440" height="240"></canvas></div>
+ <div><h2>Layers</h2><table id="layertable"></table></div>
 </div>
 <script>
-function drawLine(canvas, xs, ys, color) {
-  const c = canvas.getContext('2d');
+const PALETTE = ['#c33','#36c','#2a2','#b70','#829','#067','#a14','#551'];
+function axes(c, canvas, xmin, xmax, ymin, ymax) {
   c.clearRect(0, 0, canvas.width, canvas.height);
-  if (xs.length < 2) return;
-  const xmin = Math.min(...xs), xmax = Math.max(...xs);
-  const ymin = Math.min(...ys), ymax = Math.max(...ys);
-  const px = x => 40 + (x - xmin) / (xmax - xmin || 1) * (canvas.width - 50);
-  const py = y => canvas.height - 25 - (y - ymin) / (ymax - ymin || 1) * (canvas.height - 40);
   c.strokeStyle = '#999'; c.strokeRect(40, 15, canvas.width - 50, canvas.height - 40);
   c.fillStyle = '#333'; c.font = '11px sans-serif';
-  c.fillText(ymax.toPrecision(4), 2, 20); c.fillText(ymin.toPrecision(4), 2, canvas.height - 25);
-  c.strokeStyle = color; c.beginPath();
-  xs.forEach((x, i) => i ? c.lineTo(px(x), py(ys[i])) : c.moveTo(px(x), py(ys[i])));
-  c.stroke();
+  c.fillText(ymax.toPrecision(4), 2, 20);
+  c.fillText(ymin.toPrecision(4), 2, canvas.height - 25);
+  c.fillText(String(xmin), 40, canvas.height - 8);
+  c.fillText(String(xmax), canvas.width - 40, canvas.height - 8);
+}
+function drawSeries(canvas, xs, seriesList) {
+  // seriesList: [{ys, color}] sharing the xs domain
+  const c = canvas.getContext('2d');
+  const all = seriesList.flatMap(s => s.ys).filter(y => isFinite(y));
+  if (xs.length < 2 || !all.length) {
+    c.clearRect(0, 0, canvas.width, canvas.height); return;
+  }
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  const ymin = Math.min(...all), ymax = Math.max(...all);
+  axes(c, canvas, xmin, xmax, ymin, ymax);
+  const px = x => 40 + (x - xmin) / (xmax - xmin || 1) * (canvas.width - 50);
+  const py = y => canvas.height - 25 - (y - ymin) / (ymax - ymin || 1) * (canvas.height - 40);
+  for (const s of seriesList) {
+    c.strokeStyle = s.color; c.beginPath();
+    let started = false;
+    xs.forEach((x, i) => {
+      const y = s.ys[i];
+      if (!isFinite(y)) return;
+      if (started) c.lineTo(px(x), py(y)); else { c.moveTo(px(x), py(y)); started = true; }
+    });
+    c.stroke();
+  }
+}
+function esc(t) {
+  return String(t).replace(/[&<>"']/g,
+      ch => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[ch]));
+}
+function drawLine(canvas, xs, ys, color) { drawSeries(canvas, xs, [{ys, color}]); }
+function drawHist(canvas, hist) {
+  const c = canvas.getContext('2d');
+  c.clearRect(0, 0, canvas.width, canvas.height);
+  if (!hist || !hist.counts || !hist.counts.length) return;
+  const n = hist.counts.length, cmax = Math.max(...hist.counts);
+  const e = hist.edges || [];
+  axes(c, canvas, e.length ? e[0] : 0, e.length ? e[e.length - 1] : 1,
+       0, cmax);
+  const w = (canvas.width - 50) / n;
+  c.fillStyle = '#36c';
+  hist.counts.forEach((v, i) => {
+    const h = v / (cmax || 1) * (canvas.height - 40);
+    c.fillRect(40 + i * w, canvas.height - 25 - h, Math.max(w - 1, 1), h);
+  });
+}
+function fillSelect(el, options) {
+  // rebuild only when the option list changed (a rebuild collapses an
+  // open dropdown); keep the user's selection, default to the LAST
+  // option (newest session) on first fill
+  const cur = el.value;
+  const existing = [...el.options].map(o => o.value);
+  if (existing.length !== options.length ||
+      existing.some((v, i) => v !== options[i])) {
+    el.innerHTML = '';
+    for (const o of options) {
+      const opt = document.createElement('option');
+      opt.value = o; opt.textContent = o; el.appendChild(opt);
+    }
+    el.value = options.includes(cur) ? cur : options[options.length - 1];
+  }
 }
 async function refresh() {
   const sessions = await (await fetch('train/sessions')).json();
   if (!sessions.length) return;
-  const sid = sessions[sessions.length - 1];
+  fillSelect(document.getElementById('sess'), sessions);
+  const sid = document.getElementById('sess').value;
   const data = await (await fetch('train/overview?sid=' + sid)).json();
   const ups = data.updates || [];
   const iters = ups.map(u => u.iteration);
   drawLine(document.getElementById('score'), iters, ups.map(u => u.score), '#c33');
   const rat = ups.filter(u => u.update_param_ratio != null);
-  drawLine(document.getElementById('ratio'), rat.map(u => u.iteration),
-           rat.map(u => Math.log10(u.update_param_ratio + 1e-12)), '#36c');
+  drawSeries(document.getElementById('ratio'), rat.map(u => u.iteration),
+      [{ys: rat.map(u => Math.log10(u.update_param_ratio + 1e-12)), color: '#36c'}]);
+  const tm = ups.filter(u => u.iter_seconds != null);
+  drawSeries(document.getElementById('itertime'), tm.map(u => u.iteration),
+      [{ys: tm.map(u => u.iter_seconds), color: '#2a2'}]);
+  const mm = ups.filter(u => u.memory);
+  drawSeries(document.getElementById('mem'), mm.map(u => u.iteration),
+      [{ys: mm.map(u => u.memory.bytes_in_use / 1048576), color: '#b70'},
+       {ys: mm.map(u => (u.memory.peak_bytes_in_use || 0) / 1048576), color: '#829'}]);
+
+  // per-layer series: prefer the weight-like param (W/kernel) of each
+  // layer over biases; note truncation when layers exceed the palette
+  const last = ups[ups.length - 1] || {};
+  const names = Object.keys(last.params || {});
+  const layers = [...new Set(names.map(n => n.split('/')[0]))];
+  const series = [], legend = [];
+  layers.slice(0, PALETTE.length).forEach((ln, i) => {
+    const mine = names.filter(n => n.startsWith(ln + '/'));
+    const key = mine.find(n => /[/](W|w|kernel|Wx)$/.test(n)) || mine[0];
+    if (!key) return;
+    series.push({ys: ups.map(u => {
+      const p = (u.params || {})[key];
+      return p ? Math.log10(p.mean_mag + 1e-12) : NaN;
+    }), color: PALETTE[i]});
+    legend.push(`<span style="color:${PALETTE[i]}">■ ${esc(key)}</span>`);
+  });
+  if (layers.length > PALETTE.length) {
+    legend.push(`<span>(+${layers.length - PALETTE.length} more layers)</span>`);
+  }
+  drawSeries(document.getElementById('layers'), iters, series);
+  document.getElementById('layerlegend').innerHTML = legend.join('');
+
+  // histogram of the selected param (latest update that carries one);
+  // cleared when none exists so a stale chart never lingers
+  fillSelect(document.getElementById('layersel'), layers);
+  const lsel = document.getElementById('layersel').value;
+  const pnames = names.filter(n => n.startsWith((lsel || '') + '/'));
+  fillSelect(document.getElementById('paramsel'), pnames);
+  const psel = document.getElementById('paramsel').value;
+  let hist = null;
+  for (let i = ups.length - 1; i >= 0; i--) {
+    const p = (ups[i].params || {})[psel];
+    if (p && p.histogram) { hist = p.histogram; break; }
+  }
+  drawHist(document.getElementById('hist'), hist);
+
+  // layer table: latest l2 / mean|W| per param (names escaped — the
+  // remote ingestion endpoint is open, so treat them as untrusted)
+  const rows = ['<tr><th>param</th><th>L2</th><th>mean |W|</th></tr>'];
+  for (const n of names) {
+    const p = last.params[n];
+    rows.push(`<tr><td>${esc(n)}</td><td>${p.l2.toPrecision(5)}</td>` +
+              `<td>${p.mean_mag.toPrecision(5)}</td></tr>`);
+  }
+  document.getElementById('layertable').innerHTML = rows.join('');
+
   const s = data.static || {};
   document.getElementById('meta').textContent =
-    `session ${sid} | ${s.model_class || ''} | params: ${s.n_params || '?'} ` +
-    `| backend: ${s.backend || '?'} x${s.device_count || 1} | updates: ${ups.length}`;
+    `session ${sid} | ${s.model_class || ''} | layers: ${s.n_layers || '?'} ` +
+    `| params: ${s.n_params || '?'} | backend: ${s.backend || '?'} ` +
+    `x${s.device_count || 1} | updates: ${ups.length}`;
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>"""
