@@ -258,12 +258,6 @@ def _bidirectional_adapter(cfg):
     inner_spec = cfg.get("layer", {})
     inner_cls = inner_spec.get("class_name")
     inner_cfg = dict(inner_spec.get("config", {}))
-    if not inner_cfg.get("return_sequences", False):
-        raise ImportException(
-            "Bidirectional(return_sequences=False) is not supported — the "
-            "backward half's final state is at t=0, which the sequence-"
-            "output wrapper cannot recover; re-export with "
-            "return_sequences=True + pooling")
     mode = {"concat": "concat", "sum": "add", "mul": "mul",
             "ave": "ave", None: "concat"}.get(cfg.get("merge_mode",
                                                       "concat"))
@@ -295,12 +289,11 @@ def _time_distributed_adapter(cfg):
 
 def _conv1d_adapter(cfg):
     pad = cfg.get("padding", "valid")
-    if pad == "causal":
-        raise ImportException("Conv1D padding='causal' not supported")
     layer = L.Convolution1DLayer(
         n_out=int(cfg["filters"]), kernel_size=int(_pair(cfg["kernel_size"])[0]),
         stride=int(_pair(cfg.get("strides", 1))[0]),
-        padding="SAME" if pad == "same" else "VALID",
+        dilation=int(_pair(cfg.get("dilation_rate", 1))[0]),
+        padding={"same": "SAME", "causal": "CAUSAL"}.get(pad, "VALID"),
         activation=_act(cfg.get("activation")),
         has_bias=bool(cfg.get("use_bias", True)), name=cfg.get("name"))
 
@@ -401,14 +394,12 @@ def _prelu_adapter(cfg):
 
     def set_weights(weights, in_type):
         alpha = np.asarray(weights[0])
-        if alpha.ndim > 1:
-            squeezed = alpha.reshape(-1) if alpha.size == alpha.shape[-1] \
-                else None
-            if squeezed is None:
-                raise ImportException(
-                    "PReLU with per-position alpha is not supported; use "
-                    "shared_axes over the spatial dims")
-            alpha = squeezed
+        if alpha.ndim > 1 and alpha.size == alpha.shape[-1]:
+            alpha = alpha.reshape(-1)       # shared over all but channels
+        elif alpha.ndim > 1:
+            # per-position alpha: keras holds it channels-last (the
+            # batchless input shape); our activations are channels-first
+            alpha = np.moveaxis(alpha, -1, 0)
         return {"alpha": jnp.asarray(alpha)}
 
     return _Adapted(layer, set_weights)
@@ -850,8 +841,11 @@ def _keras_out_shape(class_name, cfg, in_shape):
         t, f = in_shape
         k = _pair(cfg["kernel_size"])[0]
         s = _pair(cfg.get("strides", 1))[0]
-        ot = -(-t // s) if cfg.get("padding", "valid") == "same" \
-            else (t - k) // s + 1
+        d = _pair(cfg.get("dilation_rate", 1))[0]
+        if cfg.get("padding", "valid") in ("same", "causal"):
+            ot = -(-t // s)
+        else:
+            ot = (t - d * (k - 1) - 1) // s + 1
         return (ot, int(cfg["filters"]))
     if class_name in ("MaxPooling1D", "AveragePooling1D"):
         t, f = in_shape

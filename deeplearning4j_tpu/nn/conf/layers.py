@@ -176,12 +176,17 @@ class ConvolutionLayer(Layer):
 
 @dataclasses.dataclass
 class Convolution1DLayer(Layer):
-    """1D conv over RNN-format input (features, time) (reference Conv1DLayer)."""
+    """1D conv over RNN-format input (features, time) (reference Conv1DLayer).
+
+    padding "CAUSAL" (keras Conv1D padding='causal'): left-pads the time
+    axis with dilation*(kernel_size-1) zeros and convolves VALID, so
+    output t sees only inputs <= t."""
     n_in: int = 0
     n_out: int = 0
     kernel_size: int = 3
     stride: int = 1
     padding: Union[str, int] = "SAME"
+    dilation: int = 1
     activation: str = "identity"
     weight_init: str = "relu"
     has_bias: bool = True
@@ -194,19 +199,33 @@ class Convolution1DLayer(Layer):
             p["b"] = jnp.zeros((self.n_out,))
         return p
 
+    def _is_causal(self):
+        return (isinstance(self.padding, str)
+                and self.padding.upper() == "CAUSAL")
+
     def forward(self, params, x, training=False, key=None):
-        pad = self.padding if isinstance(self.padding, str) else int(self.padding)
+        if self._is_causal():
+            left = self.dilation * (self.kernel_size - 1)
+            x = jnp.pad(x, ((0, 0), (0, 0), (left, 0)))
+            pad = "VALID"
+        else:
+            pad = (self.padding if isinstance(self.padding, str)
+                   else int(self.padding))
         return get_activation(self.activation)(
             conv_ops.conv1d(x, params["W"], params.get("b"),
-                            strides=self.stride, padding=pad, data_format="NCW"))
+                            strides=self.stride, padding=pad,
+                            dilation=self.dilation, data_format="NCW"))
 
     def output_type(self, input_type):
         c, t = input_type
-        if isinstance(self.padding, str) and self.padding.upper() == "SAME":
+        if self._is_causal():
+            ot = -(-t // self.stride)
+        elif isinstance(self.padding, str) and self.padding.upper() == "SAME":
             ot = -(-t // self.stride)
         else:
             p = self.padding if not isinstance(self.padding, str) else 0
-            ot = (t + 2 * p - self.kernel_size) // self.stride + 1
+            span = self.dilation * (self.kernel_size - 1) + 1
+            ot = (t + 2 * p - span) // self.stride + 1
         return (self.n_out, ot)
 
 
@@ -409,6 +428,11 @@ class Bidirectional(Layer):
     def accepts_mask(self):
         return getattr(self.fwd, "accepts_mask", False)
 
+    @property
+    def return_sequence(self):
+        # a last-step inner layer consumes the time axis (and any mask)
+        return getattr(self.fwd, "return_sequence", True)
+
     def forward(self, params, x, training=False, key=None, mask=None):
         mk = {"mask": mask} if mask is not None else {}
         out_f = self.fwd.forward(params["fwd"], x, training, key, **mk)
@@ -416,7 +440,11 @@ class Bidirectional(Layer):
         mk_b = ({"mask": jnp.flip(mask, axis=-1)} if mask is not None
                 else {})
         out_b = self.fwd.forward(params["bwd"], x_rev, training, key, **mk_b)
-        out_b = jnp.flip(out_b, axis=-1)
+        if out_b.ndim == 3:
+            out_b = jnp.flip(out_b, axis=-1)
+        # 2-D [B, H] outputs (return_sequences=False inner): no time axis
+        # to un-flip — the backward half's final state already corresponds
+        # to the sequence start, exactly keras' backward_layer output
         if mask is not None and out_f.ndim == 3:
             # Keras zero_output_for_mask: Bidirectional zeroes masked
             # positions in BOTH halves so fwd/bwd sequences stay aligned

@@ -578,7 +578,10 @@ class LocallyConnected1D(Layer):
 # -- elementwise / shape utilities ----------------------------------------
 @dataclasses.dataclass
 class PReLULayer(Layer):
-    """Learned leaky-ReLU slope (reference conf/layers/PReLULayer.java)."""
+    """Learned leaky-ReLU slope (reference conf/layers/PReLULayer.java).
+
+    alpha is per-channel (1-D) or per-position (full batchless shape,
+    channels-first — the keras PReLU-without-shared_axes case)."""
     n_in: int = 0  # number of features/channels (inferred)
 
     def init_params(self, key, input_type):
@@ -587,6 +590,9 @@ class PReLULayer(Layer):
 
     def forward(self, params, x, training=False, key=None):
         a = params["alpha"]
+        if a.ndim > 1:
+            a = a.reshape((1,) + a.shape)   # broadcast over batch
+            return jnp.where(x >= 0, x, a * x)
         shape = [1] * x.ndim
         shape[1 if x.ndim >= 3 else -1] = a.shape[0]
         a = a.reshape(shape)

@@ -1336,6 +1336,95 @@ class TestKerasMasking:
         np.testing.assert_allclose(res, golden, atol=1e-5)
 
 
+class TestKerasResidualRaises:
+    """Round-5 closures of the r4 'residual raises': causal Conv1D,
+    Bidirectional(return_sequences=False), per-position PReLU — all now
+    import with golden-matched semantics."""
+
+    def _roundtrip(self, m, x, tmp_path, name):
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_sequential_model_and_weights
+        golden = m.predict(x, verbose=0)
+        path = str(tmp_path / f"{name}.h5")
+        m.save(path)
+        return import_keras_sequential_model_and_weights(path), golden
+
+    def test_conv1d_causal(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(0)
+        m = keras.Sequential([
+            keras.Input((8, 3)),
+            layers.Conv1D(5, 3, padding="causal", activation="relu",
+                          name="c"),
+        ])
+        x = rs.randn(2, 8, 3).astype(np.float32)
+        net, golden = self._roundtrip(m, x, tmp_path, "causal")
+        res = net.output(x.transpose(0, 2, 1)).numpy()
+        np.testing.assert_allclose(np.asarray(res).transpose(0, 2, 1),
+                                   golden, atol=1e-5)
+
+    def test_conv1d_dilated_causal_then_flatten(self, tmp_path):
+        """WaveNet-style dilated causal conv, plus Flatten->Dense after it
+        (exercises the keras-side shape table for causal outputs)."""
+        from keras import layers
+        rs = np.random.RandomState(9)
+        m = keras.Sequential([
+            keras.Input((8, 3)),
+            layers.Conv1D(4, 3, padding="causal", dilation_rate=2,
+                          name="c"),
+            layers.Flatten(name="f"),
+            layers.Dense(2, name="d"),
+        ])
+        x = rs.randn(2, 8, 3).astype(np.float32)
+        net, golden = self._roundtrip(m, x, tmp_path, "dilated_causal")
+        res = net.output(x.transpose(0, 2, 1)).numpy()
+        np.testing.assert_allclose(np.asarray(res), golden, atol=1e-5)
+
+    def test_bidirectional_last_step(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(1)
+        m = keras.Sequential([
+            keras.Input((6, 4)),
+            layers.Bidirectional(layers.LSTM(5), name="bi"),
+            layers.Dense(2, name="d"),
+        ])
+        x = rs.randn(3, 6, 4).astype(np.float32)
+        net, golden = self._roundtrip(m, x, tmp_path, "bi_last")
+        res = net.output(x.transpose(0, 2, 1)).numpy()
+        np.testing.assert_allclose(np.asarray(res), golden, atol=1e-5)
+
+    def test_bidirectional_last_step_masked(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(2)
+        m = keras.Sequential([
+            keras.Input((6, 4)),
+            layers.Masking(mask_value=0.0, name="mk"),
+            layers.Bidirectional(layers.LSTM(4), name="bi"),
+        ])
+        x = _padded_seqs(rs)
+        net, golden = self._roundtrip(m, x, tmp_path, "bi_last_mask")
+        res = net.output(x.transpose(0, 2, 1)).numpy()
+        np.testing.assert_allclose(np.asarray(res), golden, atol=1e-5)
+
+    def test_prelu_per_position(self, tmp_path):
+        from keras import layers
+        rs = np.random.RandomState(3)
+        m = keras.Sequential([
+            keras.Input((4, 4, 3)),
+            layers.Conv2D(2, 1, name="c"),
+            layers.PReLU(name="pr"),      # no shared_axes: alpha per pos
+        ])
+        x = rs.randn(2, 4, 4, 3).astype(np.float32)
+        # randomize alpha so the test can't pass with zero-initialized slopes
+        pr = m.get_layer("pr")
+        pr.set_weights([rs.rand(*pr.get_weights()[0].shape)
+                        .astype(np.float32)])
+        net, golden = self._roundtrip(m, x, tmp_path, "prelu_pos")
+        res = net.output(x.transpose(0, 3, 1, 2)).numpy()
+        np.testing.assert_allclose(np.asarray(res).transpose(0, 2, 3, 1),
+                                   golden, atol=1e-5)
+
+
 class TestKerasLambdaHook:
     def test_lambda_requires_registration(self, tmp_path):
         from deeplearning4j_tpu.modelimport.ir import ImportException
