@@ -142,7 +142,8 @@ def _pool2d_adapter(cfg, pool_type):
     padding = "SAME" if cfg.get("padding", "valid") == "same" else "VALID"
     return _Adapted(L.SubsamplingLayer(
         pooling_type=pool_type, kernel_size=pool, stride=strides,
-        padding=padding, name=cfg.get("name")))
+        padding=padding, avg_include_pad=False,  # keras/TF semantics
+        name=cfg.get("name")))
 
 
 def _bn_adapter(cfg):
@@ -549,7 +550,8 @@ def _adapt_layer(class_name: str, cfg: Dict[str, Any],
             stride=tuple(int(s) for s in (cfg.get("strides") or
                                           cfg.get("pool_size", (2, 2, 2)))),
             padding="SAME" if cfg.get("padding", "valid") == "same"
-            else "VALID", name=cfg.get("name")))
+            else "VALID", avg_include_pad=False,  # keras/TF semantics
+            name=cfg.get("name")))
     if class_name in ("GlobalAveragePooling1D", "GlobalAveragePooling3D"):
         return _Adapted(L.GlobalPoolingLayer(pooling_type="avg",
                                              name=cfg.get("name")))

@@ -150,9 +150,79 @@ def _conv(node, ctx):
              padding=padding, dilation=dilations, data_format="NCHW", **kw)
 
 
+@mapper(ONNX, "ConvTranspose")
+def _conv_transpose(node, ctx):
+    x = ctx.get(node.inputs[0])
+    w_np = ctx.maybe_const(node.inputs[1])
+    if w_np is None:
+        raise ImportException("ConvTranspose weights must be an initializer")
+    if w_np.ndim != 4:
+        raise ImportException("only 2-D ConvTranspose supported")
+    if int(node.attrs.get("group", 1)) != 1:
+        raise ImportException("grouped ConvTranspose unsupported")
+    if node.attrs.get("output_shape"):
+        raise ImportException(
+            "ConvTranspose output_shape attribute unsupported; express the "
+            "crop via pads")
+    if any(int(p) for p in node.attrs.get("output_padding", [])):
+        raise ImportException("ConvTranspose output_padding unsupported")
+    strides = tuple(int(s) for s in node.attrs.get("strides", [1, 1]))
+    dil = tuple(int(d) for d in node.attrs.get("dilations", [1, 1]))
+    pads = [int(p) for p in node.attrs.get("pads", [0, 0, 0, 0])]
+    auto_pad = node.attrs.get("auto_pad", "NOTSET")
+    if isinstance(auto_pad, bytes):
+        auto_pad = auto_pad.decode()
+    if auto_pad in ("SAME_UPPER", "SAME_LOWER") and not any(pads):
+        # SAME: output = in*stride; crop the (dil*(k-1)+1-s) surplus,
+        # extra on the end for SAME_UPPER, the start for SAME_LOWER
+        kh, kw = w_np.shape[2], w_np.shape[3]
+        tot = [max(dil[0] * (kh - 1) + 1 - strides[0], 0),
+               max(dil[1] * (kw - 1) + 1 - strides[1], 0)]
+        if auto_pad == "SAME_UPPER":
+            pads = [tot[0] // 2, tot[1] // 2,
+                    tot[0] - tot[0] // 2, tot[1] - tot[1] // 2]
+        else:
+            pads = [tot[0] - tot[0] // 2, tot[1] - tot[1] // 2,
+                    tot[0] // 2, tot[1] // 2]
+    # ONNX weights [Cin, Cout, kH, kW] -> deconv2d [kH, kW, outC, inC]
+    w = ctx.sd.constant(np.transpose(w_np, (2, 3, 1, 0)),
+                        node.inputs[1].replace(":", "_") + "_hwoi")
+    bias = ctx.get(node.inputs[2]) if len(node.inputs) > 2 and \
+        node.inputs[2] else None
+    if not any(pads):
+        ctx.emit("deconv2d", [x, w, bias], node.outputs[0],
+                 strides=strides, padding="VALID", dilation=dil,
+                 data_format="NCHW")
+        return
+    # ONNX pads CROP the full (VALID) transposed output:
+    #   out = (in-1)*stride + dil*(k-1) + 1 - pad_begin - pad_end
+    full = ctx.emit("deconv2d", [x, w, bias], f"{node.name}__full",
+                    strides=strides, padding="VALID", dilation=dil,
+                    data_format="NCHW")
+    ax = ctx.aval(node.inputs[0])
+    if ax is None or ax.shape[2] is None or ax.shape[3] is None:
+        raise ImportException(
+            "ConvTranspose with pads needs static spatial input dims to "
+            "crop")
+    ih, iw = ax.shape[2], ax.shape[3]
+    kh, kw = w_np.shape[2], w_np.shape[3]
+    hh = (ih - 1) * strides[0] + dil[0] * (kh - 1) + 1
+    ww = (iw - 1) * strides[1] + dil[1] * (kw - 1) + 1
+    # -1 = rest-of-dim: batch/channel stay symbolic-friendly
+    ctx.emit("slice", [full], node.outputs[0],
+             begin=(0, 0, pads[0], pads[1]),
+             size=(-1, -1, hh - pads[0] - pads[2],
+                   ww - pads[1] - pads[3]))
+
+
 @mapper(ONNX, "MaxPool", "AveragePool")
 def _pool(node, ctx):
     x = ctx.get(node.inputs[0])
+    if int(node.attrs.get("ceil_mode", 0)):
+        raise ImportException(f"{node.op_type} ceil_mode=1 unsupported "
+                              "(floor-mode output grid only)")
+    if any(int(d) != 1 for d in node.attrs.get("dilations", [])):
+        raise ImportException(f"{node.op_type} with dilations unsupported")
     kernel = tuple(int(k) for k in node.attrs.get("kernel_shape", [2, 2]))
     strides = tuple(int(s) for s in node.attrs.get("strides", kernel))
     pads = node.attrs.get("pads")
@@ -163,9 +233,14 @@ def _pool(node, ctx):
         padding = "SAME"
     else:
         padding = "VALID"
+    kw = {}
+    if node.op_type == "AveragePool":
+        # ONNX default count_include_pad=0: padded cells do NOT count in
+        # the divisor (cross-checked against TF SAME avg-pool)
+        kw["include_pad"] = bool(node.attrs.get("count_include_pad", 0))
     ctx.emit("maxpool2d" if node.op_type == "MaxPool" else "avgpool2d",
              [x], node.outputs[0], kernel=kernel, strides=strides,
-             padding=padding, data_format="NCHW")
+             padding=padding, data_format="NCHW", **kw)
 
 
 @mapper(ONNX, "GlobalAveragePool")

@@ -506,7 +506,11 @@ def _pool(node, ctx):
     padding = node.attrs.get("padding", "VALID")
     if isinstance(padding, bytes):
         padding = padding.decode()
+    kw = {}
+    if node.op_type == "AvgPool":
+        # TF average pooling ALWAYS excludes padded cells from the divisor
+        kw["include_pad"] = False
     ctx.emit("maxpool2d" if node.op_type == "MaxPool" else "avgpool2d",
              [x], node.outputs[0], kernel=tuple(int(k) for k in kernel),
              strides=tuple(int(s) for s in strides), padding=padding,
-             data_format=df)
+             data_format=df, **kw)

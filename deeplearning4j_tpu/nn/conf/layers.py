@@ -235,6 +235,9 @@ class SubsamplingLayer(Layer):
     pooling_type: str = "max"  # max|avg|pnorm
     kernel_size: IntPair = (2, 2)
     stride: IntPair = None
+    #: average-pool divisor counts padded cells (reference legacy
+    #: behavior); keras/TF SAME pooling excludes them (importer sets False)
+    avg_include_pad: bool = True
     padding: Union[str, IntPair] = (0, 0)
     pnorm: int = 2
 
@@ -251,7 +254,8 @@ class SubsamplingLayer(Layer):
                                       pad, "NCHW")
         if pt == "avg":
             return conv_ops.avgpool2d(x, _pair(self.kernel_size), _pair(stride),
-                                      pad, "NCHW")
+                                      pad, "NCHW",
+                                      include_pad=self.avg_include_pad)
         return conv_ops.pnormpool2d(x, _pair(self.kernel_size), _pair(stride),
                                     pad, self.pnorm, "NCHW")
 

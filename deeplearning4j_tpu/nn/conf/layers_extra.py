@@ -112,6 +112,8 @@ class Subsampling3DLayer(Layer):
     kernel_size: Sequence[int] = (2, 2, 2)
     stride: Sequence[int] = None
     padding: Union[str, Sequence[int]] = "VALID"
+    #: divisor counts padded cells (reference legacy); keras/TF exclude
+    avg_include_pad: bool = True
 
     def forward(self, params, x, training=False, key=None):
         s = self.stride if self.stride is not None else self.kernel_size
@@ -121,7 +123,8 @@ class Subsampling3DLayer(Layer):
             return conv_ops.maxpool3d(x, _triple(self.kernel_size),
                                       _triple(s), pad, "NCDHW")
         return conv_ops.avgpool3d(x, _triple(self.kernel_size), _triple(s),
-                                  pad, "NCDHW")
+                                  pad, "NCDHW",
+                                  include_pad=self.avg_include_pad)
 
     def output_type(self, input_type):
         c, d, h, w = input_type

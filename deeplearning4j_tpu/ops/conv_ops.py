@@ -201,10 +201,17 @@ def _pool(x, kernel, strides, padding, data_format, init, reduce_fn, n=2):
     else:
         window = (1,) + k + (1,)
         stride = (1,) + s + (1,)
-    pad = padding.upper() if isinstance(padding, str) else \
-        [(0, 0), (0, 0)] + [(p, p) for p in _pair(padding, n)] \
-        if data_format in ("NCHW", "NCDHW", "NCW") else \
-        [(0, 0)] + [(p, p) for p in _pair(padding, n)] + [(0, 0)]
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        if isinstance(padding, (list, tuple)) and padding and \
+                isinstance(padding[0], (list, tuple)):
+            pairs = [tuple(int(a) for a in p) for p in padding]
+        else:
+            pairs = [(int(p), int(p)) for p in _pair(padding, n)]
+        pad = ([(0, 0), (0, 0)] + pairs
+               if data_format in ("NCHW", "NCDHW", "NCW")
+               else [(0, 0)] + pairs + [(0, 0)])
     return lax.reduce_window(x, init, reduce_fn, window, stride, pad)
 
 
@@ -244,11 +251,16 @@ def maxpool3d(x, kernel=(2, 2, 2), strides=None, padding="VALID",
 
 @op("avgpool3dnew", "pooling", aliases=("avgpool3d",))
 def avgpool3d(x, kernel=(2, 2, 2), strides=None, padding="VALID",
-              data_format="NCDHW"):
+              data_format="NCDHW", include_pad=True):
     strides = strides if strides is not None else kernel
     s = _pool(x, kernel, strides, padding, data_format, 0.0, lax.add, n=3)
-    k = _pair(kernel, 3)
-    return s / (k[0] * k[1] * k[2])
+    if include_pad or (isinstance(padding, str)
+                       and padding.upper() == "VALID"):
+        k = _pair(kernel, 3)
+        return s / (k[0] * k[1] * k[2])
+    counts = _pool(jnp.ones_like(x), kernel, strides, padding, data_format,
+                   0.0, lax.add, n=3)
+    return s / counts
 
 
 @op("max_pool_with_argmax", "pooling", differentiable=False)

@@ -1363,6 +1363,22 @@ class TestKerasResidualRaises:
         np.testing.assert_allclose(np.asarray(res).transpose(0, 2, 1),
                                    golden, atol=1e-5)
 
+    def test_avg_pool_same_excludes_padding(self, tmp_path):
+        """keras/TF SAME average pooling excludes padded cells from the
+        divisor; border windows would diverge if we divided by k*k."""
+        from keras import layers
+        rs = np.random.RandomState(11)
+        m = keras.Sequential([
+            keras.Input((7, 7, 2)),
+            layers.AveragePooling2D(3, strides=2, padding="same",
+                                    name="ap"),
+        ])
+        x = np.abs(rs.randn(2, 7, 7, 2)).astype(np.float32) + 1.0
+        net, golden = self._roundtrip(m, x, tmp_path, "avg_same")
+        res = net.output(x.transpose(0, 3, 1, 2)).numpy()
+        np.testing.assert_allclose(np.asarray(res).transpose(0, 2, 3, 1),
+                                   golden, atol=1e-5)
+
     def test_conv1d_dilated_causal_then_flatten(self, tmp_path):
         """WaveNet-style dilated causal conv, plus Flatten->Dense after it
         (exercises the keras-side shape table for causal outputs)."""

@@ -1,0 +1,168 @@
+"""ONNX mappers cross-checked against TF-computed goldens.
+
+The ONNX conformance sweep's goldens are numpy re-implementations of the
+spec (no onnx runtime in this environment) — self-authored, so a
+misreading of the spec could hide there (VERDICT r4 weak #7). Where TF
+implements the same operator semantics, this file recomputes the golden
+with REAL TF kernels instead: layout-adapted Conv/pool/normalization/
+resize cases whose parameter conventions (pads, count_include_pad, LRN
+size-vs-radius, half_pixel) are the classic places importers go wrong.
+"""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from test_onnx_mapper_conformance import _node, run1  # noqa: E402
+
+RS = np.random.RandomState(3)
+
+
+def F(*shape):
+    return RS.randn(*shape).astype(np.float32)
+
+
+class TestConvFamily:
+    def test_conv_asymmetric_pads_strides(self):
+        # ONNX: NCHW x, OIHW w, explicit pads [top, left, bottom, right]
+        x = F(1, 3, 7, 9)
+        w = F(4, 3, 3, 3)
+        pads = (1, 0, 2, 1)
+        got = run1(_node("Conv", ["x", "w"], ["y"],
+                         pads=list(pads), strides=[2, 2]),
+                   {"x": x}, initializers={"w": w},
+                   out_shape=(1, 4, 4, 4))
+        # TF golden: manual pad + VALID conv in NHWC/HWIO
+        xp = np.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]),
+                        (pads[1], pads[3])))
+        g = tf.nn.conv2d(xp.transpose(0, 2, 3, 1),
+                         w.transpose(2, 3, 1, 0), strides=2,
+                         padding="VALID").numpy().transpose(0, 3, 1, 2)
+        np.testing.assert_allclose(got, g, atol=1e-4, rtol=1e-4)
+
+    def test_conv_transpose_strides(self):
+        # ONNX ConvTranspose: x NCHW, w [C_in, C_out, kH, kW]
+        x = F(1, 3, 5, 5)
+        w = F(3, 4, 3, 3)
+        got = run1(_node("ConvTranspose", ["x", "w"], ["y"],
+                         strides=[2, 2]),
+                   {"x": x}, initializers={"w": w},
+                   out_shape=(1, 4, 11, 11))
+        g = tf.nn.conv2d_transpose(
+            x.transpose(0, 2, 3, 1), w.transpose(2, 3, 1, 0),
+            output_shape=(1, 11, 11, 4), strides=2,
+            padding="VALID").numpy().transpose(0, 3, 1, 2)
+        np.testing.assert_allclose(got, g, atol=1e-4, rtol=1e-4)
+
+    def test_conv_transpose_same_pads_crop(self):
+        # pads crop the VALID transposed output; TF SAME = crop (0,1)(0,1)
+        x = F(1, 3, 5, 5)
+        w = F(3, 4, 3, 3)
+        got = run1(_node("ConvTranspose", ["x", "w"], ["y"],
+                         strides=[2, 2], pads=[0, 0, 1, 1]),
+                   {"x": x}, initializers={"w": w},
+                   out_shape=(1, 4, 10, 10))
+        g = tf.nn.conv2d_transpose(
+            x.transpose(0, 2, 3, 1), w.transpose(2, 3, 1, 0),
+            output_shape=(1, 10, 10, 4), strides=2,
+            padding="SAME").numpy().transpose(0, 3, 1, 2)
+        np.testing.assert_allclose(got, g, atol=1e-4, rtol=1e-4)
+
+    def test_average_pool_excludes_padding(self):
+        # ONNX count_include_pad=0 (default) == TF SAME avg-pool behavior:
+        # border windows average over fewer elements, not zero-padded ones
+        x = np.abs(F(1, 2, 7, 7)) + 1.0   # positive so inclusion shows up
+        got = run1(_node("AveragePool", ["x"], ["y"],
+                         kernel_shape=[3, 3], strides=[2, 2],
+                         pads=[1, 1, 1, 1]),
+                   {"x": x}, out_shape=(1, 2, 4, 4))
+        g = tf.nn.avg_pool2d(x.transpose(0, 2, 3, 1), ksize=3, strides=2,
+                             padding="SAME").numpy().transpose(0, 3, 1, 2)
+        np.testing.assert_allclose(got, g, atol=1e-5, rtol=1e-5)
+
+    def test_max_pool(self):
+        x = F(1, 2, 8, 8)
+        got = run1(_node("MaxPool", ["x"], ["y"], kernel_shape=[2, 2],
+                         strides=[2, 2]),
+                   {"x": x}, out_shape=(1, 2, 4, 4))
+        g = tf.nn.max_pool2d(x.transpose(0, 2, 3, 1), ksize=2, strides=2,
+                             padding="VALID").numpy().transpose(0, 3, 1, 2)
+        np.testing.assert_allclose(got, g, atol=1e-6)
+
+
+class TestNormalization:
+    def test_batch_normalization_epsilon(self):
+        x = F(2, 3, 4, 4)
+        scale, bias = F(3), F(3)
+        mean, var = F(3), np.abs(F(3)) + 0.5
+        got = run1(_node("BatchNormalization",
+                         ["x", "s", "b", "m", "v"], ["y"], epsilon=1e-2),
+                   {"x": x},
+                   initializers={"s": scale, "b": bias, "m": mean,
+                                 "v": var},
+                   out_shape=x.shape)
+        g = tf.nn.batch_normalization(
+            x.transpose(0, 2, 3, 1), mean, var, bias, scale,
+            1e-2).numpy().transpose(0, 3, 1, 2)
+        np.testing.assert_allclose(got, g, atol=1e-4, rtol=1e-4)
+
+    def test_lrn_size_vs_radius(self):
+        # the classic trap: ONNX size is the FULL window and alpha is
+        # divided by size; TF depth_radius is the half window with raw alpha
+        x = F(1, 8, 4, 4)
+        size, alpha, beta, bias = 5, 1e-3, 0.75, 1.5
+        got = run1(_node("LRN", ["x"], ["y"], size=size, alpha=alpha,
+                         beta=beta, bias=bias),
+                   {"x": x}, out_shape=x.shape)
+        g = tf.raw_ops.LRN(input=tf.constant(x.transpose(0, 2, 3, 1)),
+                           depth_radius=(size - 1) // 2,
+                           alpha=alpha / size, beta=beta,
+                           bias=bias).numpy().transpose(0, 3, 1, 2)
+        np.testing.assert_allclose(got, g, atol=1e-5, rtol=1e-5)
+
+    def test_softmax_axis(self):
+        x = F(2, 3, 5)
+        got = run1(_node("Softmax", ["x"], ["y"], axis=1),
+                   {"x": x}, out_shape=x.shape)
+        g = tf.nn.softmax(x, axis=1).numpy()
+        np.testing.assert_allclose(got, g, atol=1e-6)
+
+
+class TestResize:
+    def test_resize_linear_half_pixel(self):
+        # ONNX linear + half_pixel == TF bilinear with half_pixel_centers
+        x = np.abs(F(1, 2, 5, 5))
+        scales = np.asarray([1.0, 1.0, 2.0, 2.0], np.float32)
+        got = run1(_node("Resize", ["x", "roi", "scales"], ["y"],
+                         mode="linear",
+                         coordinate_transformation_mode="half_pixel"),
+                   {"x": x},
+                   initializers={"roi": np.zeros(0, np.float32),
+                                 "scales": scales},
+                   out_shape=(1, 2, 10, 10))
+        g = tf.compat.v1.image.resize_bilinear(
+            tf.constant(x.transpose(0, 2, 3, 1)), (10, 10),
+            half_pixel_centers=True).numpy().transpose(0, 3, 1, 2)
+        np.testing.assert_allclose(got, g, atol=1e-4, rtol=1e-4)
+
+    def test_depth_to_space_dcr(self):
+        x = F(1, 8, 3, 3)
+        got = run1(_node("DepthToSpace", ["x"], ["y"], blocksize=2,
+                         mode="DCR"),
+                   {"x": x}, out_shape=(1, 2, 6, 6))
+        g = tf.nn.depth_to_space(
+            tf.constant(x.transpose(0, 2, 3, 1)),
+            2).numpy().transpose(0, 3, 1, 2)
+        np.testing.assert_allclose(got, g, atol=1e-6)
+
+
+class TestGemm:
+    def test_gemm_alpha_beta_trans(self):
+        a, b, c = F(6, 4), F(5, 6), F(5,)
+        got = run1(_node("Gemm", ["a", "b", "c"], ["y"], alpha=0.5,
+                         beta=2.0, transA=1, transB=1),
+                   {"a": a}, initializers={"b": b, "c": c},
+                   out_shape=(4, 5))
+        g = (0.5 * tf.matmul(a, b, transpose_a=True,
+                             transpose_b=True).numpy() + 2.0 * c)
+        np.testing.assert_allclose(got, g, atol=1e-4, rtol=1e-4)

@@ -385,6 +385,15 @@ class TestQuantSelection:
             input=v, n=tf.constant(2), reverse=False), [F(3, 6)])
 
 
+class TestAvgPoolPadding:
+    def test_avg_pool_same_excludes_padding(self):
+        # TF divides border windows by the number of REAL cells, not k*k
+        x = np.abs(RS.randn(1, 7, 7, 2)).astype(np.float32) + 1.0
+        run_case(lambda v: tf.raw_ops.AvgPool(
+            value=v, ksize=[1, 3, 3, 1], strides=[1, 2, 2, 1],
+            padding="SAME"), [x])
+
+
 class TestNNOps:
     def test_conv3d_pools(self):
         x = F(1, 6, 6, 6, 2)
