@@ -7,6 +7,9 @@ chip + MFU (BASELINE config 3; north-star acceptance 35% MFU → vs_baseline
   - lenet_imgs_per_sec    (config 1, LeNet-MNIST MultiLayerNetwork)
   - word2vec_words_per_sec(config 4, SGNS skip-gram round throughput)
   - flash_attn_speedup    (Pallas flash attention vs XLA attention)
+  - inference_serving     (mixed-batch-size stream: bucketed
+                           InferenceEngine vs naive exact-shape jit —
+                           throughput, p50/p99 latency, compile counts)
 Config 5 (multi-chip scaling) needs >1 chip; the driver's multichip dryrun
 covers correctness, scaling numbers await real multi-chip hardware.
 
@@ -48,9 +51,11 @@ def check_bert_sanity(losses, mfu, max_mfu=BERT_MFU_CEILING):
     """(ok, reason): hard gates a BERT measurement must pass to be judged.
 
     - implied MFU must be physically possible (<= max_mfu of chip peak)
-    - every timed dispatch's loss trajectory must be finite and strictly
-      changing (a flat trajectory means the device never actually
-      stepped — stale replay or a dead train step)
+    - every timed dispatch's loss trajectory must be finite and actually
+      moving: not all losses equal, and >= 80% of adjacent steps changing.
+      (A single bitwise-repeated adjacent pair is legitimate for a
+      plateaued f32 step; a flat or mostly-flat trajectory means the
+      device never actually stepped — stale replay or a dead train step.)
     - no two dispatches may return identical trajectories: a repeated
       execute served from the tunnel's replay cache returns the previous
       dispatch's arrays verbatim, with a near-zero wall time that would
@@ -69,10 +74,14 @@ def check_bert_sanity(losses, mfu, max_mfu=BERT_MFU_CEILING):
         if l.size and not np.all(np.isfinite(l)):
             return False, (f"non-finite loss in chained-step trajectory "
                            f"(dispatch {i})")
-        if l.size >= 2 and not np.all(np.diff(l) != 0.0):
-            return False, ("loss not strictly changing across chained "
-                           f"steps (dispatch {i}): training did not "
-                           "actually advance")
+        if l.size >= 2:
+            diffs = np.diff(l)
+            changed = int(np.count_nonzero(diffs))
+            if changed == 0 or changed < 0.8 * diffs.size:
+                return False, ("loss trajectory mostly flat across chained "
+                               f"steps (dispatch {i}: {changed}/{diffs.size}"
+                               " steps changed): training did not actually "
+                               "advance")
     for i in range(len(trajs)):
         for j in range(i + 1, len(trajs)):
             if trajs[i].size and np.array_equal(trajs[i], trajs[j]):
@@ -97,7 +106,7 @@ def select_headline(variants):
 
 def _measure_bert_variant(jax, jnp, bert, config, batch, B, T, n_steps,
                           kw, fpt, peak):
-    """Median-of-3 scan-chained timing for one train-step variant, with
+    """Median-of-5 scan-chained timing for one train-step variant, with
     one remeasure retry if the sanity gate rejects the first attempt."""
     params = bert.init_params(jax.random.key(0), config)
     opt = bert.init_opt_state(params)
@@ -323,6 +332,79 @@ def bench_word2vec(jax, jnp, tiny):
     return iters * B / dt
 
 
+def bench_inference_serving(jax, jnp, tiny):
+    """Mixed-batch-size serving (north-star "heavy traffic" scenario):
+    a request stream with K distinct batch sizes served (a) naively —
+    every odd shape jits an exact executable inside the timed window, the
+    pre-bucketing behavior — and (b) through the bucketed InferenceEngine
+    after warmup(). Reports throughput, p50/p99 request latency, and the
+    XLA compile count each policy pays (new compile counter)."""
+    from deeplearning4j_tpu.common.environment import environment
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.runtime.inference import InferenceEngine
+
+    n_in, hidden, n_out = (16, 32, 4) if tiny else (256, 1024, 64)
+    max_batch = 8 if tiny else 32
+    sizes = ([1, 3, 7, 5, 2, 6, 4, 8] if tiny
+             else [1, 3, 7, 17, 5, 29, 2, 11, 23, 4, 31, 9])
+    n_requests = len(sizes) * (2 if tiny else 8)
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(0).list()
+                .layer(DenseLayer(n_in=n_in, n_out=hidden,
+                                  activation="relu"))
+                .layer(DenseLayer(n_in=hidden, n_out=hidden,
+                                  activation="relu"))
+                .layer(OutputLayer(n_in=hidden, n_out=n_out))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.RandomState(0)
+    reqs = [jnp.asarray(rng.randn(sizes[i % len(sizes)], n_in)
+                        .astype(np.float32)) for i in range(n_requests)]
+    total_rows = sum(int(r.shape[0]) for r in reqs)
+
+    env = environment()
+    prev_bucketing = env.inference_bucketing()
+    results = {}
+    try:
+        for mode in ("naive", "bucketed"):
+            env.set_inference_bucketing(mode == "bucketed")
+            env.reset_compile_count()
+            net = build()
+            if mode == "bucketed":
+                eng = InferenceEngine(net, max_batch=max_batch)
+                eng.warmup(reqs[0])
+                run = eng.infer
+            else:
+                run = net.output
+            lat = []
+            t_all = time.perf_counter()
+            for r in reqs:
+                t0 = time.perf_counter()
+                jax.block_until_ready(run(r).jax())
+                lat.append(time.perf_counter() - t0)
+            dt = time.perf_counter() - t_all
+            results[mode] = {
+                "throughput_sps": round(total_rows / dt, 2),
+                "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+                "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+                "compiles": env.compile_count(),
+            }
+    finally:
+        env.set_inference_bucketing(prev_bucketing)
+        env.reset_compile_count()
+    results["request_count"] = n_requests
+    results["distinct_batch_sizes"] = len(set(sizes))
+    results["max_batch"] = max_batch
+    results["throughput_speedup"] = round(
+        results["bucketed"]["throughput_sps"]
+        / max(results["naive"]["throughput_sps"], 1e-9), 3)
+    return results
+
+
 def bench_flash_attention(jax, jnp, tiny):
     """Pallas flash attention vs XLA attention at long sequence length.
 
@@ -450,7 +532,7 @@ def main():
         "loss": round(rec["loss_last"], 4),
         "flash_attn": rec["variant"].get("use_flash", False),
         # measurement methodology: one jitted lax.scan of n_chained steps
-        # per dispatch, median of 3 dispatches, spread = (max-min)/median
+        # per dispatch, median of 5 dispatches, spread = (max-min)/median
         "n_chained_steps": r["n_chained"],
         "time_spread_pct": rec["spread_pct"],
         "bert_variants": {
@@ -494,6 +576,12 @@ def main():
                 if isinstance(v, (int, float)):
                     out[f"{model}_mfu"] = round(
                         v * VISION_TRAIN_FLOPS_PER_IMG[model] / peak, 4)
+        try:
+            out["inference_serving"] = bench_inference_serving(jax, jnp,
+                                                               tiny)
+        except Exception as e:
+            out["inference_serving"] = f"error: {type(e).__name__}"
+        _release()
         try:
             fwd, train = bench_flash_attention(jax, jnp, tiny)
             out["flash_attn_speedup_vs_xla"] = round(fwd, 3)

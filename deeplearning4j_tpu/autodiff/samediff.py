@@ -532,19 +532,29 @@ class SameDiff:
         cache_key = (outputs, placeholders, with_rng, self._graph_epoch())
         fn = self._jit_cache.get(cache_key)
         if fn is None:
+            from ..runtime.inference import counted_jit
             if with_rng:
                 def raw(variables, ph, key):
                     return self._trace(variables, ph, outputs, key)
             else:
                 def raw(variables, ph):
                     return self._trace(variables, ph, outputs)
-            fn = jax.jit(raw)
+            fn = counted_jit(raw, tag=f"sd:{id(self)}:{cache_key}")
             self._jit_cache[cache_key] = fn
         return fn
 
     def output(self, placeholders: Dict[str, Any],
                outputs: Sequence[Union[str, SDVariable]]) -> Dict[str, NDArray]:
-        """Inference execution (reference SameDiff.output, SameDiff.java:2746)."""
+        """Inference execution (reference SameDiff.output, SameDiff.java:2746).
+
+        Batch-bucketed by default for serving workloads (see
+        runtime/inference.py): placeholders sharing a leading batch dim are
+        zero-padded up to the bucket and batch-shaped results sliced back.
+        Because a SameDiff graph is arbitrary code, bucketing is attempted
+        only when `_bucketable_padding` proves the padded trace shape-checks
+        and every requested output keeps the batch dim; rng-consuming
+        graphs and everything else fall back to the exact shape.
+        """
         out_names = [o.name if isinstance(o, SDVariable) else o for o in outputs]
         ph = {k: (v.jax() if isinstance(v, NDArray) else jnp.asarray(v))
               for k, v in (placeholders or {}).items()}
@@ -554,10 +564,50 @@ class SameDiff:
             self._rng_calls = getattr(self, "_rng_calls", 0) + 1
             results = fn(self._arrays, ph,
                          jax.random.key(self._rng_seed + self._rng_calls))
-        else:
-            fn = self.make_function(out_names, tuple(sorted(ph)))
-            results = fn(self._arrays, ph)
+            return {n: NDArray(r) for n, r in zip(out_names, results)}
+        fn = self.make_function(out_names, tuple(sorted(ph)))
+        ph_p, pad = self._bucketable_padding(fn, ph)
+        results = fn(self._arrays, ph_p)
+        if pad is not None:
+            from ..runtime.inference import slice_batch
+            results = slice_batch(results, *pad)
         return {n: NDArray(r) for n, r in zip(out_names, results)}
+
+    def _bucketable_padding(self, fn, ph):
+        """(padded placeholders, (n, bucket)) when batch-dim bucketing is
+        provably shape-safe for this graph, else (ph, None).
+
+        Safe means: env flag on, every placeholder shares the leading dim,
+        and abstract evaluation (jax.eval_shape — no compile) shows every
+        requested output maps (n, *rest) -> (bucket, *rest) under padding.
+        That rejects batch reductions, transposes, concats along batch,
+        and any graph the padded shapes don't trace through; a graph that
+        couples rows but preserves shape (e.g. `x - x.mean(0)`) is on the
+        caller to exclude by disabling bucketing. The verdict is cached per
+        placeholder signature on the compiled fn.
+        """
+        from ..runtime.inference import maybe_pad_tree
+        ph_p, pad = maybe_pad_tree(ph)
+        if pad is None:
+            return ph, None
+        n, b = pad
+        cache = getattr(fn, "_pad_gate", None)
+        if cache is None:
+            cache = fn._pad_gate = {}
+        sig = tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                           for k, v in ph.items()))
+        ok = cache.get(sig)
+        if ok is None:
+            try:
+                exact = jax.eval_shape(fn._jit, self._arrays, ph)
+                padded = jax.eval_shape(fn._jit, self._arrays, ph_p)
+                ok = all(getattr(e, "ndim", 0) >= 1 and e.shape[0] == n
+                         and tuple(p.shape) == (b,) + tuple(e.shape[1:])
+                         for e, p in zip(exact, padded))
+            except Exception:
+                ok = False
+            cache[sig] = ok
+        return (ph_p, pad) if ok else (ph, None)
 
     def batch_output(self, placeholders=None, outputs=None):
         return self.output(placeholders or {}, outputs)

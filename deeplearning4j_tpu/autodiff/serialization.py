@@ -244,13 +244,21 @@ def _fb_pack_kwargs(node, opdef):
         packed, extra = {}, dict(node.kwargs)
     if extra:
         # kwargs equal to the op's declared defaults carry no information
+        def _is_default(param, v):
+            if param.default is inspect.Parameter.empty:
+                return False
+            try:
+                return bool(param.default == v)
+            except (TypeError, ValueError):
+                # array-valued kwarg: `default == v` broadcasts and bool()
+                # raises "truth value is ambiguous" — treat as non-default
+                # so the clean ValueError below names the offending op
+                return False
         try:
             sig = inspect.signature(opdef.fn)
             extra = {k: v for k, v in extra.items()
                      if not (k in sig.parameters
-                             and sig.parameters[k].default is not
-                             inspect.Parameter.empty
-                             and sig.parameters[k].default == v)}
+                             and _is_default(sig.parameters[k], v))}
         except (TypeError, ValueError):  # builtins without signatures
             pass
     if extra:

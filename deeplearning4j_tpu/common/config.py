@@ -55,6 +55,19 @@ class Environment:
                     cls._instance = Environment()
         return cls._instance
 
+    def __getattr__(self, name):
+        # the layered property system (common/environment.py) carries the
+        # inference-serving knobs and the compile-observability counter;
+        # delegate missing attributes so the public get_environment()
+        # surface reaches them (only fires for names not set in __init__)
+        from .environment import Environment as _LayeredEnvironment
+        target = _LayeredEnvironment.get()
+        try:
+            return getattr(target, name)
+        except AttributeError:
+            raise AttributeError(
+                f"{type(self).__name__!r} object has no attribute {name!r}")
+
 
 def get_environment() -> Environment:
     return Environment.get()

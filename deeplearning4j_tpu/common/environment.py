@@ -31,6 +31,8 @@ class EnvironmentVars:
     DL4J_TPU_DEFAULT_DTYPE = "DL4J_TPU_DEFAULT_DTYPE"
     DL4J_TPU_MATMUL_PRECISION = "DL4J_TPU_MATMUL_PRECISION"
     DL4J_TPU_CACHE_DIR = "DL4J_TPU_CACHE_DIR"
+    DL4J_TPU_INFERENCE_BUCKETING = "DL4J_TPU_INFERENCE_BUCKETING"
+    DL4J_TPU_INFERENCE_MAX_BATCH = "DL4J_TPU_INFERENCE_MAX_BATCH"
     XLA_FLAGS = "XLA_FLAGS"
 
 
@@ -43,6 +45,8 @@ class SystemProperties:
     MATMUL_PRECISION = "matmul_precision"
     RESOURCES_DIR = "resources_dir"
     LOG_INITIALIZATION = "log_initialization"
+    INFERENCE_BUCKETING = "inference_bucketing"
+    INFERENCE_MAX_BATCH = "inference_max_batch"
 
 
 _ENV_FOR_PROP = {
@@ -53,6 +57,10 @@ _ENV_FOR_PROP = {
     SystemProperties.MATMUL_PRECISION:
         EnvironmentVars.DL4J_TPU_MATMUL_PRECISION,
     SystemProperties.RESOURCES_DIR: EnvironmentVars.ND4J_RESOURCES_DIR,
+    SystemProperties.INFERENCE_BUCKETING:
+        EnvironmentVars.DL4J_TPU_INFERENCE_BUCKETING,
+    SystemProperties.INFERENCE_MAX_BATCH:
+        EnvironmentVars.DL4J_TPU_INFERENCE_MAX_BATCH,
 }
 
 _DEFAULTS = {
@@ -61,6 +69,8 @@ _DEFAULTS = {
     SystemProperties.VERBOSE: "0",
     SystemProperties.MATMUL_PRECISION: "default",
     SystemProperties.LOG_INITIALIZATION: "1",
+    SystemProperties.INFERENCE_BUCKETING: "1",
+    SystemProperties.INFERENCE_MAX_BATCH: "128",
 }
 
 
@@ -74,6 +84,10 @@ class Environment:
 
     def __init__(self):
         self._overrides: Dict[str, str] = {}
+        self._compile_lock = threading.Lock()
+        self._compile_keys: set = set()
+        self._compile_count = 0
+        self._compile_listeners: list = []
 
     @classmethod
     def get(cls) -> "Environment":
@@ -125,6 +139,73 @@ class Environment:
 
     def matmul_precision(self) -> str:
         return self.property(SystemProperties.MATMUL_PRECISION)
+
+    # -- inference-serving knobs (runtime/inference.py) --------------------
+    def inference_bucketing(self) -> bool:
+        """Whether batched inference pads the batch dim up to a compiled
+        bucket shape (on by default; exact-shape compile when off)."""
+        return self.property(SystemProperties.INFERENCE_BUCKETING) not in (
+            "0", "false", None)
+
+    def set_inference_bucketing(self, v: bool):
+        return self.set_property(SystemProperties.INFERENCE_BUCKETING,
+                                 "1" if v else "0")
+
+    def inference_max_batch(self) -> int:
+        """Top rung of the default bucket ladder for the direct
+        output()/predict() paths."""
+        v = self.property(SystemProperties.INFERENCE_MAX_BATCH)
+        return int(v) if v else 128
+
+    def set_inference_max_batch(self, n: int):
+        return self.set_property(SystemProperties.INFERENCE_MAX_BATCH, int(n))
+
+    # -- recompile observability ------------------------------------------
+    # One "compile event" = one new (tag, input-signature) entry entering a
+    # jitted-inference cache (runtime.inference.counted_jit). With bucketing
+    # on, K distinct request batch sizes must produce at most
+    # ceil(log2(max_batch)) + 1 events per network — the invariant bench.py
+    # and tests/test_inference_engine.py assert.
+
+    def record_compile(self, key) -> bool:
+        """Register a compile event; returns False if `key` was already
+        seen (cache hit). New keys notify compile listeners."""
+        with self._compile_lock:
+            if key in self._compile_keys:
+                return False
+            self._compile_keys.add(key)
+            self._compile_count += 1
+            listeners = list(self._compile_listeners)
+        for fn in listeners:
+            try:
+                fn(key)
+            except Exception:
+                pass  # observability must never break the inference path
+        return True
+
+    def compile_count(self) -> int:
+        return self._compile_count
+
+    def reset_compile_count(self):
+        """Zero the counter and key registry. Signatures already resident
+        in a live jit cache will NOT re-record afterwards — no XLA compile
+        actually happens for them, and the counter reports real compiles."""
+        with self._compile_lock:
+            self._compile_keys.clear()
+            self._compile_count = 0
+        return self
+
+    def add_compile_listener(self, fn: Callable[[Any], None]):
+        """`fn(key)` is invoked once per new compile event."""
+        with self._compile_lock:
+            self._compile_listeners.append(fn)
+        return self
+
+    def remove_compile_listener(self, fn: Callable[[Any], None]):
+        with self._compile_lock:
+            if fn in self._compile_listeners:
+                self._compile_listeners.remove(fn)
+        return self
 
     def _apply_matmul_precision(self, precision: str):
         """highest = f32 accumulate everywhere (reference "allowed precision

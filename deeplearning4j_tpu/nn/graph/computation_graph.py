@@ -369,6 +369,7 @@ class ComputationGraph(FitFastPathMixin):
         self._updater_state = self.conf.updater.init(
             self._trainable(self._params))
         self._train_step = None
+        self._out_fns = {}
         return self
 
     def _shard_batch(self, x):
@@ -438,14 +439,40 @@ class ComputationGraph(FitFastPathMixin):
         return {n: self._shard_batch(_unwrap(x))
                 for n, x in zip(self.conf.inputs, inputs)}
 
+    def _output_jit(self, training=False):
+        """Whole-DAG jitted inference entry, compile-counted (see
+        runtime/inference.py) — one executable per input signature."""
+        if not hasattr(self, "_out_fns"):
+            self._out_fns = {}
+        fn = self._out_fns.get(training)
+        if fn is None:
+            from ...runtime.inference import counted_jit
+
+            def fwd(params, ind):
+                acts = self._forward(params, ind, training)
+                return [acts[o] for o in self.conf.outputs]
+
+            fn = counted_jit(fwd, tag=f"cg:{id(self)}:{int(training)}")
+            self._out_fns[training] = fn
+        return fn
+
     def output(self, *inputs, training: bool = False) -> List[NDArray]:
-        """Multi-output inference (reference ComputationGraph.output)."""
+        """Multi-output inference (reference ComputationGraph.output).
+
+        Batch-bucketed by default — see MultiLayerNetwork.output: all
+        inputs sharing a leading batch dim are padded up to the bucket,
+        and outputs carrying that dim are sliced back; exact-shape
+        fallback otherwise."""
         self._check_init()
         if len(inputs) == 1 and isinstance(inputs[0], (list, tuple, dict)):
             inputs = inputs[0]
+        from ...runtime.inference import maybe_pad_tree, slice_batch
         ind = self._inputs_dict(inputs)
-        acts = self._forward(self._params, ind, training)
-        return [NDArray(acts[o]) for o in self.conf.outputs]
+        ind_p, pad = maybe_pad_tree(ind, training=training, mesh=self._mesh)
+        outs = self._output_jit(training)(self._params, ind_p)
+        if pad is not None:
+            outs = slice_batch(outs, *pad)
+        return [NDArray(o) for o in outs]
 
     def output_single(self, *inputs) -> NDArray:
         return self.output(*inputs)[0]

@@ -162,17 +162,31 @@ class MultiLayerNetwork(FitFastPathMixin):
         return h, mask, bn_inputs
 
     def output(self, x, training: bool = False) -> NDArray:
-        """Inference forward pass (reference MultiLayerNetwork.output)."""
+        """Inference forward pass (reference MultiLayerNetwork.output).
+
+        Batch-bucketed by default (`Environment.inference_bucketing`): the
+        batch dim is zero-padded up to the next bucket of the ladder so K
+        distinct request sizes share at most ceil(log2(max_batch))+1
+        compiled executables; padded rows are sliced off. Exact-shape
+        compile when disabled, training=True, sharded, or above the ladder.
+        """
         self._check_init()
-        return NDArray(self._output_jit(training)(self._params,
-                                                  self._shard_batch(_unwrap(x))))
+        from ..runtime.inference import maybe_pad_tree
+        x = self._shard_batch(_unwrap(x))
+        xp, pad = maybe_pad_tree(x, training=training, mesh=self._mesh)
+        out = self._output_jit(training)(self._params, xp)
+        if pad is not None:
+            out = out[:pad[0]]
+        return NDArray(out)
 
     def _output_jit(self, training=False):
         if not hasattr(self, "_out_fns"):
             self._out_fns = {}
         fn = self._out_fns.get(training)
         if fn is None:
-            fn = jax.jit(lambda p, x: self._forward(p, x, training))
+            from ..runtime.inference import counted_jit
+            fn = counted_jit(lambda p, x: self._forward(p, x, training),
+                             tag=f"mln:{id(self)}:{int(training)}")
             self._out_fns[training] = fn
         return fn
 

@@ -43,7 +43,7 @@ def reduce_scatter(x, axis: AxisName, *, scatter_axis: int = 0):
 
 def ppermute_next(x, axis: str, shift: int = 1):
     """Rotate shards around the ring (ring attention's K/V rotation)."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
@@ -59,12 +59,15 @@ def axis_index(axis: str):
 
 
 def axis_size(axis: str):
-    return lax.axis_size(axis)
+    # lax.axis_size (jax >= 0.5), or the static psum-of-1 idiom on 0.4.x
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
 
 
 def broadcast_from(x, axis: str, root: int = 0):
     """Broadcast root's shard to all members of `axis`."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     idx = lax.axis_index(axis)
     masked = jnp.where(idx == root, x, jnp.zeros_like(x))
     return lax.psum(masked, axis)

@@ -17,10 +17,9 @@ from typing import Callable, Sequence
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .mesh import DATA, FSDP, PIPE
+from .mesh import DATA, FSDP, PIPE, axis_size, shard_map
 
 
 def _pipeline_local(stage_params, inputs, *, stage_fn: Callable, axis: str):
@@ -29,7 +28,7 @@ def _pipeline_local(stage_params, inputs, *, stage_fn: Callable, axis: str):
     Returns [n_micro, mb, ...] outputs (valid on every device via collective
     broadcast from the last stage).
     """
-    n_stages = lax.axis_size(axis)
+    n_stages = axis_size(axis)
     stage = lax.axis_index(axis)
     n_micro = inputs.shape[0]
     mb_shape = inputs.shape[1:]
@@ -176,7 +175,7 @@ def make_pipeline_loss(stage_fn: Callable, head_fn: Callable, mesh: Mesh,
 
     def local(stage_params, head_params, x, aux):
         stage_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
-        n_stages = lax.axis_size(axis)
+        n_stages = axis_size(axis)
         stage = lax.axis_index(axis)
         n_micro = n_microbatches
         mb_shape = x.shape[1:]
@@ -201,7 +200,7 @@ def make_pipeline_loss(stage_fn: Callable, head_fn: Callable, mesh: Mesh,
                 jnp.float32(0.0))
         (_, loss_sum, wsum), _ = lax.scan(
             step_body, init,
-            jnp.arange(n_microbatches + lax.axis_size(axis) - 1))
+            jnp.arange(n_microbatches + axis_size(axis) - 1))
         for a in (axis,) + data_axes:
             loss_sum = lax.psum(loss_sum, a)
             wsum = lax.psum(wsum, a)
@@ -261,7 +260,7 @@ def make_pipeline_loss_1f1b(stage_fn: Callable, head_fn: Callable,
     def local_fwd(stage_params, head_params, xm, auxm):
         """Loss-only GPipe scan (cheap carry; nothing stashed)."""
         stage_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
-        n_stages = lax.axis_size(axis)
+        n_stages = axis_size(axis)
         stage = lax.axis_index(axis)
         n_micro = n_microbatches
         mb_shape = xm.shape[1:]
@@ -283,7 +282,7 @@ def make_pipeline_loss_1f1b(stage_fn: Callable, head_fn: Callable,
         init = (jnp.zeros(mb_shape, xm.dtype), jnp.float32(0.0),
                 jnp.float32(0.0))
         (_, loss_sum, wsum), _ = lax.scan(
-            step_body, init, jnp.arange(n_micro + lax.axis_size(axis) - 1))
+            step_body, init, jnp.arange(n_micro + axis_size(axis) - 1))
         for a in (axis,) + data_axes:
             loss_sum = lax.psum(loss_sum, a)
             wsum = lax.psum(wsum, a)
@@ -296,7 +295,7 @@ def make_pipeline_loss_1f1b(stage_fn: Callable, head_fn: Callable,
         head vjp with them directly makes every downstream gradient exact
         even when wsum depends on params or activations."""
         stage_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
-        S = lax.axis_size(axis)
+        S = axis_size(axis)
         s = lax.axis_index(axis)
         n_micro = n_microbatches
         mb_shape = xm.shape[1:]

@@ -25,10 +25,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .mesh import DATA, FSDP, SEQ, TENSOR
+from .mesh import DATA, FSDP, SEQ, TENSOR, axis_size, shard_map
 
 
 def _online_softmax_step(o, l, m, logits, v_cur):
@@ -54,7 +53,7 @@ def _ring_attention_local(q, k, v, kv_mask, *, axis: str, causal: bool,
                           scale: float):
     """Per-shard body under shard_map. q/k/v: [B, T_local, H, D];
     kv_mask: [B, T_local] bool (True = attend) rotated with K/V."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     my = lax.axis_index(axis)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -85,18 +84,35 @@ def _ring_attention_local(q, k, v, kv_mask, *, axis: str, causal: bool,
 
     (o, l, m, _, _, _), _ = lax.scan(body, (o, l, m, k, v, kv_mask),
                                      jnp.arange(n))
-    out = o / jnp.maximum(l[..., None], 1e-30)
+    lb = l[..., None]
+    # fully-masked query rows accumulate l == 0; emit exactly 0 (not 0/eps
+    # noise) so this path and the flash-merge path agree bitwise
+    out = jnp.where(lb > 1e-30, o / jnp.maximum(lb, 1e-30), 0.0)
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+# lse at/below this floor marks a block with no live key for that query row:
+# the Pallas kernel degrades a fully-masked row to a uniform softmax over
+# its -1e30-floored logits, so its lse is ~-1e30 + log(Tk) — far below
+# anything a real attention row can produce.
+_MASKED_LSE_FLOOR = -1e29
 
 
 def _merge_block(o, l, m, o_blk, lse_blk):
     """Fold a *normalized* attention block (o_blk [B,Tq,H,D] with its lse
     [B,H,Tq]) into the running (o, l, m) accumulator — the flash-merge:
-    a block behaves like one pseudo-element of weight exp(lse)."""
+    a block behaves like one pseudo-element of weight exp(lse).
+
+    Blocks whose lse sits at the masked floor contribute zero weight:
+    without this, a fully-masked row would merge the kernel's
+    uniform-softmax fallback (mean of V) instead of staying empty, and the
+    flash ring would diverge from the XLA ring (which yields l=0 -> out=0).
+    """
     m_new = jnp.maximum(m, lse_blk)
     corr = jnp.exp(jnp.where(jnp.isfinite(m), m - m_new, -jnp.inf))
     corr = jnp.where(jnp.isfinite(corr), corr, 0.0)
-    w = jnp.exp(jnp.where(jnp.isfinite(lse_blk), lse_blk - m_new, -jnp.inf))
+    w = jnp.exp(jnp.where(lse_blk > _MASKED_LSE_FLOOR, lse_blk - m_new,
+                          -jnp.inf))
     w = jnp.where(jnp.isfinite(w), w, 0.0)
     l_new = l * corr + w
     cT = jnp.transpose(corr, (0, 2, 1))[..., None]   # [B,Tq,H,1]
@@ -120,7 +136,7 @@ def _ring_flash_local(q, k, v, kv_mask, *, axis: str, causal: bool,
     """
     from ..kernels import flash_attention_with_lse
 
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     my = lax.axis_index(axis)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -166,7 +182,9 @@ def _ring_flash_local(q, k, v, kv_mask, *, axis: str, causal: bool,
     (o, l, m, _, _, _), _ = lax.scan(body, (o, l, m, k, v, kv_mask),
                                      jnp.arange(n))
     lT = jnp.transpose(l, (0, 2, 1))[..., None]      # [B,Tq,H,1]
-    out = o / jnp.maximum(lT, 1e-30)
+    # rows whose merged l underflowed saw no live key anywhere on the ring:
+    # zero them to match the XLA ring path exactly
+    out = jnp.where(lT > 1e-30, o / jnp.maximum(lT, 1e-30), 0.0)
     return out.astype(q.dtype)
 
 
@@ -243,7 +261,8 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     (o, l, m), _ = lax.scan(
         body, (o, l, m),
         (jnp.swapaxes(kb, 0, 1), jnp.swapaxes(vb, 0, 1), jnp.arange(n_blocks)))
-    out = o / jnp.maximum(l[..., None], 1e-30)
+    lb = l[..., None]
+    out = jnp.where(lb > 1e-30, o / jnp.maximum(lb, 1e-30), 0.0)
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
 
 

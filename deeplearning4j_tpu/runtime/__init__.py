@@ -1,9 +1,14 @@
 """Runtime services: memory-workspace shims (the XLA-arena-backed
-MemoryWorkspace API surface). See `workspace.py`."""
+MemoryWorkspace API surface, `workspace.py`) and the shape-bucketed
+compiled inference engine (`inference.py`)."""
+from .inference import (InferenceEngine, bucket_for, bucket_ladder,
+                        counted_jit, maybe_pad_tree, pad_batch, slice_batch)
 from .workspace import (DummyWorkspace, LayerWorkspaceMgr, MemoryWorkspace,
                         Nd4jWorkspaceManager, WorkspaceConfiguration,
                         workspace_manager)
 
 __all__ = ["DummyWorkspace", "LayerWorkspaceMgr", "MemoryWorkspace",
            "Nd4jWorkspaceManager", "WorkspaceConfiguration",
-           "workspace_manager"]
+           "workspace_manager", "InferenceEngine", "bucket_ladder",
+           "bucket_for", "pad_batch", "slice_batch", "maybe_pad_tree",
+           "counted_jit"]

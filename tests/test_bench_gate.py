@@ -51,13 +51,22 @@ class TestCheckBertSanity:
         # device never stepped: same loss replayed N times
         ok, reason = bench.check_bert_sanity(np.full(20, 10.38), 0.5)
         assert not ok
-        assert "not strictly changing" in reason
+        assert "mostly flat" in reason
 
-    def test_rejects_partially_stuck_trajectory(self):
+    def test_accepts_single_plateau_step(self):
+        # one bitwise-equal adjacent pair is a legitimately plateaued f32
+        # step, not a stuck device (the gate requires >= 80% changing)
         l = DECREASING.copy()
-        l[7] = l[6]  # one stale step is enough to distrust the timing
-        ok, _ = bench.check_bert_sanity(l, 0.5)
+        l[7] = l[6]
+        ok, reason = bench.check_bert_sanity(l, 0.5)
+        assert ok, reason
+
+    def test_rejects_mostly_stuck_trajectory(self):
+        l = DECREASING.copy()
+        l[10:] = l[10]  # back half frozen: device stopped stepping
+        ok, reason = bench.check_bert_sanity(l, 0.5)
         assert not ok
+        assert "mostly flat" in reason
 
     def test_rejects_nonfinite_loss(self):
         l = DECREASING.copy()
@@ -112,8 +121,8 @@ class TestSelectHeadline:
 
 class TestScannedStepEndToEnd:
     def test_tiny_scan_chain_produces_sane_record(self):
-        """The full measurement path on CPU: scanned step, median-of-3,
-        gate evaluation — the losses must strictly change."""
+        """The full measurement path on CPU: scanned step, median-of-5,
+        gate evaluation — the losses must actually move."""
         import jax
         import jax.numpy as jnp
 

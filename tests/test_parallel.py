@@ -141,6 +141,26 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_x),
                                    rtol=2e-4, atol=2e-5)
 
+    def test_all_masked_row_agrees_across_paths(self):
+        # a batch element whose key mask is all-False fully masks every one
+        # of its query rows: the XLA ring merges l=0 -> out=0, and the
+        # flash ring must not leak the kernel's uniform-softmax fallback
+        # (mean of V) for those rows
+        q, k, v = _qkv(B=2, T=128, seed=11)
+        mask = jnp.ones(q.shape[:2], bool).at[0].set(False)
+        mesh = make_mesh(MeshConfig(data=2, seq=4))
+        out_f = ring_attention(q, k, v, mesh, mask=mask, use_flash=True)
+        out_x = ring_attention(q, k, v, mesh, mask=mask, use_flash=False)
+        assert np.all(np.asarray(out_f)[0] == 0.0)
+        assert np.all(np.asarray(out_x)[0] == 0.0)
+        np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_x),
+                                   rtol=2e-4, atol=2e-5)
+        # live rows keep matching dense attention
+        ref = dense_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out_f)[1],
+                                   np.asarray(ref)[1],
+                                   rtol=2e-4, atol=2e-5)
+
     def test_flash_ring_grads_match_xla_ring(self):
         q, k, v = _qkv(T=128, seed=10)
         mesh = make_mesh(MeshConfig(data=2, seq=4))
