@@ -31,6 +31,16 @@ class TrainingConfig:
     data_set_label_mapping: Sequence[str] = ()
     loss_variables: Sequence[str] = ()
     minimize: bool = True
+    #: activation rematerialization: "none" keeps all forward activations
+    #: for backward; "layer"/"dots_saveable" wrap the whole loss graph in
+    #: jax.checkpoint (the graph has no layer boundaries to cut at, so both
+    #: modes recompute; "dots_saveable" keeps matmul outputs). None resolves
+    #: the Environment default (DL4J_TPU_REMAT).
+    remat: Optional[str] = None
+    #: micro-batches per optimizer step (gradient accumulation over the
+    #: leading placeholder dim); 0/None resolves the Environment default.
+    #: Exact full-batch equivalence holds for batch-MEAN-reduced losses.
+    grad_accum: int = 0
 
 
 @dataclasses.dataclass
@@ -78,8 +88,50 @@ def build_train_step(sd, config: TrainingConfig,
                                           for p in params.values())
         return loss
 
+    from ..common.environment import environment
+    remat = getattr(config, "remat", None)
+    if remat is None:
+        remat = environment().training_remat()
+    if remat and remat != "none":
+        # rematerialize: backward recomputes the graph's forward instead of
+        # storing activations (SameDiff graphs have no layer boundaries, so
+        # the whole loss is one checkpoint region — the models/bert.py recipe)
+        policy = (jax.checkpoint_policies.dots_saveable
+                  if remat == "dots_saveable" else None)
+        loss_fn = jax.checkpoint(loss_fn, policy=policy)
+
+    k = int(getattr(config, "grad_accum", 0) or 0)
+    if k <= 0:
+        k = environment().training_grad_accum()
+
+    def grads_of(params, ph):
+        if k <= 1:
+            return jax.value_and_grad(loss_fn)(params, ph)
+        # gradient accumulation: scan k micro-batches (leading placeholder
+        # dim split), average grads/loss — exact for batch-mean losses
+
+        def split(a):
+            if a.shape[0] % k:
+                raise ValueError(
+                    f"grad_accum={k} does not divide batch dim "
+                    f"{a.shape[0]} (shape {a.shape})")
+            return a.reshape((k, a.shape[0] // k) + a.shape[1:])
+
+        mph = jax.tree_util.tree_map(split, ph)
+
+        def body(carry, micro):
+            gsum, lsum = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, micro)
+            return (jax.tree_util.tree_map(jnp.add, gsum, grads),
+                    lsum + loss), None
+
+        zero_g = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (gsum, lsum), _ = jax.lax.scan(
+            body, (zero_g, jnp.zeros((), jnp.float32)), mph)
+        return lsum / k, jax.tree_util.tree_map(lambda g: g / k, gsum)
+
     def step(params, updater_state, iteration, ph):
-        loss, grads = jax.value_and_grad(loss_fn)(params, ph)
+        loss, grads = grads_of(params, ph)
         update, updater_state = config.updater.apply(grads, updater_state,
                                                      iteration)
         sign = 1.0 if config.minimize else -1.0

@@ -33,6 +33,9 @@ class EnvironmentVars:
     DL4J_TPU_CACHE_DIR = "DL4J_TPU_CACHE_DIR"
     DL4J_TPU_INFERENCE_BUCKETING = "DL4J_TPU_INFERENCE_BUCKETING"
     DL4J_TPU_INFERENCE_MAX_BATCH = "DL4J_TPU_INFERENCE_MAX_BATCH"
+    DL4J_TPU_REMAT = "DL4J_TPU_REMAT"
+    DL4J_TPU_GRAD_ACCUM = "DL4J_TPU_GRAD_ACCUM"
+    DL4J_TPU_ZERO1 = "DL4J_TPU_ZERO1"
     XLA_FLAGS = "XLA_FLAGS"
 
 
@@ -47,6 +50,9 @@ class SystemProperties:
     LOG_INITIALIZATION = "log_initialization"
     INFERENCE_BUCKETING = "inference_bucketing"
     INFERENCE_MAX_BATCH = "inference_max_batch"
+    TRAINING_REMAT = "training_remat"
+    TRAINING_GRAD_ACCUM = "training_grad_accum"
+    TRAINING_ZERO1 = "training_zero1"
 
 
 _ENV_FOR_PROP = {
@@ -61,6 +67,9 @@ _ENV_FOR_PROP = {
         EnvironmentVars.DL4J_TPU_INFERENCE_BUCKETING,
     SystemProperties.INFERENCE_MAX_BATCH:
         EnvironmentVars.DL4J_TPU_INFERENCE_MAX_BATCH,
+    SystemProperties.TRAINING_REMAT: EnvironmentVars.DL4J_TPU_REMAT,
+    SystemProperties.TRAINING_GRAD_ACCUM: EnvironmentVars.DL4J_TPU_GRAD_ACCUM,
+    SystemProperties.TRAINING_ZERO1: EnvironmentVars.DL4J_TPU_ZERO1,
 }
 
 _DEFAULTS = {
@@ -71,6 +80,9 @@ _DEFAULTS = {
     SystemProperties.LOG_INITIALIZATION: "1",
     SystemProperties.INFERENCE_BUCKETING: "1",
     SystemProperties.INFERENCE_MAX_BATCH: "128",
+    SystemProperties.TRAINING_REMAT: "none",
+    SystemProperties.TRAINING_GRAD_ACCUM: "1",
+    SystemProperties.TRAINING_ZERO1: "0",
 }
 
 
@@ -159,6 +171,36 @@ class Environment:
 
     def set_inference_max_batch(self, n: int):
         return self.set_property(SystemProperties.INFERENCE_MAX_BATCH, int(n))
+
+    # -- memory-scaled training knobs (nn/fit_fastpath.py, parallel) -------
+    # Fleet-wide defaults; an explicit per-network conf.remat / conf.grad_accum
+    # always wins (the conf fields default to "unset", which resolves here).
+
+    def training_remat(self) -> str:
+        """Default activation-rematerialization policy for training steps:
+        "none" | "layer" | "dots_saveable"."""
+        return self.property(SystemProperties.TRAINING_REMAT) or "none"
+
+    def set_training_remat(self, mode: str):
+        return self.set_property(SystemProperties.TRAINING_REMAT, mode)
+
+    def training_grad_accum(self) -> int:
+        """Default gradient-accumulation factor (micro-batches per optimizer
+        step) when a network conf leaves grad_accum unset."""
+        v = self.property(SystemProperties.TRAINING_GRAD_ACCUM)
+        return max(int(v), 1) if v else 1
+
+    def set_training_grad_accum(self, k: int):
+        return self.set_property(SystemProperties.TRAINING_GRAD_ACCUM, int(k))
+
+    def training_zero1(self) -> bool:
+        """Default for ParallelWrapper's ZeRO-1 optimizer-state sharding."""
+        return self.property(SystemProperties.TRAINING_ZERO1) not in (
+            "0", "false", None)
+
+    def set_training_zero1(self, v: bool):
+        return self.set_property(SystemProperties.TRAINING_ZERO1,
+                                 "1" if v else "0")
 
     # -- recompile observability ------------------------------------------
     # One "compile event" = one new (tag, input-signature) entry entering a

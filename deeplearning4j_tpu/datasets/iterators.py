@@ -115,6 +115,13 @@ class AsyncDataSetIterator(DataSetIterator):
 
     A worker thread pulls from the underlying iterator and device_puts into a
     bounded queue; consumer overlaps compute with host-side prep + H2D DMA.
+
+    `device` may be a Device OR a Sharding (e.g. ParallelWrapper's
+    batch NamedSharding): batches then land already in the sharded layout on
+    the prefetch thread, so the consumer's staging check is a pure no-op and
+    the H2D transfer to every chip overlaps the previous step. A batch the
+    sharding cannot take (e.g. a trailing partial batch not divisible by the
+    mesh) falls back to the default device; the consumer re-places it.
     """
 
     def __init__(self, underlying: DataSetIterator, queue_size: int = 2,
@@ -127,14 +134,20 @@ class AsyncDataSetIterator(DataSetIterator):
         self._done = object()
         self._start()
 
+    def _place(self, x):
+        try:
+            return jax.device_put(x, self.device)
+        except Exception:
+            return jax.device_put(x, jax.devices()[0])
+
     def _start(self):
         def worker():
             try:
                 self.underlying.reset()
                 while self.underlying.has_next():
                     ds = self.underlying.next()
-                    feats = jax.device_put(ds.features.jax(), self.device)
-                    labs = (jax.device_put(ds.labels.jax(), self.device)
+                    feats = self._place(ds.features.jax())
+                    labs = (self._place(ds.labels.jax())
                             if ds.labels is not None else None)
                     self._queue.put(DataSet(NDArray(feats),
                                             None if labs is None else NDArray(labs)))

@@ -163,6 +163,13 @@ class MultiLayerConfiguration:
     gradient_normalization: Optional[str] = None  # None|clip_l2|clip_value
     gradient_clip: float = 1.0
     dtype: str = "float32"
+    #: activation rematerialization inside the jitted train step:
+    #: "none" | "layer" | "dots_saveable"; None resolves the Environment
+    #: default (DL4J_TPU_REMAT)
+    remat: Optional[str] = None
+    #: micro-batches per optimizer step (gradient accumulation); 0/None
+    #: resolves the Environment default (DL4J_TPU_GRAD_ACCUM)
+    grad_accum: int = 0
     #: [(target, constraint)] applied post-update; targets: weights|bias|all
     #: (reference constrainWeights/constrainBias/constrainAllParameters)
     constraints: list = dataclasses.field(default_factory=list)
@@ -209,6 +216,7 @@ class MultiLayerConfiguration:
             "weight_decay": self.weight_decay,
             "gradient_normalization": self.gradient_normalization,
             "gradient_clip": self.gradient_clip, "dtype": self.dtype,
+            "remat": self.remat, "grad_accum": self.grad_accum,
             "constraints": constraints_mod.specs_to_json(self.constraints),
             "weight_noise": (self.weight_noise.to_dict()
                              if self.weight_noise is not None else None),
@@ -249,6 +257,8 @@ class MultiLayerConfiguration:
             gradient_normalization=data.get("gradient_normalization"),
             gradient_clip=data.get("gradient_clip", 1.0),
             dtype=data.get("dtype", "float32"),
+            remat=data.get("remat"),
+            grad_accum=data.get("grad_accum", 0),
             constraints=constraints_mod.specs_from_json(
                 data.get("constraints")),
             weight_noise=weightnoise_mod.weight_noise_from_dict(
@@ -299,6 +309,7 @@ class ListBuilder:
             l1=b._l1, l2=b._l2, weight_decay=b._weight_decay,
             gradient_normalization=b._grad_norm,
             gradient_clip=b._grad_clip, dtype=b._dtype,
+            remat=b._remat, grad_accum=b._grad_accum,
             constraints=list(b._constraints), weight_noise=b._weight_noise)
 
 
@@ -314,6 +325,8 @@ class NeuralNetConfigurationBuilder:
         self._grad_norm = None
         self._grad_clip = 1.0
         self._dtype = "float32"
+        self._remat = None
+        self._grad_accum = 0
         self._constraints = []
         self._weight_noise = None
 
@@ -344,6 +357,21 @@ class NeuralNetConfigurationBuilder:
     def gradient_normalization(self, mode: str, clip: float = 1.0):
         self._grad_norm = mode
         self._grad_clip = clip
+        return self
+
+    def remat(self, mode: str):
+        """Activation rematerialization inside the jitted train step:
+        "none" | "layer" | "dots_saveable" (trade recompute FLOPs for
+        activation HBM on the backward pass)."""
+        self._remat = mode
+        return self
+
+    def grad_accum(self, k: int):
+        """Gradient accumulation: split each fit() batch into `k`
+        micro-batches inside the jitted step, average their gradients and
+        apply the updater once — effective batch size without the
+        activation memory."""
+        self._grad_accum = int(k)
         return self
 
     # constraint hooks (reference NeuralNetConfiguration.Builder

@@ -1,4 +1,5 @@
-"""Shared fit fast path + mixed precision for the layer-API networks.
+"""Shared fit fast path + mixed precision + memory levers for the layer-API
+networks.
 
 MultiLayerNetwork and ComputationGraph both train through this mixin:
 
@@ -12,8 +13,24 @@ MultiLayerNetwork and ComputationGraph both train through this mixin:
   sync. The reference's per-iteration fit loop
   (`MultiLayerNetwork.java:1684`) has no analog of this; workspaces only
   amortize allocation, not dispatch.
+- **Activation rematerialization** (``conf.remat``): each layer/vertex apply
+  is wrapped in `jax.checkpoint` so the backward pass recomputes activations
+  instead of storing them — the XLA-native analog of the reference's
+  WS_ALL_LAYERS_ACT workspace amortization, but it changes the memory
+  *asymptote*, not just allocator churn. Modes: "none" (default), "layer"
+  (only layer boundaries saved), "dots_saveable" (matmul outputs saved).
+- **Gradient-accumulation micro-batching** (``conf.grad_accum = k``): each
+  logical batch is split into k micro-batches scanned *inside* the jitted
+  step, gradients averaged, the updater applied once — the
+  EncodedGradientsAccumulator role (one optimizer step per k micro updates)
+  with the ring buffer replaced by a lax.scan carry. Effective batch size
+  thus decouples from HBM: activations are the micro-batch's. The scanned
+  epoch path, the per-step path, and ParallelWrapper all route through the
+  same accumulating step.
 
-Subclasses provide `_step_fn()` (un-jitted single-batch step with signature
+Subclasses provide `_micro_grads()` (loss+grads+state refresh for one
+micro-batch), `_apply_update()` (clip -> updater -> decay -> constraints),
+`_step_fn()` (un-jitted single-batch step with signature
 ``step(trainable, states, ustate, iteration, data, labels, key)``),
 `_materialize_batches(data)`, `_coerce_fit_data(data, labels)`, and the class
 attr `_DONATE` (which step args are donated to XLA).
@@ -23,9 +40,48 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..common.environment import environment
+
+REMAT_MODES = ("none", "layer", "dots_saveable")
+
 
 class FitFastPathMixin:
     _DONATE = (0, 1, 2)
+
+    # -- memory levers ---------------------------------------------------
+    def _remat_mode(self) -> str:
+        """conf.remat, falling back to the Environment default
+        (DL4J_TPU_REMAT) when the conf leaves it unset."""
+        mode = getattr(self.conf, "remat", None)
+        if mode is None:
+            mode = environment().training_remat()
+        mode = str(mode or "none")
+        if mode not in REMAT_MODES:
+            raise ValueError(f"conf.remat must be one of {REMAT_MODES}, "
+                             f"got {mode!r}")
+        return mode
+
+    def _grad_accum(self) -> int:
+        """conf.grad_accum, falling back to the Environment default
+        (DL4J_TPU_GRAD_ACCUM) when the conf leaves it unset (0/None)."""
+        k = getattr(self.conf, "grad_accum", 0) or 0
+        if int(k) <= 0:
+            k = environment().training_grad_accum()
+        return max(int(k), 1)
+
+    def _remat_wrap(self, fn):
+        """Wrap a layer/vertex apply per the remat policy. Under "layer"
+        only the wrapped call's inputs/outputs survive to the backward pass
+        (everything inside is recomputed); "dots_saveable" additionally
+        keeps matmul/conv outputs (cheap recompute elsewhere, the expensive
+        MXU work saved)."""
+        mode = self._remat_mode()
+        if mode == "none":
+            return fn
+        if mode == "layer":
+            return jax.checkpoint(fn)
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.dots_saveable)
 
     # -- mixed precision -------------------------------------------------
     def _compute_dtype(self):
@@ -45,12 +101,64 @@ class FitFastPathMixin:
         return h.astype(dt) if jnp.issubdtype(h.dtype, jnp.floating) else h
 
     # -- jitted steps ----------------------------------------------------
+    def _train_step_fn(self):
+        """The single-logical-batch step: `_step_fn()` when grad_accum <= 1,
+        else a lax.scan over k micro-batches that averages gradients and
+        applies the updater ONCE (exact match to the full batch for
+        mean-reduced losses). Stateful-layer running stats refresh per
+        micro-batch, sequentially, like k small per-step fits would."""
+        k = self._grad_accum()
+        if k <= 1:
+            return self._step_fn()
+
+        def step(trainable, states, updater_state, iteration, data, labels,
+                 key):
+            def micro_split(t):
+                def r(a):
+                    if a.shape[0] % k:
+                        raise ValueError(
+                            f"grad_accum={k} does not divide batch dim "
+                            f"{a.shape[0]} (shape {a.shape})")
+                    return a.reshape((k, a.shape[0] // k) + a.shape[1:])
+                return jax.tree_util.tree_map(r, t)
+
+            mdata, mlabels = micro_split(data), micro_split(labels)
+            keys = jax.random.split(key, k)
+
+            def body(carry, inp):
+                st, gsum, lsum = carry
+                mx, my, mk = inp
+                loss, st, grads = self._micro_grads(trainable, st, mx, my, mk)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+                return (st, gsum, lsum + loss), None
+
+            zero_g = jax.tree_util.tree_map(jnp.zeros_like, trainable)
+            (new_states, gsum, lsum), _ = jax.lax.scan(
+                body, (states, zero_g, jnp.zeros((), jnp.float32)),
+                (mdata, mlabels, keys))
+            grads = jax.tree_util.tree_map(lambda g: g / k, gsum)
+            new_trainable, updater_state = self._apply_update(
+                trainable, updater_state, iteration, grads)
+            return new_trainable, new_states, updater_state, lsum / k
+
+        return step
+
+    def _step_build_key(self):
+        """Signature of the knobs baked into the built steps; a change
+        forces a rebuild on the next fit()."""
+        return (self._grad_accum(), self._remat_mode())
+
     def _build_train_step(self):
-        return jax.jit(self._step_fn(), donate_argnums=self._DONATE)
+        from ..runtime.inference import counted_jit
+        k, remat = self._step_build_key()
+        return counted_jit(self._train_step_fn(),
+                           tag=f"train:{id(self)}:k{k}:{remat}",
+                           donate_argnums=self._DONATE)
 
     def _build_epoch_step(self):
         """One jitted lax.scan over a whole epoch of stacked batches."""
-        base = self._step_fn()
+        from ..runtime.inference import counted_jit
+        base = self._train_step_fn()
 
         def epoch(trainable, states, updater_state, it0, data, labels, keys):
             def body(carry, inp):
@@ -64,18 +172,22 @@ class FitFastPathMixin:
                 (data, labels, keys))
             return tr, st, us, losses
 
-        return jax.jit(epoch, donate_argnums=self._DONATE)
+        k, remat = self._step_build_key()
+        return counted_jit(epoch, tag=f"epoch:{id(self)}:k{k}:{remat}",
+                           donate_argnums=self._DONATE)
 
     def _step_keys(self, n):
-        """The same key sequence the per-step path would draw (split chain),
-        stacked for scan."""
-        keys = []
-        k = self._rng_key
-        for _ in range(n):
-            k, s = jax.random.split(k)
-            keys.append(s)
-        self._rng_key = k
-        return jnp.stack(keys)
+        """Per-batch key stack for the scanned epoch: ONE vectorized
+        split — `split(key, n + 1)` — instead of n chained 2-way splits
+        (each a separate device dispatch). keys[0] advances the chain.
+
+        Version note: this draws a different (equally independent) stream
+        than the pre-r2 split chain, so scan-path stochastic layers sample
+        differently than the per-step path would; seeded runs remain
+        reproducible within a version."""
+        keys = jax.random.split(self._rng_key, n + 1)
+        self._rng_key = keys[0]
+        return keys[1:]
 
     @staticmethod
     def _listener_overrides(lst, name):
@@ -102,9 +214,14 @@ class FitFastPathMixin:
         self._check_init()
         data = self._coerce_fit_data(data, labels)
         batches = self._materialize_batches(data)
-        if self._train_step is None:
+        build_key = self._step_build_key()
+        if self._train_step is None or \
+                getattr(self, "_built_with", None) != build_key:
+            # first fit, or conf.grad_accum / conf.remat changed since the
+            # steps were last traced — rebuild so the knobs take effect
             self._train_step = self._build_train_step()
             self._epoch_step = None
+            self._built_with = build_key
 
         trainable = self._trainable(self._params)
         states = self._states(self._params)

@@ -81,6 +81,12 @@ class ComputationGraphConfiguration:
     gradient_normalization: Optional[str] = None
     gradient_clip: float = 1.0
     dtype: str = "float32"
+    #: activation remat inside the jitted train step ("none" | "layer" |
+    #: "dots_saveable"); None resolves the Environment default
+    remat: Optional[str] = None
+    #: micro-batches per optimizer step; 0/None resolves the Environment
+    #: default (DL4J_TPU_GRAD_ACCUM)
+    grad_accum: int = 0
     #: [(target, constraint)] applied post-update (see conf/constraints.py)
     constraints: list = dataclasses.field(default_factory=list)
     #: network-default IWeightNoise applied pre-forward during training
@@ -169,6 +175,7 @@ class ComputationGraphConfiguration:
             "weight_decay": self.weight_decay,
             "gradient_normalization": self.gradient_normalization,
             "gradient_clip": self.gradient_clip, "dtype": self.dtype,
+            "remat": self.remat, "grad_accum": self.grad_accum,
             "constraints": constraints_mod.specs_to_json(self.constraints),
             "weight_noise": (self.weight_noise.to_dict()
                              if self.weight_noise is not None else None),
@@ -233,6 +240,8 @@ class ComputationGraphConfiguration:
             gradient_normalization=data.get("gradient_normalization"),
             gradient_clip=data.get("gradient_clip", 1.0),
             dtype=data.get("dtype", "float32"),
+            remat=data.get("remat"),
+            grad_accum=data.get("grad_accum", 0),
             constraints=constraints_mod.specs_from_json(
                 data.get("constraints")),
             weight_noise=weightnoise_mod.weight_noise_from_dict(
@@ -289,6 +298,8 @@ class GraphBuilder:
             conf.gradient_normalization = b._grad_norm
             conf.gradient_clip = b._grad_clip
             conf.dtype = b._dtype
+            conf.remat = b._remat
+            conf.grad_accum = b._grad_accum
             conf.constraints = list(b._constraints)
             conf.weight_noise = b._weight_noise
         # auto-insert preprocessors from inferred types (reference
@@ -402,6 +413,9 @@ class ComputationGraph(FitFastPathMixin):
         out_set = set(self.conf.outputs)
         state_inputs: Dict[str, jax.Array] = {}
         stateful = set(self._stateful_vertices()) if collect_state else ()
+        # conf.remat: each vertex apply becomes a jax.checkpoint region
+        remat = (self._remat_wrap if training and self._remat_mode() != "none"
+                 else None)
         for name in self._order:
             v = self.conf.vertices[name]
             ins = [acts[i] for i in self.conf.vertex_inputs[name]]
@@ -425,7 +439,10 @@ class ComputationGraph(FitFastPathMixin):
             vkey = None
             if training and key is not None and v.needs_key():
                 key, vkey = jax.random.split(key)
-            acts[name] = v.forward(p, ins, training=training, key=vkey)
+
+            def fwd(p_, ins_, k_, _v=v):
+                return _v.forward(p_, ins_, training=training, key=k_)
+            acts[name] = (remat(fwd) if remat else fwd)(p, ins, vkey)
         if collect_state:
             return acts, state_inputs
         return acts
@@ -554,52 +571,63 @@ class ComputationGraph(FitFastPathMixin):
             labs = [self._shard_batch(_unwrap(ds.labels))]
         return {n: x for n, x in zip(self.conf.inputs, feats)}, labs
 
+    def _micro_grads(self, trainable, states, inputs, labels, key):
+        """Loss + refreshed states + gradients for ONE micro-batch — the
+        accumulation unit (no updater application); see
+        FitFastPathMixin._train_step_fn."""
+        output_label_idx = {o: i for i, o in enumerate(self.conf.outputs)}
+
+        def loss_fn(tr):
+            params = self._merge_states(tr, states)
+            acts, state_inputs = self._forward_collect_state(params, inputs,
+                                                             key)
+            loss = self._compute_loss(params, inputs, labels, key, acts=acts,
+                                      state_inputs=state_inputs)
+            return loss, state_inputs
+
+        (loss, state_inputs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(trainable)
+        new_states = dict(states)
+        for name, sx in state_inputs.items():
+            v = self.conf.vertices[name]
+            layer = v.layer if isinstance(v, LayerVertex) else v
+            y = labels[output_label_idx[name]] \
+                if name in output_label_idx else None
+            new_states[name] = layer.new_state(states[name], sx, labels=y)
+        return loss, new_states, grads
+
+    def _apply_update(self, trainable, updater_state, iteration, grads):
+        """Clip -> updater -> weight decay -> constraints (mirrors
+        MultiLayerNetwork._apply_update)."""
+        grad_norm = self.conf.gradient_normalization
+        grad_clip = self.conf.gradient_clip
+        if grad_norm == "clip_l2":
+            gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in
+                                 jax.tree_util.tree_leaves(grads)))
+            scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-12))
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+        elif grad_norm == "clip_value":
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.clip(g, -grad_clip, grad_clip), grads)
+        update, updater_state = self.conf.updater.apply(grads, updater_state,
+                                                        iteration)
+        wd = self.conf.weight_decay
+        new_trainable = jax.tree_util.tree_map(
+            lambda p, u: p - u.astype(p.dtype) - wd * p, trainable, update)
+        new_trainable = constraints_mod.apply_constraints(
+            getattr(self.conf, "constraints", None), new_trainable)
+        return new_trainable, updater_state
+
     def _step_fn(self):
         """Un-jitted single-batch train step (shared by per-step jit and the
         scanned epoch jit — see MultiLayerNetwork._build_epoch_step)."""
-        updater = self.conf.updater
-        grad_norm = self.conf.gradient_normalization
-        grad_clip = self.conf.gradient_clip
-        wd = self.conf.weight_decay
-
-        output_label_idx = {o: i for i, o in enumerate(self.conf.outputs)}
-
         def step(trainable, states, updater_state, iteration, inputs, labels,
                  key):
-            def loss_fn(tr):
-                params = self._merge_states(tr, states)
-                acts, state_inputs = self._forward_collect_state(params,
-                                                                 inputs, key)
-                loss = self._compute_loss(params, inputs, labels, key,
-                                          acts=acts,
-                                          state_inputs=state_inputs)
-                return loss, state_inputs
-
-            (loss, state_inputs), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(trainable)
-            new_states = dict(states)
-            for name, sx in state_inputs.items():
-                v = self.conf.vertices[name]
-                layer = v.layer if isinstance(v, LayerVertex) else v
-                y = labels[output_label_idx[name]] \
-                    if name in output_label_idx else None
-                new_states[name] = layer.new_state(states[name], sx, labels=y)
-            states = new_states
-            if grad_norm == "clip_l2":
-                gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in
-                                     jax.tree_util.tree_leaves(grads)))
-                scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-12))
-                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-            elif grad_norm == "clip_value":
-                grads = jax.tree_util.tree_map(
-                    lambda g: jnp.clip(g, -grad_clip, grad_clip), grads)
-            update, updater_state = updater.apply(grads, updater_state,
-                                                  iteration)
-            new_trainable = jax.tree_util.tree_map(
-                lambda p, u: p - u.astype(p.dtype) - wd * p, trainable, update)
-            new_trainable = constraints_mod.apply_constraints(
-                getattr(self.conf, "constraints", None), new_trainable)
-            return new_trainable, states, updater_state, loss
+            loss, new_states, grads = self._micro_grads(trainable, states,
+                                                        inputs, labels, key)
+            new_trainable, updater_state = self._apply_update(
+                trainable, updater_state, iteration, grads)
+            return new_trainable, new_states, updater_state, loss
 
         return step
 

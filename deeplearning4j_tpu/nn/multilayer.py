@@ -134,6 +134,10 @@ class MultiLayerNetwork(FitFastPathMixin):
         h = self._cast_act(x, cd) if cd is not None else x
         mask = None
         bn_inputs = {}
+        # conf.remat: each layer apply becomes a jax.checkpoint region, so
+        # the backward pass recomputes its internals instead of storing them
+        remat = (self._remat_wrap if training and self._remat_mode() != "none"
+                 else None)
         for i, layer in enumerate(self.layers):
             pre = self.conf.preprocessors.get(i)
             if pre is not None:
@@ -153,12 +157,16 @@ class MultiLayerNetwork(FitFastPathMixin):
             if getattr(layer, "emits_mask", False):
                 mask = layer.compute_mask(h)
             if mask is not None and getattr(layer, "accepts_mask", False):
-                h = layer.forward(p, h, training=training, key=layer_key,
-                                  mask=mask)
+                def fwd(p_, h_, k_, m_, _l=layer):
+                    return _l.forward(p_, h_, training=training, key=k_,
+                                      mask=m_)
+                h = (remat(fwd) if remat else fwd)(p, h, layer_key, mask)
                 if not getattr(layer, "return_sequence", True):
                     mask = None  # time axis consumed
             else:
-                h = layer.forward(p, h, training=training, key=layer_key)
+                def fwd(p_, h_, k_, _l=layer):
+                    return _l.forward(p_, h_, training=training, key=k_)
+                h = (remat(fwd) if remat else fwd)(p, h, layer_key)
         return h, mask, bn_inputs
 
     def output(self, x, training: bool = False) -> NDArray:
@@ -321,16 +329,22 @@ class MultiLayerNetwork(FitFastPathMixin):
                 new_states.append(states[i])
         return new_states
 
+    def _micro_grads(self, trainable, states, x, y, key):
+        """Loss + refreshed states + gradients for ONE micro-batch — the
+        accumulation unit (no updater application); see
+        FitFastPathMixin._train_step_fn."""
+        (loss, bn_inputs), grads = jax.value_and_grad(
+            self._loss_with_bn, has_aux=True)(trainable, states, x, y, key)
+        return loss, self._refresh_states(states, bn_inputs, y), grads
+
     def _step_fn(self):
         """The un-jitted single-batch train step (shared by the per-step jit
         and the scanned multi-batch epoch jit)."""
         def step(trainable, states, updater_state, iteration, x, y, key):
-            (loss, bn_inputs), grads = jax.value_and_grad(
-                self._loss_with_bn, has_aux=True)(trainable, states, x, y,
-                                                  key)
+            loss, new_states, grads = self._micro_grads(trainable, states,
+                                                        x, y, key)
             new_trainable, updater_state = self._apply_update(
                 trainable, updater_state, iteration, grads)
-            new_states = self._refresh_states(states, bn_inputs, y)
             return new_trainable, new_states, updater_state, loss
 
         return step

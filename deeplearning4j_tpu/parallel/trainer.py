@@ -25,9 +25,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..common.environment import environment
 from ..datasets.dataset import DataSet
 from ..ndarray.ndarray import NDArray
-from .mesh import DATA, FSDP, MeshConfig, make_mesh
+from .mesh import (DATA, FSDP, MeshConfig, make_mesh, zero1_place,
+                   zero1_shardings)
 
 
 @dataclasses.dataclass
@@ -65,13 +67,23 @@ class ParallelWrapper:
     device); averaging_frequency/residual knobs are accepted for source
     compatibility and ignored (sync allreduce every step is the semantics
     of averaging_frequency=1, the reference default for gradient sharing).
+
+    `zero1=True` (or DL4J_TPU_ZERO1=1) shards the updater state over the
+    data-parallel group (ZeRO-1): each chip keeps 1/dp of every divisible
+    state tensor, the updater math runs on the shards, and GSPMD
+    all-gathers the resulting update into the replicated params — per-chip
+    updater memory drops by the mesh's dp size (2x params' worth for Adam).
+    The network's conf.grad_accum / conf.remat are honored too: the wrapper
+    compiles the same accumulating step fit() uses, just sharded.
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None,
-                 prefetch_buffer: int = 2):
+                 prefetch_buffer: int = 2, zero1: Optional[bool] = None):
         self.net = net
         self.mesh = mesh if mesh is not None else make_mesh(MeshConfig())
         self.prefetch_buffer = prefetch_buffer
+        self.zero1 = environment().training_zero1() if zero1 is None \
+            else bool(zero1)
         self._step = None
 
     # -- builder-style construction --------------------------------------
@@ -80,6 +92,7 @@ class ParallelWrapper:
             self._net = net
             self._mesh = None
             self._prefetch = 2
+            self._zero1 = None
 
         def workers(self, n: int):
             self._mesh = make_mesh(MeshConfig(data=n),
@@ -94,6 +107,11 @@ class ParallelWrapper:
             self._prefetch = n
             return self
 
+        def zero1(self, v: bool = True):
+            """ZeRO-1 updater-state sharding over the data-parallel group."""
+            self._zero1 = bool(v)
+            return self
+
         # accepted-for-compat no-ops (sync allreduce subsumes them)
         def averaging_frequency(self, n: int):
             return self
@@ -105,7 +123,8 @@ class ParallelWrapper:
             return self
 
         def build(self) -> "ParallelWrapper":
-            return ParallelWrapper(self._net, self._mesh, self._prefetch)
+            return ParallelWrapper(self._net, self._mesh, self._prefetch,
+                                   zero1=self._zero1)
 
     @staticmethod
     def builder(net) -> "ParallelWrapper.Builder":
@@ -115,18 +134,33 @@ class ParallelWrapper:
     def _build_step(self):
         net = self.net
         mesh = self.mesh
-        base_step = net._build_train_step()
+        base_step = net._train_step_fn()  # honors conf.grad_accum/remat
         repl = NamedSharding(mesh, P())
         batch_sh = NamedSharding(mesh, P((DATA, FSDP)))
+        # ZeRO-1: updater state lives sharded over the dp group; the step's
+        # in/out shardings pin the layout so the updater math partitions and
+        # only the final update all-gathers into the replicated params
+        ustate_sh = zero1_shardings(mesh, net._updater_state) \
+            if self.zero1 else repl
 
         def step(trainable, states, ustate, iteration, x, y, key):
             return base_step(trainable, states, ustate, iteration, x, y, key)
 
         return jax.jit(
             step,
-            in_shardings=(repl, repl, repl, None, batch_sh, batch_sh, repl),
-            out_shardings=(repl, repl, repl, None),
+            in_shardings=(repl, repl, ustate_sh, None, batch_sh, batch_sh,
+                          repl),
+            out_shardings=(repl, repl, ustate_sh, None),
             donate_argnums=(0, 1, 2))
+
+    def _stage(self, value, batch_sharding):
+        """Device-place one batch array — a no-op when the prefetch thread
+        already committed it in the sharded layout (the blocking
+        device_put then never runs on the consumer side)."""
+        x = _unwrap(value)
+        if getattr(x, "sharding", None) == batch_sharding:
+            return x
+        return jax.device_put(x, batch_sharding)
 
     def fit(self, iterator, num_epochs: int = 1):
         net = self.net
@@ -136,6 +170,8 @@ class ParallelWrapper:
         trainable = net._trainable(net._params)
         states = net._states(net._params)
         ustate = net._updater_state
+        if self.zero1 and ustate is not None:
+            ustate = zero1_place(self.mesh, ustate)
         batch_sharding = NamedSharding(self.mesh, P((DATA, FSDP)))
         from ..datasets.iterators import AsyncDataSetIterator
         if self.prefetch_buffer > 0 and not isinstance(
@@ -148,8 +184,8 @@ class ParallelWrapper:
             if hasattr(iterator, "reset"):
                 iterator.reset()
             for ds in iterator:
-                x = jax.device_put(_unwrap(ds.features), batch_sharding)
-                y = jax.device_put(_unwrap(ds.labels), batch_sharding)
+                x = self._stage(ds.features, batch_sharding)
+                y = self._stage(ds.labels, batch_sharding)
                 net._rng_key, step_key = jax.random.split(net._rng_key)
                 trainable, states, ustate, loss = self._step(
                     trainable, states, ustate, net._iteration, x, y, step_key)

@@ -130,23 +130,28 @@ def slice_batch(outputs: Sequence[Any], n: int, bucket: int) -> List[Any]:
 # compile-counted jit
 # ---------------------------------------------------------------------------
 
-def counted_jit(fn: Callable, tag: str) -> Callable:
-    """``jax.jit(fn)`` wrapped with recompile observability: each new input
-    signature records one compile event with the Environment counter.
+def counted_jit(fn: Callable, tag: str, **jit_kwargs) -> Callable:
+    """``jax.jit(fn, **jit_kwargs)`` wrapped with recompile observability:
+    each new input signature records one compile event with the Environment
+    counter. Used by every jitted inference entry AND the fit fast path's
+    train/epoch steps (donate_argnums passes through).
 
     The signature is computed from ``args[1:]`` — by convention the first
     argument is the parameter pytree, whose shapes only change on
     re-init/distribute (which rebuild the wrapper anyway); skipping it
-    keeps the per-call overhead off the hot path.
+    keeps the per-call overhead off the hot path. Python-scalar leaves
+    (e.g. the iteration counter) hash by type, matching jit's behavior of
+    tracing them as abstract values — a changing int must not count as a
+    recompile.
     """
-    jfn = jax.jit(fn)
+    jfn = jax.jit(fn, **jit_kwargs)
     seen = set()
 
     def wrapped(*args):
         data = args[1:]
         sig = (jax.tree_util.tree_structure(data),
                tuple((tuple(l.shape), str(l.dtype))
-                     if hasattr(l, "shape") else repr(l)
+                     if hasattr(l, "shape") else f"py:{type(l).__name__}"
                      for l in jax.tree_util.tree_leaves(data)))
         if sig not in seen:
             seen.add(sig)

@@ -119,6 +119,68 @@ class TestSelectHeadline:
         assert name == "b"
 
 
+def _tm_record(default_sps=100.0, remat_sps=80.0, default_peak=4_000_000,
+               accum_peak=1_000_000, default_act=10_000_000,
+               remat_act=2_000_000):
+    return {
+        "default": {"samples_per_sec": default_sps,
+                    "peak_bytes": default_peak,
+                    "activation_bytes": default_act},
+        "remat": {"samples_per_sec": remat_sps,
+                  "peak_bytes": default_peak,
+                  "activation_bytes": remat_act},
+        "remat_accum": {"samples_per_sec": remat_sps,
+                        "peak_bytes": accum_peak,
+                        "activation_bytes": remat_act},
+    }
+
+
+class TestCheckTrainMemory:
+    """Gate logic for the train_memory metric (perf trajectory): remat must
+    not cost >30% samples/sec at equal batch, and the accumulation path
+    must actually lower peak memory at equal effective batch."""
+
+    def test_accepts_good_record(self):
+        ok, reason = bench.check_train_memory(_tm_record())
+        assert ok, reason
+
+    def test_rejects_slow_remat(self):
+        # 69 < 0.7 * 100: recompute ate more than the one-extra-forward
+        # budget — the checkpoint boundaries are wrong
+        ok, reason = bench.check_train_memory(_tm_record(remat_sps=69.0))
+        assert not ok
+        assert "remat samples/sec" in reason
+        ok, _ = bench.check_train_memory(_tm_record(remat_sps=71.0))
+        assert ok
+
+    def test_rejects_accum_without_memory_win(self):
+        ok, reason = bench.check_train_memory(
+            _tm_record(accum_peak=4_000_000))
+        assert not ok
+        assert "saved no memory" in reason
+
+    def test_rejects_remat_without_activation_win(self):
+        ok, reason = bench.check_train_memory(
+            _tm_record(remat_act=10_000_000))
+        assert not ok
+        assert "saved no activations" in reason
+
+    def test_tiny_live_measurement_passes_gate(self):
+        """The full metric end-to-end on CPU: the tiny CNN record must pass
+        its own gate — deterministically lower XLA peak for the accum path
+        and lower stored residuals for remat (analytic quantities, not
+        wall-clock), and the wall-clock gate with the 30% margin."""
+        import jax
+        import jax.numpy as jnp
+
+        rec = bench.bench_train_memory(jax, jnp, tiny=True)
+        assert rec["gate_ok"], rec["gate_reason"]
+        assert rec["remat_accum"]["peak_bytes"] < rec["default"]["peak_bytes"]
+        assert (rec["remat"]["activation_bytes"]
+                < rec["default"]["activation_bytes"])
+        assert rec["effective_batch"] == rec["batch"]
+
+
 class TestScannedStepEndToEnd:
     def test_tiny_scan_chain_produces_sane_record(self):
         """The full measurement path on CPU: scanned step, median-of-5,
