@@ -10,6 +10,8 @@ chip + MFU (BASELINE config 3; north-star acceptance 35% MFU → vs_baseline
   - inference_serving     (mixed-batch-size stream: bucketed
                            InferenceEngine vs naive exact-shape jit —
                            throughput, p50/p99 latency, compile counts)
+  - telemetry_overhead    (bucketed serving throughput with the metrics
+                           registry + spans on vs off; gated <3%)
 Config 5 (multi-chip scaling) needs >1 chip; the driver's multichip dryrun
 covers correctness, scaling numbers await real multi-chip hardware.
 
@@ -577,6 +579,76 @@ def bench_inference_serving(jax, jnp, tiny):
     return results
 
 
+def bench_telemetry_overhead(jax, jnp, tiny):
+    """Cost of the telemetry subsystem on the serving hot path: bucketed
+    InferenceEngine throughput over a mixed-size request stream with the
+    metrics registry + spans enabled vs disabled (DL4J_TPU_METRICS).
+    The instrumentation contract is near-zero cost, so `overhead_frac`
+    must stay under the `check_telemetry_overhead` gate's 3%."""
+    from deeplearning4j_tpu.common.environment import environment
+    from deeplearning4j_tpu.common.tracing import tracer
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.runtime.inference import InferenceEngine
+
+    n_in, hidden, n_out = (16, 32, 4) if tiny else (256, 1024, 64)
+    max_batch = 8 if tiny else 32
+    sizes = [1, 3, 7, 5, 2, 6, 4, 8] if tiny \
+        else [1, 3, 7, 17, 5, 29, 2, 11, 23, 4, 31, 9]
+    n_requests = len(sizes) * (4 if tiny else 16)
+
+    conf = (NeuralNetConfiguration.builder().seed(0).list()
+            .layer(DenseLayer(n_in=n_in, n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_in=hidden, n_out=n_out))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    reqs = [jnp.asarray(rng.randn(sizes[i % len(sizes)], n_in)
+                        .astype(np.float32)) for i in range(n_requests)]
+    total_rows = sum(int(r.shape[0]) for r in reqs)
+
+    reg = environment().metrics()
+    prev_enabled = reg.enabled
+    out = {"request_count": n_requests, "max_batch": max_batch}
+    try:
+        for mode in ("off", "on"):
+            reg.set_enabled(mode == "on")
+            eng = InferenceEngine(net, max_batch=max_batch)
+            eng.warmup(reqs[0])
+            runs = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for r in reqs:
+                    jax.block_until_ready(eng.infer(r).jax())
+                runs.append(time.perf_counter() - t0)
+            runs.sort()
+            out[f"metrics_{mode}_sps"] = round(total_rows / runs[1], 2)
+    finally:
+        reg.set_enabled(prev_enabled)
+        tracer().clear()
+    out["overhead_frac"] = round(
+        1.0 - out["metrics_on_sps"] / max(out["metrics_off_sps"], 1e-9), 4)
+    ok, reason = check_telemetry_overhead(out)
+    out["gate_ok"], out["gate_reason"] = ok, reason
+    return out
+
+
+def check_telemetry_overhead(rec, max_overhead=0.03):
+    """(ok, reason): metrics-on serving throughput may cost at most
+    `max_overhead` (3%) vs metrics-off — the near-zero-cost contract of
+    the telemetry subsystem. A bigger gap means instrumentation leaked
+    onto the per-dispatch path (allocation, locking, or a host sync)."""
+    on, off = rec["metrics_on_sps"], rec["metrics_off_sps"]
+    floor = (1.0 - max_overhead) * off
+    if on < floor:
+        return False, (
+            f"metrics-on throughput {on:.2f} < {floor:.2f} "
+            f"({(1 - max_overhead) * 100:.0f}% of metrics-off {off:.2f}): "
+            "telemetry is not near-zero-cost on the serving path")
+    return True, "ok"
+
+
 def bench_flash_attention(jax, jnp, tiny):
     """Pallas flash attention vs XLA attention at long sequence length.
 
@@ -758,6 +830,12 @@ def main():
             out["train_memory"] = bench_train_memory(jax, jnp, tiny)
         except Exception as e:
             out["train_memory"] = f"error: {type(e).__name__}"
+        _release()
+        try:
+            out["telemetry_overhead"] = bench_telemetry_overhead(jax, jnp,
+                                                                 tiny)
+        except Exception as e:
+            out["telemetry_overhead"] = f"error: {type(e).__name__}"
         _release()
         try:
             fwd, train = bench_flash_attention(jax, jnp, tiny)

@@ -168,28 +168,52 @@ def fit(sd, iterator=None, num_epochs: int = 1, placeholders_fn=None,
     curves = []
     iteration = 0
     t0 = time.time()
+
+    from ..common.environment import environment
+    from ..common.tracing import span
+    reg = environment().metrics()
+    tel = reg.enabled
+    if tel:
+        steps_c = reg.counter("dl4j_train_steps_total",
+                              "Optimizer steps taken",
+                              labels=("path",)).labels(path="samediff")
+        samples_c = reg.counter("dl4j_train_samples_total",
+                                "Training samples consumed",
+                                labels=("path",)).labels(path="samediff")
+        loss_g = reg.gauge("dl4j_train_loss", "Most recent training loss")
+
     for epoch in range(num_epochs):
         losses = []
         if hasattr(iterator, "reset"):
             iterator.reset()
         for ds in iterator:
-            ph = {}
-            feats = ds.features if isinstance(ds.features, (list, tuple)) \
-                else [ds.features]
-            labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
-                else [ds.labels]
-            for name, arr in zip(f_map, feats):
-                ph[name] = arr.jax() if isinstance(arr, NDArray) else jnp.asarray(arr)
-            for name, arr in zip(l_map, labs):
-                ph[name] = arr.jax() if isinstance(arr, NDArray) else jnp.asarray(arr)
-            params, state, loss = step(params, state, iteration, ph)
+            with span("train/data_wait"):
+                ph = {}
+                feats = ds.features if isinstance(ds.features, (list, tuple)) \
+                    else [ds.features]
+                labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
+                    else [ds.labels]
+                for name, arr in zip(f_map, feats):
+                    ph[name] = arr.jax() if isinstance(arr, NDArray) else jnp.asarray(arr)
+                for name, arr in zip(l_map, labs):
+                    ph[name] = arr.jax() if isinstance(arr, NDArray) else jnp.asarray(arr)
+            with span("train/dispatch"):
+                params, state, loss = step(params, state, iteration, ph)
             # donated buffers are now invalid — repoint graph arrays before
             # listeners (which may call sd.output / save) run
             for n, p in params.items():
                 sd._arrays[n] = p
             sd._updater_state = state
-            loss_val = float(loss)
+            with span("train/device"):
+                loss_val = float(loss)  # host sync: device time lands here
             losses.append(loss_val)
+            sd._last_batch_size = next(
+                (int(v.shape[0]) for v in ph.values()
+                 if getattr(v, "ndim", 0) >= 1), 0)
+            if tel:
+                steps_c.inc()
+                samples_c.inc(sd._last_batch_size)
+                loss_g.set(loss_val)
             for lst in all_listeners:
                 if hasattr(lst, "iteration_done"):
                     lst.iteration_done(sd, iteration, epoch, loss_val)

@@ -1,8 +1,13 @@
 """Cross-cutting services: dtype system, layered env config, RNG facade,
-chrome-trace profile analysis (nd4j-common / linalg.api.environment role)."""
+runtime telemetry (metrics registry + tracing spans), chrome-trace profile
+analysis (nd4j-common / linalg.api.environment role)."""
 from .dtype import DataType
 from .environment import Environment, EnvironmentVars, SystemProperties, environment
+from .metrics import MetricsRegistry, exponential_buckets, linear_buckets, registry
 from .rng import NativeRandom, get_random, set_default_seed
+from .tracing import Tracer, span, tracer
 
 __all__ = ["DataType", "Environment", "EnvironmentVars", "SystemProperties",
-           "environment", "NativeRandom", "get_random", "set_default_seed"]
+           "environment", "NativeRandom", "get_random", "set_default_seed",
+           "MetricsRegistry", "registry", "exponential_buckets",
+           "linear_buckets", "Tracer", "span", "tracer"]

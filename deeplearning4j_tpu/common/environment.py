@@ -36,6 +36,8 @@ class EnvironmentVars:
     DL4J_TPU_REMAT = "DL4J_TPU_REMAT"
     DL4J_TPU_GRAD_ACCUM = "DL4J_TPU_GRAD_ACCUM"
     DL4J_TPU_ZERO1 = "DL4J_TPU_ZERO1"
+    DL4J_TPU_METRICS = "DL4J_TPU_METRICS"
+    DL4J_TPU_TRACE_BUFFER = "DL4J_TPU_TRACE_BUFFER"
     XLA_FLAGS = "XLA_FLAGS"
 
 
@@ -53,6 +55,8 @@ class SystemProperties:
     TRAINING_REMAT = "training_remat"
     TRAINING_GRAD_ACCUM = "training_grad_accum"
     TRAINING_ZERO1 = "training_zero1"
+    METRICS = "metrics"
+    TRACE_BUFFER = "trace_buffer"
 
 
 _ENV_FOR_PROP = {
@@ -70,6 +74,8 @@ _ENV_FOR_PROP = {
     SystemProperties.TRAINING_REMAT: EnvironmentVars.DL4J_TPU_REMAT,
     SystemProperties.TRAINING_GRAD_ACCUM: EnvironmentVars.DL4J_TPU_GRAD_ACCUM,
     SystemProperties.TRAINING_ZERO1: EnvironmentVars.DL4J_TPU_ZERO1,
+    SystemProperties.METRICS: EnvironmentVars.DL4J_TPU_METRICS,
+    SystemProperties.TRACE_BUFFER: EnvironmentVars.DL4J_TPU_TRACE_BUFFER,
 }
 
 _DEFAULTS = {
@@ -83,6 +89,8 @@ _DEFAULTS = {
     SystemProperties.TRAINING_REMAT: "none",
     SystemProperties.TRAINING_GRAD_ACCUM: "1",
     SystemProperties.TRAINING_ZERO1: "0",
+    SystemProperties.METRICS: "1",
+    SystemProperties.TRACE_BUFFER: "16384",
 }
 
 
@@ -100,6 +108,7 @@ class Environment:
         self._compile_keys: set = set()
         self._compile_count = 0
         self._compile_listeners: list = []
+        self._listener_errors_logged: set = set()
 
     @classmethod
     def get(cls) -> "Environment":
@@ -202,6 +211,26 @@ class Environment:
         return self.set_property(SystemProperties.TRAINING_ZERO1,
                                  "1" if v else "0")
 
+    # -- telemetry (common/metrics.py, common/tracing.py) ------------------
+    def metrics(self):
+        """The process-wide MetricsRegistry (DL4J_TPU_METRICS gates all
+        instrumentation writes; see `common.metrics.registry`)."""
+        from .metrics import registry
+        return registry()
+
+    def metrics_enabled(self) -> bool:
+        return self.metrics().enabled
+
+    def set_metrics_enabled(self, v: bool):
+        self.set_property(SystemProperties.METRICS, "1" if v else "0")
+        self.metrics().set_enabled(v)
+        return self
+
+    def trace_buffer(self) -> int:
+        """Span ring-buffer capacity (DL4J_TPU_TRACE_BUFFER)."""
+        v = self.property(SystemProperties.TRACE_BUFFER)
+        return int(v) if v else 16384
+
     # -- recompile observability ------------------------------------------
     # One "compile event" = one new (tag, input-signature) entry entering a
     # jitted-inference cache (runtime.inference.counted_jit). With bucketing
@@ -211,18 +240,37 @@ class Environment:
 
     def record_compile(self, key) -> bool:
         """Register a compile event; returns False if `key` was already
-        seen (cache hit). New keys notify compile listeners."""
+        seen (cache hit). New keys notify compile listeners and bump the
+        `dl4j_compiles_total` metric (labeled by the tag kind)."""
         with self._compile_lock:
             if key in self._compile_keys:
                 return False
             self._compile_keys.add(key)
             self._compile_count += 1
             listeners = list(self._compile_listeners)
+        try:
+            from .metrics import registry
+            kind = key[0] if isinstance(key, (tuple, list)) and key else key
+            registry().counter(
+                "dl4j_compiles_total",
+                "XLA compile events recorded by counted_jit",
+                labels=("kind",)).labels(
+                    kind=str(kind).split(":")[0]).inc()
+        except Exception:
+            pass  # observability must never break the inference path
         for fn in listeners:
             try:
                 fn(key)
             except Exception:
-                pass  # observability must never break the inference path
+                # swallowed so a bad listener can't break serving — but
+                # under is_debug(), surface it once per listener
+                if self.is_debug() and id(fn) not in \
+                        self._listener_errors_logged:
+                    self._listener_errors_logged.add(id(fn))
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "compile listener %r raised (logged once; further "
+                        "exceptions from this listener are dropped)", fn)
         return True
 
     def compile_count(self) -> int:

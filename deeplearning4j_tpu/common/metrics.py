@@ -1,0 +1,375 @@
+"""Framework-wide metrics registry: labeled Counter/Gauge/Histogram.
+
+Reference: the observability surface of `StatsListener` /
+`PerformanceListener` (per-iteration scores, samples/sec, memory, timing),
+reshaped into the Prometheus data model so one registry serves training AND
+serving: the hot paths (`runtime/inference.py`, `nn/fit_fastpath.py`,
+`autodiff/training.py`, `parallel/trainer.py`) write counters/gauges/
+histograms here, and `ui/server.py` exposes them at `/metrics` (text
+exposition format) and `/metrics.json`.
+
+Design constraints (the train/serve paths must never pay for what they
+don't use):
+
+- one process-wide singleton (`registry()`), reachable as
+  `environment().metrics()`;
+- every write path reads ONE cached ``enabled`` flag (resolved from
+  ``DL4J_TPU_METRICS``, on by default) and returns immediately when off —
+  no allocation, no lock;
+- label lookups (`family.labels(...)`) return cached children so hot
+  loops can hoist the child and pay only an inc/observe per event;
+- writes never raise into the instrumented path: a metric type clash at
+  *creation* raises (programming error), but inc/set/observe are plain
+  arithmetic under a per-child lock.
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+def exponential_buckets(start: float, factor: float,
+                        count: int) -> Tuple[float, ...]:
+    """`count` bucket upper bounds: start, start*factor, ... (Prometheus
+    client convention; the +Inf bucket is implicit)."""
+    if start <= 0 or factor <= 1 or count < 1:
+        raise ValueError("need start > 0, factor > 1, count >= 1")
+    return tuple(start * factor ** i for i in range(count))
+
+
+def linear_buckets(start: float, width: float,
+                   count: int) -> Tuple[float, ...]:
+    if width <= 0 or count < 1:
+        raise ValueError("need width > 0, count >= 1")
+    return tuple(start + width * i for i in range(count))
+
+
+#: default latency buckets: 1us .. ~8.4s, x2 per rung — wide enough for
+#: both a CPU dispatch and a cold TPU compile to land inside the ladder
+DEFAULT_BUCKETS = exponential_buckets(1e-6, 2.0, 24)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus float formatting: integers without the trailing .0."""
+    f = float(v)
+    if f == math.inf:
+        return "+Inf"
+    if f == -math.inf:
+        return "-Inf"
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _label_str(names: Sequence[str], values: Sequence[str],
+               extra: str = "") -> str:
+    parts = [f'{n}="{v}"' for n, v in zip(names, values)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Child:
+    """One labeled time series. Base for counter/gauge children."""
+    __slots__ = ("_registry", "_value", "_lock")
+
+    def __init__(self, registry):
+        self._registry = registry
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def value(self) -> float:
+        return self._value
+
+
+class _CounterChild(_Child):
+    __slots__ = ()
+
+    def inc(self, amount: float = 1.0):
+        if not self._registry.enabled:
+            return
+        if amount < 0:
+            raise ValueError("counters only go up; use a gauge")
+        with self._lock:
+            self._value += amount
+
+
+class _GaugeChild(_Child):
+    __slots__ = ()
+
+    def set(self, value: float):
+        if not self._registry.enabled:
+            return
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0):
+        if not self._registry.enabled:
+            return
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0):
+        self.inc(-amount)
+
+
+class _HistogramChild:
+    __slots__ = ("_registry", "_bounds", "_counts", "_sum", "_count",
+                 "_lock")
+
+    def __init__(self, registry, bounds: Tuple[float, ...]):
+        self._registry = registry
+        self._bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # last = +Inf overflow
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float):
+        if not self._registry.enabled:
+            return
+        v = float(value)
+        i = 0
+        for b in self._bounds:
+            if v <= b:
+                break
+            i += 1
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    # -- snapshots --------------------------------------------------------
+    def count(self) -> int:
+        return self._count
+
+    def sum(self) -> float:
+        return self._sum
+
+    def quantile(self, q: float) -> float:
+        """Estimate the q-quantile (0..1) by linear interpolation inside
+        the exponential buckets — the standard histogram_quantile rule.
+        Observations past the top bound clamp to it."""
+        with self._lock:
+            counts, total = list(self._counts), self._count
+        if total == 0:
+            return float("nan")
+        rank = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            prev_cum = cum
+            cum += c
+            if cum >= rank and c > 0:
+                if i >= len(self._bounds):  # +Inf bucket
+                    return self._bounds[-1]
+                lo = self._bounds[i - 1] if i > 0 else 0.0
+                hi = self._bounds[i]
+                return lo + (hi - lo) * (rank - prev_cum) / c
+        return self._bounds[-1]
+
+    def percentiles(self) -> Dict[str, float]:
+        return {"p50": self.quantile(0.50), "p90": self.quantile(0.90),
+                "p99": self.quantile(0.99)}
+
+
+class _Family:
+    """A named metric with a fixed label set; unlabeled families act as
+    their own single child."""
+
+    def __init__(self, registry, name: str, help: str, kind: str,
+                 label_names: Tuple[str, ...],
+                 buckets: Optional[Tuple[float, ...]] = None):
+        self._registry = registry
+        self.name = name
+        self.help = help
+        self.kind = kind
+        self.label_names = label_names
+        self._buckets = buckets
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+        if not label_names:
+            self._default = self._make_child()
+            self._children[()] = self._default
+        else:
+            self._default = None
+
+    def _make_child(self):
+        if self.kind == "counter":
+            return _CounterChild(self._registry)
+        if self.kind == "gauge":
+            return _GaugeChild(self._registry)
+        return _HistogramChild(self._registry, self._buckets)
+
+    def labels(self, **kv):
+        """Cached child for a label-value combination."""
+        key = tuple(str(kv[n]) for n in self.label_names)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.get(key)
+                if child is None:
+                    child = self._make_child()
+                    self._children[key] = child
+        return child
+
+    def _require_default(self):
+        if self._default is None:
+            raise ValueError(
+                f"metric {self.name} has labels {self.label_names}; "
+                "use .labels(...)")
+        return self._default
+
+    # unlabeled convenience passthroughs
+    def inc(self, amount: float = 1.0):
+        self._require_default().inc(amount)
+
+    def set(self, value: float):
+        self._require_default().set(value)
+
+    def dec(self, amount: float = 1.0):
+        self._require_default().dec(amount)
+
+    def observe(self, value: float):
+        self._require_default().observe(value)
+
+    def value(self) -> float:
+        return self._require_default().value()
+
+    def count(self) -> int:
+        return self._require_default().count()
+
+    def quantile(self, q: float) -> float:
+        return self._require_default().quantile(q)
+
+    def children(self) -> List[Tuple[Tuple[str, ...], object]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+
+class MetricsRegistry:
+    """Thread-safe named-metric registry with Prometheus exposition.
+
+    `counter`/`gauge`/`histogram` are get-or-create: the same name returns
+    the same family (a kind or label-set clash raises — that is a
+    programming error, not a runtime hazard)."""
+
+    def __init__(self, enabled: Optional[bool] = None):
+        if enabled is None:
+            enabled = os.environ.get("DL4J_TPU_METRICS", "1") not in (
+                "0", "false")
+        self.enabled = bool(enabled)
+        self._families: Dict[str, _Family] = {}
+        self._lock = threading.Lock()
+
+    def set_enabled(self, v: bool):
+        self.enabled = bool(v)
+        return self
+
+    # -- factories ---------------------------------------------------------
+    def _get_or_create(self, name, help, kind, labels, buckets=None):
+        fam = self._families.get(name)
+        if fam is None:
+            with self._lock:
+                fam = self._families.get(name)
+                if fam is None:
+                    fam = _Family(self, name, help, kind, tuple(labels),
+                                  buckets)
+                    self._families[name] = fam
+        if fam.kind != kind:
+            raise ValueError(f"metric {name} already registered as "
+                             f"{fam.kind}, not {kind}")
+        if fam.label_names != tuple(labels):
+            raise ValueError(f"metric {name} registered with labels "
+                             f"{fam.label_names}, not {tuple(labels)}")
+        return fam
+
+    def counter(self, name: str, help: str = "",
+                labels: Iterable[str] = ()) -> _Family:
+        return self._get_or_create(name, help, "counter", labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Iterable[str] = ()) -> _Family:
+        return self._get_or_create(name, help, "gauge", labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Iterable[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> _Family:
+        b = tuple(sorted(float(x) for x in (buckets or DEFAULT_BUCKETS)))
+        return self._get_or_create(name, help, "histogram", labels, b)
+
+    def get(self, name: str) -> Optional[_Family]:
+        return self._families.get(name)
+
+    def clear(self):
+        """Drop every registered family (test isolation)."""
+        with self._lock:
+            self._families.clear()
+        return self
+
+    # -- exposition --------------------------------------------------------
+    def snapshot(self) -> Dict[str, dict]:
+        """JSON-able view: per family, the type/help and every labeled
+        series; histograms add sum/count and p50/p90/p99."""
+        out = {}
+        for name in sorted(self._families):
+            fam = self._families[name]
+            series = []
+            for key, child in fam.children():
+                labels = dict(zip(fam.label_names, key))
+                if fam.kind == "histogram":
+                    n = child.count()
+                    # None (not NaN) for empty histograms: the snapshot
+                    # must stay strict-JSON for /metrics.json consumers
+                    pct = child.percentiles() if n else {
+                        "p50": None, "p90": None, "p99": None}
+                    series.append({"labels": labels, "count": n,
+                                   "sum": child.sum(), **pct})
+                else:
+                    series.append({"labels": labels,
+                                   "value": child.value()})
+            out[name] = {"type": fam.kind, "help": fam.help,
+                         "series": series}
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (version 0.0.4)."""
+        lines = []
+        for name in sorted(self._families):
+            fam = self._families[name]
+            if fam.help:
+                lines.append(f"# HELP {name} {fam.help}")
+            lines.append(f"# TYPE {name} {fam.kind}")
+            for key, child in fam.children():
+                if fam.kind == "histogram":
+                    with child._lock:
+                        counts = list(child._counts)
+                        total, s = child._count, child._sum
+                    cum = 0
+                    for bound, c in zip(child._bounds, counts):
+                        cum += c
+                        le = _label_str(fam.label_names, key,
+                                        f'le="{_fmt(bound)}"')
+                        lines.append(f"{name}_bucket{le} {cum}")
+                    le = _label_str(fam.label_names, key, 'le="+Inf"')
+                    lines.append(f"{name}_bucket{le} {total}")
+                    ls = _label_str(fam.label_names, key)
+                    lines.append(f"{name}_sum{ls} {_fmt(s)}")
+                    lines.append(f"{name}_count{ls} {total}")
+                else:
+                    ls = _label_str(fam.label_names, key)
+                    lines.append(f"{name}{ls} {_fmt(child.value())}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+_REGISTRY: Optional[MetricsRegistry] = None
+_REGISTRY_LOCK = threading.Lock()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry (also `environment().metrics()`)."""
+    global _REGISTRY
+    if _REGISTRY is None:
+        with _REGISTRY_LOCK:
+            if _REGISTRY is None:
+                _REGISTRY = MetricsRegistry()
+    return _REGISTRY

@@ -24,11 +24,24 @@ def load_trace(path: str) -> List[dict]:
             and "name" in e]
 
 
-def aggregate(events: List[dict]) -> Dict[str, Dict[str, float]]:
+class Aggregate(dict):
+    """Per-name totals, plus truncation visibility: ``unmatched`` counts
+    "E" events whose (tid, name) never had an open "B" — a nonzero value
+    means the trace was cut mid-span (ring-buffer wrap, early export) and
+    the per-name totals undercount."""
+
+    def __init__(self, *args, unmatched: int = 0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.unmatched = unmatched
+
+
+def aggregate(events: List[dict]) -> Aggregate:
     """Per-name totals (reference ProfileAnalyzer summarize): complete
-    ("X") events aggregate by duration; B/E pairs are matched per tid."""
+    ("X") events aggregate by duration; B/E pairs are matched per tid.
+    The result's ``unmatched`` attribute counts orphan "E" events."""
     totals = defaultdict(lambda: {"total_us": 0.0, "count": 0})
     open_begins: Dict[tuple, List[dict]] = defaultdict(list)
+    unmatched = 0
     for e in events:
         if e.get("ph") == "X":
             t = totals[e["name"]]
@@ -43,7 +56,9 @@ def aggregate(events: List[dict]) -> Dict[str, Dict[str, float]]:
                 t = totals[e["name"]]
                 t["total_us"] += float(e.get("ts", 0)) - float(b.get("ts", 0))
                 t["count"] += 1
-    out = {}
+            else:
+                unmatched += 1
+    out = Aggregate(unmatched=unmatched)
     for name, t in totals.items():
         out[name] = {**t, "avg_us": t["total_us"] / max(t["count"], 1)}
     return out

@@ -1,0 +1,161 @@
+"""Host-side tracing spans: chrome-trace "X" events in a ring buffer.
+
+Reference: the `ProfilingListener` half of the reference observability
+stack — it emits chrome trace-format JSON that
+`common/profile_analyzer.py` loads and compares. Here `span(name,
+**attrs)` is the single primitive: a context manager that records one
+complete ("X") event per exit into a bounded ring buffer
+(``DL4J_TPU_TRACE_BUFFER`` events, oldest dropped first), exportable with
+``tracer().export(path)`` in exactly the format `load_trace`/`aggregate`
+consume — so a training run can be diffed against a previous one with
+`profile_analyzer.compare` like two reference profiles.
+
+When a jax device profile is active (`jax.profiler.start_trace`), each
+span additionally enters a `jax.profiler.TraceAnnotation` so the host
+span shows up on the device timeline too.
+
+Cost model: enabled-ness is ONE cached flag (the metrics registry's,
+resolved from ``DL4J_TPU_METRICS``); a disabled `span()` returns a shared
+no-op context manager — no event dict, no buffer append, no lock.
+"""
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .metrics import registry
+
+# device-profile-active probe; resolved lazily so importing tracing never
+# forces a jax import (False = not yet resolved / unavailable)
+_JAX_PROFILE_STATE = None
+
+
+def _device_profile_active() -> bool:
+    global _JAX_PROFILE_STATE
+    if _JAX_PROFILE_STATE is None:
+        import sys
+        if "jax" not in sys.modules:  # no jax yet -> no profile either
+            return False
+        try:
+            from jax._src.profiler import _profile_state
+            _JAX_PROFILE_STATE = _profile_state
+        except Exception:  # pragma: no cover - older/newer jax layouts
+            _JAX_PROFILE_STATE = False
+    return (_JAX_PROFILE_STATE is not False
+            and getattr(_JAX_PROFILE_STATE, "profile_session", None)
+            is not None)
+
+
+class _NullSpan:
+    """Shared no-op context manager returned when tracing is disabled."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "args", "_t0", "_annotation")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Dict):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+        self._t0 = 0.0
+        self._annotation = None
+
+    def __enter__(self):
+        if _device_profile_active():
+            try:
+                import jax.profiler
+                self._annotation = jax.profiler.TraceAnnotation(self.name)
+                self._annotation.__enter__()
+            except Exception:
+                self._annotation = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        if self._annotation is not None:
+            try:
+                self._annotation.__exit__(*exc)
+            except Exception:
+                pass
+        ev = {"name": self.name, "ph": "X",
+              "ts": self._t0 * 1e6, "dur": (t1 - self._t0) * 1e6,
+              "pid": self._tracer.pid, "tid": threading.get_ident()}
+        if self.args:
+            ev["args"] = self.args
+        self._tracer._events.append(ev)  # deque append: thread-safe
+        return False
+
+
+class Tracer:
+    """Ring buffer of span events (capacity = DL4J_TPU_TRACE_BUFFER)."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = int(os.environ.get("DL4J_TPU_TRACE_BUFFER", "16384"))
+        self.capacity = max(int(capacity), 1)
+        self.pid = os.getpid()
+        self._events: deque = deque(maxlen=self.capacity)
+
+    def span(self, name: str, **attrs):
+        """Context manager timing one region; a no-op singleton when
+        telemetry is disabled."""
+        if not registry().enabled:
+            return _NULL_SPAN
+        return _Span(self, name, attrs)
+
+    def events(self) -> List[dict]:
+        return list(self._events)
+
+    def clear(self):
+        self._events.clear()
+        return self
+
+    def export(self, path: str) -> int:
+        """Write the buffer as a chrome trace JSON file (gzipped when the
+        path ends in .gz) that `profile_analyzer.load_trace` reads back.
+        Returns the number of events written."""
+        events = self.events()
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "wt") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        return len(events)
+
+
+_TRACER: Optional[Tracer] = None
+_TRACER_LOCK = threading.Lock()
+
+
+def tracer() -> Tracer:
+    global _TRACER
+    if _TRACER is None:
+        with _TRACER_LOCK:
+            if _TRACER is None:
+                _TRACER = Tracer()
+    return _TRACER
+
+
+def span(name: str, **attrs):
+    """`with span("train/step", epoch=3): ...` on the process tracer."""
+    return tracer().span(name, **attrs)
+
+
+def export(path: str) -> int:
+    """Module-level convenience: `tracing.export(path)`."""
+    return tracer().export(path)

@@ -41,8 +41,19 @@ import jax
 import jax.numpy as jnp
 
 from ..common.environment import environment
+from ..common.tracing import span
 
 REMAT_MODES = ("none", "layer", "dots_saveable")
+
+_END = object()  # iterator-exhausted sentinel for the instrumented loop
+
+
+def _batch_rows(tree) -> int:
+    """Leading dim of the first batched leaf (0 if none)."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if getattr(leaf, "ndim", 0) >= 1:
+            return int(leaf.shape[0])
+    return 0
 
 
 class FitFastPathMixin:
@@ -237,18 +248,37 @@ class FitFastPathMixin:
 
         use_scan = (batches is not None and batches and not iter_listeners
                     and all(sig(b) == sig(batches[0]) for b in batches[1:]))
+
+        # telemetry handles (one cached enabled-flag read; children hoisted
+        # so the loop pays one inc/observe per step when enabled)
+        reg = environment().metrics()
+        tel = reg.enabled
+        if tel:
+            path = "scan" if use_scan else "step"
+            steps_c = reg.counter("dl4j_train_steps_total",
+                                  "Optimizer steps taken",
+                                  labels=("path",)).labels(path=path)
+            samples_c = reg.counter("dl4j_train_samples_total",
+                                    "Training samples consumed",
+                                    labels=("path",)).labels(path=path)
+            loss_g = reg.gauge("dl4j_train_loss",
+                               "Most recent training loss")
+
         loss = None
         if use_scan:
             if getattr(self, "_epoch_step", None) is None:
                 self._epoch_step = self._build_epoch_step()
             n = len(batches)
+            bs = _batch_rows(batches[0][0])
+            self._last_batch_size = bs
             xs, ys = jax.tree_util.tree_map(lambda *a: jnp.stack(a), *batches)
             batches = None  # free the unstacked device copies
             for _ in range(num_epochs):
                 keys = self._step_keys(n)
-                trainable, states, ustate, losses = self._epoch_step(
-                    trainable, states, ustate,
-                    jnp.asarray(self._iteration, jnp.int32), xs, ys, keys)
+                with span("train/epoch_scan", batches=n, batch_size=bs):
+                    trainable, states, ustate, losses = self._epoch_step(
+                        trainable, states, ustate,
+                        jnp.asarray(self._iteration, jnp.int32), xs, ys, keys)
                 # the donated buffers self._params aliased are now invalid —
                 # repoint live model state before anything can observe it
                 self._params = self._merge_states(trainable, states)
@@ -256,6 +286,12 @@ class FitFastPathMixin:
                 self._iteration += n
                 loss = losses[-1]
                 self._epoch += 1
+                if tel:
+                    with span("train/device"):
+                        jax.block_until_ready(loss)
+                    steps_c.inc(n)
+                    samples_c.inc(n * bs)
+                    loss_g.set(float(loss))
                 if epoch_listeners:
                     self.score_value = float(loss)
                     for lst in epoch_listeners:
@@ -264,15 +300,30 @@ class FitFastPathMixin:
             for _ in range(num_epochs):
                 if batches is None and hasattr(data, "reset"):
                     data.reset()
-                for item in (batches if batches is not None else data):
-                    x, y = item if batches is not None \
-                        else self._stage_batch(item)
+                src = iter(batches if batches is not None else data)
+                while True:
+                    # data-wait covers both the iterator pull (host ETL /
+                    # prefetch queue) and device staging
+                    with span("train/data_wait"):
+                        item = next(src, _END)
+                        if item is _END:
+                            break
+                        x, y = item if batches is not None \
+                            else self._stage_batch(item)
+                    self._last_batch_size = _batch_rows(x)
                     self._rng_key, step_key = jax.random.split(self._rng_key)
-                    trainable, states, ustate, loss = self._train_step(
-                        trainable, states, ustate, self._iteration, x, y,
-                        step_key)
+                    with span("train/dispatch"):
+                        trainable, states, ustate, loss = self._train_step(
+                            trainable, states, ustate, self._iteration, x, y,
+                            step_key)
                     self._params = self._merge_states(trainable, states)
                     self._updater_state = ustate
+                    if tel:
+                        with span("train/device"):
+                            jax.block_until_ready(loss)
+                        steps_c.inc()
+                        samples_c.inc(self._last_batch_size)
+                        loss_g.set(float(loss))
                     if iter_listeners:
                         self.score_value = float(loss)
                         for lst in iter_listeners:

@@ -483,10 +483,12 @@ class ComputationGraph(FitFastPathMixin):
         self._check_init()
         if len(inputs) == 1 and isinstance(inputs[0], (list, tuple, dict)):
             inputs = inputs[0]
+        from ...common.tracing import span
         from ...runtime.inference import maybe_pad_tree, slice_batch
         ind = self._inputs_dict(inputs)
         ind_p, pad = maybe_pad_tree(ind, training=training, mesh=self._mesh)
-        outs = self._output_jit(training)(self._params, ind_p)
+        with span("cg/output"):
+            outs = self._output_jit(training)(self._params, ind_p)
         if pad is not None:
             outs = slice_batch(outs, *pad)
         return [NDArray(o) for o in outs]

@@ -50,11 +50,68 @@ class PerformanceListener(TrainingListener):
             dt = now - self._last_time
             iters = iteration - self._last_iter
             self.batches_per_sec = iters / dt
+            # batch size of the model's last fit input (set by the fit
+            # paths); 0 when the model never recorded one
+            bs = int(getattr(model, "_last_batch_size", 0) or 0)
+            self.samples_per_sec = self.batches_per_sec * bs
             if iteration % self.frequency == 0:
-                msg = f"iteration {iteration}: {self.batches_per_sec:.2f} batches/sec"
+                msg = (f"iteration {iteration}: "
+                       f"{self.batches_per_sec:.2f} batches/sec")
+                if self.report_samples and bs:
+                    msg += f", {self.samples_per_sec:.2f} samples/sec"
                 self.log_fn(msg)
         self._last_time = now
         self._last_iter = iteration
+
+
+class MetricsListener(TrainingListener):
+    """Bridges iteration callbacks into the MetricsRegistry
+    (`environment().metrics()`), so listener-driven training shows up at
+    the UI server's /metrics endpoint alongside the fast-path counters.
+
+    Note: like any listener overriding `iteration_done`, attaching it
+    routes fit() through the per-step path (the scanned-epoch fast path
+    has no per-iteration callback to bridge)."""
+
+    def __init__(self):
+        from ..common.environment import environment
+        reg = environment().metrics()
+        self._reg = reg
+        self._iters = reg.counter(
+            "dl4j_listener_iterations_total",
+            "Iterations observed by MetricsListener")
+        self._epochs = reg.counter(
+            "dl4j_listener_epochs_total",
+            "Epochs observed by MetricsListener")
+        self._score = reg.gauge(
+            "dl4j_train_score", "Most recent listener-observed score")
+        self._iter_time = reg.histogram(
+            "dl4j_iteration_seconds",
+            "Wall time between successive iterations")
+        self._sps = reg.gauge(
+            "dl4j_train_samples_per_sec",
+            "Listener-derived training throughput")
+        self._last_time = None
+
+    def iteration_done(self, model, iteration, loss=None):
+        if not self._reg.enabled:
+            return
+        now = time.time()
+        self._iters.inc()
+        score = loss if loss is not None else getattr(model, "score_value",
+                                                      None)
+        if score is not None:
+            self._score.set(float(score))
+        if self._last_time is not None and now > self._last_time:
+            dt = now - self._last_time
+            self._iter_time.observe(dt)
+            bs = int(getattr(model, "_last_batch_size", 0) or 0)
+            if bs:
+                self._sps.set(bs / dt)
+        self._last_time = now
+
+    def on_epoch_end(self, epoch, model):
+        self._epochs.inc()
 
 
 class TimeIterationListener(TrainingListener):

@@ -179,10 +179,12 @@ class MultiLayerNetwork(FitFastPathMixin):
         compile when disabled, training=True, sharded, or above the ladder.
         """
         self._check_init()
+        from ..common.tracing import span
         from ..runtime.inference import maybe_pad_tree
         x = self._shard_batch(_unwrap(x))
         xp, pad = maybe_pad_tree(x, training=training, mesh=self._mesh)
-        out = self._output_jit(training)(self._params, xp)
+        with span("mln/output"):
+            out = self._output_jit(training)(self._params, xp)
         if pad is not None:
             out = out[:pad[0]]
         return NDArray(out)

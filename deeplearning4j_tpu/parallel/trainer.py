@@ -18,6 +18,7 @@ convergence per wall-clock on ICI, documented intentional change.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -26,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..common.environment import environment
+from ..common.tracing import span
 from ..datasets.dataset import DataSet
 from ..ndarray.ndarray import NDArray
 from .mesh import (DATA, FSDP, MeshConfig, make_mesh, zero1_place,
@@ -173,6 +175,27 @@ class ParallelWrapper:
         if self.zero1 and ustate is not None:
             ustate = zero1_place(self.mesh, ustate)
         batch_sharding = NamedSharding(self.mesh, P((DATA, FSDP)))
+
+        # telemetry: per-worker throughput gauges, one series per mesh
+        # device (the reference's replica threads); children hoisted here
+        reg = environment().metrics()
+        tel = reg.enabled
+        workers = [str(d.id) for d in self.mesh.devices.flat]
+        if tel:
+            steps_c = reg.counter("dl4j_train_steps_total",
+                                  "Optimizer steps taken",
+                                  labels=("path",)).labels(path="parallel")
+            samples_c = reg.counter("dl4j_train_samples_total",
+                                    "Training samples consumed",
+                                    labels=("path",)).labels(path="parallel")
+            total_g = reg.gauge("dl4j_parallel_samples_per_sec",
+                                "ParallelWrapper whole-mesh throughput")
+            worker_fam = reg.gauge(
+                "dl4j_parallel_worker_samples_per_sec",
+                "Per-worker (mesh device) share of training throughput",
+                labels=("worker",))
+            worker_g = [worker_fam.labels(worker=w) for w in workers]
+
         from ..datasets.iterators import AsyncDataSetIterator
         if self.prefetch_buffer > 0 and not isinstance(
                 iterator, AsyncDataSetIterator):
@@ -184,14 +207,29 @@ class ParallelWrapper:
             if hasattr(iterator, "reset"):
                 iterator.reset()
             for ds in iterator:
-                x = self._stage(ds.features, batch_sharding)
-                y = self._stage(ds.labels, batch_sharding)
+                with span("train/data_wait"):
+                    x = self._stage(ds.features, batch_sharding)
+                    y = self._stage(ds.labels, batch_sharding)
                 net._rng_key, step_key = jax.random.split(net._rng_key)
-                trainable, states, ustate, loss = self._step(
-                    trainable, states, ustate, net._iteration, x, y, step_key)
+                t0 = time.perf_counter()
+                with span("train/dispatch"):
+                    trainable, states, ustate, loss = self._step(
+                        trainable, states, ustate, net._iteration, x, y,
+                        step_key)
                 net._params = net._merge_states(trainable, states)
                 net._updater_state = ustate
-                net.score_value = float(loss)
+                with span("train/device"):
+                    net.score_value = float(loss)  # host sync
+                if tel:
+                    bs = int(x.shape[0]) if getattr(x, "ndim", 0) else 0
+                    net._last_batch_size = bs
+                    dt = max(time.perf_counter() - t0, 1e-9)
+                    steps_c.inc()
+                    samples_c.inc(bs)
+                    total_g.set(bs / dt)
+                    per_worker = bs / dt / max(len(workers), 1)
+                    for g in worker_g:
+                        g.set(per_worker)
                 for lst in net._listeners:
                     if hasattr(lst, "iteration_done"):
                         lst.iteration_done(net, net._iteration,

@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 
 from ..common.environment import environment
+from ..common.metrics import linear_buckets, registry
+from ..common.tracing import span
 
 
 # ---------------------------------------------------------------------------
@@ -320,13 +322,46 @@ class InferenceEngine:
         self._stats = {"requests": 0, "dispatches": 0, "rows_real": 0,
                        "rows_padded": 0, "coalesced": 0,
                        "bucket_dispatches": {}}
+        # telemetry: registry families created once, per-bucket children
+        # cached so the dispatch path pays one dict lookup + observe
+        self._reg = registry()
+        lat = self._reg.histogram(
+            "dl4j_inference_latency_seconds",
+            "Per-bucket dispatch latency of the inference engine",
+            labels=("bucket",))
+        pad = self._reg.histogram(
+            "dl4j_inference_padding_ratio",
+            "Fraction of dispatched rows that were bucket padding",
+            labels=("bucket",), buckets=linear_buckets(0.0, 0.05, 20))
+        self._m_latency = {b: lat.labels(bucket=b) for b in self.ladder}
+        self._m_padding = {b: pad.labels(bucket=b) for b in self.ladder}
+        self._m_requests = self._reg.counter(
+            "dl4j_inference_requests_total",
+            "Requests accepted by infer()/submit()")
+        self._m_queue = self._reg.gauge(
+            "dl4j_inference_queue_depth",
+            "Requests waiting in the submit() micro-batcher queue")
+        self._m_coalesce = self._reg.histogram(
+            "dl4j_inference_coalesce_size",
+            "Requests coalesced into one micro-batched dispatch",
+            buckets=[float(1 << i) for i in range(11)])
 
     # -- core dispatch ---------------------------------------------------
     def _dispatch(self, inputs: List[jax.Array], n: int) -> List[jax.Array]:
         """Pad `inputs` (shared leading dim n <= max_batch) to the bucket,
         run, slice the padded rows back off."""
         b = bucket_for(n, self.ladder)
-        outs = self._adapter.run([pad_batch(x, b) for x in inputs])
+        padded = [pad_batch(x, b) for x in inputs]
+        if self._reg.enabled:
+            t0 = time.perf_counter()
+            with span("inference/dispatch", bucket=b, rows=n):
+                outs = self._adapter.run(padded)
+            lat = self._m_latency.get(b)
+            if lat is not None:
+                lat.observe(time.perf_counter() - t0)
+                self._m_padding[b].observe((b - n) / b)
+        else:
+            outs = self._adapter.run(padded)
         with self._lock:
             s = self._stats
             s["dispatches"] += 1
@@ -365,6 +400,7 @@ class InferenceEngine:
             raise ValueError("request inputs must share a leading batch dim")
         with self._lock:
             self._stats["requests"] += 1
+        self._m_requests.inc()
         return self._adapter.package(self._dispatch_chunked(inputs, n))
 
     __call__ = infer
@@ -406,9 +442,12 @@ class InferenceEngine:
             if self._stopping:
                 raise RuntimeError("engine is stopped")
             self._pending.append(_Request(inputs, sig, fut))
+            depth = len(self._pending)
             self._cv.notify_all()
         with self._lock:
             self._stats["requests"] += 1
+        self._m_requests.inc()
+        self._m_queue.set(depth)
         self._ensure_thread()
         return fut
 
@@ -466,9 +505,13 @@ class InferenceEngine:
                     self._pending.pop(0)
                 group.append(nxt)
                 total += nxt.n
+            if self._reg.enabled:
+                with self._cv:
+                    self._m_queue.set(len(self._pending))
             self._run_group(group, total)
 
     def _run_group(self, group: List[_Request], total: int):
+        self._m_coalesce.observe(len(group))
         try:
             if len(group) == 1:
                 outs = self._dispatch(group[0].inputs, total)

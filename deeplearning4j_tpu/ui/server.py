@@ -8,6 +8,8 @@ stdlib http.server; endpoints:
   GET  /                      dashboard (score chart, param norms, ratios)
   GET  /train/sessions        session id list
   GET  /train/overview?sid=   static info + updates
+  GET  /metrics               runtime telemetry, Prometheus text exposition
+  GET  /metrics.json          same registry as a JSON snapshot (+quantiles)
   POST /remote/static|update  remote stats ingestion
 """
 from __future__ import annotations
@@ -18,6 +20,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from ..common.environment import environment
 from .stats import BaseStatsStorage, InMemoryStatsStorage
 
 _PAGE = """<!DOCTYPE html>
@@ -249,6 +252,19 @@ class UIServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif url.path == "/metrics":
+                    # Prometheus text exposition of the process registry
+                    # (training + serving instrumentation alike)
+                    body = environment().metrics().prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif url.path == "/metrics.json":
+                    self._json(environment().metrics().snapshot())
                 elif url.path == "/train/sessions":
                     self._json(server.storage.list_session_ids())
                 elif url.path == "/train/overview":

@@ -1,0 +1,598 @@
+"""Unified runtime telemetry tests (common/metrics.py, common/tracing.py).
+
+Covers: registry semantics (labeled counters/gauges/histograms, quantile
+estimation, thread-safety under concurrent increments), span nesting +
+chrome-trace export round-trip through `profile_analyzer.load_trace`/
+`aggregate`, the Prometheus text-format golden check, disabled-mode no-op
+behavior, the compile-counter bridge, the instrumented InferenceEngine /
+fit() hot paths, and the UI server's /metrics endpoints.
+"""
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common import profile_analyzer
+from deeplearning4j_tpu.common.environment import environment
+from deeplearning4j_tpu.common.metrics import (MetricsRegistry,
+                                               exponential_buckets,
+                                               linear_buckets, registry)
+from deeplearning4j_tpu.common.tracing import Tracer, span, tracer
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_enabled():
+    """Every test starts with the singleton registry enabled and leaves
+    the global enabled-state as it found it."""
+    reg = registry()
+    prev = reg.enabled
+    reg.set_enabled(True)
+    yield reg
+    reg.set_enabled(prev)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_inc(self):
+        reg = MetricsRegistry(enabled=True)
+        c = reg.counter("c_total", "help")
+        c.inc()
+        c.inc(4)
+        assert c.value() == 5.0
+
+    def test_counter_rejects_negative(self):
+        reg = MetricsRegistry(enabled=True)
+        with pytest.raises(ValueError, match="only go up"):
+            reg.counter("c_total").inc(-1)
+
+    def test_get_or_create_returns_same_family(self):
+        reg = MetricsRegistry(enabled=True)
+        assert reg.counter("x_total") is reg.counter("x_total")
+
+    def test_kind_clash_raises(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("x_total")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("x_total")
+
+    def test_label_set_clash_raises(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("x_total", labels=("a",))
+        with pytest.raises(ValueError, match="labels"):
+            reg.counter("x_total", labels=("b",))
+
+    def test_labeled_children_independent_and_cached(self):
+        reg = MetricsRegistry(enabled=True)
+        fam = reg.counter("req_total", labels=("code",))
+        a, b = fam.labels(code="200"), fam.labels(code="500")
+        a.inc(3)
+        b.inc()
+        assert a.value() == 3.0 and b.value() == 1.0
+        assert fam.labels(code="200") is a  # cached child identity
+        with pytest.raises(ValueError, match="use .labels"):
+            fam.inc()  # labeled family has no default child
+
+    def test_gauge_set_inc_dec(self):
+        reg = MetricsRegistry(enabled=True)
+        g = reg.gauge("depth")
+        g.set(10)
+        g.inc(2)
+        g.dec(5)
+        assert g.value() == 7.0
+
+    def test_histogram_count_sum(self):
+        reg = MetricsRegistry(enabled=True)
+        h = reg.histogram("lat", buckets=(1.0, 10.0))
+        for v in (0.5, 5.0, 50.0):
+            h.observe(v)
+        assert h.count() == 3
+        assert reg.get("lat")._default.sum() == pytest.approx(55.5)
+
+    def test_histogram_quantiles(self):
+        reg = MetricsRegistry(enabled=True)
+        h = reg.histogram("q", buckets=linear_buckets(1.0, 1.0, 100))
+        for v in range(1, 101):
+            h.observe(float(v))
+        assert h.quantile(0.50) == pytest.approx(50.0, abs=1.5)
+        assert h.quantile(0.90) == pytest.approx(90.0, abs=1.5)
+        assert h.quantile(0.99) == pytest.approx(99.0, abs=1.5)
+
+    def test_quantile_clamps_to_top_bucket(self):
+        reg = MetricsRegistry(enabled=True)
+        h = reg.histogram("q", buckets=(1.0, 2.0))
+        h.observe(100.0)  # lands in +Inf overflow
+        assert h.quantile(0.99) == 2.0
+
+    def test_empty_histogram_quantile_nan(self):
+        reg = MetricsRegistry(enabled=True)
+        assert np.isnan(reg.histogram("q").quantile(0.5))
+
+    def test_exponential_buckets(self):
+        assert exponential_buckets(1.0, 2.0, 4) == (1.0, 2.0, 4.0, 8.0)
+        with pytest.raises(ValueError):
+            exponential_buckets(0.0, 2.0, 4)
+
+    def test_thread_safety_concurrent_increments(self):
+        reg = MetricsRegistry(enabled=True)
+        c = reg.counter("tc_total")
+        h = reg.histogram("th", buckets=(0.5,))
+        n_threads, per_thread = 8, 5000
+
+        def work():
+            for _ in range(per_thread):
+                c.inc()
+                h.observe(1.0)
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value() == n_threads * per_thread
+        assert h.count() == n_threads * per_thread
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (golden)
+# ---------------------------------------------------------------------------
+
+class TestPrometheusText:
+    def test_golden_format(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("req_total", "Total requests",
+                    labels=("code",)).labels(code="200").inc(3)
+        reg.gauge("queue_depth", "Depth").set(5)
+        h = reg.histogram("lat", "Latency", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        expected = (
+            "# HELP lat Latency\n"
+            "# TYPE lat histogram\n"
+            'lat_bucket{le="0.1"} 1\n'
+            'lat_bucket{le="1"} 2\n'
+            'lat_bucket{le="+Inf"} 3\n'
+            "lat_sum 5.55\n"
+            "lat_count 3\n"
+            "# HELP queue_depth Depth\n"
+            "# TYPE queue_depth gauge\n"
+            "queue_depth 5\n"
+            "# HELP req_total Total requests\n"
+            "# TYPE req_total counter\n"
+            'req_total{code="200"} 3\n'
+        )
+        assert reg.prometheus_text() == expected
+
+    def test_snapshot_is_strict_json(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.histogram("empty_h")  # no observations: quantiles must be None
+        reg.counter("c_total").inc()
+        s = json.loads(json.dumps(reg.snapshot(), allow_nan=False))
+        assert s["empty_h"]["series"][0]["p50"] is None
+        assert s["c_total"]["series"][0]["value"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# tracing spans
+# ---------------------------------------------------------------------------
+
+class TestTracing:
+    def test_span_records_x_event(self):
+        t = Tracer(capacity=64)
+        with t.span("work", phase="test"):
+            time.sleep(0.002)
+        (ev,) = t.events()
+        assert ev["name"] == "work" and ev["ph"] == "X"
+        assert ev["dur"] >= 1000  # >= 1ms in microseconds
+        assert ev["args"] == {"phase": "test"}
+
+    def test_span_nesting_containment(self):
+        t = Tracer(capacity=64)
+        with t.span("outer"):
+            time.sleep(0.001)
+            with t.span("inner"):
+                time.sleep(0.001)
+            time.sleep(0.001)
+        inner, outer = t.events()  # inner exits (appends) first
+        assert inner["name"] == "inner" and outer["name"] == "outer"
+        assert outer["ts"] <= inner["ts"]
+        assert outer["ts"] + outer["dur"] >= inner["ts"] + inner["dur"]
+        assert outer["dur"] > inner["dur"]
+
+    def test_ring_buffer_drops_oldest(self):
+        t = Tracer(capacity=4)
+        for i in range(10):
+            with t.span(f"s{i}"):
+                pass
+        names = [e["name"] for e in t.events()]
+        assert names == ["s6", "s7", "s8", "s9"]
+
+    def test_export_round_trip_through_profile_analyzer(self, tmp_path):
+        t = Tracer(capacity=64)
+        for _ in range(3):
+            with t.span("step"):
+                time.sleep(0.001)
+        with t.span("eval"):
+            time.sleep(0.001)
+        path = str(tmp_path / "trace.json")
+        assert t.export(path) == 4
+        agg = profile_analyzer.aggregate(profile_analyzer.load_trace(path))
+        assert agg["step"]["count"] == 3
+        assert agg["step"]["total_us"] > 0
+        assert agg["step"]["avg_us"] > 0
+        assert agg["eval"]["count"] == 1
+        assert agg.unmatched == 0
+
+    def test_export_gzip(self, tmp_path):
+        t = Tracer(capacity=8)
+        with t.span("z"):
+            pass
+        path = str(tmp_path / "trace.json.gz")
+        t.export(path)
+        events = profile_analyzer.load_trace(path)
+        assert events[0]["name"] == "z"
+
+
+# ---------------------------------------------------------------------------
+# disabled-mode no-op behavior
+# ---------------------------------------------------------------------------
+
+class TestDisabledMode:
+    def test_disabled_registry_writes_are_noops(self):
+        reg = MetricsRegistry(enabled=False)
+        c = reg.counter("c_total")
+        g = reg.gauge("g")
+        h = reg.histogram("h")
+        c.inc(5)
+        g.set(3)
+        h.observe(1.0)
+        assert c.value() == 0.0 and g.value() == 0.0 and h.count() == 0
+
+    def test_disabled_span_records_nothing(self, _telemetry_enabled):
+        _telemetry_enabled.set_enabled(False)
+        before = len(tracer().events())
+        s = span("never")
+        with s:
+            pass
+        assert len(tracer().events()) == before
+        # the no-op context manager is a shared singleton — no allocation
+        assert span("never2") is s
+
+    def test_env_var_resolution(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_METRICS", "0")
+        assert MetricsRegistry().enabled is False
+        monkeypatch.setenv("DL4J_TPU_METRICS", "1")
+        assert MetricsRegistry().enabled is True
+
+    def test_environment_toggle_reaches_registry(self, _telemetry_enabled):
+        env = environment()
+        env.set_metrics_enabled(False)
+        assert registry().enabled is False
+        env.set_metrics_enabled(True)
+        assert registry().enabled is True
+
+
+# ---------------------------------------------------------------------------
+# profile_analyzer unmatched-E regression (satellite)
+# ---------------------------------------------------------------------------
+
+class TestAggregateUnmatched:
+    def test_orphan_end_events_counted(self):
+        events = [
+            {"name": "a", "ph": "B", "ts": 0, "tid": 1},
+            {"name": "a", "ph": "E", "ts": 10, "tid": 1},
+            {"name": "b", "ph": "E", "ts": 5, "tid": 1},   # no B ever
+            {"name": "a", "ph": "E", "ts": 20, "tid": 2},  # wrong tid
+        ]
+        agg = profile_analyzer.aggregate(events)
+        assert agg.unmatched == 2
+        assert agg["a"]["count"] == 1
+        assert agg["a"]["total_us"] == pytest.approx(10.0)
+        assert "b" not in agg
+
+    def test_clean_trace_reports_zero(self):
+        events = [{"name": "x", "ph": "X", "ts": 0, "dur": 5.0}]
+        agg = profile_analyzer.aggregate(events)
+        assert agg.unmatched == 0
+        assert agg["x"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# environment bridge: compiles_total + debug listener logging (satellites)
+# ---------------------------------------------------------------------------
+
+class TestEnvironmentBridge:
+    def test_record_compile_feeds_compiles_total(self):
+        env = environment()
+        child = registry().counter(
+            "dl4j_compiles_total",
+            "XLA compile events recorded by counted_jit",
+            labels=("kind",)).labels(kind="tmetrics")
+        v0 = child.value()
+        assert env.record_compile(("tmetrics:1:sig", "a"))
+        assert child.value() == v0 + 1
+        # duplicate key: cache hit, no metric increment
+        assert not env.record_compile(("tmetrics:1:sig", "a"))
+        assert child.value() == v0 + 1
+
+    def test_debug_logs_listener_exception_once(self, caplog):
+        env = environment()
+        prev_debug = env.is_debug()
+        env.set_debug(True)
+
+        def bad(key):
+            raise RuntimeError("boom")
+
+        env.add_compile_listener(bad)
+        try:
+            with caplog.at_level(logging.ERROR,
+                                 logger="deeplearning4j_tpu.common"
+                                        ".environment"):
+                env.record_compile(("tdbg:1",))
+                env.record_compile(("tdbg:2",))
+        finally:
+            env.remove_compile_listener(bad)
+            env.set_debug(prev_debug)
+        logged = [r for r in caplog.records
+                  if "compile listener" in r.getMessage()]
+        assert len(logged) == 1  # once per listener, not per event
+        assert logged[0].exc_info is not None
+
+    def test_silent_without_debug(self, caplog):
+        env = environment()
+        prev_debug = env.is_debug()
+        env.set_debug(False)
+
+        def bad(key):
+            raise RuntimeError("boom")
+
+        env.add_compile_listener(bad)
+        try:
+            with caplog.at_level(logging.ERROR):
+                env.record_compile(("tquiet:1",))
+        finally:
+            env.remove_compile_listener(bad)
+            env.set_debug(prev_debug)
+        assert not [r for r in caplog.records
+                    if "compile listener" in r.getMessage()]
+
+    def test_trace_buffer_knob(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_TRACE_BUFFER", "1234")
+        assert environment().trace_buffer() == 1234
+
+
+# ---------------------------------------------------------------------------
+# instrumented hot paths
+# ---------------------------------------------------------------------------
+
+def _mlp(n_in=6, hidden=8, n_out=3, seed=0):
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=n_in, n_out=hidden, activation="tanh"))
+            .layer(OutputLayer(n_in=hidden, n_out=n_out))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _series(name, **labels):
+    """Current value/count of one labeled series from the snapshot."""
+    fam = registry().snapshot().get(name)
+    if fam is None:
+        return None
+    for s in fam["series"]:
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s
+    return None
+
+
+class TestInferenceEngineTelemetry:
+    def test_submit_populates_queue_and_latency_metrics(self):
+        from deeplearning4j_tpu.runtime.inference import InferenceEngine
+        net = _mlp()
+        eng = InferenceEngine(net, max_batch=8, max_delay_ms=5.0)
+        lat0 = sum(s["count"] for s in registry().snapshot()
+                   ["dl4j_inference_latency_seconds"]["series"])
+        req = registry().get("dl4j_inference_requests_total")
+        req0 = req.value() if req else 0.0
+        rng = np.random.RandomState(0)
+        with eng:
+            futs = [eng.submit(jnp.asarray(
+                rng.randn(2, 6).astype(np.float32))) for _ in range(6)]
+            outs = [f.result(timeout=60) for f in futs]
+        assert all(o.shape == (2, 3) for o in outs)
+        snap = registry().snapshot()
+        lat = sum(s["count"] for s in
+                  snap["dl4j_inference_latency_seconds"]["series"])
+        assert lat > lat0  # per-bucket latency observed
+        assert registry().get(
+            "dl4j_inference_requests_total").value() == req0 + 6
+        assert "dl4j_inference_queue_depth" in snap
+        co = snap["dl4j_inference_coalesce_size"]["series"][0]
+        assert co["count"] >= 1
+        # padding histogram saw the 2-row -> 2-bucket dispatches
+        assert sum(s["count"] for s in
+                   snap["dl4j_inference_padding_ratio"]["series"]) > 0
+
+    def test_infer_counts_requests_and_spans(self):
+        from deeplearning4j_tpu.runtime.inference import InferenceEngine
+        net = _mlp()
+        eng = InferenceEngine(net, max_batch=8)
+        before = len(tracer().events())
+        eng.infer(jnp.zeros((3, 6), jnp.float32))
+        names = [e["name"] for e in tracer().events()[before:]]
+        assert "inference/dispatch" in names
+
+    def test_disabled_engine_records_nothing(self, _telemetry_enabled):
+        from deeplearning4j_tpu.runtime.inference import InferenceEngine
+        net = _mlp()
+        _telemetry_enabled.set_enabled(False)
+        eng = InferenceEngine(net, max_batch=8)
+        lat_fam = registry().get("dl4j_inference_latency_seconds")
+        before = sum(c.count() for _, c in lat_fam.children())
+        ev_before = len(tracer().events())
+        eng.infer(jnp.zeros((3, 6), jnp.float32))
+        assert sum(c.count() for _, c in lat_fam.children()) == before
+        assert len(tracer().events()) == ev_before
+
+
+class TestTrainingTelemetry:
+    def _dataset(self, n=2, b=8):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        rng = np.random.RandomState(0)
+        out = []
+        for _ in range(n):
+            x = rng.randn(b, 6).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, b)]
+            out.append(DataSet(jnp.asarray(x), jnp.asarray(y)))
+        return out
+
+    def test_scanned_fit_counts_steps_and_samples(self):
+        net = _mlp()
+        s0 = _series("dl4j_train_steps_total", path="scan")
+        n0 = s0["value"] if s0 else 0.0
+        net.fit(self._dataset(n=3, b=8), num_epochs=2)
+        s = _series("dl4j_train_steps_total", path="scan")
+        assert s["value"] == n0 + 6  # 3 batches x 2 epochs
+        samples = _series("dl4j_train_samples_total", path="scan")
+        assert samples["value"] >= 6 * 8
+        assert net._last_batch_size == 8
+
+    def test_per_step_fit_emits_spans(self):
+        from deeplearning4j_tpu.nn.listeners import CollectScoresListener
+        net = _mlp()
+        net.set_listeners(CollectScoresListener())  # forces per-step path
+        before = len(tracer().events())
+        net.fit(self._dataset(n=2, b=4), num_epochs=1)
+        names = [e["name"] for e in tracer().events()[before:]]
+        assert names.count("train/dispatch") == 2
+        assert "train/data_wait" in names
+        assert "train/device" in names
+
+    def test_span_export_aggregates_with_durations(self, tmp_path):
+        from deeplearning4j_tpu.nn.listeners import CollectScoresListener
+        tracer().clear()
+        net = _mlp()
+        net.set_listeners(CollectScoresListener())
+        net.fit(self._dataset(n=2, b=4), num_epochs=2)
+        path = str(tmp_path / "train_trace.json")
+        from deeplearning4j_tpu.common import tracing
+        assert tracing.export(path) > 0
+        agg = profile_analyzer.aggregate(profile_analyzer.load_trace(path))
+        assert agg["train/dispatch"]["count"] == 4
+        assert agg["train/dispatch"]["total_us"] > 0
+        assert agg.unmatched == 0
+
+    def test_metrics_listener_bridges_iterations(self):
+        from deeplearning4j_tpu.nn.listeners import MetricsListener
+        net = _mlp()
+        lst = MetricsListener()
+        net.set_listeners(lst)
+        it0 = registry().get("dl4j_listener_iterations_total").value()
+        net.fit(self._dataset(n=3, b=4), num_epochs=1)
+        assert registry().get(
+            "dl4j_listener_iterations_total").value() == it0 + 3
+        assert registry().get("dl4j_iteration_seconds").count() >= 2
+        score = registry().get("dl4j_train_score").value()
+        assert np.isfinite(score)
+
+    def test_performance_listener_samples_per_sec(self):
+        from deeplearning4j_tpu.nn.listeners import PerformanceListener
+        lines = []
+        lst = PerformanceListener(frequency=1, log_fn=lines.append)
+
+        class FakeModel:
+            score_value = 1.0
+            _last_batch_size = 32
+
+        m = FakeModel()
+        lst.iteration_done(m, 0)
+        lst._last_time -= 2.0  # pretend 2s elapsed since iteration 0
+        lst.iteration_done(m, 1)
+        assert lst.batches_per_sec == pytest.approx(0.5, rel=0.2)
+        assert lst.samples_per_sec == pytest.approx(16.0, rel=0.2)
+        assert any("samples/sec" in l for l in lines)
+
+    def test_performance_listener_live_fit(self):
+        from deeplearning4j_tpu.nn.listeners import PerformanceListener
+        lines = []
+        net = _mlp()
+        net.set_listeners(PerformanceListener(frequency=1,
+                                              log_fn=lines.append))
+        net.fit(self._dataset(n=3, b=8), num_epochs=1)
+        assert net._last_batch_size == 8
+        assert any("samples/sec" in l for l in lines)
+
+    def test_samediff_fit_counts_steps(self):
+        from deeplearning4j_tpu import nd
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        from deeplearning4j_tpu.autodiff.training import TrainingConfig
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+        from deeplearning4j_tpu.learning import Adam
+
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 3))
+        y = sd.placeholder("y", (None, 1))
+        w = sd.var("w", nd.zeros(3, 1))
+        loss = sd.loss.mean_squared_error(x.mmul(w), None, y)
+        sd.set_loss_variables(loss)
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(learning_rate=0.1),
+            data_set_feature_mapping=["x"], data_set_label_mapping=["y"]))
+        rng = np.random.RandomState(1)
+        ds = DataSet(nd.create(rng.randn(4, 3).astype(np.float32)),
+                     nd.create(rng.randn(4, 1).astype(np.float32)))
+        s0 = _series("dl4j_train_steps_total", path="samediff")
+        n0 = s0["value"] if s0 else 0.0
+        sd.fit(ListDataSetIterator([ds, ds]), num_epochs=1)
+        s = _series("dl4j_train_steps_total", path="samediff")
+        assert s["value"] == n0 + 2
+        assert sd._last_batch_size == 4
+
+
+class TestUIServerMetricsEndpoint:
+    def test_metrics_routes(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        # populate the registry through the real hot paths first
+        from deeplearning4j_tpu.runtime.inference import InferenceEngine
+        net = _mlp()
+        eng = InferenceEngine(net, max_batch=8, max_delay_ms=5.0)
+        with eng:
+            eng.submit(jnp.zeros((2, 6), jnp.float32)).result(timeout=60)
+        net.fit(TestTrainingTelemetry()._dataset(n=2, b=4), num_epochs=1)
+
+        server = UIServer(port=0)
+        port = server.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/plain")
+                text = r.read().decode()
+            for needle in ("dl4j_inference_latency_seconds_bucket",
+                           "dl4j_inference_queue_depth",
+                           "dl4j_compiles_total",
+                           "dl4j_train_steps_total",
+                           "dl4j_train_samples_total"):
+                assert needle in text, f"{needle} missing from /metrics"
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics.json",
+                    timeout=10) as r:
+                snap = json.loads(r.read())
+            assert snap["dl4j_train_steps_total"]["type"] == "counter"
+            lat = snap["dl4j_inference_latency_seconds"]
+            assert lat["type"] == "histogram"
+            assert sum(s["count"] for s in lat["series"]) >= 1
+        finally:
+            server.stop()
