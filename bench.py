@@ -12,6 +12,9 @@ chip + MFU (BASELINE config 3; north-star acceptance 35% MFU → vs_baseline
                            throughput, p50/p99 latency, compile counts)
   - telemetry_overhead    (bucketed serving throughput with the metrics
                            registry + spans on vs off; gated <3%)
+  - cold_start            (time-to-first-inference + warmup wall-clock
+                           for a restarted server, cold vs warm
+                           persistent executable cache; gated >= 2x)
 Config 5 (multi-chip scaling) needs >1 chip; the driver's multichip dryrun
 covers correctness, scaling numbers await real multi-chip hardware.
 
@@ -634,6 +637,114 @@ def bench_telemetry_overhead(jax, jnp, tiny):
     return out
 
 
+def bench_cold_start(jax, jnp, tiny):
+    """Cold-start serving latency (the AOT compile pipeline's headline):
+    time-to-first-inference and full-ladder warmup wall-clock for a
+    freshly built server, cold vs warm persistent executable cache
+    (DL4J_TPU_CACHE_DIR). A "restart" is simulated with fresh
+    network/engine objects plus jax.clear_caches() — only the disk store
+    survives between the phases, exactly like a process restart. The gate
+    requires the warm restart's time-to-first-inference to be >= 2x
+    faster than the cold one."""
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu.common.environment import environment
+    from deeplearning4j_tpu.common.metrics import registry
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.runtime import compile_cache
+    from deeplearning4j_tpu.runtime.inference import InferenceEngine
+
+    # deep enough that XLA compile time (what the cache removes), not
+    # tracing (what it cannot), dominates the cold path
+    n_in, hidden, n_out, depth = (16, 64, 4, 8) if tiny \
+        else (256, 1024, 64, 12)
+    max_batch = 8 if tiny else 32
+
+    def build():
+        b = NeuralNetConfiguration.builder().seed(0).list()
+        b.layer(DenseLayer(n_in=n_in, n_out=hidden, activation="relu"))
+        for _ in range(depth - 2):
+            b.layer(DenseLayer(n_in=hidden, n_out=hidden,
+                               activation="relu"))
+        conf = b.layer(OutputLayer(n_in=hidden, n_out=n_out)).build()
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, n_in).astype(np.float32)
+
+    env = environment()
+    from deeplearning4j_tpu.common.environment import SystemProperties
+    prev_override = env.property_override(SystemProperties.CACHE_DIR)
+    tmp = tempfile.mkdtemp(prefix="dl4j-cold-start-")
+    rec = {"max_batch": max_batch, "model_depth": depth}
+    try:
+        env.set_cache_dir(tmp)
+        compile_cache.reset_cache()
+        for phase in ("cold", "warm"):
+            jax.clear_caches()
+            cc = compile_cache.cache()
+            h0 = cc.stats["hits"] if cc else 0
+            net = build()
+            eng = InferenceEngine(net, max_batch=max_batch)
+            t0 = time.perf_counter()
+            jax.block_until_ready(eng.infer(jnp.asarray(x)).jax())
+            ttfi = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            warmed = eng.warmup(jnp.asarray(x))
+            warmup_s = time.perf_counter() - t0
+            rec[phase] = {
+                "ttfi_s": round(ttfi, 4),
+                "warmup_s": round(warmup_s, 4),
+                "buckets_warmed": len(warmed),
+                "cache_hits": (cc.stats["hits"] - h0) if cc else 0,
+            }
+    finally:
+        if prev_override is None:
+            env.clear_property(SystemProperties.CACHE_DIR)
+        else:
+            env.set_property(SystemProperties.CACHE_DIR, prev_override)
+        compile_cache.reset_cache()
+        shutil.rmtree(tmp, ignore_errors=True)
+    rec["ttfi_speedup"] = round(
+        rec["cold"]["ttfi_s"] / max(rec["warm"]["ttfi_s"], 1e-9), 3)
+    rec["warmup_speedup"] = round(
+        rec["cold"]["warmup_s"] / max(rec["warm"]["warmup_s"], 1e-9), 3)
+    # the acceptance surface: /metrics must show hit-labeled compile events
+    fam = registry().get("dl4j_compile_seconds")
+    rec["hit_observations"] = sum(
+        child.count() for key, child in (fam.children() if fam else [])
+        if len(key) == 2 and key[1] == "hit")
+    ok, reason = check_cold_start(rec)
+    rec["gate_ok"], rec["gate_reason"] = ok, reason
+    return rec
+
+
+def check_cold_start(rec, min_speedup=2.0):
+    """(ok, reason): gates a cold_start record must pass.
+
+    - the warm phase must have actually loaded executables from the
+      persistent store (cache_hits > 0) — a "speedup" without hits is
+      measuring something else (e.g. leaked in-memory caches);
+    - warm-cache time-to-first-inference must be >= `min_speedup` (2x)
+      faster than the cold compile path — the acceptance bar of the AOT
+      pipeline."""
+    warm, cold = rec["warm"], rec["cold"]
+    if warm.get("cache_hits", 0) <= 0:
+        return False, ("warm phase recorded no executable-store hits: the "
+                       "restart did not load from the persistent cache")
+    speedup = cold["ttfi_s"] / max(warm["ttfi_s"], 1e-9)
+    if speedup < min_speedup:
+        return False, (
+            f"warm-cache time-to-first-inference {warm['ttfi_s']:.4f}s is "
+            f"only {speedup:.2f}x faster than cold {cold['ttfi_s']:.4f}s "
+            f"(gate: >= {min_speedup}x): the executable cache is not "
+            "removing the XLA compile from the restart path")
+    return True, "ok"
+
+
 def check_telemetry_overhead(rec, max_overhead=0.03):
     """(ok, reason): metrics-on serving throughput may cost at most
     `max_overhead` (3%) vs metrics-off — the near-zero-cost contract of
@@ -836,6 +947,11 @@ def main():
                                                                  tiny)
         except Exception as e:
             out["telemetry_overhead"] = f"error: {type(e).__name__}"
+        _release()
+        try:
+            out["cold_start"] = bench_cold_start(jax, jnp, tiny)
+        except Exception as e:
+            out["cold_start"] = f"error: {type(e).__name__}"
         _release()
         try:
             fwd, train = bench_flash_attention(jax, jnp, tiny)

@@ -142,7 +142,12 @@ def build_train_step(sd, config: TrainingConfig,
             params, update)
         return new_params, updater_state, loss
 
-    return jax.jit(step, donate_argnums=(0, 1)), trainable
+    # counted_jit: SameDiff train steps now register compile events
+    # (dl4j_compiles_total{kind=sdtrain}) and restart-compile through the
+    # persistent-compilation-cache backstop like every other entry point
+    from ..runtime.inference import counted_jit
+    return counted_jit(step, tag=f"sdtrain:{id(sd)}:k{k}:{remat}",
+                       donate_argnums=(0, 1)), trainable
 
 
 def fit(sd, iterator=None, num_epochs: int = 1, placeholders_fn=None,

@@ -31,6 +31,10 @@ class EnvironmentVars:
     DL4J_TPU_DEFAULT_DTYPE = "DL4J_TPU_DEFAULT_DTYPE"
     DL4J_TPU_MATMUL_PRECISION = "DL4J_TPU_MATMUL_PRECISION"
     DL4J_TPU_CACHE_DIR = "DL4J_TPU_CACHE_DIR"
+    DL4J_TPU_CACHE_MAX_BYTES = "DL4J_TPU_CACHE_MAX_BYTES"
+    DL4J_TPU_XLA_CACHE = "DL4J_TPU_XLA_CACHE"
+    DL4J_TPU_WARMUP_THREADS = "DL4J_TPU_WARMUP_THREADS"
+    DL4J_TPU_FLASH_MIN_SEQ = "DL4J_TPU_FLASH_MIN_SEQ"
     DL4J_TPU_INFERENCE_BUCKETING = "DL4J_TPU_INFERENCE_BUCKETING"
     DL4J_TPU_INFERENCE_MAX_BATCH = "DL4J_TPU_INFERENCE_MAX_BATCH"
     DL4J_TPU_REMAT = "DL4J_TPU_REMAT"
@@ -50,6 +54,11 @@ class SystemProperties:
     MATMUL_PRECISION = "matmul_precision"
     RESOURCES_DIR = "resources_dir"
     LOG_INITIALIZATION = "log_initialization"
+    CACHE_DIR = "cache_dir"
+    CACHE_MAX_BYTES = "cache_max_bytes"
+    XLA_CACHE = "xla_cache"
+    WARMUP_THREADS = "warmup_threads"
+    FLASH_MIN_SEQ = "flash_min_seq"
     INFERENCE_BUCKETING = "inference_bucketing"
     INFERENCE_MAX_BATCH = "inference_max_batch"
     TRAINING_REMAT = "training_remat"
@@ -67,6 +76,12 @@ _ENV_FOR_PROP = {
     SystemProperties.MATMUL_PRECISION:
         EnvironmentVars.DL4J_TPU_MATMUL_PRECISION,
     SystemProperties.RESOURCES_DIR: EnvironmentVars.ND4J_RESOURCES_DIR,
+    SystemProperties.CACHE_DIR: EnvironmentVars.DL4J_TPU_CACHE_DIR,
+    SystemProperties.CACHE_MAX_BYTES:
+        EnvironmentVars.DL4J_TPU_CACHE_MAX_BYTES,
+    SystemProperties.XLA_CACHE: EnvironmentVars.DL4J_TPU_XLA_CACHE,
+    SystemProperties.WARMUP_THREADS: EnvironmentVars.DL4J_TPU_WARMUP_THREADS,
+    SystemProperties.FLASH_MIN_SEQ: EnvironmentVars.DL4J_TPU_FLASH_MIN_SEQ,
     SystemProperties.INFERENCE_BUCKETING:
         EnvironmentVars.DL4J_TPU_INFERENCE_BUCKETING,
     SystemProperties.INFERENCE_MAX_BATCH:
@@ -84,6 +99,11 @@ _DEFAULTS = {
     SystemProperties.VERBOSE: "0",
     SystemProperties.MATMUL_PRECISION: "default",
     SystemProperties.LOG_INITIALIZATION: "1",
+    SystemProperties.CACHE_DIR: "~/.cache/deeplearning4j_tpu",
+    SystemProperties.CACHE_MAX_BYTES: str(2 << 30),  # 2 GiB
+    SystemProperties.XLA_CACHE: "auto",
+    SystemProperties.WARMUP_THREADS: "0",  # 0 = auto
+    SystemProperties.FLASH_MIN_SEQ: "1024",
     SystemProperties.INFERENCE_BUCKETING: "1",
     SystemProperties.INFERENCE_MAX_BATCH: "128",
     SystemProperties.TRAINING_REMAT: "none",
@@ -133,6 +153,17 @@ class Environment:
             self._apply_matmul_precision(str(value))
         return self
 
+    def property_override(self, key: str) -> Optional[str]:
+        """The programmatic override for `key`, or None when the value
+        resolves from the env var / default layers (lets callers save and
+        faithfully restore a property around a scoped change)."""
+        return self._overrides.get(key)
+
+    def clear_property(self, key: str):
+        """Drop a programmatic override, re-exposing env var/default."""
+        self._overrides.pop(key, None)
+        return self
+
     # -- reference Environment getters ------------------------------------
     def is_debug(self) -> bool:
         return self.property(SystemProperties.DEBUG) not in ("0", "false",
@@ -160,6 +191,62 @@ class Environment:
 
     def matmul_precision(self) -> str:
         return self.property(SystemProperties.MATMUL_PRECISION)
+
+    # -- AOT compile cache (runtime/compile_cache.py) ----------------------
+    def cache_dir(self) -> Optional[str]:
+        """Root of the persistent executable cache, expanded; None when
+        caching is disabled (``DL4J_TPU_CACHE_DIR=""``)."""
+        d = self.property(SystemProperties.CACHE_DIR)
+        if not d:
+            return None
+        return os.path.expanduser(d)
+
+    def set_cache_dir(self, d: Optional[str]):
+        """Programmatic override; "" or None disables all caching."""
+        return self.set_property(SystemProperties.CACHE_DIR, d or "")
+
+    def cache_max_bytes(self) -> int:
+        """LRU size cap for the executable store
+        (``DL4J_TPU_CACHE_MAX_BYTES``); <= 0 means uncapped."""
+        v = self.property(SystemProperties.CACHE_MAX_BYTES)
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return 2 << 30
+
+    def xla_cache(self) -> str:
+        """Policy for the ``jax_compilation_cache_dir`` backstop
+        (``DL4J_TPU_XLA_CACHE``): "auto" (default) enables it on
+        accelerator backends only — on the CPU backend the raw executable
+        store already covers serving-shaped entries, and XLA:CPU
+        executables deserialized from jax's persistent cache proved
+        unstable under churn (nondeterministic aborts in donated train
+        steps mid-suite); "on"/"off" force either way."""
+        v = str(self.property(SystemProperties.XLA_CACHE) or "auto").lower()
+        return v if v in ("auto", "on", "off") else "auto"
+
+    def warmup_threads(self) -> int:
+        """Thread-pool width for InferenceEngine.warmup(); 0 = auto
+        (bounded by bucket count and host CPUs)."""
+        v = self.property(SystemProperties.WARMUP_THREADS)
+        try:
+            return max(int(v), 0)
+        except (TypeError, ValueError):
+            return 0
+
+    # -- attention auto-dispatch (kernels/__init__.py) ---------------------
+    def flash_min_seq(self) -> int:
+        """Minimum sequence length at which flash=True configs actually
+        run the Pallas flash kernel; below it the XLA path wins (BENCH_r05:
+        93.7 vs 1373 samples/sec at seq_len=128) and is silently used."""
+        v = self.property(SystemProperties.FLASH_MIN_SEQ)
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return 1024
+
+    def set_flash_min_seq(self, n: int):
+        return self.set_property(SystemProperties.FLASH_MIN_SEQ, int(n))
 
     # -- inference-serving knobs (runtime/inference.py) --------------------
     def inference_bucketing(self) -> bool:
@@ -238,10 +325,15 @@ class Environment:
     # ceil(log2(max_batch)) + 1 events per network — the invariant bench.py
     # and tests/test_inference_engine.py assert.
 
-    def record_compile(self, key) -> bool:
+    def record_compile(self, key, cache: str = "bypass") -> bool:
         """Register a compile event; returns False if `key` was already
-        seen (cache hit). New keys notify compile listeners and bump the
-        `dl4j_compiles_total` metric (labeled by the tag kind)."""
+        seen (in-process signature already materialized). New keys notify
+        compile listeners and bump the `dl4j_compiles_total` metric,
+        labeled by tag kind and AOT-cache outcome (``cache=hit`` means the
+        executable was loaded from the persistent store and XLA never
+        actually ran — the event still counts one executable
+        materialization, which is what the bucket-ladder invariants
+        assert)."""
         with self._compile_lock:
             if key in self._compile_keys:
                 return False
@@ -253,9 +345,9 @@ class Environment:
             kind = key[0] if isinstance(key, (tuple, list)) and key else key
             registry().counter(
                 "dl4j_compiles_total",
-                "XLA compile events recorded by counted_jit",
-                labels=("kind",)).labels(
-                    kind=str(kind).split(":")[0]).inc()
+                "Executable materializations recorded by counted_jit",
+                labels=("kind", "cache")).labels(
+                    kind=str(kind).split(":")[0], cache=cache).inc()
         except Exception:
             pass  # observability must never break the inference path
         for fn in listeners:

@@ -50,6 +50,12 @@ def linear_buckets(start: float, width: float,
 #: both a CPU dispatch and a cold TPU compile to land inside the ladder
 DEFAULT_BUCKETS = exponential_buckets(1e-6, 2.0, 24)
 
+#: buckets for dl4j_compile_seconds: 1ms .. ~17min. Cache hits land in the
+#: low rungs (deserialize + first dispatch), cold XLA compiles of big
+#: programs in the high ones — the hit/miss split must be visible in the
+#: histogram, not washed into one bucket
+COMPILE_SECONDS_BUCKETS = exponential_buckets(1e-3, 2.0, 20)
+
 
 def _fmt(v: float) -> str:
     """Prometheus float formatting: integers without the trailing .0."""

@@ -156,6 +156,14 @@ def _ln(x, g, b, eps):
 
 # -- forward ------------------------------------------------------------
 
+def _flash_effective(seq_len: int) -> bool:
+    """Whether a flash=True config actually runs the Pallas kernel at this
+    sequence length (below DL4J_TPU_FLASH_MIN_SEQ the XLA path is faster —
+    BENCH_r05 measured 14.6x at seq_len=128)."""
+    from ..kernels import attention_dispatch
+    return attention_dispatch(seq_len) == "flash"
+
+
 def _attention(layer_params, h, attention_mask, config: BertConfig,
                mesh: Optional[Mesh], seq_parallel: bool,
                use_flash: bool = False, tp_axis: Optional[str] = None):
@@ -178,7 +186,7 @@ def _attention(layer_params, h, attention_mask, config: BertConfig,
         # K/V block inside the ring (VERDICT r4 #4 / SURVEY §5)
         ctx = ring_attention(q, k, v, mesh, mask=attention_mask,
                              causal=False, use_flash=use_flash)
-    elif use_flash:
+    elif use_flash and _flash_effective(q.shape[1]):
         from ..kernels import flash_attention
         ctx = flash_attention(q, k, v, mask=attention_mask)
     else:

@@ -187,6 +187,33 @@ class FitFastPathMixin:
         return counted_jit(epoch, tag=f"epoch:{id(self)}:k{k}:{remat}",
                            donate_argnums=self._DONATE)
 
+    def warm_compile(self, data, labels=None):
+        """AOT-compile the train step for one example batch WITHOUT
+        executing it (``lower().compile()`` — params are not touched, no
+        donation happens because nothing runs). The compile lands in the
+        persistent executable cache / jax compilation cache
+        (``DL4J_TPU_CACHE_DIR``), so CI can pre-bake a cache image and a
+        restarted trainer's first ``fit()`` step starts warm. Returns the
+        cache label ("hit" | "miss" | "bypass")."""
+        import jax.numpy as jnp
+
+        from ..runtime import compile_cache
+
+        self._check_init()
+        data = self._coerce_fit_data(data, labels)
+        batches = self._materialize_batches(data)
+        if not batches:
+            raise ValueError("warm_compile needs at least one batch")
+        x, y = batches[0]
+        k, remat = self._step_build_key()
+        jfn = jax.jit(self._train_step_fn(), donate_argnums=self._DONATE)
+        args = (self._trainable(self._params), self._states(self._params),
+                self._updater_state, jnp.asarray(0, jnp.int32), x, y,
+                jax.random.key(0))
+        return compile_cache.warm(
+            jfn, args, {"donate_argnums": self._DONATE},
+            tag=f"train:{id(self)}:k{k}:{remat}")
+
     def _step_keys(self, n):
         """Per-batch key stack for the scanned epoch: ONE vectorized
         split — `split(key, n + 1)` — instead of n chained 2-way splits

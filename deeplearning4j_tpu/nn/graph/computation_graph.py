@@ -496,6 +496,17 @@ class ComputationGraph(FitFastPathMixin):
     def output_single(self, *inputs) -> NDArray:
         return self.output(*inputs)[0]
 
+    def warm_buckets(self, example, batch_sizes=None) -> List[int]:
+        """Pre-compile the inference bucket ladder for the direct
+        ``output()`` path (cold-start mitigation; see
+        MultiLayerNetwork.warm_buckets). ``example`` is any valid request
+        (array/list/dict of inputs). Returns the buckets warmed."""
+        from ...common.environment import environment
+        from ...runtime.inference import InferenceEngine
+        return InferenceEngine(
+            self, max_batch=environment().inference_max_batch()).warmup(
+                example, batch_sizes=batch_sizes)
+
     def feed_forward(self, inputs, training: bool = False) -> Dict[str, NDArray]:
         """All vertex activations (reference feedForward)."""
         self._check_init()

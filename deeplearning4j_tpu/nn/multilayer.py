@@ -200,6 +200,20 @@ class MultiLayerNetwork(FitFastPathMixin):
             self._out_fns[training] = fn
         return fn
 
+    def warm_buckets(self, example, batch_sizes=None) -> List[int]:
+        """Pre-compile the inference bucket ladder for the direct
+        ``output()``/``predict()`` paths (cold-start mitigation without a
+        standing InferenceEngine). Delegates to
+        ``InferenceEngine.warmup`` — the engine dispatches through the
+        same ``_output_jit(False)`` executable ``output()`` uses, so the
+        compiles (and any persistent-cache hits) are shared. Returns the
+        buckets warmed."""
+        from ..common.environment import environment
+        from ..runtime.inference import InferenceEngine
+        return InferenceEngine(
+            self, max_batch=environment().inference_max_batch()).warmup(
+                example, batch_sizes=batch_sizes)
+
     def feed_forward(self, x, training: bool = False) -> List[NDArray]:
         """All layer activations (reference feedForward :871)."""
         self._check_init()

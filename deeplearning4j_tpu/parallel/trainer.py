@@ -148,8 +148,14 @@ class ParallelWrapper:
         def step(trainable, states, ustate, iteration, x, y, key):
             return base_step(trainable, states, ustate, iteration, x, y, key)
 
-        return jax.jit(
-            step,
+        # counted_jit: sharded steps register compile events
+        # (dl4j_compiles_total{kind=parallel}, cache=bypass — explicit
+        # shardings keep them off the raw executable store, but the
+        # persistent-compilation-cache backstop still shortens restart
+        # compiles) and share the recompile-observability invariants
+        from ..runtime.inference import counted_jit
+        return counted_jit(
+            step, tag=f"parallel:{id(self.net)}:z{int(self.zero1)}",
             in_shardings=(repl, repl, ustate_sh, None, batch_sh, batch_sh,
                           repl),
             out_shardings=(repl, repl, ustate_sh, None),

@@ -1,6 +1,8 @@
 """Runtime services: memory-workspace shims (the XLA-arena-backed
-MemoryWorkspace API surface, `workspace.py`) and the shape-bucketed
-compiled inference engine (`inference.py`)."""
+MemoryWorkspace API surface, `workspace.py`), the shape-bucketed compiled
+inference engine (`inference.py`), and the persistent AOT executable cache
+(`compile_cache.py`) that makes process restarts start warm."""
+from . import compile_cache
 from .inference import (InferenceEngine, bucket_for, bucket_ladder,
                         counted_jit, maybe_pad_tree, pad_batch, slice_batch)
 from .workspace import (DummyWorkspace, LayerWorkspaceMgr, MemoryWorkspace,
@@ -11,4 +13,4 @@ __all__ = ["DummyWorkspace", "LayerWorkspaceMgr", "MemoryWorkspace",
            "Nd4jWorkspaceManager", "WorkspaceConfiguration",
            "workspace_manager", "InferenceEngine", "bucket_ladder",
            "bucket_for", "pad_batch", "slice_batch", "maybe_pad_tree",
-           "counted_jit"]
+           "counted_jit", "compile_cache"]

@@ -1,0 +1,562 @@
+"""Ahead-of-time compilation pipeline: persistent executable cache.
+
+The north-star deployment restarts constantly (autoscaling, rollouts,
+preemption), and before this module every restart paid a full re-trace +
+XLA re-compile for every inference bucket, train step, and SameDiff graph.
+Production serving systems treat compiled executables as cacheable
+artifacts (ORCA's amortized engine builds; JAX's persistent compilation
+cache); here the same idea is wired through ``counted_jit``, the single
+choke point every jitted entry in this codebase dispatches through.
+
+Three layers, safest-first:
+
+1. **On-disk executable store** (``DL4J_TPU_CACHE_DIR``, on by default at
+   ``~/.cache/deeplearning4j_tpu``): for *serving-shaped* entries (no
+   donation, no explicit shardings, plain array args) the first call per
+   input signature runs ``jit(...).lower(...)`` and consults the store.
+   A hit deserializes the XLA executable (``PjRtClient.deserialize_
+   executable``) and skips XLA compilation entirely; a miss compiles via
+   ``lowered.compile()`` and serializes the result back. The cache key is
+   a sha256 over everything that feeds a trace: the lowered StableHLO
+   module (which captures shapes, dtypes, batch bucket, donation/sharding
+   attributes, and every conf knob that changes the traced program), the
+   jit kwargs, jax/jaxlib versions, backend platform + device kind +
+   device count, and the trace-relevant ``DL4J_TPU_*`` flags.
+2. **jax persistent-compilation-cache backstop**: when the store is
+   enabled on an accelerator backend, ``jax_compilation_cache_dir`` is
+   pointed at ``<dir>/xla`` so every compile this process runs —
+   including donated train steps and mesh-sharded programs our own store
+   refuses to wrap — still loads from disk on restart instead of
+   re-running XLA. Gated by ``DL4J_TPU_XLA_CACHE`` (auto|on|off;
+   "auto" keeps it off on the CPU backend, where deserialized donated
+   executables proved unstable under churn and the store already covers
+   the serving path).
+3. **Fallback, never crash**: corrupt/truncated/version-mismatched
+   entries are deleted and recompiled with a one-time warning; any error
+   while lowering, loading, serializing, or calling an AOT entry falls
+   back to the live ``jax.jit`` dispatch that predates this module.
+
+Observability: ``dl4j_compiles_total`` and the ``dl4j_compile_seconds``
+histogram are labeled ``cache=hit|miss|bypass`` (hit = loaded from the
+store; miss = compiled and stored; bypass = caching disabled or entry not
+eligible for serialization). Disable everything with
+``DL4J_TPU_CACHE_DIR=""``.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..common.environment import environment
+
+log = logging.getLogger(__name__)
+
+#: bump to invalidate every existing on-disk entry (layout change)
+FORMAT_VERSION = 1
+
+_PAYLOAD_EXT = ".bin"
+_META_EXT = ".json"
+
+
+# ---------------------------------------------------------------------------
+# environment fingerprint + cache key
+# ---------------------------------------------------------------------------
+
+def env_fingerprint() -> str:
+    """JSON of everything outside the traced program that can change what
+    an executable computes or how it was compiled: versions, topology, and
+    the DL4J_TPU_* flags that feed traces. Part of every cache key."""
+    import jax
+    import jaxlib
+
+    env = environment()
+    dev = jax.devices()[0]
+    return json.dumps({
+        "format": FORMAT_VERSION,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "num_devices": jax.device_count(),
+        "dtype": env.default_float_dtype(),
+        "matmul_precision": env.matmul_precision(),
+        "remat": env.training_remat(),
+        "grad_accum": env.training_grad_accum(),
+        "zero1": env.training_zero1(),
+        "bucketing": env.inference_bucketing(),
+        "flash_min_seq": env.flash_min_seq(),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+    }, sort_keys=True)
+
+
+def _jit_kwargs_repr(jit_kwargs: Dict[str, Any]) -> str:
+    """Stable repr of the jit kwargs for key composition. Donation and
+    shardings must key entries apart even when they do not change the
+    lowered text (e.g. donation XLA judged unusable)."""
+    return repr(sorted((k, repr(v)) for k, v in jit_kwargs.items()))
+
+
+def cache_key(lowered, jit_kwargs: Optional[Dict[str, Any]] = None) -> str:
+    """sha256 hex key for a ``jax.stages.Lowered``: the StableHLO text
+    captures shapes/dtypes/buckets/mesh attributes and every conf knob
+    that alters the traced program; the fingerprint adds versions,
+    topology, and env flags."""
+    h = hashlib.sha256()
+    h.update(env_fingerprint().encode())
+    h.update(b"\x00")
+    h.update(_jit_kwargs_repr(jit_kwargs or {}).encode())
+    h.update(b"\x00")
+    h.update(lowered.as_text().encode())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# the on-disk store
+# ---------------------------------------------------------------------------
+
+class AOTCompileCache:
+    """Content-addressed executable store under ``<dir>/aot``.
+
+    Entry = ``<key>.bin`` (serialized XLA executable) + ``<key>.json``
+    (integrity + reload metadata). LRU by file mtime, capped at
+    ``max_bytes`` (``DL4J_TPU_CACHE_MAX_BYTES``). Every read validates
+    format version, payload size, and payload sha256; anything off is
+    deleted and reported as a miss — a corrupt cache can cost a compile,
+    never an exception."""
+
+    def __init__(self, base_dir: str, max_bytes: int):
+        self.base_dir = base_dir
+        self.aot_dir = os.path.join(base_dir, "aot")
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._warned_keys: set = set()
+        self.stats = {"hits": 0, "misses": 0, "puts": 0, "corrupt": 0,
+                      "evictions": 0, "put_errors": 0}
+        os.makedirs(self.aot_dir, exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+    def _paths(self, key: str) -> Tuple[str, str]:
+        return (os.path.join(self.aot_dir, key + _PAYLOAD_EXT),
+                os.path.join(self.aot_dir, key + _META_EXT))
+
+    def _drop(self, key: str):
+        for p in self._paths(key):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    def _warn_once(self, key: str, why: str):
+        with self._lock:
+            self.stats["corrupt"] += 1
+            if key in self._warned_keys:
+                return
+            self._warned_keys.add(key)
+        log.warning("compile cache entry %s.. dropped (%s); recompiling",
+                    key[:12], why)
+
+    # -- read --------------------------------------------------------------
+    def get(self, key: str) -> Optional[Tuple[bytes, dict]]:
+        """(payload, meta) for a valid entry, else None. Corrupt entries
+        are deleted with a one-time warning."""
+        payload_p, meta_p = self._paths(key)
+        if not os.path.exists(meta_p):
+            with self._lock:
+                self.stats["misses"] += 1
+            return None
+        try:
+            with open(meta_p, "r") as f:
+                meta = json.load(f)
+            if meta.get("format") != FORMAT_VERSION:
+                raise ValueError(f"format {meta.get('format')} != "
+                                 f"{FORMAT_VERSION}")
+            with open(payload_p, "rb") as f:
+                payload = f.read()
+            if len(payload) != meta.get("payload_bytes"):
+                raise ValueError("payload truncated")
+            if hashlib.sha256(payload).hexdigest() != meta.get("payload_sha"):
+                raise ValueError("payload checksum mismatch")
+        except Exception as e:
+            self._drop(key)
+            self._warn_once(key, f"{type(e).__name__}: {e}")
+            with self._lock:
+                self.stats["misses"] += 1
+            return None
+        now = time.time()
+        try:
+            os.utime(payload_p, (now, now))  # LRU touch
+        except OSError:
+            pass
+        with self._lock:
+            self.stats["hits"] += 1
+        return payload, meta
+
+    # -- write -------------------------------------------------------------
+    def put(self, key: str, payload: bytes, meta: dict) -> bool:
+        """Atomic write (tmp + rename), then LRU cap enforcement."""
+        payload_p, meta_p = self._paths(key)
+        meta = dict(meta)
+        meta["format"] = FORMAT_VERSION
+        meta["payload_bytes"] = len(payload)
+        meta["payload_sha"] = hashlib.sha256(payload).hexdigest()
+        try:
+            for path, data, mode in ((payload_p, payload, "wb"),
+                                     (meta_p, json.dumps(meta), "w")):
+                tmp = path + f".tmp{os.getpid()}"
+                with open(tmp, mode) as f:
+                    f.write(data)
+                os.replace(tmp, path)
+        except OSError as e:
+            log.warning("compile cache write failed (%s); continuing "
+                        "uncached", e)
+            with self._lock:
+                self.stats["put_errors"] += 1
+            return False
+        with self._lock:
+            self.stats["puts"] += 1
+        self._enforce_cap()
+        return True
+
+    def _enforce_cap(self):
+        """Evict least-recently-used entries beyond max_bytes."""
+        if self.max_bytes <= 0:
+            return
+        try:
+            entries = []
+            total = 0
+            for name in os.listdir(self.aot_dir):
+                if not name.endswith(_PAYLOAD_EXT):
+                    continue
+                p = os.path.join(self.aot_dir, name)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                total += st.st_size
+                entries.append((st.st_mtime, st.st_size,
+                                name[:-len(_PAYLOAD_EXT)]))
+            if total <= self.max_bytes:
+                return
+            entries.sort()  # oldest first
+            for _, size, key in entries:
+                if total <= self.max_bytes:
+                    break
+                self._drop(key)
+                total -= size
+                with self._lock:
+                    self.stats["evictions"] += 1
+        except OSError:
+            pass  # capping is best-effort; never fail the compile path
+
+    # -- maintenance -------------------------------------------------------
+    def clear(self):
+        try:
+            for name in os.listdir(self.aot_dir):
+                try:
+                    os.remove(os.path.join(self.aot_dir, name))
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        return self
+
+    def entry_count(self) -> int:
+        try:
+            return sum(1 for n in os.listdir(self.aot_dir)
+                       if n.endswith(_META_EXT))
+        except OSError:
+            return 0
+
+
+# ---------------------------------------------------------------------------
+# singleton resolution (env-driven, re-resolved when the dir changes)
+# ---------------------------------------------------------------------------
+
+_CACHE: Optional[AOTCompileCache] = None
+_CACHE_DIR_USED: Optional[str] = None
+_CACHE_LOCK = threading.Lock()
+_BACKSTOP_DIR: Optional[str] = None
+
+
+def cache() -> Optional[AOTCompileCache]:
+    """The process-wide store, or None when caching is disabled
+    (``DL4J_TPU_CACHE_DIR=""``). Re-resolves if the configured dir
+    changed since the last call (tests, ``Environment.set_cache_dir``)."""
+    global _CACHE, _CACHE_DIR_USED
+    d = environment().cache_dir()
+    if d == _CACHE_DIR_USED:
+        return _CACHE
+    with _CACHE_LOCK:
+        if d != _CACHE_DIR_USED:
+            if d:
+                try:
+                    _CACHE = AOTCompileCache(
+                        d, environment().cache_max_bytes())
+                except OSError as e:
+                    log.warning("compile cache dir %s unusable (%s); "
+                                "caching disabled", d, e)
+                    _CACHE = None
+            else:
+                _CACHE = None
+            _CACHE_DIR_USED = d
+        if _CACHE is not None and _backstop_wanted():
+            _configure_backstop(_CACHE.base_dir)
+        else:
+            _disable_backstop()
+    return _CACHE
+
+
+def reset_cache():
+    """Drop the singleton and immediately re-resolve DL4J_TPU_CACHE_DIR,
+    re-pointing (or disabling) the jax backstop so no compile keeps
+    writing into a stale — possibly deleted — directory."""
+    global _CACHE, _CACHE_DIR_USED
+    with _CACHE_LOCK:
+        _CACHE = None
+        _CACHE_DIR_USED = None
+    cache()
+
+
+def _backstop_wanted() -> bool:
+    """Whether to wire ``jax_compilation_cache_dir`` at ``<dir>/xla``
+    (``DL4J_TPU_XLA_CACHE``): "on"/"off" force it; "auto" (default)
+    enables it only on accelerator backends. On the CPU backend the raw
+    executable store already covers serving-shaped entries, and the
+    programs only the backstop would cover (donated train steps) proved
+    unstable when XLA:CPU deserializes them under churn — reproducible
+    nondeterministic SIGABRTs / corrupted updates mid-train-step across
+    full-suite runs, gone with the backstop off — so auto keeps CPU on
+    the store alone."""
+    mode = environment().xla_cache()
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    try:
+        import jax
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+def _configure_backstop(base_dir: str):
+    """Point jax's persistent compilation cache at ``<dir>/xla`` so every
+    compile — including the donated/sharded programs the store cannot wrap
+    raw — is disk-backed across restarts. Backends without executable
+    serialization simply no-op inside jax; this must never raise."""
+    global _BACKSTOP_DIR
+    xla_dir = os.path.join(base_dir, "xla")
+    if _BACKSTOP_DIR == xla_dir:
+        return
+    try:
+        import jax
+        os.makedirs(xla_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", xla_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        # jax latches its cache object at the first compile of the
+        # process; (re)pointing the config only takes effect after an
+        # explicit reset
+        try:
+            from jax._src import compilation_cache as _jcc
+            _jcc.reset_cache()
+        except Exception:
+            pass
+        _BACKSTOP_DIR = xla_dir
+    except Exception as e:  # unsupported jax version/backend: store-only
+        log.debug("persistent-compilation-cache backstop unavailable: %s", e)
+
+
+def _disable_backstop():
+    """Unset the jax compilation-cache dir (store disabled, or its old
+    directory is going away)."""
+    global _BACKSTOP_DIR
+    if _BACKSTOP_DIR is None:
+        return
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", None)
+        try:
+            from jax._src import compilation_cache as _jcc
+            _jcc.reset_cache()
+        except Exception:
+            pass
+        _BACKSTOP_DIR = None
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# AOT entry construction (the counted_jit integration point)
+# ---------------------------------------------------------------------------
+
+def _eligible(args, jit_kwargs: Dict[str, Any]) -> bool:
+    """Serving-shaped calls only: raw executables bypass jax's arg
+    handling, so refuse anything with donation (buffer invalidation),
+    explicit shardings / static args (layout and closure semantics), or
+    non-array leaves beyond plain python scalars (extended dtypes such as
+    PRNG keys lower to internal layouts)."""
+    import jax
+
+    for k in ("donate_argnums", "donate_argnames", "static_argnums",
+              "static_argnames", "in_shardings", "out_shardings"):
+        if jit_kwargs.get(k):
+            return False
+    try:
+        for leaf in jax.tree_util.tree_leaves(args):
+            if isinstance(leaf, (bool, int, float)):
+                continue
+            dt = getattr(leaf, "dtype", None)
+            if dt is None or not hasattr(leaf, "shape"):
+                return False
+            if jax.dtypes.issubdtype(dt, jax.dtypes.extended):
+                return False
+            sh = getattr(leaf, "sharding", None)
+            if sh is not None and len(getattr(sh, "device_set", ())) > 1:
+                # sharded/replicated input: a raw executor would hand back
+                # one shard of the output — multi-device programs stay on
+                # the live jit + backstop
+                return False
+    except Exception:
+        return False
+    return True
+
+
+def _serialize(compiled) -> Tuple[bytes, dict]:
+    """(payload, meta) for a ``jax.stages.Compiled``. Raises when the
+    backend does not support executable serialization (caller treats the
+    entry as bypass; the jax backstop still covers it)."""
+    import jax
+
+    exe = compiled.runtime_executable()
+    backend = jax.devices()[0].client
+    payload = backend.serialize_executable(exe)
+    kept = getattr(compiled._executable, "_kept_var_idx", None)
+    if kept is None:
+        raise ValueError("executable exposes no kept_var_idx")
+    meta = {"kept_var_idx": sorted(int(i) for i in kept),
+            "created": time.time()}
+    return payload, meta
+
+
+def _load_executor(payload: bytes, meta: dict, lowered) -> Optional[Callable]:
+    """Rebuild a callable from a stored executable: deserialize, then per
+    call flatten args in jit order, keep only the argument positions the
+    compiled program kept, execute, and unflatten with the lowering's
+    output treedef. Single-device, non-donating programs only (enforced
+    by ``_eligible`` before anything is stored)."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        backend = jax.devices()[0].client
+        exe = backend.deserialize_executable(payload)
+        kept = meta["kept_var_idx"]
+        out_tree = lowered.out_tree
+    except Exception as e:
+        log.warning("compile cache deserialize failed (%s: %s); "
+                    "recompiling", type(e).__name__, e)
+        return None
+
+    def call(*args):
+        flat = jax.tree_util.tree_leaves(args)
+        bufs = [flat[i] if isinstance(flat[i], jax.Array)
+                else jnp.asarray(flat[i]) for i in kept]
+        results = exe.execute_sharded(
+            bufs).disassemble_into_single_device_arrays()
+        return jax.tree_util.tree_unflatten(out_tree,
+                                            [r[0] for r in results])
+
+    return call
+
+
+def aot_entry(jfn, tag: str, args, jit_kwargs: Dict[str, Any]
+              ) -> Tuple[Callable, str]:
+    """Resolve the callable for one new input signature of ``jfn``.
+
+    Returns ``(callable, label)`` with label in:
+
+    - ``"hit"``    — executable loaded from the store, XLA never ran;
+    - ``"miss"``   — lowered + compiled AOT, serialized into the store;
+    - ``"bypass"`` — caching disabled, entry ineligible for raw
+      serialization, or any step failed: the live ``jax.jit`` dispatch is
+      returned unchanged (the jax persistent-cache backstop still
+      shortens its compile when enabled).
+    """
+    cc = cache()
+    if cc is None or not _eligible(args, jit_kwargs):
+        return jfn, "bypass"
+    try:
+        lowered = jfn.lower(*args)
+        key = cache_key(lowered, jit_kwargs)
+    except Exception as e:
+        log.debug("AOT lowering failed for %s (%s); live jit", tag, e)
+        return jfn, "bypass"
+    entry = cc.get(key)
+    if entry is not None:
+        call = _load_executor(entry[0], entry[1], lowered)
+        if call is not None:
+            return call, "hit"
+        cc._drop(key)  # deserialization failure: stale artifact
+    try:
+        compiled = lowered.compile()
+    except Exception as e:
+        log.debug("AOT compile failed for %s (%s); live jit", tag, e)
+        return jfn, "bypass"
+    try:
+        payload, meta = _serialize(compiled)
+        meta["tag_kind"] = tag.split(":")[0]
+        stored = cc.put(key, payload, meta)
+    except Exception as e:
+        log.debug("executable serialization unavailable for %s (%s); "
+                  "backstop only", tag, e)
+        return compiled, "bypass"
+    return compiled, ("miss" if stored else "bypass")
+
+
+def warm(jfn, args, jit_kwargs: Optional[Dict[str, Any]] = None,
+         tag: str = "warm") -> str:
+    """Pre-bake one entry without executing it: lower + compile + store
+    (and populate the jax backstop) so a later process — or this one —
+    starts warm. Unlike ``aot_entry``, ineligible entries (donated train
+    steps, sharded programs) are still AOT-compiled here so the backstop
+    gets their executable on disk — nothing runs, so donation never
+    invalidates a live buffer. Returns the cache label. Used by
+    ``FitFastPathMixin.warm_compile`` and CI cache-baking."""
+    cc = cache()
+    if cc is None:
+        return "bypass"
+    jit_kwargs = jit_kwargs or {}
+    if _eligible(args, jit_kwargs):
+        _, label = aot_entry(jfn, tag, args, jit_kwargs)
+        return label
+    try:
+        jfn.lower(*args).compile()
+    except Exception as e:
+        log.debug("warm compile failed for %s (%s: %s)", tag,
+                  type(e).__name__, e)
+    return "bypass"
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+def observe_compile(kind: str, cache_label: str, seconds: float):
+    """Record one executable materialization (build + first dispatch) in
+    ``dl4j_compile_seconds{kind,cache}``."""
+    try:
+        from ..common.metrics import COMPILE_SECONDS_BUCKETS, registry
+        registry().histogram(
+            "dl4j_compile_seconds",
+            "Wall time to materialize + first-run an executable, by cache "
+            "outcome", labels=("kind", "cache"),
+            buckets=COMPILE_SECONDS_BUCKETS).labels(
+                kind=kind, cache=cache_label).observe(seconds)
+    except Exception:
+        pass  # observability must never break the dispatch path

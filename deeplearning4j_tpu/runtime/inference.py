@@ -30,9 +30,12 @@ input signature.
 """
 from __future__ import annotations
 
+import json
+import logging
+import os
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -133,10 +136,15 @@ def slice_batch(outputs: Sequence[Any], n: int, bucket: int) -> List[Any]:
 # ---------------------------------------------------------------------------
 
 def counted_jit(fn: Callable, tag: str, **jit_kwargs) -> Callable:
-    """``jax.jit(fn, **jit_kwargs)`` wrapped with recompile observability:
-    each new input signature records one compile event with the Environment
-    counter. Used by every jitted inference entry AND the fit fast path's
-    train/epoch steps (donate_argnums passes through).
+    """``jax.jit(fn, **jit_kwargs)`` wrapped with recompile observability
+    AND the AOT compile cache: each new input signature records one
+    compile event and resolves its executable through
+    ``runtime.compile_cache.aot_entry`` — a persistent-store hit
+    deserializes the executable and skips XLA, a miss compiles via
+    ``lower().compile()`` and serializes back, and ineligible entries
+    (donation, shardings, caching disabled) dispatch through the live jit
+    exactly as before. Used by every jitted inference entry AND the fit
+    fast path's train/epoch steps (donate_argnums passes through).
 
     The signature is computed from ``args[1:]`` — by convention the first
     argument is the parameter pytree, whose shapes only change on
@@ -144,21 +152,50 @@ def counted_jit(fn: Callable, tag: str, **jit_kwargs) -> Callable:
     keeps the per-call overhead off the hot path. Python-scalar leaves
     (e.g. the iteration counter) hash by type, matching jit's behavior of
     tracing them as abstract values — a changing int must not count as a
-    recompile.
+    recompile. Array leaves include weak_type so an AOT executable is
+    never fed an aval it was not built for; and if a resolved entry still
+    fails to accept a call (e.g. the param tree was re-initialized with
+    new shapes under an unchanged data signature), the entry permanently
+    falls back to the live jit for that signature — cache problems may
+    cost a compile, never an exception.
     """
+    from . import compile_cache
+
     jfn = jax.jit(fn, **jit_kwargs)
-    seen = set()
+    entries: Dict[Any, Callable] = {}
+    kind = tag.split(":")[0]
 
     def wrapped(*args):
         data = args[1:]
         sig = (jax.tree_util.tree_structure(data),
-               tuple((tuple(l.shape), str(l.dtype))
+               tuple((tuple(l.shape), str(l.dtype),
+                      bool(getattr(l, "weak_type", False)))
                      if hasattr(l, "shape") else f"py:{type(l).__name__}"
                      for l in jax.tree_util.tree_leaves(data)))
-        if sig not in seen:
-            seen.add(sig)
-            environment().record_compile((tag,) + sig)
-        return jfn(*args)
+        call = entries.get(sig)
+        if call is None:
+            t0 = time.perf_counter()
+            call, label = compile_cache.aot_entry(jfn, tag, args, jit_kwargs)
+            environment().record_compile((tag,) + sig, cache=label)
+            if call is jfn:
+                out = jfn(*args)  # first call compiles via the live jit
+            else:
+                try:
+                    out = call(*args)
+                except Exception:
+                    entries[sig] = jfn
+                    return jfn(*args)
+            compile_cache.observe_compile(kind, label,
+                                          time.perf_counter() - t0)
+            entries[sig] = call
+            return out
+        if call is jfn:
+            return jfn(*args)
+        try:
+            return call(*args)
+        except Exception:
+            entries[sig] = jfn
+            return jfn(*args)
 
     wrapped._jit = jfn
     return wrapped
@@ -304,7 +341,8 @@ class InferenceEngine:
     def __init__(self, model, *, max_batch: Optional[int] = None,
                  buckets: Optional[Sequence[int]] = None,
                  max_delay_ms: float = 2.0,
-                 outputs: Optional[Sequence[Any]] = None):
+                 outputs: Optional[Sequence[Any]] = None,
+                 manifest_path: Optional[str] = None):
         self.model = model
         self._adapter = _make_adapter(model, outputs)
         self.max_batch = int(max_batch if max_batch is not None
@@ -312,6 +350,18 @@ class InferenceEngine:
         self.ladder = bucket_ladder(self.max_batch, buckets)
         self.max_batch = self.ladder[-1]
         self.max_delay_ms = float(max_delay_ms)
+        # warmup guard + traffic-shape manifest: _warmed holds
+        # (bucket, input-sig) keys already compiled by warmup, _warming the
+        # in-flight ones (concurrent/repeated warmups wait instead of
+        # double-compiling); _observed accumulates the shapes live traffic
+        # actually dispatched, auto-persisted when manifest_path is set so
+        # a restarted server can replay yesterday's buckets before taking
+        # traffic.
+        self._warm_lock = threading.Lock()
+        self._warmed: set = set()
+        self._warming: Dict[Any, threading.Event] = {}
+        self.manifest_path = manifest_path
+        self._observed: Dict[Tuple, set] = {}
         # micro-batcher state
         self._cv = threading.Condition()
         self._pending: List[_Request] = []
@@ -368,6 +418,7 @@ class InferenceEngine:
             s["rows_real"] += n
             s["rows_padded"] += b - n
             s["bucket_dispatches"][b] = s["bucket_dispatches"].get(b, 0) + 1
+        self._record_observed(inputs, b)
         return slice_batch(outs, n, b)
 
     def _dispatch_chunked(self, inputs: List[jax.Array],
@@ -405,25 +456,158 @@ class InferenceEngine:
 
     __call__ = infer
 
-    # -- warmup ----------------------------------------------------------
-    def warmup(self, example, batch_sizes: Optional[Sequence[int]] = None
-               ) -> List[int]:
-        """Pre-compile bucket executables before traffic arrives.
+    # -- warmup + manifest -------------------------------------------------
+    @staticmethod
+    def _input_sig(inputs: Sequence[Any]) -> Tuple:
+        """Trailing (feature) shapes + dtypes — what identifies a traffic
+        shape independent of its batch bucket."""
+        return tuple((tuple(int(d) for d in x.shape[1:]), str(x.dtype))
+                     for x in inputs)
+
+    def _record_observed(self, inputs: Sequence[Any], bucket: int):
+        """Remember that live traffic exercised (sig, bucket); persist to
+        the manifest file when one is configured (new keys only — the hot
+        path pays a set lookup per dispatch)."""
+        sig = self._input_sig(inputs)
+        with self._warm_lock:
+            buckets = self._observed.setdefault(sig, set())
+            if bucket in buckets:
+                return
+            buckets.add(bucket)
+        if self.manifest_path:
+            try:
+                self.save_manifest(self.manifest_path)
+            except OSError as e:
+                logging.getLogger(__name__).warning(
+                    "warmup manifest write to %s failed (%s)",
+                    self.manifest_path, e)
+
+    def save_manifest(self, path: Optional[str] = None) -> str:
+        """Write the observed bucket/shape/dtype keys as JSON (atomic).
+        A restarted server hands the file to ``warmup()`` to replay
+        yesterday's shapes before taking traffic."""
+        path = path or self.manifest_path
+        if not path:
+            raise ValueError("no manifest path given or configured")
+        with self._warm_lock:
+            entries = [{"inputs": [{"shape": list(s), "dtype": d}
+                                   for s, d in sig],
+                        "buckets": sorted(int(b) for b in buckets)}
+                       for sig, buckets in sorted(self._observed.items())]
+        doc = {"version": 1, "max_batch": self.max_batch,
+               "entries": entries}
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
+        return path
+
+    @staticmethod
+    def load_manifest(path: str) -> List[dict]:
+        """Parse a warmup manifest; malformed files return [] with a
+        warning (a stale manifest must never block serving startup)."""
+        try:
+            with open(path, "r") as f:
+                doc = json.load(f)
+            entries = []
+            for e in doc.get("entries", []):
+                inputs = [(tuple(int(d) for d in i["shape"]), str(i["dtype"]))
+                          for i in e["inputs"]]
+                buckets = [int(b) for b in e["buckets"]]
+                if buckets:
+                    entries.append({"inputs": inputs, "buckets": buckets})
+            return entries
+        except Exception as e:
+            logging.getLogger(__name__).warning(
+                "warmup manifest %s unreadable (%s: %s); skipping replay",
+                path, type(e).__name__, e)
+            return []
+
+    def warmup(self, example=None,
+               batch_sizes: Optional[Sequence[int]] = None,
+               manifest: Optional[str] = None,
+               workers: Optional[int] = None) -> List[int]:
+        """Pre-compile bucket executables before traffic arrives,
+        concurrently (XLA compilation releases the GIL, so the ladder
+        compiles on a thread pool — wall clock ~ the slowest bucket, not
+        the sum).
 
         `example` is any valid request (its batch size is irrelevant; only
         the trailing feature shapes/dtypes matter). With `batch_sizes`,
         only the buckets those sizes map to are compiled; default is the
-        whole ladder. Returns the buckets warmed."""
-        inputs = self._adapter.inputs_of(example)
-        if batch_sizes is not None:
-            todo = sorted({bucket_for(min(int(s), self.max_batch), self.ladder)
-                           for s in batch_sizes})
+        whole ladder. With ``example=None``, shapes are replayed from
+        ``manifest`` (or the engine's configured ``manifest_path``)
+        instead — the restart flow. Returns the sorted buckets warmed.
+
+        Idempotent and re-entrant: a (bucket, shape) pair already warmed —
+        or being warmed by a concurrent call — is never compiled twice;
+        late callers wait for the in-flight compile instead.
+        """
+        jobs: List[Tuple[int, Tuple]] = []  # (bucket, input-sig)
+        if example is not None:
+            sig = self._input_sig(self._adapter.inputs_of(example))
+            if batch_sizes is not None:
+                todo = sorted({bucket_for(min(int(s), self.max_batch),
+                                          self.ladder)
+                               for s in batch_sizes})
+            else:
+                todo = list(self.ladder)
+            jobs = [(b, sig) for b in todo]
         else:
-            todo = list(self.ladder)
-        for b in todo:
-            self._dispatch([jnp.zeros((b,) + x.shape[1:], x.dtype)
-                            for x in inputs], b)
-        return todo
+            path = manifest or self.manifest_path
+            if not path or not os.path.exists(path):
+                return []
+            for e in self.load_manifest(path):
+                for b in e["buckets"]:
+                    b = bucket_for(min(int(b), self.max_batch), self.ladder)
+                    jobs.append((b, tuple(e["inputs"])))
+            jobs = sorted(set(jobs))
+        if not jobs:
+            return []
+
+        claimed: List[Tuple[int, Tuple, threading.Event]] = []
+        wait_for: List[threading.Event] = []
+        with self._warm_lock:
+            for b, sig in jobs:
+                key = (b, sig)
+                if key in self._warmed:
+                    continue
+                ev = self._warming.get(key)
+                if ev is not None:
+                    wait_for.append(ev)
+                    continue
+                ev = threading.Event()
+                self._warming[key] = ev
+                claimed.append((b, sig, ev))
+
+        def compile_one(b, sig, ev):
+            try:
+                self._dispatch([jnp.zeros((b,) + shape, dtype)
+                                for shape, dtype in sig], b)
+                with self._warm_lock:
+                    self._warmed.add((b, sig))
+            finally:
+                ev.set()
+                with self._warm_lock:
+                    self._warming.pop((b, sig), None)
+
+        if claimed:
+            n_workers = workers or environment().warmup_threads() \
+                or min(len(claimed), os.cpu_count() or 1, 8)
+            if n_workers <= 1 or len(claimed) == 1:
+                for b, sig, ev in claimed:
+                    compile_one(b, sig, ev)
+            else:
+                with ThreadPoolExecutor(
+                        max_workers=min(int(n_workers), len(claimed)),
+                        thread_name_prefix="dl4j-tpu-warmup") as pool:
+                    futs = [pool.submit(compile_one, b, sig, ev)
+                            for b, sig, ev in claimed]
+                    for f in futs:
+                        f.result()  # surface the first compile error
+        for ev in wait_for:
+            ev.wait(timeout=600)
+        return sorted({b for b, _ in jobs})
 
     # -- dynamic micro-batcher -------------------------------------------
     def submit(self, request) -> Future:

@@ -22,3 +22,23 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _compile_cache_tmpdir(tmp_path_factory):
+    """Point the AOT executable cache (DL4J_TPU_CACHE_DIR) at a per-run
+    tmpdir for the whole suite: tests exercise the real cache code paths
+    but never read another run's entries or litter the user cache dir."""
+    d = tmp_path_factory.mktemp("dl4j-tpu-compile-cache")
+    prev = os.environ.get("DL4J_TPU_CACHE_DIR")
+    os.environ["DL4J_TPU_CACHE_DIR"] = str(d)
+    from deeplearning4j_tpu.runtime import compile_cache
+    compile_cache.reset_cache()
+    yield str(d)
+    if prev is None:
+        os.environ.pop("DL4J_TPU_CACHE_DIR", None)
+    else:
+        os.environ["DL4J_TPU_CACHE_DIR"] = prev
+    compile_cache.reset_cache()

@@ -1,0 +1,601 @@
+"""AOT compile cache: keying, store round trips, corruption recovery,
+warmup concurrency/idempotence, and the warmup manifest.
+
+The cache contract under test (ISSUE 4 acceptance): same config -> hit;
+changed dtype / batch bucket / donation / remat-grad_accum knob / mesh
+spec -> miss; corrupted cache file -> recompile + warning, never an
+exception; DL4J_TPU_CACHE_DIR="" disables everything.
+"""
+import json
+import logging
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common.environment import (SystemProperties,
+                                                   environment)
+from deeplearning4j_tpu.common.metrics import registry
+from deeplearning4j_tpu.runtime import compile_cache
+from deeplearning4j_tpu.runtime.compile_cache import (AOTCompileCache,
+                                                      cache_key)
+from deeplearning4j_tpu.runtime.inference import InferenceEngine, counted_jit
+
+
+@pytest.fixture
+def fresh_cache(tmp_path):
+    """A private cache dir for one test, resolved through the real env
+    layering, restored afterwards."""
+    env = environment()
+    prev = env.property_override(SystemProperties.CACHE_DIR)
+    env.set_cache_dir(str(tmp_path))
+    compile_cache.reset_cache()
+    yield compile_cache.cache()
+    if prev is None:
+        env.clear_property(SystemProperties.CACHE_DIR)
+    else:
+        env.set_property(SystemProperties.CACHE_DIR, prev)
+    compile_cache.reset_cache()
+
+
+def _model(p, x):
+    for w in p:
+        x = jnp.tanh(x @ w)
+    return x
+
+
+def _params(n=3, d=16, dtype=jnp.float32):
+    return [jnp.full((d, d), 0.1, dtype) for _ in range(n)]
+
+
+def _x(b=4, d=16, dtype=jnp.float32):
+    return jnp.ones((b, d), dtype)
+
+
+def _key_of(fn, *args, **jit_kwargs):
+    return cache_key(jax.jit(fn, **jit_kwargs).lower(*args), jit_kwargs)
+
+
+# ---------------------------------------------------------------------------
+# cache keying
+# ---------------------------------------------------------------------------
+
+class TestCacheKey:
+    def test_same_config_same_key(self):
+        k1 = _key_of(_model, _params(), _x())
+        k2 = _key_of(_model, _params(), _x())
+        assert k1 == k2
+
+    def test_changed_dtype_misses(self):
+        k1 = _key_of(_model, _params(), _x())
+        k2 = _key_of(_model, _params(dtype=jnp.bfloat16),
+                     _x(dtype=jnp.bfloat16))
+        assert k1 != k2
+
+    def test_changed_batch_bucket_misses(self):
+        assert _key_of(_model, _params(), _x(b=4)) != \
+            _key_of(_model, _params(), _x(b=8))
+
+    def test_changed_model_structure_misses(self):
+        # same input signature, different closure -> different program
+        assert _key_of(_model, _params(n=3), _x()) != \
+            _key_of(_model, _params(n=4), _x())
+
+    def test_donation_misses(self):
+        def addone(x):
+            return x + 1.0  # same shape: the donation is actually usable
+
+        k1 = _key_of(addone, _x())
+        k2 = _key_of(addone, _x(), donate_argnums=(0,))
+        assert k1 != k2
+
+    def test_remat_knob_misses(self):
+        env = environment()
+        k1 = _key_of(_model, _params(), _x())
+        env.set_training_remat("layer")
+        try:
+            k2 = _key_of(_model, _params(), _x())
+        finally:
+            env.clear_property(SystemProperties.TRAINING_REMAT)
+        assert k1 != k2
+
+    def test_grad_accum_knob_misses(self):
+        env = environment()
+        k1 = _key_of(_model, _params(), _x())
+        env.set_training_grad_accum(4)
+        try:
+            k2 = _key_of(_model, _params(), _x())
+        finally:
+            env.clear_property(SystemProperties.TRAINING_GRAD_ACCUM)
+        assert k1 != k2
+
+    def test_mesh_spec_misses(self):
+        from jax.sharding import (Mesh, NamedSharding, PartitionSpec as P)
+
+        devs = np.asarray(jax.devices()[:2]).reshape(2)
+        mesh = Mesh(devs, ("data",))
+        repl = NamedSharding(mesh, P())
+        sharded = NamedSharding(mesh, P("data"))
+        k1 = _key_of(_model, _params(), _x(),
+                     in_shardings=(repl, repl))
+        k2 = _key_of(_model, _params(), _x(),
+                     in_shardings=(repl, sharded))
+        assert k1 != k2
+
+
+# ---------------------------------------------------------------------------
+# store round trip through counted_jit
+# ---------------------------------------------------------------------------
+
+class TestStoreRoundTrip:
+    def test_miss_then_hit_with_identical_result(self, fresh_cache):
+        cc = fresh_cache
+        f1 = counted_jit(_model, tag="tcc:1")
+        ref = np.asarray(f1(_params(), _x()))
+        assert cc.stats["misses"] == 1 and cc.stats["puts"] == 1
+        assert cc.entry_count() == 1
+
+        jax.clear_caches()  # drop in-memory jax caches: "restart"
+        f2 = counted_jit(_model, tag="tcc:2")
+        out = np.asarray(f2(_params(), _x()))
+        assert cc.stats["hits"] == 1
+        np.testing.assert_array_equal(ref, out)
+
+    def test_hit_entry_survives_repeated_calls(self, fresh_cache):
+        f1 = counted_jit(_model, tag="tcc:1")
+        ref = np.asarray(f1(_params(), _x()))
+        jax.clear_caches()
+        f2 = counted_jit(_model, tag="tcc:2")
+        for _ in range(3):
+            np.testing.assert_array_equal(np.asarray(f2(_params(), _x())),
+                                          ref)
+
+    def test_pytree_output_round_trip(self, fresh_cache):
+        def fn(p, x):
+            return {"h": x @ p[0], "n": jnp.sum(x)}
+
+        f1 = counted_jit(fn, tag="tcc:1")
+        ref = f1(_params(1), _x())
+        jax.clear_caches()
+        f2 = counted_jit(fn, tag="tcc:2")
+        out = f2(_params(1), _x())
+        assert fresh_cache.stats["hits"] == 1
+        assert set(out) == {"h", "n"}
+        np.testing.assert_array_equal(np.asarray(ref["h"]),
+                                      np.asarray(out["h"]))
+        np.testing.assert_array_equal(np.asarray(ref["n"]),
+                                      np.asarray(out["n"]))
+
+    def test_compile_seconds_histogram_labels(self, fresh_cache):
+        f1 = counted_jit(_model, tag="tsec:1")
+        f1(_params(), _x())
+        jax.clear_caches()
+        f2 = counted_jit(_model, tag="tsec:2")
+        f2(_params(), _x())
+        fam = registry().get("dl4j_compile_seconds")
+        assert fam is not None
+        labels = {key for key, _ in fam.children()}
+        assert ("tsec", "miss") in labels
+        assert ("tsec", "hit") in labels
+
+    def test_donated_entries_bypass_the_store(self, fresh_cache):
+        cc = fresh_cache
+        f = counted_jit(lambda p, x: [w + x.sum() for w in p], tag="tdon:1",
+                        donate_argnums=(0,))
+        f(_params(), _x())
+        assert cc.stats["puts"] == 0  # never serialized
+        fam = registry().get("dl4j_compiles_total")
+        assert any(key == ("tdon", "bypass") for key, _ in fam.children())
+
+    def test_stale_entry_falls_back_to_live_jit(self, fresh_cache):
+        f = counted_jit(lambda p, x: x @ p, tag="tstale:1")
+        f(jnp.ones((16, 16)), _x())
+        # same data signature (x), params re-initialized with a NEW shape:
+        # the AOT entry cannot accept the call and must fall back, not raise
+        out = f(jnp.ones((16, 32)), _x())
+        assert out.shape == (4, 32)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_x() @ jnp.ones((16, 32))),
+                                   rtol=1e-6)
+
+    def test_disabled_via_empty_dir(self):
+        env = environment()
+        prev = env.property_override(SystemProperties.CACHE_DIR)
+        env.set_cache_dir("")
+        compile_cache.reset_cache()
+        try:
+            assert compile_cache.cache() is None
+            f = counted_jit(_model, tag="toff:1")
+            out = f(_params(), _x())
+            assert out.shape == (4, 16)
+            fam = registry().get("dl4j_compiles_total")
+            assert any(key == ("toff", "bypass")
+                       for key, _ in fam.children())
+        finally:
+            if prev is None:
+                env.clear_property(SystemProperties.CACHE_DIR)
+            else:
+                env.set_property(SystemProperties.CACHE_DIR, prev)
+            compile_cache.reset_cache()
+
+
+# ---------------------------------------------------------------------------
+# corruption recovery: a bad cache may cost a compile, never an exception
+# ---------------------------------------------------------------------------
+
+def _entry_files(cc, ext):
+    return [os.path.join(cc.aot_dir, n) for n in os.listdir(cc.aot_dir)
+            if n.endswith(ext)]
+
+
+class TestCorruptionRecovery:
+    def _seed_entry(self, cc):
+        f = counted_jit(_model, tag="tcor:seed")
+        ref = np.asarray(f(_params(), _x()))
+        assert cc.entry_count() == 1
+        jax.clear_caches()
+        return ref
+
+    def _rerun(self):
+        f = counted_jit(_model, tag="tcor:rerun")
+        return np.asarray(f(_params(), _x()))
+
+    def test_corrupt_payload_recompiles_with_warning(self, fresh_cache,
+                                                     caplog):
+        ref = self._seed_entry(fresh_cache)
+        for p in _entry_files(fresh_cache, ".bin"):
+            with open(p, "wb") as fh:
+                fh.write(b"garbage")
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu.runtime"
+                                    ".compile_cache"):
+            out = self._rerun()
+        np.testing.assert_array_equal(ref, out)
+        assert fresh_cache.stats["corrupt"] >= 1
+        assert any("recompiling" in r.getMessage() for r in caplog.records)
+        # the recompile re-stored a valid entry
+        assert fresh_cache.stats["puts"] == 2
+
+    def test_corrupt_meta_recompiles(self, fresh_cache):
+        ref = self._seed_entry(fresh_cache)
+        for p in _entry_files(fresh_cache, ".json"):
+            with open(p, "w") as fh:
+                fh.write("{not json")
+        out = self._rerun()
+        np.testing.assert_array_equal(ref, out)
+        assert fresh_cache.stats["corrupt"] >= 1
+
+    def test_format_version_mismatch_recompiles(self, fresh_cache):
+        ref = self._seed_entry(fresh_cache)
+        for p in _entry_files(fresh_cache, ".json"):
+            with open(p) as fh:
+                meta = json.load(fh)
+            meta["format"] = 999
+            with open(p, "w") as fh:
+                json.dump(meta, fh)
+        out = self._rerun()
+        np.testing.assert_array_equal(ref, out)
+        assert fresh_cache.stats["corrupt"] >= 1
+
+    def test_undeserializable_payload_recompiles(self, fresh_cache):
+        """Payload passes the checksum but is not an executable (stale
+        artifact from another backend): deserialize fails -> recompile."""
+        ref = self._seed_entry(fresh_cache)
+        for p in _entry_files(fresh_cache, ".bin"):
+            key = os.path.basename(p)[:-4]
+            meta_p = os.path.join(fresh_cache.aot_dir, key + ".json")
+            with open(meta_p) as fh:
+                meta = json.load(fh)
+            fresh_cache.put(key, b"not-an-executable",
+                            {"kept_var_idx": meta["kept_var_idx"]})
+        out = self._rerun()
+        np.testing.assert_array_equal(ref, out)
+
+    def test_truncated_payload_recompiles(self, fresh_cache):
+        ref = self._seed_entry(fresh_cache)
+        for p in _entry_files(fresh_cache, ".bin"):
+            with open(p, "rb") as fh:
+                data = fh.read()
+            with open(p, "wb") as fh:
+                fh.write(data[:len(data) // 2])
+        out = self._rerun()
+        np.testing.assert_array_equal(ref, out)
+        assert fresh_cache.stats["corrupt"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# LRU size capping
+# ---------------------------------------------------------------------------
+
+class TestLRUCap:
+    def test_oldest_entry_evicted_beyond_cap(self, tmp_path):
+        cc = AOTCompileCache(str(tmp_path), max_bytes=100)
+        cc.put("k1", b"x" * 80, {"kept_var_idx": [0]})
+        old = os.path.join(cc.aot_dir, "k1.bin")
+        os.utime(old, (1.0, 1.0))  # force k1 to be the LRU entry
+        cc.put("k2", b"y" * 80, {"kept_var_idx": [0]})
+        assert cc.stats["evictions"] >= 1
+        assert cc.get("k1") is None
+        got = cc.get("k2")
+        assert got is not None and got[0] == b"y" * 80
+
+    def test_hit_refreshes_recency(self, tmp_path):
+        cc = AOTCompileCache(str(tmp_path), max_bytes=180)
+        cc.put("k1", b"x" * 80, {"kept_var_idx": [0]})
+        cc.put("k2", b"y" * 80, {"kept_var_idx": [0]})
+        for p in (os.path.join(cc.aot_dir, "k1.bin"),
+                  os.path.join(cc.aot_dir, "k2.bin")):
+            os.utime(p, (1.0, 1.0))
+        assert cc.get("k1") is not None  # touch k1: k2 becomes LRU
+        cc.put("k3", b"z" * 80, {"kept_var_idx": [0]})
+        assert cc.get("k1") is not None
+        assert cc.get("k2") is None
+
+    def test_uncapped_when_nonpositive(self, tmp_path):
+        cc = AOTCompileCache(str(tmp_path), max_bytes=0)
+        for i in range(5):
+            cc.put(f"k{i}", b"x" * 1000, {"kept_var_idx": [0]})
+        assert cc.entry_count() == 5
+        assert cc.stats["evictions"] == 0
+
+
+# ---------------------------------------------------------------------------
+# eligibility (what may be wrapped as a raw executable)
+# ---------------------------------------------------------------------------
+
+class TestEligibility:
+    def test_plain_arrays_eligible(self):
+        assert compile_cache._eligible((_params(), _x()), {})
+
+    def test_python_scalars_eligible(self):
+        assert compile_cache._eligible((_params(), 3, 0.5, True), {})
+
+    def test_donation_ineligible(self):
+        assert not compile_cache._eligible((_params(), _x()),
+                                           {"donate_argnums": (0,)})
+
+    def test_shardings_ineligible(self):
+        assert not compile_cache._eligible((_params(), _x()),
+                                           {"in_shardings": object()})
+
+    def test_prng_key_ineligible(self):
+        assert not compile_cache._eligible((_x(), jax.random.key(0)), {})
+
+    def test_multi_device_array_ineligible(self):
+        from jax.sharding import (Mesh, NamedSharding, PartitionSpec as P)
+
+        if jax.device_count() < 2:
+            pytest.skip("needs >= 2 devices")
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
+        x = jax.device_put(_x(b=4), NamedSharding(mesh, P("data")))
+        assert not compile_cache._eligible((x,), {})
+
+
+# ---------------------------------------------------------------------------
+# warmup: concurrency, idempotence, manifest
+# ---------------------------------------------------------------------------
+
+def _mlp(n_in=6, hidden=8, n_out=3):
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.builder().seed(0).list()
+            .layer(DenseLayer(n_in=n_in, n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_in=hidden, n_out=n_out))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _req(b=1, n_in=6):
+    return jnp.zeros((b, n_in), jnp.float32)
+
+
+class TestWarmupGuard:
+    def test_warmup_idempotent(self):
+        eng = InferenceEngine(_mlp(), max_batch=8)
+        assert eng.warmup(_req()) == [1, 2, 4, 8]
+        d0 = eng.stats()["dispatches"]
+        assert d0 == 4
+        assert eng.warmup(_req()) == [1, 2, 4, 8]  # same buckets reported
+        assert eng.stats()["dispatches"] == d0     # nothing re-dispatched
+
+    def test_concurrent_warmup_no_double_compile(self):
+        eng = InferenceEngine(_mlp(), max_batch=8)
+        barrier = threading.Barrier(2)
+        results, errors = [], []
+
+        def go():
+            try:
+                barrier.wait(timeout=30)
+                results.append(eng.warmup(_req()))
+            except Exception as e:  # pragma: no cover - diagnostic
+                errors.append(e)
+
+        threads = [threading.Thread(target=go) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors
+        assert results == [[1, 2, 4, 8], [1, 2, 4, 8]]
+        # each bucket dispatched (and therefore compiled) exactly once
+        assert eng.stats()["dispatches"] == 4
+        assert all(v == 1
+                   for v in eng.stats()["bucket_dispatches"].values())
+
+    def test_warmup_serial_worker_override(self):
+        eng = InferenceEngine(_mlp(), max_batch=4)
+        assert eng.warmup(_req(), workers=1) == [1, 2, 4]
+        assert eng.stats()["dispatches"] == 3
+
+
+class TestWarmupManifest:
+    def test_traffic_records_manifest(self, tmp_path):
+        man = str(tmp_path / "warmup.json")
+        eng = InferenceEngine(_mlp(), max_batch=8, manifest_path=man)
+        eng.infer(_req(b=3))  # bucket 4
+        eng.infer(_req(b=1))  # bucket 1
+        assert os.path.exists(man)
+        with open(man) as f:
+            doc = json.load(f)
+        assert doc["version"] == 1
+        buckets = sorted(b for e in doc["entries"] for b in e["buckets"])
+        assert buckets == [1, 4]
+        assert doc["entries"][0]["inputs"][0]["shape"] == [6]
+
+    def test_restart_replays_manifest(self, tmp_path):
+        man = str(tmp_path / "warmup.json")
+        eng = InferenceEngine(_mlp(), max_batch=8, manifest_path=man)
+        eng.infer(_req(b=3))
+        eng.infer(_req(b=7))  # bucket 8
+
+        # "restart": fresh model + engine, warmup with no example replays
+        eng2 = InferenceEngine(_mlp(), max_batch=8, manifest_path=man)
+        env = environment()
+        c0 = env.compile_count()
+        assert eng2.warmup() == [4, 8]
+        warm_compiles = env.compile_count() - c0
+        assert warm_compiles == 2
+        # yesterday's shapes now serve without compiling anything new
+        eng2.infer(_req(b=3))
+        eng2.infer(_req(b=7))
+        assert env.compile_count() - c0 == warm_compiles
+
+    def test_explicit_save_and_replay(self, tmp_path):
+        eng = InferenceEngine(_mlp(), max_batch=8)
+        eng.infer(_req(b=2))
+        path = eng.save_manifest(str(tmp_path / "m.json"))
+        entries = InferenceEngine.load_manifest(path)
+        assert entries and entries[0]["buckets"] == [2]
+
+    def test_save_without_path_raises(self):
+        eng = InferenceEngine(_mlp(), max_batch=8)
+        with pytest.raises(ValueError):
+            eng.save_manifest()
+
+    def test_corrupt_manifest_skipped_with_warning(self, tmp_path, caplog):
+        man = tmp_path / "warmup.json"
+        man.write_text("{broken")
+        eng = InferenceEngine(_mlp(), max_batch=8,
+                              manifest_path=str(man))
+        with caplog.at_level(logging.WARNING):
+            assert eng.warmup() == []  # skipped, no exception
+        assert any("unreadable" in r.getMessage() for r in caplog.records)
+
+    def test_warmup_without_example_or_manifest_is_noop(self):
+        eng = InferenceEngine(_mlp(), max_batch=8)
+        assert eng.warmup() == []
+        assert eng.stats()["dispatches"] == 0
+
+
+# ---------------------------------------------------------------------------
+# warm_compile (CI cache pre-baking for train steps)
+# ---------------------------------------------------------------------------
+
+class TestWarmCompile:
+    def test_warm_compile_populates_backstop_without_stepping(
+            self, fresh_cache, monkeypatch):
+        # the backstop defaults off on the CPU backend (DL4J_TPU_XLA_CACHE
+        # =auto); force it on to exercise the wiring
+        monkeypatch.setenv("DL4J_TPU_XLA_CACHE", "on")
+        compile_cache.reset_cache()
+        try:
+            net = _mlp()
+            before = jax.tree_util.tree_map(np.asarray, net._params)
+            x = np.random.RandomState(0).randn(8, 6).astype(np.float32)
+            y = np.zeros((8, 3), np.float32)
+            y[np.arange(8), np.arange(8) % 3] = 1.0
+            label = net.warm_compile(x, y)
+            assert label == "bypass"  # donated train steps: backstop only
+            # params untouched (nothing executed, nothing donated)
+            after = jax.tree_util.tree_map(np.asarray, net._params)
+            for b, a in zip(jax.tree_util.tree_leaves(before),
+                            jax.tree_util.tree_leaves(after)):
+                np.testing.assert_array_equal(b, a)
+            xla_dir = os.path.join(fresh_cache.base_dir, "xla")
+            assert os.path.isdir(xla_dir) and os.listdir(xla_dir)
+        finally:
+            # detach the backstop before the env var reverts to auto —
+            # fixture teardown order must not leave it wired for the
+            # rest of the suite
+            monkeypatch.setenv("DL4J_TPU_XLA_CACHE", "off")
+            compile_cache.reset_cache()
+
+    def test_backstop_defaults_off_on_cpu(self, fresh_cache):
+        """DL4J_TPU_XLA_CACHE=auto: on the CPU backend the store is
+        active but jax's compilation-cache dir stays unwired (XLA:CPU
+        deserialized-executable instability; see _backstop_wanted)."""
+        assert environment().xla_cache() == "auto"
+        assert fresh_cache is not None  # the store itself is on
+        assert not compile_cache._backstop_wanted()
+        assert jax.config.jax_compilation_cache_dir is None
+
+    def test_warm_buckets_precompiles_direct_output_path(self):
+        net = _mlp()
+        env = environment()
+        c0 = env.compile_count()
+        warmed = net.warm_buckets(_req(), batch_sizes=[1, 3])
+        assert warmed == [1, 4]
+        compiles = env.compile_count() - c0
+        assert compiles == 2
+        # the direct output() path reuses the warmed executables
+        net.output(_req(b=3))
+        assert env.compile_count() - c0 == compiles
+
+
+# ---------------------------------------------------------------------------
+# attention auto-dispatch satellite
+# ---------------------------------------------------------------------------
+
+class TestAttentionDispatch:
+    def test_threshold_default(self):
+        from deeplearning4j_tpu.kernels import attention_dispatch
+
+        assert environment().flash_min_seq() == 1024
+        assert attention_dispatch(128) == "xla"
+        assert attention_dispatch(1024) == "flash"
+        assert attention_dispatch(4096) == "flash"
+
+    def test_threshold_env_override(self):
+        from deeplearning4j_tpu.kernels import attention_dispatch
+
+        env = environment()
+        env.set_flash_min_seq(64)
+        try:
+            assert attention_dispatch(128) == "flash"
+            assert attention_dispatch(32) == "xla"
+        finally:
+            env.clear_property(SystemProperties.FLASH_MIN_SEQ)
+
+    def test_dispatch_counter(self):
+        from deeplearning4j_tpu.kernels import attention_dispatch
+
+        fam = registry().counter("dl4j_attn_dispatch_total",
+                                 "Attention path decisions for flash=True "
+                                 "configs", labels=("path",))
+        x0 = fam.labels(path="xla").value()
+        f0 = fam.labels(path="flash").value()
+        attention_dispatch(8)
+        attention_dispatch(8192)
+        assert fam.labels(path="xla").value() == x0 + 1
+        assert fam.labels(path="flash").value() == f0 + 1
+
+    def test_bert_flash_below_threshold_takes_xla_path(self):
+        """flash=True at short seq must produce bitwise the XLA result —
+        proof the dispatch silently switched paths."""
+        from deeplearning4j_tpu.models import bert
+
+        config = bert.BertConfig.tiny()
+        params = bert.init_params(jax.random.key(0), config)
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, config.vocab_size, (2, 16)),
+                          jnp.int32)
+        out_flash = bert.encode(params, ids, config=config, use_flash=True)
+        out_xla = bert.encode(params, ids, config=config, use_flash=False)
+        np.testing.assert_array_equal(np.asarray(out_flash),
+                                      np.asarray(out_xla))

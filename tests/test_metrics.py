@@ -314,14 +314,30 @@ class TestEnvironmentBridge:
         env = environment()
         child = registry().counter(
             "dl4j_compiles_total",
-            "XLA compile events recorded by counted_jit",
-            labels=("kind",)).labels(kind="tmetrics")
+            "Executable materializations recorded by counted_jit",
+            labels=("kind", "cache")).labels(kind="tmetrics",
+                                             cache="bypass")
         v0 = child.value()
         assert env.record_compile(("tmetrics:1:sig", "a"))
         assert child.value() == v0 + 1
-        # duplicate key: cache hit, no metric increment
+        # duplicate key: already materialized, no metric increment
         assert not env.record_compile(("tmetrics:1:sig", "a"))
         assert child.value() == v0 + 1
+
+    def test_record_compile_cache_labels(self):
+        env = environment()
+        fam = registry().counter(
+            "dl4j_compiles_total",
+            "Executable materializations recorded by counted_jit",
+            labels=("kind", "cache"))
+        hit = fam.labels(kind="tlabels", cache="hit")
+        v0 = hit.value()
+        assert env.record_compile(("tlabels:1:sig", "h"), cache="hit")
+        assert hit.value() == v0 + 1
+        miss = fam.labels(kind="tlabels", cache="miss")
+        v1 = miss.value()
+        assert env.record_compile(("tlabels:2:sig", "m"), cache="miss")
+        assert miss.value() == v1 + 1
 
     def test_debug_logs_listener_exception_once(self, caplog):
         env = environment()
