@@ -15,6 +15,10 @@ chip + MFU (BASELINE config 3; north-star acceptance 35% MFU → vs_baseline
   - cold_start            (time-to-first-inference + warmup wall-clock
                            for a restarted server, cold vs warm
                            persistent executable cache; gated >= 2x)
+  - serving_overload      (admission control under synthetic overload:
+                           admitted-request p99 + shed counts with the
+                           shedder on vs off; gated: shedding keeps
+                           admitted p99 within 3x of unloaded p99)
 Config 5 (multi-chip scaling) needs >1 chip; the driver's multichip dryrun
 covers correctness, scaling numbers await real multi-chip hardware.
 
@@ -745,6 +749,152 @@ def check_cold_start(rec, min_speedup=2.0):
     return True, "ok"
 
 
+def bench_serving_overload(jax, jnp, tiny):
+    """Admission control under synthetic overload (the serving
+    subsystem's headline): client threads hammer one deployed model far
+    past its dispatch concurrency. With shedding ON the controller
+    refuses arrivals past the high-water mark (429 + retry-after at the
+    HTTP layer) so the admitted requests keep a bounded queue — their p99
+    must stay within 3x of the unloaded p99 (check_serving_overload).
+    With shedding OFF every arrival queues and the p99 grows with the
+    backlog; the ratio between the two runs is the record's evidence that
+    admission control, not luck, bounds the tail."""
+    import sys
+    import threading
+
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.serving import (AdmissionController,
+                                            ModelRegistry, ShedError)
+
+    # sized so one dispatch is a few ms even on CPU: the 3x-of-unloaded
+    # p99 gate must be judged against model service time, not against OS
+    # scheduler jitter (which dominates sub-ms dispatches)
+    n_in, hidden, n_out, depth, B = ((128, 1024, 8, 6, 32) if tiny
+                                     else (256, 2048, 64, 8, 64))
+    n_threads = 4 if tiny else 16
+    per_thread = 30 if tiny else 60
+
+    b = NeuralNetConfiguration.builder().seed(0).list()
+    b.layer(DenseLayer(n_in=n_in, n_out=hidden, activation="relu"))
+    for _ in range(depth - 2):
+        b.layer(DenseLayer(n_in=hidden, n_out=hidden, activation="relu"))
+    conf = b.layer(OutputLayer(n_in=hidden, n_out=n_out)).build()
+    net = MultiLayerNetwork(conf).init()
+    registry = ModelRegistry(manifest_dir=None, retain=0)
+    x = jnp.asarray(np.random.RandomState(0).randn(B, n_in)
+                    .astype(np.float32))
+    # max_delay_ms=0: this storm measures admission, so the coalesce
+    # window would only add a constant to every latency
+    registry.deploy("bench", "v1", net, example=x, max_batch=B,
+                    max_delay_ms=0.0)
+    # the p99 under GIL-contended client threads is dominated by the
+    # interpreter's 5ms switch interval unless it is turned down — a real
+    # serving process tunes this for the same reason
+    prev_switch = sys.getswitchinterval()
+    sys.setswitchinterval(1e-4)
+
+    def unloaded_floor():
+        # unloaded p99: one client, no contention — the latency floor the
+        # shedder is judged against (enough samples that the p99 actually
+        # samples the dispatch tail, or the 3x gate judges against noise)
+        lat = []
+        for _ in range(100 if tiny else 200):
+            t0 = time.perf_counter()
+            jax.block_until_ready(registry.predict("bench", x).jax())
+            lat.append(time.perf_counter() - t0)
+        return float(np.percentile(lat, 99))
+
+    def storm(shed: bool):
+        big = 1 << 20  # effectively unbounded
+        ctrl = AdmissionController(
+            "bench", max_concurrent=1,
+            queue_depth=2 if shed else big,
+            high_water=1 if shed else big,
+            default_timeout_s=None)
+        admitted, shed_n, lock = [], [0], threading.Lock()
+
+        def client():
+            for _ in range(per_thread):
+                t0 = time.perf_counter()
+                try:
+                    ctrl.run(lambda: jax.block_until_ready(
+                        registry.predict("bench", x).jax()))
+                except ShedError:
+                    with lock:
+                        shed_n[0] += 1
+                    continue
+                dt = time.perf_counter() - t0
+                with lock:
+                    admitted.append(dt)
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(n_threads)]
+        t_all = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_all
+        return {
+            "completed": len(admitted),
+            "shed": shed_n[0],
+            "offered": n_threads * per_thread,
+            "p50_ms": round(float(np.percentile(admitted, 50)) * 1e3, 3)
+            if admitted else None,
+            "p99_ms": round(float(np.percentile(admitted, 99)) * 1e3, 3)
+            if admitted else None,
+            "throughput_rps": round(len(admitted) / wall, 2),
+        }
+
+    try:
+        # one remeasure retry, same as the BERT variants: a single
+        # scheduler hiccup in the p99 tail must not fail the artifact
+        for attempt in range(2):
+            rec = {"unloaded_p99_ms": round(unloaded_floor() * 1e3, 3),
+                   "threads": n_threads,
+                   "shed_on": storm(True), "shed_off": storm(False)}
+            ok, reason = check_serving_overload(rec)
+            if ok or attempt == 1:
+                break
+    finally:
+        sys.setswitchinterval(prev_switch)
+        registry.drain_all(save_manifests=False)
+    if rec["shed_on"]["p99_ms"] and rec["shed_off"]["p99_ms"]:
+        rec["p99_ratio_off_over_on"] = round(
+            rec["shed_off"]["p99_ms"] / rec["shed_on"]["p99_ms"], 3)
+    rec["gate_ok"], rec["gate_reason"] = ok, reason
+    return rec
+
+
+def check_serving_overload(rec, max_p99_ratio=3.0):
+    """(ok, reason): gates a serving_overload record must pass.
+
+    - with shedding on, admitted requests must exist AND the shedder must
+      actually have engaged under the synthetic overload (zero shed means
+      the storm never overloaded the controller — the record proves
+      nothing);
+    - the admitted requests' p99 must stay within ``max_p99_ratio`` (3x)
+      of the unloaded p99: shedding exists precisely so the clients that
+      ARE admitted never sit behind an unbounded queue."""
+    on = rec["shed_on"]
+    if not on.get("completed"):
+        return False, ("no admitted request completed under overload "
+                       "with shedding on: the controller shed everything")
+    if on.get("shed", 0) <= 0:
+        return False, ("overload never tripped the shedder (0 shed): the "
+                       "storm did not overload the controller, so the "
+                       "bounded-p99 claim is untested")
+    limit = max_p99_ratio * rec["unloaded_p99_ms"]
+    if on["p99_ms"] > limit:
+        return False, (
+            f"admitted-request p99 {on['p99_ms']:.3f}ms > {limit:.3f}ms "
+            f"({max_p99_ratio}x unloaded {rec['unloaded_p99_ms']:.3f}ms): "
+            "shedding is not bounding the admitted queue")
+    return True, "ok"
+
+
 def check_telemetry_overhead(rec, max_overhead=0.03):
     """(ok, reason): metrics-on serving throughput may cost at most
     `max_overhead` (3%) vs metrics-off — the near-zero-cost contract of
@@ -952,6 +1102,12 @@ def main():
             out["cold_start"] = bench_cold_start(jax, jnp, tiny)
         except Exception as e:
             out["cold_start"] = f"error: {type(e).__name__}"
+        _release()
+        try:
+            out["serving_overload"] = bench_serving_overload(jax, jnp,
+                                                             tiny)
+        except Exception as e:
+            out["serving_overload"] = f"error: {type(e).__name__}"
         _release()
         try:
             fwd, train = bench_flash_attention(jax, jnp, tiny)

@@ -42,6 +42,13 @@ class EnvironmentVars:
     DL4J_TPU_ZERO1 = "DL4J_TPU_ZERO1"
     DL4J_TPU_METRICS = "DL4J_TPU_METRICS"
     DL4J_TPU_TRACE_BUFFER = "DL4J_TPU_TRACE_BUFFER"
+    DL4J_TPU_SERVING_MAX_CONCURRENT = "DL4J_TPU_SERVING_MAX_CONCURRENT"
+    DL4J_TPU_SERVING_QUEUE_DEPTH = "DL4J_TPU_SERVING_QUEUE_DEPTH"
+    DL4J_TPU_SERVING_HIGH_WATER = "DL4J_TPU_SERVING_HIGH_WATER"
+    DL4J_TPU_SERVING_TIMEOUT_S = "DL4J_TPU_SERVING_TIMEOUT_S"
+    DL4J_TPU_SERVING_DRAIN_TIMEOUT_S = "DL4J_TPU_SERVING_DRAIN_TIMEOUT_S"
+    DL4J_TPU_SERVING_RETAIN = "DL4J_TPU_SERVING_RETAIN"
+    DL4J_TPU_SERVING_MANIFEST_DIR = "DL4J_TPU_SERVING_MANIFEST_DIR"
     XLA_FLAGS = "XLA_FLAGS"
 
 
@@ -66,6 +73,13 @@ class SystemProperties:
     TRAINING_ZERO1 = "training_zero1"
     METRICS = "metrics"
     TRACE_BUFFER = "trace_buffer"
+    SERVING_MAX_CONCURRENT = "serving_max_concurrent"
+    SERVING_QUEUE_DEPTH = "serving_queue_depth"
+    SERVING_HIGH_WATER = "serving_high_water"
+    SERVING_TIMEOUT_S = "serving_timeout_s"
+    SERVING_DRAIN_TIMEOUT_S = "serving_drain_timeout_s"
+    SERVING_RETAIN = "serving_retain"
+    SERVING_MANIFEST_DIR = "serving_manifest_dir"
 
 
 _ENV_FOR_PROP = {
@@ -91,6 +105,20 @@ _ENV_FOR_PROP = {
     SystemProperties.TRAINING_ZERO1: EnvironmentVars.DL4J_TPU_ZERO1,
     SystemProperties.METRICS: EnvironmentVars.DL4J_TPU_METRICS,
     SystemProperties.TRACE_BUFFER: EnvironmentVars.DL4J_TPU_TRACE_BUFFER,
+    SystemProperties.SERVING_MAX_CONCURRENT:
+        EnvironmentVars.DL4J_TPU_SERVING_MAX_CONCURRENT,
+    SystemProperties.SERVING_QUEUE_DEPTH:
+        EnvironmentVars.DL4J_TPU_SERVING_QUEUE_DEPTH,
+    SystemProperties.SERVING_HIGH_WATER:
+        EnvironmentVars.DL4J_TPU_SERVING_HIGH_WATER,
+    SystemProperties.SERVING_TIMEOUT_S:
+        EnvironmentVars.DL4J_TPU_SERVING_TIMEOUT_S,
+    SystemProperties.SERVING_DRAIN_TIMEOUT_S:
+        EnvironmentVars.DL4J_TPU_SERVING_DRAIN_TIMEOUT_S,
+    SystemProperties.SERVING_RETAIN:
+        EnvironmentVars.DL4J_TPU_SERVING_RETAIN,
+    SystemProperties.SERVING_MANIFEST_DIR:
+        EnvironmentVars.DL4J_TPU_SERVING_MANIFEST_DIR,
 }
 
 _DEFAULTS = {
@@ -111,6 +139,13 @@ _DEFAULTS = {
     SystemProperties.TRAINING_ZERO1: "0",
     SystemProperties.METRICS: "1",
     SystemProperties.TRACE_BUFFER: "16384",
+    SystemProperties.SERVING_MAX_CONCURRENT: "8",
+    SystemProperties.SERVING_QUEUE_DEPTH: "64",
+    SystemProperties.SERVING_HIGH_WATER: "0",  # 0 = auto (3/4 of queue)
+    SystemProperties.SERVING_TIMEOUT_S: "30",
+    SystemProperties.SERVING_DRAIN_TIMEOUT_S: "30",
+    SystemProperties.SERVING_RETAIN: "2",
+    SystemProperties.SERVING_MANIFEST_DIR: "",  # "" = <cache_dir>/manifests
 }
 
 
@@ -297,6 +332,76 @@ class Environment:
     def set_training_zero1(self, v: bool):
         return self.set_property(SystemProperties.TRAINING_ZERO1,
                                  "1" if v else "0")
+
+    # -- model serving knobs (serving/) ------------------------------------
+
+    def serving_max_concurrent(self) -> int:
+        """Per-model concurrent-dispatch limit for the admission
+        controller (``DL4J_TPU_SERVING_MAX_CONCURRENT``)."""
+        v = self.property(SystemProperties.SERVING_MAX_CONCURRENT)
+        try:
+            return max(int(v), 1)
+        except (TypeError, ValueError):
+            return 8
+
+    def serving_queue_depth(self) -> int:
+        """Hard bound on requests waiting for a dispatch slot per model
+        (``DL4J_TPU_SERVING_QUEUE_DEPTH``); arrivals beyond it shed."""
+        v = self.property(SystemProperties.SERVING_QUEUE_DEPTH)
+        try:
+            return max(int(v), 1)
+        except (TypeError, ValueError):
+            return 64
+
+    def serving_high_water(self) -> int:
+        """Queue depth at which load shedding engages
+        (``DL4J_TPU_SERVING_HIGH_WATER``); <= 0 resolves to 3/4 of
+        ``serving_queue_depth`` (shed before the hard bound so retried
+        requests see headroom)."""
+        v = self.property(SystemProperties.SERVING_HIGH_WATER)
+        try:
+            hw = int(v)
+        except (TypeError, ValueError):
+            hw = 0
+        if hw <= 0:
+            hw = max(1, (3 * self.serving_queue_depth()) // 4)
+        return hw
+
+    def serving_default_timeout_s(self) -> Optional[float]:
+        """Default per-request deadline budget in seconds
+        (``DL4J_TPU_SERVING_TIMEOUT_S``); <= 0 means no deadline."""
+        v = self.property(SystemProperties.SERVING_TIMEOUT_S)
+        try:
+            t = float(v)
+        except (TypeError, ValueError):
+            t = 30.0
+        return t if t > 0 else None
+
+    def serving_drain_timeout_s(self) -> float:
+        """How long graceful drain waits for in-flight work
+        (``DL4J_TPU_SERVING_DRAIN_TIMEOUT_S``)."""
+        v = self.property(SystemProperties.SERVING_DRAIN_TIMEOUT_S)
+        try:
+            return max(float(v), 0.0)
+        except (TypeError, ValueError):
+            return 30.0
+
+    def serving_retain(self) -> int:
+        """Previous model versions the registry keeps warm for rollback
+        (``DL4J_TPU_SERVING_RETAIN``)."""
+        v = self.property(SystemProperties.SERVING_RETAIN)
+        try:
+            return max(int(v), 0)
+        except (TypeError, ValueError):
+            return 2
+
+    def serving_manifest_dir(self) -> Optional[str]:
+        """Explicit warmup-manifest directory override
+        (``DL4J_TPU_SERVING_MANIFEST_DIR``); None/"" defers to
+        ``runtime.compile_cache.serving_manifest_dir`` (defaults under
+        the executable cache dir)."""
+        d = self.property(SystemProperties.SERVING_MANIFEST_DIR)
+        return os.path.expanduser(d) if d else None
 
     # -- telemetry (common/metrics.py, common/tracing.py) ------------------
     def metrics(self):

@@ -1,0 +1,107 @@
+"""Shared stdlib-HTTP building blocks for the UI and serving servers.
+
+Reference: the Vertx handler idioms of `VertxUIServer.java` (one router,
+JSON in/out, content-length on everything) mapped onto `http.server`.
+Both `ui/server.py` (training dashboard + /metrics) and
+`serving/server.py` (model serving front end) build on these so the HTTP
+hygiene — Content-Length on every response, client disconnects handled
+without stack traces, debug-gated request logging — is fixed in one
+place.
+
+- ``QuietThreadingHTTPServer`` — ThreadingHTTPServer whose
+  ``handle_error`` treats client disconnects (``BrokenPipeError`` /
+  ``ConnectionResetError`` when the peer goes away mid-response) as
+  routine: counted on ``server.client_disconnects`` and debug-logged,
+  never a stderr stack trace. Anything else still reports normally.
+- ``JsonRequestHandler`` — BaseHTTPRequestHandler with ``send_payload``/
+  ``send_json`` (always sets Content-Length, swallows disconnects while
+  writing) and ``read_body``.
+- ``metrics_payload`` — the Prometheus / JSON exposition of the process
+  metrics registry, shared by every ``/metrics`` endpoint.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Iterable, Tuple
+
+log = logging.getLogger(__name__)
+
+#: exceptions that mean "the client hung up", not "the server broke"
+CLIENT_DISCONNECTS = (BrokenPipeError, ConnectionResetError,
+                      ConnectionAbortedError)
+
+
+class QuietThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that logs client disconnects instead of
+    printing a traceback for every impatient curl."""
+
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.client_disconnects = 0
+
+    def handle_error(self, request, client_address):
+        exc = sys.exc_info()[1]
+        if isinstance(exc, CLIENT_DISCONNECTS):
+            self.client_disconnects += 1
+            log.debug("client %s disconnected mid-request: %r",
+                      client_address, exc)
+            return
+        super().handle_error(request, client_address)
+
+
+class JsonRequestHandler(BaseHTTPRequestHandler):
+    """Handler base: every response carries Content-Length (HTTP/1.1
+    keep-alive safe), writes survive the client hanging up, and per-line
+    request logging only appears under debug logging."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        log.debug("%s - %s", self.address_string(), fmt % args)
+
+    def send_payload(self, body: bytes, content_type: str = "text/plain",
+                     code: int = 200,
+                     headers: Iterable[Tuple[str, str]] = ()):
+        """One response: status + Content-Type + Content-Length + body.
+        A client that disconnected mid-write is counted and the
+        connection dropped — no stack trace, no retry."""
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, str(v))
+            self.end_headers()
+            self.wfile.write(body)
+        except CLIENT_DISCONNECTS as e:
+            srv = getattr(self, "server", None)
+            if hasattr(srv, "client_disconnects"):
+                srv.client_disconnects += 1
+            log.debug("client disconnected during response: %r", e)
+            self.close_connection = True
+
+    def send_json(self, obj, code: int = 200,
+                  headers: Iterable[Tuple[str, str]] = ()):
+        self.send_payload(json.dumps(obj).encode(), "application/json",
+                          code, headers)
+
+    def read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        return self.rfile.read(n) if n > 0 else b""
+
+
+def metrics_payload(fmt: str = "text") -> Tuple[bytes, str]:
+    """(body, content_type) for a /metrics[.json] endpoint, off the
+    process-wide registry (``environment().metrics()``)."""
+    from .environment import environment
+
+    reg = environment().metrics()
+    if fmt == "json":
+        return json.dumps(reg.snapshot()).encode(), "application/json"
+    return (reg.prometheus_text().encode(),
+            "text/plain; version=0.0.4; charset=utf-8")

@@ -392,6 +392,37 @@ def _disable_backstop():
 
 
 # ---------------------------------------------------------------------------
+# serving warmup-manifest handoff
+# ---------------------------------------------------------------------------
+
+def serving_manifest_dir(create: bool = True) -> Optional[str]:
+    """Directory where the serving registry persists per-model warmup
+    manifests so the NEXT replica (or the incoming version of a hot swap)
+    replays the shapes live traffic exercised before taking traffic.
+
+    ``DL4J_TPU_SERVING_MANIFEST_DIR`` overrides; the default rides the
+    executable cache at ``<DL4J_TPU_CACHE_DIR>/manifests`` — the same
+    volume a deployment already ships between replicas for AOT
+    executables. Returns None when both are disabled (manifests then live
+    only in process memory: hot-swap handoff still works, restart replay
+    does not)."""
+    d = environment().serving_manifest_dir()
+    if not d:
+        base = environment().cache_dir()
+        if not base:
+            return None
+        d = os.path.join(base, "manifests")
+    if create:
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError as e:
+            log.warning("serving manifest dir %s unusable (%s); manifests "
+                        "stay in-memory", d, e)
+            return None
+    return d
+
+
+# ---------------------------------------------------------------------------
 # AOT entry construction (the counted_jit integration point)
 # ---------------------------------------------------------------------------
 

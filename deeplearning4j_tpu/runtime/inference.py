@@ -311,14 +311,25 @@ def _make_adapter(model, outputs):
 # the engine
 # ---------------------------------------------------------------------------
 
-class _Request:
-    __slots__ = ("inputs", "n", "sig", "future")
+class EngineClosedError(RuntimeError):
+    """Raised by ``submit()``/``infer()`` once the engine is draining or
+    closed: late requests must fail fast with a clear signal the caller
+    can act on (the serving registry retries them against the engine that
+    replaced this one; everyone else surfaces the error)."""
 
-    def __init__(self, inputs, sig, future):
+
+class _Request:
+    __slots__ = ("inputs", "n", "sig", "future", "deadline")
+
+    def __init__(self, inputs, sig, future, deadline=None):
         self.inputs = inputs
         self.n = inputs[0].shape[0]
         self.sig = sig
         self.future = future
+        self.deadline = deadline  # monotonic instant, or None
+
+    def expired(self) -> bool:
+        return self.deadline is not None and time.monotonic() >= self.deadline
 
 
 class InferenceEngine:
@@ -367,6 +378,12 @@ class InferenceEngine:
         self._pending: List[_Request] = []
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
+        # lifecycle: draining refuses new requests but is reversible via
+        # start() (the registry parks retired versions this way so a
+        # rollback re-admits without recompiling); closed is permanent
+        self._draining = False
+        self._closed = False
+        self._inflight = 0  # synchronous infer() calls currently running
         # stats
         self._lock = threading.Lock()
         self._stats = {"requests": 0, "dispatches": 0, "rows_real": 0,
@@ -395,6 +412,9 @@ class InferenceEngine:
             "dl4j_inference_coalesce_size",
             "Requests coalesced into one micro-batched dispatch",
             buckets=[float(1 << i) for i in range(11)])
+        self._m_expired = self._reg.counter(
+            "dl4j_inference_deadline_expired_total",
+            "submit() requests whose deadline expired before dispatch")
 
     # -- core dispatch ---------------------------------------------------
     def _dispatch(self, inputs: List[jax.Array], n: int) -> List[jax.Array]:
@@ -445,14 +465,27 @@ class InferenceEngine:
 
     def infer(self, request):
         """Synchronous bucketed inference for one request."""
-        inputs = self._adapter.inputs_of(request)
-        n = _leading_dim(inputs)
-        if n is None:
-            raise ValueError("request inputs must share a leading batch dim")
-        with self._lock:
-            self._stats["requests"] += 1
-        self._m_requests.inc()
-        return self._adapter.package(self._dispatch_chunked(inputs, n))
+        with self._cv:
+            if self._draining or self._closed:
+                raise EngineClosedError(
+                    "InferenceEngine is "
+                    + ("closed" if self._closed else "draining")
+                    + "; it no longer accepts requests")
+            self._inflight += 1
+        try:
+            inputs = self._adapter.inputs_of(request)
+            n = _leading_dim(inputs)
+            if n is None:
+                raise ValueError(
+                    "request inputs must share a leading batch dim")
+            with self._lock:
+                self._stats["requests"] += 1
+            self._m_requests.inc()
+            return self._adapter.package(self._dispatch_chunked(inputs, n))
+        finally:
+            with self._cv:
+                self._inflight -= 1
+                self._cv.notify_all()
 
     __call__ = infer
 
@@ -523,10 +556,22 @@ class InferenceEngine:
                 path, type(e).__name__, e)
             return []
 
+    def observed_entries(self) -> List[dict]:
+        """The live-traffic manifest in ``load_manifest`` format, without
+        touching disk — the in-process handoff a serving registry uses to
+        warm an incoming model version with the shapes the outgoing
+        version actually served."""
+        with self._warm_lock:
+            return [{"inputs": [(tuple(int(d) for d in s), str(dt))
+                                for s, dt in sig],
+                     "buckets": sorted(int(b) for b in buckets)}
+                    for sig, buckets in sorted(self._observed.items())]
+
     def warmup(self, example=None,
                batch_sizes: Optional[Sequence[int]] = None,
                manifest: Optional[str] = None,
-               workers: Optional[int] = None) -> List[int]:
+               workers: Optional[int] = None,
+               entries: Optional[List[dict]] = None) -> List[int]:
         """Pre-compile bucket executables before traffic arrives,
         concurrently (XLA compilation releases the GIL, so the ladder
         compiles on a thread pool — wall clock ~ the slowest bucket, not
@@ -536,8 +581,10 @@ class InferenceEngine:
         the trailing feature shapes/dtypes matter). With `batch_sizes`,
         only the buckets those sizes map to are compiled; default is the
         whole ladder. With ``example=None``, shapes are replayed from
-        ``manifest`` (or the engine's configured ``manifest_path``)
-        instead — the restart flow. Returns the sorted buckets warmed.
+        ``entries`` (``load_manifest``/``observed_entries`` format — the
+        hot-swap handoff from a live predecessor engine) or from
+        ``manifest`` (or the engine's configured ``manifest_path``) — the
+        restart flow. Returns the sorted buckets warmed.
 
         Idempotent and re-entrant: a (bucket, shape) pair already warmed —
         or being warmed by a concurrent call — is never compiled twice;
@@ -554,13 +601,17 @@ class InferenceEngine:
                 todo = list(self.ladder)
             jobs = [(b, sig) for b in todo]
         else:
-            path = manifest or self.manifest_path
-            if not path or not os.path.exists(path):
-                return []
-            for e in self.load_manifest(path):
+            if entries is None:
+                path = manifest or self.manifest_path
+                if not path or not os.path.exists(path):
+                    return []
+                entries = self.load_manifest(path)
+            for e in entries:
+                sig = tuple((tuple(int(d) for d in s), str(dt))
+                            for s, dt in e["inputs"])
                 for b in e["buckets"]:
                     b = bucket_for(min(int(b), self.max_batch), self.ladder)
-                    jobs.append((b, tuple(e["inputs"])))
+                    jobs.append((b, sig))
             jobs = sorted(set(jobs))
         if not jobs:
             return []
@@ -610,9 +661,15 @@ class InferenceEngine:
         return sorted({b for b, _ in jobs})
 
     # -- dynamic micro-batcher -------------------------------------------
-    def submit(self, request) -> Future:
+    def submit(self, request, timeout_s: Optional[float] = None) -> Future:
         """Enqueue one request; the returned Future resolves to the same
-        value infer(request) would produce."""
+        value infer(request) would produce.
+
+        With ``timeout_s``, the request carries a deadline budget: if it
+        is still queued when the budget expires, the micro-batcher
+        resolves its Future with ``TimeoutError`` instead of padding it
+        into a batch slot nobody is waiting for (deadline propagation —
+        expired work is shed before dispatch, not after)."""
         inputs = self._adapter.inputs_of(request)
         n = _leading_dim(inputs)
         if n is None:
@@ -622,10 +679,15 @@ class InferenceEngine:
                              f"{self.max_batch}; use infer() (it chunks)")
         sig = tuple((x.shape[1:], str(x.dtype)) for x in inputs)
         fut: Future = Future()
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
         with self._cv:
-            if self._stopping:
-                raise RuntimeError("engine is stopped")
-            self._pending.append(_Request(inputs, sig, fut))
+            if self._draining or self._closed:
+                raise EngineClosedError(
+                    "InferenceEngine is "
+                    + ("closed" if self._closed else "draining")
+                    + "; it no longer accepts requests")
+            self._pending.append(_Request(inputs, sig, fut, deadline))
             depth = len(self._pending)
             self._cv.notify_all()
         with self._lock:
@@ -637,6 +699,8 @@ class InferenceEngine:
 
     def _ensure_thread(self):
         with self._cv:
+            if self._draining or self._closed:
+                return  # a drain in progress must never be un-stopped
             if self._thread is None or not self._thread.is_alive():
                 self._stopping = False
                 self._thread = threading.Thread(
@@ -645,11 +709,20 @@ class InferenceEngine:
                 self._thread.start()
 
     def start(self):
+        """(Re)open the engine for requests: reverses drain() — a parked
+        previous version resumes without recompiling — and starts the
+        micro-batcher thread. Raises once close() has run."""
+        with self._cv:
+            if self._closed:
+                raise EngineClosedError(
+                    "InferenceEngine is closed; it cannot be restarted")
+            self._draining = False
         self._ensure_thread()
         return self
 
     def stop(self):
-        """Drain pending requests, then stop the batcher thread."""
+        """Drain pending requests, then stop the batcher thread (the
+        engine stays open: a later submit() restarts it)."""
         with self._cv:
             self._stopping = True
             self._cv.notify_all()
@@ -658,11 +731,69 @@ class InferenceEngine:
             t.join(timeout=30)
         return self
 
+    # -- graceful drain / close ------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._draining and not self._closed
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Stop admitting, flush every queued request through the
+        micro-batcher, wait for in-flight infer() calls, and stop the
+        batcher thread. Idempotent; reversible via start() (a rollback
+        re-admits a parked version). Late submit()/infer() calls raise
+        ``EngineClosedError``. Returns True when fully drained within
+        ``timeout_s``."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            self._draining = True
+            self._stopping = True
+            self._cv.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        # a submit that raced the drain may have left requests behind a
+        # dead batcher: fail them explicitly rather than strand futures
+        with self._cv:
+            leftovers, self._pending = self._pending, []
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            drained = self._inflight == 0 and (t is None or not t.is_alive())
+        for r in leftovers:
+            if not r.future.done():
+                r.future.set_exception(EngineClosedError(
+                    "InferenceEngine drained before this request was "
+                    "dispatched"))
+        return drained
+
+    def close(self, timeout_s: float = 30.0) -> bool:
+        """Permanent drain: like drain(), but the engine can never be
+        restarted. Idempotent. Returns True when fully drained."""
+        self._closed = True
+        return self.drain(timeout_s)
+
     def __enter__(self):
         return self.start()
 
     def __exit__(self, *exc):
         self.stop()
+
+    def _expire(self, req: _Request) -> bool:
+        """Resolve an expired request's Future with TimeoutError; True if
+        it was expired (and must not occupy a batch slot)."""
+        if not req.expired():
+            return False
+        if not req.future.done():
+            req.future.set_exception(TimeoutError(
+                "request deadline expired before dispatch"))
+        self._m_expired.inc()
+        return True
 
     def _batcher_loop(self):
         while True:
@@ -670,8 +801,14 @@ class InferenceEngine:
                 while not self._pending and not self._stopping:
                     self._cv.wait()
                 if not self._pending:  # stopping and drained
+                    if self._thread is threading.current_thread():
+                        # a submit() racing this exit sees _thread None and
+                        # reliably starts a fresh batcher for its request
+                        self._thread = None
                     return
                 first = self._pending.pop(0)
+            if self._expire(first):
+                continue
             group, total = [first], first.n
             deadline = time.monotonic() + self.max_delay_ms / 1000.0
             while total < self.max_batch:
@@ -687,6 +824,8 @@ class InferenceEngine:
                     if nxt.sig != first.sig or total + nxt.n > self.max_batch:
                         break
                     self._pending.pop(0)
+                if self._expire(nxt):
+                    continue
                 group.append(nxt)
                 total += nxt.n
             if self._reg.enabled:

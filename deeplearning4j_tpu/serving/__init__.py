@@ -1,0 +1,36 @@
+"""Production model-serving subsystem.
+
+The layer between the library-level ``runtime.inference.InferenceEngine``
+and "heavy traffic from millions of users": a versioned multi-model
+registry with warm-before-cutover hot swap and rollback
+(``registry.ModelRegistry``), Clipper/Orca-style admission control with
+deadlines and load shedding (``admission.AdmissionController``), a stdlib
+HTTP front end with liveness/readiness probes and the shared ``/metrics``
+exposition (``server.ModelServer``), and a SIGTERM graceful-drain
+sequence that hands warmup manifests to the next replica
+(``lifecycle.GracefulLifecycle``).
+
+Minimal flow::
+
+    from deeplearning4j_tpu.serving import (GracefulLifecycle,
+                                            ModelRegistry, ModelServer)
+
+    registry = ModelRegistry()
+    registry.deploy("mnist", "v1", net, example=x)   # warms BEFORE serving
+    server = ModelServer(registry)
+    port = server.start()
+    GracefulLifecycle(registry, server).install()    # SIGTERM drains
+    ...
+    registry.deploy("mnist", "v2", net2)  # warm-before-cutover hot swap
+    registry.rollback("mnist")            # instant: v1 stayed warm
+
+Env knobs (``DL4J_TPU_SERVING_*``): ``MAX_CONCURRENT``, ``QUEUE_DEPTH``,
+``HIGH_WATER``, ``TIMEOUT_S``, ``DRAIN_TIMEOUT_S``, ``RETAIN``,
+``MANIFEST_DIR``.
+"""
+from .admission import (AdmissionController, DeadlineExceededError,  # noqa: F401
+                        ShedError)
+from .lifecycle import GracefulLifecycle  # noqa: F401
+from .registry import (READY, RETIRED, WARMING, ModelRegistry,  # noqa: F401
+                       ModelVersion)
+from .server import ModelServer  # noqa: F401

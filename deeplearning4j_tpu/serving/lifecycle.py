@@ -1,0 +1,111 @@
+"""Graceful serving lifecycle: SIGTERM drain and replica handoff.
+
+Reference: the Kubernetes termination contract every production serving
+deployment runs under — on SIGTERM a replica must (1) fail its readiness
+probe so the load balancer stops routing to it, (2) refuse new work with
+backpressure the client understands, (3) finish what it already accepted,
+and (4) leave enough state behind that its replacement starts warm. Here:
+
+1. ``ModelServer.begin_drain()`` — ``/readyz`` answers 503, predicts
+   answer 503/429, admission controllers shed their waiters.
+2. ``ModelRegistry.drain_all()`` — every engine's micro-batcher flushes
+   its queued requests, in-flight dispatches finish, late submits fail
+   fast with ``EngineClosedError``.
+3. ``save_manifests()`` — the observed-traffic warmup manifests land in
+   ``runtime.compile_cache.serving_manifest_dir()``; paired with the
+   persistent executable cache, the next replica (or the next version of
+   a rolling deploy) warms the same bucket ladder before taking traffic.
+4. The HTTP socket closes last, after the work is done.
+
+``GracefulLifecycle.install()`` wires this to SIGTERM (handler chains to
+any previously installed one); ``drain()`` can also be called directly —
+e.g. from a preStop hook or a test.
+"""
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Callable, Iterable, Optional
+
+from ..common.environment import environment
+from .registry import ModelRegistry
+from .server import ModelServer
+
+log = logging.getLogger(__name__)
+
+
+class GracefulLifecycle:
+    """Owns the drain sequence for one (registry, server) pair."""
+
+    def __init__(self, registry: ModelRegistry,
+                 server: Optional[ModelServer] = None,
+                 drain_timeout_s: Optional[float] = None,
+                 on_drained: Optional[Callable[[], None]] = None):
+        self.registry = registry
+        self.server = server
+        self.drain_timeout_s = (drain_timeout_s
+                                if drain_timeout_s is not None
+                                else environment().serving_drain_timeout_s())
+        self.on_drained = on_drained
+        self._lock = threading.Lock()
+        self._drain_started = False
+        self._drained = threading.Event()
+        self._previous: dict = {}
+
+    # -- signal wiring ----------------------------------------------------
+    def install(self, signals: Iterable[int] = (signal.SIGTERM,)):
+        """Install the drain handler (main thread only — a CPython
+        constraint of ``signal.signal``). The previous handler is chained
+        after ours and restored by ``uninstall()``."""
+        for sig in signals:
+            self._previous[sig] = signal.signal(sig, self._handle)
+        return self
+
+    def uninstall(self):
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+        return self
+
+    def _handle(self, signum, frame):
+        log.info("signal %d: starting graceful drain", signum)
+        # the drain blocks on in-flight work; never do that in a signal
+        # handler — hand it to a thread and return immediately
+        threading.Thread(target=self.drain, name="dl4j-tpu-drain",
+                         daemon=True).start()
+        prev = self._previous.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+
+    # -- the drain sequence -----------------------------------------------
+    @property
+    def drained(self) -> bool:
+        return self._drained.is_set()
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        return self._drained.wait(timeout)
+
+    def drain(self) -> bool:
+        """Run the full drain sequence (idempotent: concurrent calls wait
+        for the first). Returns True when everything flushed in time."""
+        with self._lock:
+            if self._drain_started:
+                return self._drained.wait(self.drain_timeout_s + 5)
+            self._drain_started = True
+        try:
+            if self.server is not None:
+                self.server.begin_drain()  # readyz -> 503, shed new work
+            ok = self.registry.drain_all(timeout_s=self.drain_timeout_s,
+                                         save_manifests=True)
+            if self.server is not None:
+                self.server.stop()  # socket closes after the work is done
+            if self.on_drained is not None:
+                try:
+                    self.on_drained()
+                except Exception:
+                    log.exception("on_drained callback failed")
+            log.info("graceful drain complete (flushed=%s)", ok)
+            return ok
+        finally:
+            self._drained.set()

@@ -1,0 +1,339 @@
+"""Versioned multi-model registry with warm-before-cutover hot swap.
+
+Reference: the reference ecosystem's model-server layer (ParallelInference
+behind a router) plus the Clipper model-container registry — reshaped for
+the TPU cost model, where "loading a model" is cheap and *compiling* it is
+the outage. Deploying a new version therefore warms it first:
+
+1. ``deploy(name, version, model)`` wraps the model in an
+   ``InferenceEngine`` and compiles its bucket ladder BEFORE any traffic
+   sees it, replaying — in priority order — the explicit ``example``, the
+   live traffic shapes of the outgoing version
+   (``InferenceEngine.observed_entries()``), or the on-disk warmup
+   manifest a previous replica saved (``runtime.compile_cache.
+   serving_manifest_dir``). Every compile lands in the PR-4 persistent
+   executable cache, so the same ladder warms in milliseconds on the next
+   replica.
+2. The registry then atomically repoints the model's current version.
+   The outgoing engine drains its in-flight requests before release and
+   is *parked* (drained, but retained warm) so that…
+3. ``rollback(name)`` repoints to the previous retained version
+   instantly — its executables never left the process. Retention is
+   bounded by ``DL4J_TPU_SERVING_RETAIN``; evicted versions are closed
+   for good.
+
+``predict()`` routes a request to the current (or a pinned) version and
+transparently retries a request that raced a cutover — the
+zero-failed-in-flight contract of the hot swap.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..common.environment import environment
+from ..common.metrics import registry as metrics_registry
+from ..runtime import compile_cache
+from ..runtime.inference import EngineClosedError, InferenceEngine
+
+log = logging.getLogger(__name__)
+
+#: ModelVersion lifecycle states
+WARMING = "warming"   # deployed but not yet warmed: /readyz stays false
+READY = "ready"       # warmed and serving (or parked warm for rollback)
+RETIRED = "retired"   # drained after a cutover/rollback; warm, re-admittable
+
+
+def _safe_name(name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9._-]", "_", name)
+
+
+class ModelVersion:
+    """One deployed (name, version) pair and its serving engine."""
+
+    __slots__ = ("name", "version", "engine", "state", "deployed_at")
+
+    def __init__(self, name: str, version: str, engine: InferenceEngine):
+        self.name = name
+        self.version = version
+        self.engine = engine
+        self.state = WARMING
+        self.deployed_at = time.time()
+
+    def describe(self) -> Dict[str, Any]:
+        return {"version": self.version, "state": self.state,
+                "deployed_at": self.deployed_at,
+                "buckets": list(self.engine.ladder),
+                "max_batch": self.engine.max_batch}
+
+
+class ModelRegistry:
+    """Named, versioned models behind one object; thread-safe."""
+
+    def __init__(self, *, retain: Optional[int] = None,
+                 manifest_dir: Optional[str] = "auto"):
+        self.retain = (environment().serving_retain()
+                       if retain is None else int(retain))
+        # "auto" = ride the executable cache volume; None disables disk
+        # manifests entirely (hot-swap handoff still works in-process)
+        self._manifest_dir = (compile_cache.serving_manifest_dir()
+                              if manifest_dir == "auto" else manifest_dir)
+        self._lock = threading.RLock()
+        self._versions: Dict[str, List[ModelVersion]] = {}
+        self._current: Dict[str, ModelVersion] = {}
+        self._draining = False
+        reg = metrics_registry()
+        self._m_deploys = reg.counter(
+            "dl4j_serving_deploys_total", "Model versions deployed",
+            labels=("model",))
+        self._m_rollbacks = reg.counter(
+            "dl4j_serving_rollbacks_total", "Model rollbacks",
+            labels=("model",))
+
+    # -- manifests --------------------------------------------------------
+    def manifest_path(self, name: str) -> Optional[str]:
+        """Per-model warmup-manifest file (shared across versions: the
+        incoming version replays what the model — not the executable —
+        was serving)."""
+        if not self._manifest_dir:
+            return None
+        return os.path.join(self._manifest_dir,
+                            f"{_safe_name(name)}.warmup.json")
+
+    def save_manifests(self) -> List[str]:
+        """Persist the current versions' observed traffic shapes so the
+        next replica warms before taking traffic. Returns written paths."""
+        written = []
+        with self._lock:
+            currents = list(self._current.values())
+        for mv in currents:
+            path = mv.engine.manifest_path
+            if not path:
+                continue
+            try:
+                written.append(mv.engine.save_manifest(path))
+            except (OSError, ValueError) as e:
+                log.warning("warmup manifest save for %s:%s failed (%s)",
+                            mv.name, mv.version, e)
+        return written
+
+    # -- deployment -------------------------------------------------------
+    def deploy(self, name: str, version: str, model, *,
+               outputs: Optional[Sequence[Any]] = None,
+               max_batch: Optional[int] = None,
+               buckets: Optional[Sequence[int]] = None,
+               max_delay_ms: float = 2.0,
+               warm: bool = True,
+               example=None,
+               batch_sizes: Optional[Sequence[int]] = None,
+               drain_timeout_s: Optional[float] = None) -> ModelVersion:
+        """Deploy ``model`` as ``name``:``version`` with warm-before-
+        cutover; returns the new (current) ModelVersion.
+
+        With ``warm=True`` (default) the incoming engine compiles its
+        buckets before the swap, from the first available source:
+        ``example`` (optionally narrowed by ``batch_sizes``) > the live
+        observed shapes of the outgoing version > the model's on-disk
+        warmup manifest. ``warm=False`` cuts over immediately in the
+        ``warming`` state — ``/readyz`` stays false until ``warm()``
+        runs. The outgoing version drains in-flight requests and is
+        parked warm for rollback."""
+        name, version = str(name), str(version)
+        with self._lock:
+            if self._draining:
+                raise RuntimeError("registry is draining; no new deploys")
+            for mv in self._versions.get(name, ()):
+                if mv.version == version:
+                    raise ValueError(
+                        f"model '{name}' version '{version}' is already "
+                        "deployed (versions are immutable; bump the "
+                        "version)")
+            outgoing = self._current.get(name)
+        engine = InferenceEngine(model, max_batch=max_batch,
+                                 buckets=buckets, max_delay_ms=max_delay_ms,
+                                 outputs=outputs,
+                                 manifest_path=self.manifest_path(name))
+        mv = ModelVersion(name, version, engine)
+        if warm:
+            self._warm_engine(engine, outgoing, example, batch_sizes)
+            mv.state = READY
+        # atomic cutover: one pointer swap under the lock
+        with self._lock:
+            if self._draining:
+                engine.close(0.0)
+                raise RuntimeError("registry is draining; no new deploys")
+            self._versions.setdefault(name, []).append(mv)
+            self._current[name] = mv
+        self._m_deploys.labels(model=name).inc()
+        # the outgoing engine finishes its in-flight work, then parks
+        if outgoing is not None:
+            outgoing.engine.drain(
+                drain_timeout_s if drain_timeout_s is not None
+                else environment().serving_drain_timeout_s())
+            outgoing.state = RETIRED
+        self._prune(name)
+        log.info("deployed %s:%s (%s)%s", name, version, mv.state,
+                 f", replacing {outgoing.version}" if outgoing else "")
+        return mv
+
+    def _warm_engine(self, engine: InferenceEngine,
+                     outgoing: Optional[ModelVersion], example,
+                     batch_sizes) -> List[int]:
+        if example is not None:
+            return engine.warmup(example, batch_sizes=batch_sizes)
+        if outgoing is not None:
+            entries = outgoing.engine.observed_entries()
+            if entries:
+                return engine.warmup(entries=entries)
+        return engine.warmup()  # on-disk manifest of a previous replica
+
+    def warm(self, name: str, example=None,
+             batch_sizes: Optional[Sequence[int]] = None) -> List[int]:
+        """Warm the *current* version of ``name`` (the deferred half of a
+        ``deploy(warm=False)``) and flip it ready."""
+        mv = self.get(name)
+        buckets = self._warm_engine(mv.engine, None, example, batch_sizes)
+        mv.state = READY
+        return buckets
+
+    # -- resolution -------------------------------------------------------
+    def get(self, name: str, version: Optional[str] = None) -> ModelVersion:
+        """The current ModelVersion of ``name``, or a pinned version.
+        Raises KeyError when unknown."""
+        with self._lock:
+            if version is None:
+                mv = self._current.get(name)
+                if mv is None:
+                    raise KeyError(f"no model '{name}' deployed")
+                return mv
+            for mv in self._versions.get(name, ()):
+                if mv.version == str(version):
+                    return mv
+        raise KeyError(f"model '{name}' has no version '{version}'")
+
+    def models(self) -> Dict[str, Dict[str, Any]]:
+        """Listing for ``GET /v1/models``."""
+        with self._lock:
+            return {name: {
+                "current": self._current[name].version
+                if name in self._current else None,
+                "versions": [mv.describe() for mv in versions],
+            } for name, versions in sorted(self._versions.items())}
+
+    def ready(self) -> bool:
+        """Readiness: not draining, and every deployed model's current
+        version is warmed. (An empty registry is ready — liveness is
+        /healthz's job.)"""
+        with self._lock:
+            return not self._draining and all(
+                mv.state == READY for mv in self._current.values())
+
+    # -- prediction -------------------------------------------------------
+    def predict(self, name: str, request,
+                version: Optional[str] = None,
+                timeout_s: Optional[float] = None):
+        """Route one request through the micro-batcher of the resolved
+        version. A request that races a hot swap (the engine drains
+        between resolution and dispatch) is transparently retried against
+        the replacement — in-flight traffic never fails on a deploy or
+        rollback. TimeoutError propagates when ``timeout_s`` expires
+        before dispatch."""
+        last_exc: Optional[Exception] = None
+        for _ in range(4):
+            mv = self.get(name, version)
+            try:
+                try:
+                    return mv.engine.submit(request,
+                                            timeout_s=timeout_s).result()
+                except ValueError:
+                    # batch larger than max_batch: the chunked sync path
+                    # (re-raises genuine bad-request errors itself)
+                    return mv.engine.infer(request)
+            except EngineClosedError as e:
+                last_exc = e
+                if version is not None:
+                    raise  # pinned to a retired/closed version: surface it
+                continue  # current was swapped mid-flight; re-resolve
+        raise last_exc  # registry is shutting down (drain_all)
+
+    # -- rollback / retention ---------------------------------------------
+    def rollback(self, name: str,
+                 drain_timeout_s: Optional[float] = None) -> ModelVersion:
+        """Repoint ``name`` to the previous retained version (its engine
+        re-admits instantly — executables never left the process). The
+        rolled-away-from version drains and is parked."""
+        with self._lock:
+            versions = self._versions.get(name)
+            if not versions:
+                raise KeyError(f"no model '{name}' deployed")
+            cur = self._current[name]
+            idx = versions.index(cur)
+            if idx == 0:
+                raise RuntimeError(
+                    f"model '{name}' has no retained version to roll "
+                    f"back to (current: {cur.version})")
+            target = versions[idx - 1]
+            target.engine.start()  # reverse the park-drain
+            target.state = READY
+            self._current[name] = target
+        cur.engine.drain(drain_timeout_s if drain_timeout_s is not None
+                         else environment().serving_drain_timeout_s())
+        cur.state = RETIRED
+        self._m_rollbacks.labels(model=name).inc()
+        log.info("rolled back %s: %s -> %s", name, cur.version,
+                 target.version)
+        return target
+
+    def _prune(self, name: str):
+        """Close and drop the oldest non-current versions beyond the
+        retention cap."""
+        to_close: List[ModelVersion] = []
+        with self._lock:
+            versions = self._versions.get(name, [])
+            cur = self._current.get(name)
+            others = [mv for mv in versions if mv is not cur]
+            excess = len(others) - self.retain
+            if excess > 0:
+                for mv in others[:excess]:
+                    versions.remove(mv)
+                    to_close.append(mv)
+        for mv in to_close:
+            mv.engine.close(environment().serving_drain_timeout_s())
+            log.info("evicted %s:%s beyond retain=%d", name, mv.version,
+                     self.retain)
+
+    def undeploy(self, name: str,
+                 drain_timeout_s: Optional[float] = None):
+        """Drain and permanently close every version of ``name``."""
+        with self._lock:
+            versions = self._versions.pop(name, [])
+            self._current.pop(name, None)
+        t = (drain_timeout_s if drain_timeout_s is not None
+             else environment().serving_drain_timeout_s())
+        for mv in versions:
+            mv.engine.close(t)
+            mv.state = RETIRED
+        return self
+
+    # -- graceful drain ---------------------------------------------------
+    def drain_all(self, timeout_s: Optional[float] = None,
+                  save_manifests: bool = True) -> bool:
+        """SIGTERM path: stop serving, flush every engine's micro-batcher,
+        and (by default) save the warmup manifests the next replica warms
+        from. Idempotent. Returns True when everything drained in time."""
+        t = (timeout_s if timeout_s is not None
+             else environment().serving_drain_timeout_s())
+        with self._lock:
+            self._draining = True
+            versions = [mv for vs in self._versions.values() for mv in vs]
+        if save_manifests:
+            self.save_manifests()
+        ok = True
+        for mv in versions:
+            ok = mv.engine.close(t) and ok
+            mv.state = RETIRED
+        return ok

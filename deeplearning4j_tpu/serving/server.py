@@ -1,0 +1,253 @@
+"""Model-serving HTTP front end (stdlib ThreadingHTTPServer).
+
+Reference: the Vertx remote-router endpoints mirrored by `ui/server.py`,
+grown into a production serving surface:
+
+    POST /v1/models/<name>/predict            current version
+    POST /v1/models/<name>:<version>/predict  pinned version
+    GET  /v1/models                           registry listing
+    GET  /healthz                             liveness (process is up)
+    GET  /readyz                              readiness (all current
+                                              versions warmed, not
+                                              draining) — 503 otherwise
+    GET  /metrics, /metrics.json              shared Prometheus/JSON
+                                              exposition (PR 3 registry)
+
+Request bodies are JSON (``{"inputs": ..., "timeout_s": ...}`` — a list
+becomes one array, a dict maps input/placeholder names for graph/SameDiff
+models) or a raw ``.npy`` payload (``Content-Type: application/x-npy``;
+the response mirrors the format). Deadlines propagate: the JSON
+``timeout_s`` (or ``X-Request-Timeout-S`` header) bounds admission wait
+AND micro-batcher queueing; an expired request answers 504 without ever
+occupying a batch slot. Overload answers 429 with a ``Retry-After`` hint
+from the admission controller. Status mapping: 404 unknown model/version,
+400 malformed input, 409 pinned to a retired version, 503 draining.
+"""
+from __future__ import annotations
+
+import io
+import json
+import logging
+import re
+import threading
+from typing import Dict, Optional
+from urllib.parse import urlparse
+
+import numpy as np
+
+from ..common.httpserver import (JsonRequestHandler,
+                                 QuietThreadingHTTPServer, metrics_payload)
+from ..runtime.inference import EngineClosedError
+from .admission import AdmissionController, DeadlineExceededError, ShedError
+from .registry import ModelRegistry
+
+log = logging.getLogger(__name__)
+
+_PREDICT_RE = re.compile(r"^/v1/models/([^/:]+)(?::([^/]+))?/predict$")
+_NPY_TYPES = ("application/x-npy", "application/octet-stream")
+
+
+def _np_cast(a: np.ndarray) -> np.ndarray:
+    """JSON numbers arrive as f64/i64; the frontends run f32/i32 (x64 is
+    disabled)."""
+    if a.dtype == np.float64:
+        return a.astype(np.float32)
+    if a.dtype == np.int64:
+        return a.astype(np.int32)
+    return a
+
+
+def _parse_inputs(obj):
+    if isinstance(obj, dict):
+        return {k: _np_cast(np.asarray(v)) for k, v in obj.items()}
+    return _np_cast(np.asarray(obj))
+
+
+def _jsonable_outputs(out):
+    def arr(x):
+        return np.asarray(x.jax() if hasattr(x, "jax") else x).tolist()
+
+    if isinstance(out, dict):
+        return {k: arr(v) for k, v in out.items()}
+    if isinstance(out, (list, tuple)):
+        return [arr(v) for v in out]
+    return arr(out)
+
+
+class ModelServer:
+    """HTTP server over a ModelRegistry with per-model admission control.
+
+    One ``AdmissionController`` per model, created on first use from the
+    ``DL4J_TPU_SERVING_*`` env knobs (or the constructor overrides);
+    ``set_admission()`` swaps in a custom-tuned controller."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_concurrent: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 high_water: Optional[int] = None):
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.host = host
+        self.port = port
+        self.draining = False
+        self._admission_kwargs = dict(max_concurrent=max_concurrent,
+                                      queue_depth=queue_depth,
+                                      high_water=high_water)
+        self._admission: Dict[str, AdmissionController] = {}
+        self._admission_lock = threading.Lock()
+        self._httpd: Optional[QuietThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- admission plumbing -----------------------------------------------
+    def admission_for(self, name: str) -> AdmissionController:
+        ctrl = self._admission.get(name)
+        if ctrl is None:
+            with self._admission_lock:
+                ctrl = self._admission.get(name)
+                if ctrl is None:
+                    ctrl = AdmissionController(name,
+                                               **self._admission_kwargs)
+                    self._admission[name] = ctrl
+        return ctrl
+
+    def set_admission(self, name: str, controller: AdmissionController):
+        with self._admission_lock:
+            self._admission[name] = controller
+        return self
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> int:
+        """Serve on a daemon thread; returns the bound port."""
+        self._httpd = QuietThreadingHTTPServer((self.host, self.port),
+                                               self._handler())
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="dl4j-tpu-model-server",
+                                        daemon=True)
+        self._thread.start()
+        log.info("model server on %s:%d", self.host, self.port)
+        return self.port
+
+    def begin_drain(self):
+        """Flip readiness off and shed all new work (the first step of a
+        graceful shutdown; the HTTP socket stays up so load balancers see
+        the 503s and drain routing)."""
+        self.draining = True
+        with self._admission_lock:
+            for ctrl in self._admission.values():
+                ctrl.close()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        return self
+
+    # -- handler ----------------------------------------------------------
+    def _handler(self):
+        server = self
+
+        class Handler(JsonRequestHandler):
+            def do_GET(self):
+                path = urlparse(self.path).path
+                if path == "/healthz":
+                    self.send_payload(b"ok", "text/plain")
+                elif path == "/readyz":
+                    ready = not server.draining and server.registry.ready()
+                    self.send_json(
+                        {"ready": ready, "draining": server.draining,
+                         "models": server.registry.models()},
+                        200 if ready else 503)
+                elif path == "/v1/models":
+                    self.send_json({"models": server.registry.models()})
+                elif path == "/metrics":
+                    self.send_payload(*metrics_payload())
+                elif path == "/metrics.json":
+                    self.send_payload(*metrics_payload("json"))
+                else:
+                    self.send_json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                m = _PREDICT_RE.match(urlparse(self.path).path)
+                if not m:
+                    self.send_json({"error": "not found"}, 404)
+                    return
+                name, version = m.group(1), m.group(2)
+                if server.draining:
+                    self.send_json(
+                        {"error": "server is draining"}, 503,
+                        headers=[("Retry-After", "1")])
+                    return
+                try:
+                    self._predict(name, version)
+                except KeyError as e:
+                    self.send_json({"error": str(e.args[0])}, 404)
+                except ShedError as e:
+                    retry = max(1, int(np.ceil(e.retry_after_s)))
+                    self.send_json(
+                        {"error": str(e),
+                         "retry_after_s": round(e.retry_after_s, 3)},
+                        429, headers=[("Retry-After", retry)])
+                except (DeadlineExceededError, TimeoutError) as e:
+                    self.send_json({"error": f"deadline exceeded: {e}"},
+                                   504)
+                except EngineClosedError as e:
+                    # a version pinned to a retired/drained engine: a
+                    # routine routing miss, not a server fault
+                    self.send_json({"error": str(e)}, 409)
+                except (ValueError, TypeError) as e:
+                    self.send_json({"error": f"bad request: {e}"}, 400)
+                except Exception as e:  # the server must outlive any model
+                    log.exception("predict failed for %s", name)
+                    self.send_json(
+                        {"error": f"{type(e).__name__}: {e}"}, 500)
+
+            def _predict(self, name: str, version: Optional[str]):
+                body = self.read_body()
+                ctype = (self.headers.get("Content-Type") or
+                         "application/json").split(";")[0].strip()
+                timeout_s = None
+                hdr = self.headers.get("X-Request-Timeout-S")
+                if hdr:
+                    timeout_s = float(hdr)
+                if ctype in _NPY_TYPES:
+                    request = _np_cast(
+                        np.load(io.BytesIO(body), allow_pickle=False))
+                    as_npy = True
+                else:
+                    doc = json.loads(body or b"{}")
+                    if "inputs" not in doc:
+                        raise ValueError('JSON body must carry "inputs"')
+                    request = _parse_inputs(doc["inputs"])
+                    if doc.get("timeout_s") is not None:
+                        timeout_s = float(doc["timeout_s"])
+                    as_npy = False
+                # resolve first so unknown models 404 before admission
+                mv = server.registry.get(name, version)
+                ctrl = server.admission_for(name)
+                with ctrl.admit(timeout_s if timeout_s is not None
+                                else "default",
+                                version=mv.version) as permit:
+                    out = server.registry.predict(
+                        name, request, version=version,
+                        timeout_s=permit.remaining_s())
+                    mv = server.registry.get(name, version)
+                if as_npy:
+                    first = out
+                    if isinstance(out, dict):
+                        first = next(iter(out.values()))
+                    elif isinstance(out, (list, tuple)):
+                        first = out[0]
+                    buf = io.BytesIO()
+                    np.save(buf, np.asarray(
+                        first.jax() if hasattr(first, "jax") else first))
+                    self.send_payload(
+                        buf.getvalue(), "application/x-npy",
+                        headers=[("X-Model-Version", mv.version)])
+                else:
+                    self.send_json({"model": name, "version": mv.version,
+                                    "outputs": _jsonable_outputs(out)})
+
+        return Handler

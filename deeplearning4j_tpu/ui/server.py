@@ -4,7 +4,9 @@ Reference: `deeplearning4j-vertx/.../VertxUIServer.java:78` serving the
 train module (`module/train/TrainModule.java`) over HTTP, plus the remote
 POST endpoints used by RemoteUIStatsStorageRouter.
 
-stdlib http.server; endpoints:
+stdlib http.server via the shared handler base in `common/httpserver.py`
+(Content-Length on every response, client disconnects without stack
+traces — same hygiene as the serving front end); endpoints:
   GET  /                      dashboard (score chart, param norms, ratios)
   GET  /train/sessions        session id list
   GET  /train/overview?sid=   static info + updates
@@ -16,11 +18,11 @@ from __future__ import annotations
 
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
-from ..common.environment import environment
+from ..common.httpserver import (JsonRequestHandler,
+                                 QuietThreadingHTTPServer, metrics_payload)
 from .stats import BaseStatsStorage, InMemoryStatsStorage
 
 _PAGE = """<!DOCTYPE html>
@@ -231,75 +233,51 @@ class UIServer:
     def _handler(self):
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def _json(self, obj, code=200):
-                body = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
+        class Handler(JsonRequestHandler):
             def do_GET(self):
                 url = urlparse(self.path)
                 if url.path in ("/", "/train", "/train/"):
-                    body = _PAGE.encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/html")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self.send_payload(_PAGE.encode(), "text/html")
                 elif url.path == "/metrics":
                     # Prometheus text exposition of the process registry
                     # (training + serving instrumentation alike)
-                    body = environment().metrics().prometheus_text().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4; "
-                                     "charset=utf-8")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self.send_payload(*metrics_payload())
                 elif url.path == "/metrics.json":
-                    self._json(environment().metrics().snapshot())
+                    self.send_payload(*metrics_payload("json"))
                 elif url.path == "/train/sessions":
-                    self._json(server.storage.list_session_ids())
+                    self.send_json(server.storage.list_session_ids())
                 elif url.path == "/train/overview":
                     q = parse_qs(url.query)
                     sid = q.get("sid", [""])[0]
                     if not sid:
                         ids = server.storage.list_session_ids()
                         sid = ids[-1] if ids else ""
-                    self._json({
+                    self.send_json({
                         "static": server.storage.get_static_info(sid),
                         "updates": server.storage.get_updates(sid),
                     })
                 else:
-                    self._json({"error": "not found"}, 404)
+                    self.send_json({"error": "not found"}, 404)
 
             def do_POST(self):
-                n = int(self.headers.get("Content-Length", 0))
-                payload = json.loads(self.rfile.read(n) or b"{}")
+                payload = json.loads(self.read_body() or b"{}")
                 if self.path == "/remote/static":
                     server.storage.put_static_info(payload["session"],
                                                    payload["data"])
-                    self._json({"ok": True})
+                    self.send_json({"ok": True})
                 elif self.path == "/remote/update":
                     server.storage.put_update(payload["session"],
                                               payload["data"])
-                    self._json({"ok": True})
+                    self.send_json({"ok": True})
                 else:
-                    self._json({"error": "not found"}, 404)
+                    self.send_json({"error": "not found"}, 404)
 
         return Handler
 
     def start(self) -> int:
         """Start serving (daemon thread); returns the bound port."""
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
-                                          self._handler())
+        self._httpd = QuietThreadingHTTPServer(("127.0.0.1", self.port),
+                                               self._handler())
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)

@@ -1,0 +1,757 @@
+"""Production serving subsystem (serving/ + the engine lifecycle hooks).
+
+Covers the acceptance contract of the serving PR: deploy -> serve over
+HTTP -> hot-swap with zero failed in-flight requests -> rollback;
+admission shedding under synthetic overload (429 + retry-after, bounded
+queue); deadline expiry before dispatch; /readyz flipping only after
+warmup; SIGTERM graceful drain saving warmup manifests; and the
+InferenceEngine drain()/close()/deadline satellites.
+"""
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.environment import environment
+from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.runtime import compile_cache
+from deeplearning4j_tpu.runtime.inference import (EngineClosedError,
+                                                  InferenceEngine)
+from deeplearning4j_tpu.serving import (AdmissionController,
+                                        DeadlineExceededError,
+                                        GracefulLifecycle, ModelRegistry,
+                                        ModelServer, ShedError)
+
+N_IN, N_OUT = 6, 3
+
+
+def _mlp(seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=N_IN, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=N_OUT))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _x(n=4, seed=0):
+    return np.random.RandomState(seed).randn(n, N_IN).astype(np.float32)
+
+
+def _get(url, timeout=10):
+    """(status, headers, parsed-or-raw body) without raising on 4xx/5xx."""
+    try:
+        r = urllib.request.urlopen(url, timeout=timeout)
+        body = r.read()
+        return r.status, r.headers, body
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, e.read()
+
+
+def _post(url, data, content_type="application/json", timeout=30,
+          headers=()):
+    req = urllib.request.Request(url, data=data,
+                                 headers={"Content-Type": content_type,
+                                          **dict(headers)})
+    try:
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, r.headers, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, e.read()
+
+
+# ---------------------------------------------------------------------------
+# InferenceEngine drain/close/deadline satellites
+# ---------------------------------------------------------------------------
+
+class TestEngineDrainClose:
+    def test_drain_flushes_queued_requests(self):
+        eng = InferenceEngine(_mlp(), max_batch=8, max_delay_ms=50.0)
+        futs = [eng.submit(_x(2, seed=i)) for i in range(3)]
+        assert eng.drain(timeout_s=30)
+        for f in futs:
+            out = f.result(timeout=5)  # resolved, not dropped
+            assert np.asarray(out.jax()).shape == (2, N_OUT)
+
+    def test_submit_after_drain_raises(self):
+        eng = InferenceEngine(_mlp(), max_batch=8)
+        eng.drain()
+        with pytest.raises(EngineClosedError, match="draining"):
+            eng.submit(_x())
+
+    def test_submit_after_close_raises(self):
+        # the regression the satellite asks for: a late submit must fail
+        # with a clear error, not hang on a dead batcher thread
+        eng = InferenceEngine(_mlp(), max_batch=8)
+        eng.submit(_x()).result(timeout=10)
+        eng.close()
+        with pytest.raises(EngineClosedError, match="closed"):
+            eng.submit(_x())
+
+    def test_infer_after_close_raises(self):
+        eng = InferenceEngine(_mlp(), max_batch=8)
+        eng.close()
+        with pytest.raises(EngineClosedError):
+            eng.infer(_x())
+
+    def test_drain_and_close_are_idempotent(self):
+        eng = InferenceEngine(_mlp(), max_batch=8)
+        assert eng.drain()
+        assert eng.drain()
+        assert eng.close()
+        assert eng.close()
+        assert eng.closed
+
+    def test_start_reverses_drain_but_not_close(self):
+        eng = InferenceEngine(_mlp(), max_batch=8)
+        eng.drain()
+        assert eng.draining
+        eng.start()  # a rollback re-admits a parked engine
+        out = eng.submit(_x()).result(timeout=10)
+        assert np.asarray(out.jax()).shape == (4, N_OUT)
+        eng.close()
+        with pytest.raises(EngineClosedError, match="cannot be restarted"):
+            eng.start()
+
+    def test_context_manager_still_works(self):
+        with InferenceEngine(_mlp(), max_batch=8) as eng:
+            assert eng.submit(_x()).result(timeout=10) is not None
+        # stop() (not close): the engine stays usable
+        assert eng.submit(_x()).result(timeout=10) is not None
+
+
+class TestEngineDeadline:
+    def test_expired_request_resolves_with_timeout_error(self):
+        eng = InferenceEngine(_mlp(), max_batch=8)
+        fut = eng.submit(_x(), timeout_s=0.0)  # already expired at pop
+        with pytest.raises(TimeoutError):
+            fut.result(timeout=10)
+
+    def test_unexpired_request_serves_normally(self):
+        eng = InferenceEngine(_mlp(), max_batch=8)
+        out = eng.submit(_x(), timeout_s=30.0).result(timeout=10)
+        assert np.asarray(out.jax()).shape == (4, N_OUT)
+
+    def test_expired_does_not_poison_live_requests(self):
+        eng = InferenceEngine(_mlp(), max_batch=8, max_delay_ms=20.0)
+        dead = eng.submit(_x(2, seed=1), timeout_s=0.0)
+        live = eng.submit(_x(2, seed=2), timeout_s=30.0)
+        out = live.result(timeout=10)
+        assert np.asarray(out.jax()).shape == (2, N_OUT)
+        with pytest.raises(TimeoutError):
+            dead.result(timeout=10)
+
+    def test_expiry_counted_in_metrics(self):
+        reg = environment().metrics()
+        fam = reg.counter("dl4j_inference_deadline_expired_total")
+        before = fam.value()
+        eng = InferenceEngine(_mlp(), max_batch=8)
+        with pytest.raises(TimeoutError):
+            eng.submit(_x(), timeout_s=0.0).result(timeout=10)
+        assert fam.value() >= before + 1
+
+
+class TestEngineManifestHandoff:
+    def test_observed_entries_round_trip(self):
+        eng = InferenceEngine(_mlp(), max_batch=8)
+        eng.infer(_x(3))
+        entries = eng.observed_entries()
+        assert entries and entries[0]["buckets"] == [4]  # 3 -> bucket 4
+        eng2 = InferenceEngine(_mlp(1), max_batch=8)
+        warmed = eng2.warmup(entries=entries)
+        assert warmed == [4]
+        assert len(eng2._warmed) == 1
+
+
+# ---------------------------------------------------------------------------
+# ModelRegistry: deploy / hot swap / rollback
+# ---------------------------------------------------------------------------
+
+class TestModelRegistry:
+    def test_deploy_and_predict(self):
+        reg = ModelRegistry(manifest_dir=None)
+        mv = reg.deploy("m", "v1", _mlp(), example=_x())
+        assert mv.state == "ready"
+        out = reg.predict("m", _x())
+        np.testing.assert_allclose(np.asarray(out.jax()),
+                                   np.asarray(_mlp().output(_x()).jax()),
+                                   rtol=1e-5)
+
+    def test_deploy_warms_before_cutover(self):
+        reg = ModelRegistry(manifest_dir=None)
+        mv = reg.deploy("m", "v1", _mlp(), example=_x())
+        # the ladder compiled before any traffic: warmup keys recorded
+        assert len(mv.engine._warmed) == len(mv.engine.ladder)
+
+    def test_duplicate_version_rejected(self):
+        reg = ModelRegistry(manifest_dir=None)
+        reg.deploy("m", "v1", _mlp(), example=_x())
+        with pytest.raises(ValueError, match="already"):
+            reg.deploy("m", "v1", _mlp(1))
+
+    def test_unknown_model_and_version_raise_keyerror(self):
+        reg = ModelRegistry(manifest_dir=None)
+        with pytest.raises(KeyError):
+            reg.get("nope")
+        reg.deploy("m", "v1", _mlp(), example=_x())
+        with pytest.raises(KeyError):
+            reg.get("m", "v9")
+
+    def test_hot_swap_repoints_and_drains_old(self):
+        reg = ModelRegistry(manifest_dir=None)
+        v1 = reg.deploy("m", "v1", _mlp(0), example=_x())
+        v2 = reg.deploy("m", "v2", _mlp(1), example=_x())
+        assert reg.get("m") is v2
+        assert v1.state == "retired"
+        assert v1.engine.draining and not v1.engine.closed  # parked warm
+        out = reg.predict("m", _x())
+        np.testing.assert_allclose(
+            np.asarray(out.jax()),
+            np.asarray(_mlp(1).output(_x()).jax()), rtol=1e-5)
+
+    def test_swap_warms_incoming_from_outgoing_traffic(self):
+        # no example given on the v2 deploy: its engine warms from the
+        # shapes v1 actually served (the in-process manifest handoff)
+        reg = ModelRegistry(manifest_dir=None)
+        reg.deploy("m", "v1", _mlp(0), example=None, warm=False)
+        reg.warm("m")  # nothing to warm: flips ready with no sources
+        reg.predict("m", _x(3))
+        reg.predict("m", _x(7))
+        v2 = reg.deploy("m", "v2", _mlp(1))
+        warmed_buckets = {b for b, _ in v2.engine._warmed}
+        assert warmed_buckets == {4, 8}  # 3 -> 4, 7 -> 8
+
+    def test_rollback_repoints_to_previous(self):
+        reg = ModelRegistry(manifest_dir=None)
+        reg.deploy("m", "v1", _mlp(0), example=_x())
+        reg.deploy("m", "v2", _mlp(1), example=_x())
+        back = reg.rollback("m")
+        assert back.version == "v1"
+        assert reg.get("m").version == "v1"
+        out = reg.predict("m", _x())  # v1 engine re-admitted instantly
+        np.testing.assert_allclose(
+            np.asarray(out.jax()),
+            np.asarray(_mlp(0).output(_x()).jax()), rtol=1e-5)
+
+    def test_rollback_without_previous_raises(self):
+        reg = ModelRegistry(manifest_dir=None)
+        reg.deploy("m", "v1", _mlp(), example=_x())
+        with pytest.raises(RuntimeError, match="no retained version"):
+            reg.rollback("m")
+
+    def test_retention_cap_closes_oldest(self):
+        reg = ModelRegistry(manifest_dir=None, retain=1)
+        v1 = reg.deploy("m", "v1", _mlp(0), example=_x())
+        reg.deploy("m", "v2", _mlp(1), example=_x())
+        reg.deploy("m", "v3", _mlp(2), example=_x())
+        assert v1.engine.closed  # evicted beyond retain=1
+        with pytest.raises(KeyError):
+            reg.get("m", "v1")
+        assert reg.get("m", "v2") is not None  # retained for rollback
+
+    def test_pinned_version_predict(self):
+        reg = ModelRegistry(manifest_dir=None)
+        reg.deploy("m", "v1", _mlp(0), example=_x())
+        reg.deploy("m", "v2", _mlp(1), example=_x())
+        reg.rollback("m")  # v2 parked again, v1 current
+        out = reg.predict("m", _x())
+        np.testing.assert_allclose(
+            np.asarray(out.jax()),
+            np.asarray(_mlp(0).output(_x()).jax()), rtol=1e-5)
+        # pinning a retired version surfaces the closed error
+        with pytest.raises(EngineClosedError):
+            reg.predict("m", _x(), version="v2")
+
+    def test_hot_swap_zero_failed_inflight(self):
+        """The acceptance bar: deploy + rollback under concurrent traffic
+        with not one failed request."""
+        reg = ModelRegistry(manifest_dir=None)
+        reg.deploy("m", "v1", _mlp(0), example=_x())
+        errors, done = [], threading.Event()
+
+        def client(seed):
+            x = _x(2, seed=seed)
+            while not done.is_set():
+                try:
+                    out = reg.predict("m", x)
+                    assert np.asarray(out.jax()).shape == (2, N_OUT)
+                except Exception as e:  # noqa: BLE001 - the test IS this
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        reg.deploy("m", "v2", _mlp(1))
+        time.sleep(0.1)
+        reg.rollback("m")
+        time.sleep(0.1)
+        done.set()
+        for t in threads:
+            t.join()
+        assert errors == []
+
+    def test_models_listing(self):
+        reg = ModelRegistry(manifest_dir=None)
+        reg.deploy("a", "v1", _mlp(), example=_x())
+        reg.deploy("a", "v2", _mlp(1), example=_x())
+        listing = reg.models()
+        assert listing["a"]["current"] == "v2"
+        assert [v["version"] for v in listing["a"]["versions"]] == \
+            ["v1", "v2"]
+        assert listing["a"]["versions"][1]["state"] == "ready"
+
+    def test_manifest_saved_and_replayed_across_registries(self, tmp_path):
+        d = str(tmp_path)
+        reg = ModelRegistry(manifest_dir=d)
+        reg.deploy("m", "v1", _mlp(), warm=False)
+        reg.warm("m")
+        reg.predict("m", _x(5))  # observed: bucket 8
+        reg.drain_all()
+        assert os.path.exists(os.path.join(d, "m.warmup.json"))
+        # the "next replica": same manifest dir, fresh registry/model
+        reg2 = ModelRegistry(manifest_dir=d)
+        mv = reg2.deploy("m", "v1", _mlp(1))  # no example, no outgoing
+        assert {b for b, _ in mv.engine._warmed} == {8}
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_admits_within_capacity(self):
+        ctrl = AdmissionController("m", max_concurrent=2, queue_depth=4,
+                                   high_water=3)
+        assert ctrl.run(lambda: 42) == 42
+
+    def test_sheds_past_high_water(self):
+        ctrl = AdmissionController("m", max_concurrent=1, queue_depth=4,
+                                   high_water=1, default_timeout_s=None)
+        release = threading.Event()
+        started = threading.Event()
+
+        def hog():
+            with ctrl.admit():
+                started.set()
+                release.wait(10)
+
+        def waiter():
+            with ctrl.admit():
+                pass
+
+        t1 = threading.Thread(target=hog)
+        t1.start()
+        started.wait(5)
+        t2 = threading.Thread(target=waiter)
+        t2.start()
+        for _ in range(100):  # until t2 is queued
+            if ctrl.depth() >= 1:
+                break
+            time.sleep(0.01)
+        with pytest.raises(ShedError) as ei:
+            ctrl.admit()
+        assert ei.value.retry_after_s > 0
+        release.set()
+        t1.join()
+        t2.join()
+
+    def test_shed_happens_before_dispatch(self):
+        ctrl = AdmissionController("m", max_concurrent=1, queue_depth=1,
+                                   high_water=1, default_timeout_s=None)
+        calls = []
+        hold = threading.Event()
+        go = threading.Event()
+
+        def hog():
+            ctrl.run(lambda: (go.set(), hold.wait(10)))
+
+        t = threading.Thread(target=hog)
+        t.start()
+        go.wait(5)
+        waiter = threading.Thread(
+            target=lambda: ctrl.run(lambda: calls.append("late")))
+        waiter.start()
+        for _ in range(100):
+            if ctrl.depth() >= 1:
+                break
+            time.sleep(0.01)
+        with pytest.raises(ShedError):
+            ctrl.run(lambda: calls.append("shed"))  # fn must NOT run
+        assert "shed" not in calls
+        hold.set()
+        t.join()
+        waiter.join()
+        assert calls == ["late"]
+
+    def test_deadline_expires_while_waiting(self):
+        ctrl = AdmissionController("m", max_concurrent=1, queue_depth=8,
+                                   high_water=8)
+        hold = threading.Event()
+        go = threading.Event()
+        t = threading.Thread(
+            target=lambda: ctrl.run(lambda: (go.set(), hold.wait(10))))
+        t.start()
+        go.wait(5)
+        calls = []
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceededError):
+            ctrl.run(lambda: calls.append("ran"), timeout_s=0.05)
+        assert time.monotonic() - t0 < 5  # expired on budget, not later
+        assert calls == []  # shed before dispatch, never after
+        hold.set()
+        t.join()
+
+    def test_fifo_fairness_no_barging(self):
+        """A releaser immediately re-arriving must queue behind the
+        waiter, not starve it (the tail the serving_overload p99 gate
+        measures)."""
+        ctrl = AdmissionController("m", max_concurrent=1, queue_depth=8,
+                                   high_water=8, default_timeout_s=None)
+        order = []
+        lock = threading.Lock()
+
+        def client(name, n):
+            for i in range(n):
+                with ctrl.admit():
+                    with lock:
+                        order.append(name)
+                    time.sleep(0.002)
+
+        threads = [threading.Thread(target=client, args=(i, 10))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # fair interleaving: no client runs many turns back-to-back while
+        # others wait (with barging, runs of 10 were routine)
+        longest_run, run = 1, 1
+        for a, b in zip(order, order[1:]):
+            run = run + 1 if a == b else 1
+            longest_run = max(longest_run, run)
+        assert longest_run <= 3, order
+
+    def test_close_sheds_waiters_and_new_arrivals(self):
+        ctrl = AdmissionController("m", max_concurrent=1, queue_depth=8,
+                                   high_water=8, default_timeout_s=None)
+        hold = threading.Event()
+        go = threading.Event()
+        results = []
+
+        def hog():
+            with ctrl.admit():
+                go.set()
+                hold.wait(10)
+
+        def waiter():
+            try:
+                with ctrl.admit():
+                    results.append("ran")
+            except ShedError:
+                results.append("shed")
+
+        t1 = threading.Thread(target=hog)
+        t1.start()
+        go.wait(5)
+        t2 = threading.Thread(target=waiter)
+        t2.start()
+        for _ in range(100):
+            if ctrl.depth() >= 1:
+                break
+            time.sleep(0.01)
+        ctrl.close()
+        t2.join(5)
+        assert results == ["shed"]
+        with pytest.raises(ShedError, match="draining"):
+            ctrl.admit()
+        hold.set()
+        t1.join()
+
+    def test_metrics_labeled_per_model_and_version(self):
+        reg = environment().metrics()
+        ctrl = AdmissionController("labeled-model", max_concurrent=2,
+                                   queue_depth=4, high_water=3)
+        ctrl.run(lambda: None, version="v7")
+        fam = reg.get("dl4j_serving_requests_total")
+        series = {key for key, _ in fam.children()}
+        assert ("labeled-model", "v7", "ok") in series
+        lat = reg.get("dl4j_serving_queue_seconds")
+        assert ("labeled-model", "v7") in {k for k, _ in lat.children()}
+
+
+# ---------------------------------------------------------------------------
+# HTTP server
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def served():
+    reg = ModelRegistry(manifest_dir=None)
+    reg.deploy("mlp", "v1", _mlp(0), example=_x())
+    server = ModelServer(reg)
+    port = server.start()
+    yield reg, server, f"http://127.0.0.1:{port}"
+    server.stop()
+    reg.drain_all(save_manifests=False)
+
+
+class TestModelServer:
+    def test_predict_json(self, served):
+        reg, server, base = served
+        code, headers, body = _post(
+            base + "/v1/models/mlp/predict",
+            json.dumps({"inputs": _x().tolist()}).encode())
+        assert code == 200
+        assert headers["Content-Length"] == str(len(body))
+        doc = json.loads(body)
+        assert doc["model"] == "mlp" and doc["version"] == "v1"
+        np.testing.assert_allclose(
+            np.asarray(doc["outputs"], np.float32),
+            np.asarray(_mlp(0).output(_x()).jax()), rtol=1e-4)
+
+    def test_predict_pinned_version(self, served):
+        reg, server, base = served
+        reg.deploy("mlp", "v2", _mlp(1), example=_x())
+        code, _, body = _post(
+            base + "/v1/models/mlp:v2/predict",
+            json.dumps({"inputs": _x().tolist()}).encode())
+        assert code == 200
+        assert json.loads(body)["version"] == "v2"
+
+    def test_predict_pinned_retired_version_409(self, served):
+        # a parked (drained-for-rollback) version refuses pinned traffic
+        # with 409, not a 500 + stack trace
+        reg, server, base = served
+        reg.deploy("mlp", "v2", _mlp(1), example=_x())
+        code, _, body = _post(
+            base + "/v1/models/mlp:v1/predict",
+            json.dumps({"inputs": _x().tolist()}).encode())
+        assert code == 409
+        assert "error" in json.loads(body)
+
+    def test_predict_npy_roundtrip(self, served):
+        import io
+        reg, server, base = served
+        buf = io.BytesIO()
+        np.save(buf, _x())
+        code, headers, body = _post(base + "/v1/models/mlp/predict",
+                                    buf.getvalue(), "application/x-npy")
+        assert code == 200
+        assert headers["Content-Type"] == "application/x-npy"
+        assert headers["X-Model-Version"] == "v1"
+        out = np.load(io.BytesIO(body))
+        assert out.shape == (4, N_OUT)
+
+    def test_unknown_model_404(self, served):
+        _, _, base = served
+        code, _, body = _post(base + "/v1/models/nope/predict",
+                              json.dumps({"inputs": _x().tolist()}).encode())
+        assert code == 404
+        assert "error" in json.loads(body)
+        code, _, _ = _post(base + "/v1/models/mlp:v9/predict",
+                           json.dumps({"inputs": _x().tolist()}).encode())
+        assert code == 404
+
+    def test_bad_payload_400(self, served):
+        _, _, base = served
+        code, _, _ = _post(base + "/v1/models/mlp/predict",
+                           json.dumps({"wrong": 1}).encode())
+        assert code == 400
+
+    def test_models_listing(self, served):
+        _, _, base = served
+        code, _, body = _get(base + "/v1/models")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["models"]["mlp"]["current"] == "v1"
+
+    def test_healthz_always_ok(self, served):
+        _, _, base = served
+        code, _, body = _get(base + "/healthz")
+        assert code == 200 and body == b"ok"
+
+    def test_readyz_flips_only_after_warmup(self):
+        reg = ModelRegistry(manifest_dir=None)
+        server = ModelServer(reg)
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            reg.deploy("cold", "v1", _mlp(), warm=False)
+            code, _, body = _get(base + "/readyz")
+            assert code == 503
+            assert json.loads(body)["ready"] is False
+            reg.warm("cold", example=_x())
+            code, _, body = _get(base + "/readyz")
+            assert code == 200
+            assert json.loads(body)["ready"] is True
+        finally:
+            server.stop()
+            reg.drain_all(save_manifests=False)
+
+    def test_metrics_endpoints_shared_with_ui(self, served):
+        _, _, base = served
+        code, headers, body = _get(base + "/metrics")
+        assert code == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        assert b"dl4j_serving_requests_total" in body
+        code, _, body = _get(base + "/metrics.json")
+        assert code == 200
+        assert "dl4j_inference_requests_total" in json.loads(body)
+
+    def test_overload_returns_429_with_retry_after(self, served):
+        reg, server, base = served
+        ctrl = AdmissionController("mlp", max_concurrent=1, queue_depth=1,
+                                   high_water=0, default_timeout_s=None)
+        server.set_admission("mlp", ctrl)
+        permit = ctrl.admit()  # hold the only slot; high_water=0 -> shed
+        try:
+            code, headers, body = _post(
+                base + "/v1/models/mlp/predict",
+                json.dumps({"inputs": _x().tolist()}).encode())
+            assert code == 429
+            assert int(headers["Retry-After"]) >= 1
+            assert json.loads(body)["retry_after_s"] > 0
+        finally:
+            permit.__exit__(None, None, None)
+
+    def test_deadline_expiry_returns_504(self, served):
+        reg, server, base = served
+        ctrl = AdmissionController("mlp", max_concurrent=1, queue_depth=8,
+                                   high_water=8, default_timeout_s=None)
+        server.set_admission("mlp", ctrl)
+        permit = ctrl.admit()  # saturate so the request waits
+        try:
+            code, _, body = _post(
+                base + "/v1/models/mlp/predict",
+                json.dumps({"inputs": _x().tolist(),
+                            "timeout_s": 0.05}).encode())
+            assert code == 504
+            assert "deadline" in json.loads(body)["error"]
+        finally:
+            permit.__exit__(None, None, None)
+
+    def test_unknown_path_404(self, served):
+        _, _, base = served
+        code, _, _ = _get(base + "/v1/nope")
+        assert code == 404
+
+
+class TestClientDisconnects:
+    def test_broken_pipe_suppressed_without_traceback(self, served,
+                                                      capsys):
+        reg, server, base = served
+        httpd = server._httpd
+        before = httpd.client_disconnects
+        try:
+            raise BrokenPipeError("peer went away")
+        except BrokenPipeError:
+            httpd.handle_error(None, ("127.0.0.1", 12345))
+        assert httpd.client_disconnects == before + 1
+        assert capsys.readouterr().err == ""  # no stack trace in logs
+
+    def test_real_errors_still_reported(self, served, capsys):
+        _, server, _ = served
+        try:
+            raise ValueError("an actual bug")
+        except ValueError:
+            server._httpd.handle_error(None, ("127.0.0.1", 12345))
+        assert "ValueError" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# Graceful lifecycle (SIGTERM drain)
+# ---------------------------------------------------------------------------
+
+class TestGracefulLifecycle:
+    def test_sigterm_drains_and_saves_manifest(self, tmp_path):
+        d = str(tmp_path)
+        reg = ModelRegistry(manifest_dir=d)
+        reg.deploy("m", "v1", _mlp(), example=_x())
+        reg.predict("m", _x(5))
+        server = ModelServer(reg)
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+        lc = GracefulLifecycle(reg, server, drain_timeout_s=10)
+        lc.install()
+        try:
+            signal.raise_signal(signal.SIGTERM)
+            assert lc.wait_drained(30)
+            # manifest for the next replica
+            path = os.path.join(d, "m.warmup.json")
+            assert os.path.exists(path)
+            doc = json.load(open(path))
+            assert doc["entries"]  # the observed shapes were persisted
+            # engines drained: late work fails fast
+            with pytest.raises(EngineClosedError):
+                reg.predict("m", _x())
+            assert not reg.ready()
+            # http socket closed last
+            with pytest.raises(urllib.error.URLError):
+                urllib.request.urlopen(base + "/healthz", timeout=2)
+        finally:
+            lc.uninstall()
+
+    def test_drain_is_idempotent(self):
+        reg = ModelRegistry(manifest_dir=None)
+        reg.deploy("m", "v1", _mlp(), example=_x())
+        lc = GracefulLifecycle(reg, server=None, drain_timeout_s=10)
+        assert lc.drain()
+        assert lc.drain()  # second call waits on the first, no explosion
+        assert lc.drained
+
+    def test_uninstall_restores_previous_handler(self):
+        prev = signal.getsignal(signal.SIGTERM)
+        reg = ModelRegistry(manifest_dir=None)
+        lc = GracefulLifecycle(reg).install()
+        assert signal.getsignal(signal.SIGTERM) != prev
+        lc.uninstall()
+        assert signal.getsignal(signal.SIGTERM) == prev
+
+    def test_begin_drain_sheds_http_traffic(self):
+        reg = ModelRegistry(manifest_dir=None)
+        reg.deploy("m", "v1", _mlp(), example=_x())
+        server = ModelServer(reg)
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            server.begin_drain()
+            code, headers, _ = _post(
+                base + "/v1/models/m/predict",
+                json.dumps({"inputs": _x().tolist()}).encode())
+            assert code == 503
+            assert "Retry-After" in headers
+            code, _, _ = _get(base + "/readyz")
+            assert code == 503
+        finally:
+            server.stop()
+            reg.drain_all(save_manifests=False)
+
+
+# ---------------------------------------------------------------------------
+# Manifest-dir handoff (runtime/compile_cache.py)
+# ---------------------------------------------------------------------------
+
+class TestServingManifestDir:
+    def test_env_override_wins(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_SERVING_MANIFEST_DIR", str(tmp_path))
+        assert compile_cache.serving_manifest_dir() == str(tmp_path)
+
+    def test_defaults_under_cache_dir(self):
+        d = compile_cache.serving_manifest_dir()
+        cache_dir = environment().cache_dir()
+        assert d == os.path.join(cache_dir, "manifests")
+        assert os.path.isdir(d)
+
+    def test_disabled_when_cache_disabled(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_CACHE_DIR", "")
+        assert compile_cache.serving_manifest_dir() is None
