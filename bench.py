@@ -589,11 +589,17 @@ def bench_inference_serving(jax, jnp, tiny):
 def bench_telemetry_overhead(jax, jnp, tiny):
     """Cost of the telemetry subsystem on the serving hot path: bucketed
     InferenceEngine throughput over a mixed-size request stream with the
-    metrics registry + spans enabled vs disabled (DL4J_TPU_METRICS).
+    metrics registry + spans enabled vs disabled (DL4J_TPU_METRICS),
+    plus a third pass with a per-request trace context bound — the
+    serving front end's request-scoped tracing (traceparent in,
+    span-tree out) — to price the contextvar/span-id machinery.
     The instrumentation contract is near-zero cost, so `overhead_frac`
-    must stay under the `check_telemetry_overhead` gate's 3%."""
+    must stay under the `check_telemetry_overhead` gate's 3%;
+    `tracing_overhead_frac` is reported alongside it."""
     from deeplearning4j_tpu.common.environment import environment
-    from deeplearning4j_tpu.common.tracing import tracer
+    from deeplearning4j_tpu.common.tracing import (TraceContext,
+                                                   new_trace_id, tracer,
+                                                   use_context)
     from deeplearning4j_tpu.nn import (MultiLayerNetwork,
                                        NeuralNetConfiguration)
     from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
@@ -619,23 +625,34 @@ def bench_telemetry_overhead(jax, jnp, tiny):
     prev_enabled = reg.enabled
     out = {"request_count": n_requests, "max_batch": max_batch}
     try:
-        for mode in ("off", "on"):
-            reg.set_enabled(mode == "on")
+        for mode in ("off", "on", "trace"):
+            reg.set_enabled(mode != "off")
             eng = InferenceEngine(net, max_batch=max_batch)
             eng.warmup(reqs[0])
             runs = []
-            for _ in range(3):
+            for _ in range(5):
                 t0 = time.perf_counter()
-                for r in reqs:
-                    jax.block_until_ready(eng.infer(r).jax())
+                if mode == "trace":
+                    # one fresh trace context per request, like the HTTP
+                    # front end binds from traceparent
+                    for r in reqs:
+                        with use_context(TraceContext(new_trace_id())):
+                            jax.block_until_ready(eng.infer(r).jax())
+                else:
+                    for r in reqs:
+                        jax.block_until_ready(eng.infer(r).jax())
                 runs.append(time.perf_counter() - t0)
             runs.sort()
-            out[f"metrics_{mode}_sps"] = round(total_rows / runs[1], 2)
+            out[f"metrics_{mode}_sps"] = round(
+                total_rows / runs[len(runs) // 2], 2)
     finally:
         reg.set_enabled(prev_enabled)
         tracer().clear()
     out["overhead_frac"] = round(
         1.0 - out["metrics_on_sps"] / max(out["metrics_off_sps"], 1e-9), 4)
+    out["tracing_overhead_frac"] = round(
+        1.0 - out["metrics_trace_sps"] / max(out["metrics_off_sps"], 1e-9),
+        4)
     ok, reason = check_telemetry_overhead(out)
     out["gate_ok"], out["gate_reason"] = ok, reason
     return out

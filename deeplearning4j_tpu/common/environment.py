@@ -49,6 +49,14 @@ class EnvironmentVars:
     DL4J_TPU_SERVING_DRAIN_TIMEOUT_S = "DL4J_TPU_SERVING_DRAIN_TIMEOUT_S"
     DL4J_TPU_SERVING_RETAIN = "DL4J_TPU_SERVING_RETAIN"
     DL4J_TPU_SERVING_MANIFEST_DIR = "DL4J_TPU_SERVING_MANIFEST_DIR"
+    DL4J_TPU_SLO_OBJECTIVE = "DL4J_TPU_SLO_OBJECTIVE"
+    DL4J_TPU_SLO_LATENCY_MS = "DL4J_TPU_SLO_LATENCY_MS"
+    DL4J_TPU_SLO_WINDOWS = "DL4J_TPU_SLO_WINDOWS"
+    DL4J_TPU_SLO_READYZ = "DL4J_TPU_SLO_READYZ"
+    DL4J_TPU_REQUEST_RING = "DL4J_TPU_REQUEST_RING"
+    DL4J_TPU_DEBUG_ENDPOINTS = "DL4J_TPU_DEBUG_ENDPOINTS"
+    DL4J_TPU_PROFILE_DIR = "DL4J_TPU_PROFILE_DIR"
+    DL4J_TPU_FLIGHT_RECORDER_DIR = "DL4J_TPU_FLIGHT_RECORDER_DIR"
     XLA_FLAGS = "XLA_FLAGS"
 
 
@@ -80,6 +88,14 @@ class SystemProperties:
     SERVING_DRAIN_TIMEOUT_S = "serving_drain_timeout_s"
     SERVING_RETAIN = "serving_retain"
     SERVING_MANIFEST_DIR = "serving_manifest_dir"
+    SLO_OBJECTIVE = "slo_objective"
+    SLO_LATENCY_MS = "slo_latency_ms"
+    SLO_WINDOWS = "slo_windows"
+    SLO_READYZ = "slo_readyz"
+    REQUEST_RING = "request_ring"
+    DEBUG_ENDPOINTS = "debug_endpoints"
+    PROFILE_DIR = "profile_dir"
+    FLIGHT_RECORDER_DIR = "flight_recorder_dir"
 
 
 _ENV_FOR_PROP = {
@@ -119,6 +135,16 @@ _ENV_FOR_PROP = {
         EnvironmentVars.DL4J_TPU_SERVING_RETAIN,
     SystemProperties.SERVING_MANIFEST_DIR:
         EnvironmentVars.DL4J_TPU_SERVING_MANIFEST_DIR,
+    SystemProperties.SLO_OBJECTIVE: EnvironmentVars.DL4J_TPU_SLO_OBJECTIVE,
+    SystemProperties.SLO_LATENCY_MS: EnvironmentVars.DL4J_TPU_SLO_LATENCY_MS,
+    SystemProperties.SLO_WINDOWS: EnvironmentVars.DL4J_TPU_SLO_WINDOWS,
+    SystemProperties.SLO_READYZ: EnvironmentVars.DL4J_TPU_SLO_READYZ,
+    SystemProperties.REQUEST_RING: EnvironmentVars.DL4J_TPU_REQUEST_RING,
+    SystemProperties.DEBUG_ENDPOINTS:
+        EnvironmentVars.DL4J_TPU_DEBUG_ENDPOINTS,
+    SystemProperties.PROFILE_DIR: EnvironmentVars.DL4J_TPU_PROFILE_DIR,
+    SystemProperties.FLIGHT_RECORDER_DIR:
+        EnvironmentVars.DL4J_TPU_FLIGHT_RECORDER_DIR,
 }
 
 _DEFAULTS = {
@@ -146,6 +172,14 @@ _DEFAULTS = {
     SystemProperties.SERVING_DRAIN_TIMEOUT_S: "30",
     SystemProperties.SERVING_RETAIN: "2",
     SystemProperties.SERVING_MANIFEST_DIR: "",  # "" = <cache_dir>/manifests
+    SystemProperties.SLO_OBJECTIVE: "0.999",
+    SystemProperties.SLO_LATENCY_MS: "0",      # 0 = deadline-hit-rate only
+    SystemProperties.SLO_WINDOWS: "300:14.4,3600:6",
+    SystemProperties.SLO_READYZ: "1",
+    SystemProperties.REQUEST_RING: "256",
+    SystemProperties.DEBUG_ENDPOINTS: "1",
+    SystemProperties.PROFILE_DIR: "",          # "" = <cache_dir>/profiles
+    SystemProperties.FLIGHT_RECORDER_DIR: "",  # "" = <cache_dir>/flight
 }
 
 
@@ -402,6 +436,98 @@ class Environment:
         the executable cache dir)."""
         d = self.property(SystemProperties.SERVING_MANIFEST_DIR)
         return os.path.expanduser(d) if d else None
+
+    # -- SLO / debug-observability knobs (serving/slo.py, /debug/*) --------
+
+    def slo_objective(self) -> float:
+        """Per-model success-rate objective (``DL4J_TPU_SLO_OBJECTIVE``,
+        default 0.999): the fraction of served requests that must
+        complete OK (and within the latency objective, when one is
+        set)."""
+        v = self.property(SystemProperties.SLO_OBJECTIVE)
+        try:
+            obj = float(v)
+        except (TypeError, ValueError):
+            obj = 0.999
+        return min(max(obj, 0.0), 0.999999)
+
+    def slo_latency_s(self) -> Optional[float]:
+        """Optional per-request latency objective in seconds
+        (``DL4J_TPU_SLO_LATENCY_MS``); <= 0 (default) means only
+        deadline misses / errors count against the SLO."""
+        v = self.property(SystemProperties.SLO_LATENCY_MS)
+        try:
+            ms = float(v)
+        except (TypeError, ValueError):
+            ms = 0.0
+        return ms / 1e3 if ms > 0 else None
+
+    def slo_windows(self):
+        """Multi-window burn-rate alert policy
+        (``DL4J_TPU_SLO_WINDOWS`` = ``"<seconds>:<burn>,..."``, default
+        ``300:14.4,3600:6`` — the SRE-workbook fast-burn pair). Returns
+        ((window_s, burn_threshold), ...) sorted short-to-long."""
+        v = self.property(SystemProperties.SLO_WINDOWS) or ""
+        out = []
+        for part in v.split(","):
+            if ":" not in part:
+                continue
+            w, b = part.split(":", 1)
+            try:
+                out.append((float(w), float(b)))
+            except ValueError:
+                continue
+        if not out:
+            out = [(300.0, 14.4), (3600.0, 6.0)]
+        return tuple(sorted(out))
+
+    def slo_gate_readyz(self) -> bool:
+        """Whether a fast-burning SLO flips ``/readyz`` to 503
+        (``DL4J_TPU_SLO_READYZ``, on by default) so the load balancer
+        stops routing to a replica that is torching its error budget."""
+        return self.property(SystemProperties.SLO_READYZ) not in (
+            "0", "false", None)
+
+    def request_ring_size(self) -> int:
+        """Capacity of the serving recent-requests ring behind
+        ``/debug/requests`` and the flight recorder
+        (``DL4J_TPU_REQUEST_RING``)."""
+        v = self.property(SystemProperties.REQUEST_RING)
+        try:
+            return max(int(v), 1)
+        except (TypeError, ValueError):
+            return 256
+
+    def debug_endpoints_enabled(self) -> bool:
+        """Whether the ``/debug/*`` endpoint family is served
+        (``DL4J_TPU_DEBUG_ENDPOINTS``, on by default — turn off on
+        internet-facing deployments)."""
+        return self.property(SystemProperties.DEBUG_ENDPOINTS) not in (
+            "0", "false", None)
+
+    def profile_dir(self) -> str:
+        """Where ``/debug/profile`` captures land
+        (``DL4J_TPU_PROFILE_DIR``); defaults under the executable cache
+        dir, falling back to the system tmpdir when caching is off."""
+        d = self.property(SystemProperties.PROFILE_DIR)
+        if d:
+            return os.path.expanduser(d)
+        base = self.cache_dir()
+        if base:
+            return os.path.join(base, "profiles")
+        import tempfile
+        return os.path.join(tempfile.gettempdir(), "dl4j_tpu_profiles")
+
+    def flight_recorder_dir(self) -> Optional[str]:
+        """Where SIGTERM/SIGQUIT flight-recorder dumps land
+        (``DL4J_TPU_FLIGHT_RECORDER_DIR``); defaults under the
+        executable cache dir; None (recorder disabled) when that is off
+        and no explicit dir is set."""
+        d = self.property(SystemProperties.FLIGHT_RECORDER_DIR)
+        if d:
+            return os.path.expanduser(d)
+        base = self.cache_dir()
+        return os.path.join(base, "flight") if base else None
 
     # -- telemetry (common/metrics.py, common/tracing.py) ------------------
     def metrics(self):

@@ -17,7 +17,21 @@ place.
   ``send_json`` (always sets Content-Length, swallows disconnects while
   writing) and ``read_body``.
 - ``metrics_payload`` — the Prometheus / JSON exposition of the process
-  metrics registry, shared by every ``/metrics`` endpoint.
+  metrics registry, shared by every ``/metrics`` endpoint (refreshes the
+  ``dl4j_uptime_seconds`` / ``dl4j_build_info`` gauges at scrape time).
+- ``handle_debug_get`` / ``handle_debug_post`` — the shared ``/debug/*``
+  endpoint family (gated by ``DL4J_TPU_DEBUG_ENDPOINTS``), mounted by
+  both servers:
+
+      GET  /debug/trace/<trace_id>       buffered span events + tree
+      GET  /debug/compile_cache          executable inventory with XLA
+                                         cost analysis (flops / bytes)
+      GET  /debug/memory                 per-device memory stats
+      POST /debug/profile?seconds=       blocking jax.profiler capture
+
+  (``/debug/requests`` — the recent-requests ring — lives on
+  ``serving.ModelServer``, the only server that owns per-request
+  records.)
 """
 from __future__ import annotations
 
@@ -25,7 +39,7 @@ import json
 import logging
 import sys
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Iterable, Tuple
+from typing import Dict, Iterable, List, Tuple
 
 log = logging.getLogger(__name__)
 
@@ -97,11 +111,87 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
 
 def metrics_payload(fmt: str = "text") -> Tuple[bytes, str]:
     """(body, content_type) for a /metrics[.json] endpoint, off the
-    process-wide registry (``environment().metrics()``)."""
+    process-wide registry (``environment().metrics()``). Refreshes the
+    scrape-time process-identity gauges (uptime, build info) first."""
     from .environment import environment
+    from .metrics import touch_runtime_info
 
     reg = environment().metrics()
+    touch_runtime_info(reg)
     if fmt == "json":
         return json.dumps(reg.snapshot()).encode(), "application/json"
     return (reg.prometheus_text().encode(),
             "text/plain; version=0.0.4; charset=utf-8")
+
+
+# ---------------------------------------------------------------------------
+# shared /debug/* endpoint family
+# ---------------------------------------------------------------------------
+
+def device_memory_stats() -> dict:
+    """Per-device memory stats (``/debug/memory``): whatever the backend
+    exposes via ``Device.memory_stats()`` (bytes_in_use / peak / limit on
+    TPU and GPU; usually empty on CPU), never raising."""
+    devices: List[Dict] = []
+    try:
+        import jax
+        for d in jax.devices():
+            try:
+                stats = getattr(d, "memory_stats", lambda: None)() or {}
+            except Exception:
+                stats = {}
+            devices.append({"device": str(d), "platform": d.platform,
+                            "stats": {k: int(v) for k, v in stats.items()
+                                      if isinstance(v, (int, float))}})
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}", "devices": []}
+    return {"devices": devices}
+
+
+def debug_enabled() -> bool:
+    from .environment import environment
+    return environment().debug_endpoints_enabled()
+
+
+def handle_debug_get(handler: "JsonRequestHandler", path: str) -> bool:
+    """Serve the shared GET ``/debug/*`` endpoints; returns True when the
+    path was handled (the caller 404s otherwise)."""
+    from .tracing import span_tree, tracer
+
+    if path.startswith("/debug/trace/"):
+        trace_id = path[len("/debug/trace/"):].strip("/")
+        events = tracer().events_for(trace_id)
+        handler.send_json({"trace_id": trace_id, "count": len(events),
+                           "tree": span_tree(events), "events": events})
+        return True
+    if path == "/debug/compile_cache":
+        from ..runtime import compile_cache
+        handler.send_json(compile_cache.inventory())
+        return True
+    if path == "/debug/memory":
+        handler.send_json(device_memory_stats())
+        return True
+    return False
+
+
+def handle_debug_post(handler: "JsonRequestHandler", path: str,
+                      query: Dict[str, List[str]]) -> bool:
+    """Serve the shared POST ``/debug/*`` endpoints (currently the
+    on-demand profiler capture); returns True when handled."""
+    from .tracing import ProfileBusyError, capture_profile
+
+    if path == "/debug/profile":
+        try:
+            seconds = float((query.get("seconds") or ["1"])[0])
+        except ValueError:
+            handler.send_json({"error": "seconds must be a number"}, 400)
+            return True
+        try:
+            handler.send_json(capture_profile(seconds))
+        except ProfileBusyError as e:
+            handler.send_json({"error": str(e)}, 409)
+        except Exception as e:
+            log.exception("profiler capture failed")
+            handler.send_json({"error": f"{type(e).__name__}: {e}"}, 500)
+        return True
+    return False

@@ -21,13 +21,23 @@ don't use):
 - writes never raise into the instrumented path: a metric type clash at
   *creation* raises (programming error), but inc/set/observe are plain
   arithmetic under a per-child lock.
+
+Histograms carry *exemplars* (OpenMetrics-style): ``observe(value,
+exemplar=trace_id)`` remembers the last exemplar per bucket, so a tail
+observation in ``/metrics.json`` links straight to the request trace that
+produced it (``/debug/trace/<id>``) — the Canopy pattern of joining
+aggregate metrics back to individual traces.
 """
 from __future__ import annotations
 
 import math
 import os
 import threading
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: process telemetry epoch — dl4j_uptime_seconds measures from here
+_START_TIME = time.time()
 
 
 def exponential_buckets(start: float, factor: float,
@@ -121,7 +131,7 @@ class _GaugeChild(_Child):
 
 class _HistogramChild:
     __slots__ = ("_registry", "_bounds", "_counts", "_sum", "_count",
-                 "_lock")
+                 "_lock", "_exemplars")
 
     def __init__(self, registry, bounds: Tuple[float, ...]):
         self._registry = registry
@@ -130,8 +140,13 @@ class _HistogramChild:
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
+        self._exemplars: Optional[Dict[int, Tuple[float, str, float]]] = None
 
-    def observe(self, value: float):
+    def observe(self, value: float, exemplar: Optional[str] = None):
+        """Record one observation; with ``exemplar`` (a trace_id), the
+        bucket it lands in remembers (value, trace_id, unix-time) — last
+        writer wins, one slot per bucket, so the tail buckets always
+        point at a recent offending trace."""
         if not self._registry.enabled:
             return
         v = float(value)
@@ -144,6 +159,23 @@ class _HistogramChild:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar:
+                if self._exemplars is None:
+                    self._exemplars = {}
+                self._exemplars[i] = (v, str(exemplar), time.time())
+
+    def exemplars(self) -> List[dict]:
+        """Per-bucket exemplars, highest bucket first: ``{"le", "value",
+        "trace_id", "ts"}`` — ``le`` is the bucket's upper bound
+        ("+Inf" for the overflow bucket)."""
+        with self._lock:
+            if not self._exemplars:
+                return []
+            items = sorted(self._exemplars.items(), reverse=True)
+        return [{"le": (_fmt(self._bounds[i]) if i < len(self._bounds)
+                        else "+Inf"),
+                 "value": v, "trace_id": tid, "ts": ts}
+                for i, (v, tid, ts) in items]
 
     # -- snapshots --------------------------------------------------------
     def count(self) -> int:
@@ -235,8 +267,8 @@ class _Family:
     def dec(self, amount: float = 1.0):
         self._require_default().dec(amount)
 
-    def observe(self, value: float):
-        self._require_default().observe(value)
+    def observe(self, value: float, exemplar: Optional[str] = None):
+        self._require_default().observe(value, exemplar)
 
     def value(self) -> float:
         return self._require_default().value()
@@ -328,8 +360,12 @@ class MetricsRegistry:
                     # must stay strict-JSON for /metrics.json consumers
                     pct = child.percentiles() if n else {
                         "p50": None, "p90": None, "p99": None}
-                    series.append({"labels": labels, "count": n,
-                                   "sum": child.sum(), **pct})
+                    entry = {"labels": labels, "count": n,
+                             "sum": child.sum(), **pct}
+                    ex = child.exemplars()
+                    if ex:
+                        entry["exemplars"] = ex
+                    series.append(entry)
                 else:
                     series.append({"labels": labels,
                                    "value": child.value()})
@@ -365,6 +401,59 @@ class MetricsRegistry:
                     ls = _label_str(fam.label_names, key)
                     lines.append(f"{name}{ls} {_fmt(child.value())}")
         return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ---------------------------------------------------------------------------
+# process-identity gauges (dl4j_uptime_seconds, dl4j_build_info)
+# ---------------------------------------------------------------------------
+
+_BUILD_LABELS: Optional[Dict[str, str]] = None
+
+
+def _build_labels() -> Dict[str, str]:
+    """Label values for dl4j_build_info, resolved once: jax/jaxlib
+    versions, the active backend platform, and whether the persistent
+    executable cache is enabled. Never raises — a jax-less process
+    reports "unavailable"."""
+    global _BUILD_LABELS
+    if _BUILD_LABELS is None:
+        labels = {"jax_version": "unavailable",
+                  "jaxlib_version": "unavailable",
+                  "platform": "unavailable", "cache": "unknown"}
+        try:
+            import jax
+            import jaxlib
+            labels["jax_version"] = jax.__version__
+            labels["jaxlib_version"] = getattr(jaxlib, "__version__",
+                                               jax.__version__)
+            labels["platform"] = jax.default_backend()
+        except Exception:
+            pass
+        try:
+            from .environment import environment
+            labels["cache"] = ("enabled" if environment().cache_dir()
+                               else "disabled")
+        except Exception:
+            pass
+        _BUILD_LABELS = labels
+    return _BUILD_LABELS
+
+
+def touch_runtime_info(reg: Optional[MetricsRegistry] = None):
+    """Refresh the scrape-time process-identity gauges: uptime since
+    telemetry import, and the constant-1 ``dl4j_build_info`` gauge whose
+    labels carry jax/jaxlib version, backend platform, and executable
+    cache state. Called by every ``/metrics``/``/metrics.json`` render
+    (``common.httpserver.metrics_payload``)."""
+    reg = reg or registry()
+    reg.gauge("dl4j_uptime_seconds",
+              "Seconds since process telemetry initialized").set(
+                  time.time() - _START_TIME)
+    labels = _build_labels()
+    reg.gauge("dl4j_build_info",
+              "Constant 1; build/runtime identity in the labels",
+              labels=tuple(sorted(labels))).labels(**labels).set(1)
+    return reg
 
 
 _REGISTRY: Optional[MetricsRegistry] = None

@@ -1,14 +1,38 @@
-"""Host-side tracing spans: chrome-trace "X" events in a ring buffer.
+"""Host-side tracing: request-scoped span trees in a chrome-trace ring.
 
 Reference: the `ProfilingListener` half of the reference observability
-stack — it emits chrome trace-format JSON that
-`common/profile_analyzer.py` loads and compares. Here `span(name,
-**attrs)` is the single primitive: a context manager that records one
-complete ("X") event per exit into a bounded ring buffer
-(``DL4J_TPU_TRACE_BUFFER`` events, oldest dropped first), exportable with
-``tracer().export(path)`` in exactly the format `load_trace`/`aggregate`
-consume — so a training run can be diffed against a previous one with
-`profile_analyzer.compare` like two reference profiles.
+stack (chrome trace-format JSON that `common/profile_analyzer.py` loads
+and compares) grown into a Dapper/Canopy-style request tracer: a
+contextvar ``TraceContext`` (trace_id / span_id / parent) propagates
+through every layer, so nested ``span()`` calls form a *tree* that can be
+reassembled per request (``span_tree``), fetched by trace id
+(``tracer().events_for``), and linked from metric exemplars.
+
+Primitives:
+
+- ``span(name, **attrs)`` — context manager recording one complete ("X")
+  event per exit into a bounded ring buffer (``DL4J_TPU_TRACE_BUFFER``
+  events, oldest dropped first). When a trace context is active the span
+  allocates a child span_id and pushes itself as the new parent, so
+  nested spans — across admission wait, micro-batch coalesce, padded
+  dispatch — share the request's trace_id. A span that exits with an
+  exception records ``args["error"]`` and counts
+  ``dl4j_span_errors_total{name}`` so failing requests are
+  distinguishable in traces.
+- ``use_context(ctx)`` / ``current_context()`` — bind/read the active
+  ``TraceContext`` (contextvar: thread- and task-local).
+- ``parse_traceparent`` / ``format_traceparent`` — W3C trace-context
+  interop for the HTTP edge.
+- ``tracer().record(name, t0, t1, context=...)`` — append a completed
+  span on behalf of another thread (the micro-batcher emits per-rider
+  spans this way; contextvars do not cross threads).
+- ``capture_profile(seconds)`` — on-demand ``jax.profiler`` device
+  capture for the ``/debug/profile`` endpoint.
+
+Export (``tracer().export(path)``) writes exactly the format
+`load_trace`/`aggregate` consume — atomically (tmp + rename, parent dirs
+created), so a run can be diffed against a previous one with
+`profile_analyzer.compare` and a crash never leaves a truncated file.
 
 When a jax device profile is active (`jax.profiler.start_trace`), each
 span additionally enters a `jax.profiler.TraceAnnotation` so the host
@@ -16,17 +40,21 @@ span shows up on the device timeline too.
 
 Cost model: enabled-ness is ONE cached flag (the metrics registry's,
 resolved from ``DL4J_TPU_METRICS``); a disabled `span()` returns a shared
-no-op context manager — no event dict, no buffer append, no lock.
+no-op context manager — no event dict, no buffer append, no lock. An
+enabled span with no active trace context pays one contextvar read over
+the previous flat-span cost.
 """
 from __future__ import annotations
 
+import contextvars
 import gzip
 import json
 import os
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from contextlib import contextmanager
+from typing import Dict, List, NamedTuple, Optional
 
 from .metrics import registry
 
@@ -51,6 +79,83 @@ def _device_profile_active() -> bool:
             is not None)
 
 
+# ---------------------------------------------------------------------------
+# trace context (contextvar: per-thread, per-task)
+# ---------------------------------------------------------------------------
+
+class TraceContext(NamedTuple):
+    """The active position in a request's span tree.
+
+    ``span_id`` is the id of the currently open span — children created
+    under this context take it as their parent. An empty ``span_id``
+    marks a root context (children become tree roots)."""
+    trace_id: str
+    span_id: str = ""
+    parent_id: Optional[str] = None
+
+
+_CTX: contextvars.ContextVar[Optional[TraceContext]] = \
+    contextvars.ContextVar("dl4j_tpu_trace_ctx", default=None)
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def current_context() -> Optional[TraceContext]:
+    """The TraceContext bound to this thread/task, or None."""
+    return _CTX.get()
+
+
+@contextmanager
+def use_context(ctx: Optional[TraceContext]):
+    """Bind ``ctx`` as the active trace context for the with-block."""
+    token = _CTX.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CTX.reset(token)
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[TraceContext]:
+    """W3C `traceparent` -> TraceContext, or None when absent/malformed.
+    Format: ``<2hex version>-<32hex trace-id>-<16hex parent-id>-<2hex
+    flags>``; all-zero ids are invalid per the spec."""
+    if not header:
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, parent_id = parts[0], parts[1], parts[2]
+    if (len(version) != 2 or len(trace_id) != 32 or len(parent_id) != 16
+            or version == "ff"):
+        return None
+    try:
+        int(trace_id, 16), int(parent_id, 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32 or parent_id == "0" * 16:
+        return None
+    return TraceContext(trace_id, parent_id, None)
+
+
+def format_traceparent(ctx: TraceContext) -> str:
+    return f"00-{ctx.trace_id}-{ctx.span_id or '0' * 16}-01"
+
+
+def context_from_traceparent(header: Optional[str]) -> TraceContext:
+    """The entry context for one inbound request: the remote caller's
+    (trace_id, span_id) when a valid ``traceparent`` arrives — locally
+    created spans then parent under the remote span — else a fresh root
+    trace."""
+    ctx = parse_traceparent(header)
+    return ctx if ctx is not None else TraceContext(new_trace_id())
+
+
 class _NullSpan:
     """Shared no-op context manager returned when tracing is disabled."""
     __slots__ = ()
@@ -65,8 +170,19 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+def _count_span_error(name: str):
+    try:
+        registry().counter(
+            "dl4j_span_errors_total",
+            "Spans that exited with an exception, by span name",
+            labels=("name",)).labels(name=name).inc()
+    except Exception:
+        pass  # observability must never break the failing path further
+
+
 class _Span:
-    __slots__ = ("_tracer", "name", "args", "_t0", "_annotation")
+    __slots__ = ("_tracer", "name", "args", "_t0", "_annotation", "_ctx",
+                 "_token")
 
     def __init__(self, tracer: "Tracer", name: str, args: Dict):
         self._tracer = tracer
@@ -74,8 +190,15 @@ class _Span:
         self.args = args
         self._t0 = 0.0
         self._annotation = None
+        self._ctx: Optional[TraceContext] = None
+        self._token = None
 
     def __enter__(self):
+        parent = _CTX.get()
+        if parent is not None:
+            self._ctx = TraceContext(parent.trace_id, new_span_id(),
+                                     parent.span_id or None)
+            self._token = _CTX.set(self._ctx)
         if _device_profile_active():
             try:
                 import jax.profiler
@@ -86,18 +209,31 @@ class _Span:
         self._t0 = time.perf_counter()
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, exc_type, exc, tb):
         t1 = time.perf_counter()
         if self._annotation is not None:
             try:
-                self._annotation.__exit__(*exc)
+                self._annotation.__exit__(exc_type, exc, tb)
             except Exception:
                 pass
+        if self._token is not None:
+            _CTX.reset(self._token)
         ev = {"name": self.name, "ph": "X",
               "ts": self._t0 * 1e6, "dur": (t1 - self._t0) * 1e6,
               "pid": self._tracer.pid, "tid": threading.get_ident()}
-        if self.args:
-            ev["args"] = self.args
+        args = self.args
+        if exc_type is not None:
+            args = dict(args) if args else {}
+            args["error"] = exc_type.__name__
+            _count_span_error(self.name)
+        if self._ctx is not None:
+            args = dict(args) if args else {}
+            args["trace_id"] = self._ctx.trace_id
+            args["span_id"] = self._ctx.span_id
+            if self._ctx.parent_id:
+                args["parent_span_id"] = self._ctx.parent_id
+        if args:
+            ev["args"] = args
         self._tracer._events.append(ev)  # deque append: thread-safe
         return False
 
@@ -119,8 +255,41 @@ class Tracer:
             return _NULL_SPAN
         return _Span(self, name, attrs)
 
+    def record(self, name: str, t0: float, t1: float,
+               context: Optional[TraceContext] = None,
+               **attrs) -> Optional[dict]:
+        """Append one completed span on behalf of a request whose context
+        lives on another thread (``t0``/``t1`` in ``time.perf_counter``
+        seconds). With ``context``, the span enters that request's tree
+        as a child of ``context.span_id``. An ``error=...`` attr counts
+        ``dl4j_span_errors_total`` exactly like a failing ``span()``."""
+        if not registry().enabled:
+            return None
+        ev = {"name": name, "ph": "X", "ts": t0 * 1e6,
+              "dur": max(t1 - t0, 0.0) * 1e6, "pid": self.pid,
+              "tid": threading.get_ident()}
+        args = dict(attrs)
+        if context is not None:
+            args["trace_id"] = context.trace_id
+            args["span_id"] = new_span_id()
+            if context.span_id:
+                args["parent_span_id"] = context.span_id
+        if args.get("error"):
+            _count_span_error(name)
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
+        return ev
+
     def events(self) -> List[dict]:
         return list(self._events)
+
+    def events_for(self, trace_id: str) -> List[dict]:
+        """Every buffered event tagged with ``trace_id``, oldest first
+        (a linear scan of the ring — debug/flight-recorder use, not the
+        request hot path)."""
+        return [e for e in self._events
+                if e.get("args", {}).get("trace_id") == trace_id]
 
     def clear(self):
         self._events.clear()
@@ -129,13 +298,114 @@ class Tracer:
     def export(self, path: str) -> int:
         """Write the buffer as a chrome trace JSON file (gzipped when the
         path ends in .gz) that `profile_analyzer.load_trace` reads back.
+        Parent directories are created; the write is atomic (tmp +
+        rename) so a crash mid-export never leaves a truncated file.
         Returns the number of events written."""
         events = self.events()
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
         opener = gzip.open if path.endswith(".gz") else open
-        with opener(path, "wt") as f:
-            json.dump({"traceEvents": events,
-                       "displayTimeUnit": "ms"}, f)
+        tmp = f"{path}.tmp{os.getpid()}"
+        try:
+            with opener(tmp, "wt") as f:
+                json.dump({"traceEvents": events,
+                           "displayTimeUnit": "ms"}, f)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
         return len(events)
+
+
+# ---------------------------------------------------------------------------
+# span-tree reconstruction (the /debug/requests view)
+# ---------------------------------------------------------------------------
+
+def span_tree(events: List[dict]) -> List[dict]:
+    """Nest a flat event list (``events_for`` output) into span trees by
+    span_id/parent_span_id; roots (and orphans whose parent fell off the
+    ring) sort by start time. Context-free events pass through as
+    roots."""
+    nodes, order = {}, []
+    for e in events:
+        args = e.get("args", {})
+        node = {"name": e.get("name"), "ts": e.get("ts"),
+                "dur": e.get("dur"),
+                "args": {k: v for k, v in args.items()
+                         if k not in ("trace_id", "span_id",
+                                      "parent_span_id")},
+                "span_id": args.get("span_id"),
+                "parent_span_id": args.get("parent_span_id"),
+                "children": []}
+        order.append(node)
+        if node["span_id"]:
+            nodes[node["span_id"]] = node
+    roots = []
+    for node in order:
+        parent = nodes.get(node["parent_span_id"]) \
+            if node["parent_span_id"] else None
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    for node in order:
+        node["children"].sort(key=lambda n: n["ts"] or 0)
+    roots.sort(key=lambda n: n["ts"] or 0)
+    return roots
+
+
+# ---------------------------------------------------------------------------
+# on-demand device profiling (the /debug/profile endpoint)
+# ---------------------------------------------------------------------------
+
+_PROFILE_CAPTURE_LOCK = threading.Lock()
+
+
+class ProfileBusyError(RuntimeError):
+    """A device-profile capture is already running (jax allows one)."""
+
+
+def capture_profile(seconds: float, log_dir: Optional[str] = None) -> dict:
+    """Run a blocking ``jax.profiler`` capture for ``seconds`` and return
+    ``{"path", "seconds", "files": [{"file", "bytes"}, ...]}`` — the
+    ``files`` list includes the ``.xplane.pb`` capture TensorBoard /
+    XProf load. One capture at a time (``ProfileBusyError`` otherwise);
+    captures land under ``log_dir`` (default
+    ``Environment.profile_dir()``), one timestamped subdir each."""
+    import jax
+
+    from .environment import environment
+
+    seconds = min(max(float(seconds), 0.01), 120.0)
+    base = log_dir or environment().profile_dir()
+    path = os.path.join(
+        base, time.strftime("%Y%m%d-%H%M%S") + f"-{os.getpid()}")
+    if not _PROFILE_CAPTURE_LOCK.acquire(blocking=False):
+        raise ProfileBusyError(
+            "a profiler capture is already running; retry when it ends")
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.profiler.start_trace(path)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+    finally:
+        _PROFILE_CAPTURE_LOCK.release()
+    files = []
+    for root, _, names in os.walk(path):
+        for name in names:
+            p = os.path.join(root, name)
+            try:
+                files.append({"file": os.path.relpath(p, path),
+                              "bytes": os.path.getsize(p)})
+            except OSError:
+                pass
+    return {"path": path, "seconds": seconds,
+            "files": sorted(files, key=lambda f: f["file"])}
 
 
 _TRACER: Optional[Tracer] = None

@@ -458,6 +458,37 @@ def _eligible(args, jit_kwargs: Dict[str, Any]) -> bool:
     return True
 
 
+def cost_analysis(compiled) -> Optional[dict]:
+    """XLA cost/memory analysis of a ``jax.stages.Compiled`` as a small
+    JSON-able dict — flops, bytes accessed, and the compiled buffer
+    sizes. Best-effort: None when the backend exposes neither (the
+    inventory then shows the entry without cost columns)."""
+    out = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax: one dict per device
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            for key, name in (("flops", "flops"),
+                              ("bytes accessed", "bytes_accessed")):
+                if key in ca:
+                    out[name] = float(ca[key])
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for attr, name in (("argument_size_in_bytes", "argument_bytes"),
+                           ("output_size_in_bytes", "output_bytes"),
+                           ("temp_size_in_bytes", "temp_bytes"),
+                           ("generated_code_size_in_bytes", "code_bytes")):
+            v = getattr(ma, attr, None)
+            if v is not None:
+                out[name] = int(v)
+    except Exception:
+        pass
+    return out or None
+
+
 def _serialize(compiled) -> Tuple[bytes, dict]:
     """(payload, meta) for a ``jax.stages.Compiled``. Raises when the
     backend does not support executable serialization (caller treats the
@@ -472,6 +503,9 @@ def _serialize(compiled) -> Tuple[bytes, dict]:
         raise ValueError("executable exposes no kept_var_idx")
     meta = {"kept_var_idx": sorted(int(i) for i in kept),
             "created": time.time()}
+    cost = cost_analysis(compiled)
+    if cost:
+        meta["cost"] = cost
     return payload, meta
 
 
@@ -572,6 +606,54 @@ def warm(jfn, args, jit_kwargs: Optional[Dict[str, Any]] = None,
         log.debug("warm compile failed for %s (%s: %s)", tag,
                   type(e).__name__, e)
     return "bypass"
+
+
+# ---------------------------------------------------------------------------
+# executable inventory (the /debug/compile_cache endpoint)
+# ---------------------------------------------------------------------------
+
+def inventory() -> dict:
+    """The on-disk executable store as a JSON-able listing: per entry the
+    cache key, tag kind, payload size, creation/last-use times, and the
+    XLA cost analysis captured at compile time (flops, bytes accessed,
+    buffer sizes). Entries sort most-recently-used first."""
+    cc = cache()
+    if cc is None:
+        return {"enabled": False, "entries": [], "stats": {}}
+    entries = []
+    try:
+        names = os.listdir(cc.aot_dir)
+    except OSError:
+        names = []
+    for name in names:
+        if not name.endswith(_META_EXT):
+            continue
+        key = name[:-len(_META_EXT)]
+        meta_p, payload_p = (os.path.join(cc.aot_dir, name),
+                             os.path.join(cc.aot_dir, key + _PAYLOAD_EXT))
+        try:
+            with open(meta_p, "r") as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            continue
+        try:
+            last_used = os.stat(payload_p).st_mtime
+        except OSError:
+            last_used = None
+        entry = {"key": key, "tag_kind": meta.get("tag_kind"),
+                 "payload_bytes": meta.get("payload_bytes"),
+                 "created": meta.get("created"), "last_used": last_used}
+        if meta.get("cost"):
+            entry["cost"] = meta["cost"]
+        entries.append(entry)
+    entries.sort(key=lambda e: e.get("last_used") or 0, reverse=True)
+    with cc._lock:
+        stats = dict(cc.stats)
+    return {"enabled": True, "dir": cc.base_dir,
+            "max_bytes": cc.max_bytes, "entry_count": len(entries),
+            "total_payload_bytes": sum(e.get("payload_bytes") or 0
+                                       for e in entries),
+            "stats": stats, "entries": entries}
 
 
 # ---------------------------------------------------------------------------

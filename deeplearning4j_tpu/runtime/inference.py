@@ -43,7 +43,7 @@ import jax.numpy as jnp
 
 from ..common.environment import environment
 from ..common.metrics import linear_buckets, registry
-from ..common.tracing import span
+from ..common.tracing import current_context, span, tracer, use_context
 
 
 # ---------------------------------------------------------------------------
@@ -319,14 +319,19 @@ class EngineClosedError(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("inputs", "n", "sig", "future", "deadline")
+    __slots__ = ("inputs", "n", "sig", "future", "deadline", "ctx",
+                 "t_submit")
 
-    def __init__(self, inputs, sig, future, deadline=None):
+    def __init__(self, inputs, sig, future, deadline=None, ctx=None):
         self.inputs = inputs
         self.n = inputs[0].shape[0]
         self.sig = sig
         self.future = future
         self.deadline = deadline  # monotonic instant, or None
+        # the submitter's trace context: the batcher thread emits this
+        # request's spans under it (contextvars don't cross threads)
+        self.ctx = ctx
+        self.t_submit = time.perf_counter()
 
     def expired(self) -> bool:
         return self.deadline is not None and time.monotonic() >= self.deadline
@@ -417,18 +422,27 @@ class InferenceEngine:
             "submit() requests whose deadline expired before dispatch")
 
     # -- core dispatch ---------------------------------------------------
-    def _dispatch(self, inputs: List[jax.Array], n: int) -> List[jax.Array]:
+    def _dispatch(self, inputs: List[jax.Array], n: int,
+                  span_attrs: Optional[Dict[str, Any]] = None
+                  ) -> List[jax.Array]:
         """Pad `inputs` (shared leading dim n <= max_batch) to the bucket,
-        run, slice the padded rows back off."""
+        run, slice the padded rows back off. The dispatch span inherits
+        any active trace context; ``span_attrs`` lets the micro-batcher
+        stamp the coalesced riders' trace_ids onto it."""
         b = bucket_for(n, self.ladder)
         padded = [pad_batch(x, b) for x in inputs]
         if self._reg.enabled:
+            ctx = current_context()
             t0 = time.perf_counter()
-            with span("inference/dispatch", bucket=b, rows=n):
+            with span("inference/dispatch", bucket=b, rows=n,
+                      **(span_attrs or {})):
                 outs = self._adapter.run(padded)
             lat = self._m_latency.get(b)
             if lat is not None:
-                lat.observe(time.perf_counter() - t0)
+                # tail observations carry the request's trace_id as an
+                # exemplar, linking the histogram back to /debug/trace
+                lat.observe(time.perf_counter() - t0,
+                            exemplar=ctx.trace_id if ctx else None)
                 self._m_padding[b].observe((b - n) / b)
         else:
             outs = self._adapter.run(padded)
@@ -687,7 +701,8 @@ class InferenceEngine:
                     "InferenceEngine is "
                     + ("closed" if self._closed else "draining")
                     + "; it no longer accepts requests")
-            self._pending.append(_Request(inputs, sig, fut, deadline))
+            self._pending.append(_Request(inputs, sig, fut, deadline,
+                                          ctx=current_context()))
             depth = len(self._pending)
             self._cv.notify_all()
         with self._lock:
@@ -793,6 +808,12 @@ class InferenceEngine:
             req.future.set_exception(TimeoutError(
                 "request deadline expired before dispatch"))
         self._m_expired.inc()
+        if req.ctx is not None and self._reg.enabled:
+            # the expired wait shows up in the request's trace with error
+            # status — a shed request's timeline stays reconstructable
+            tracer().record("inference/queue_expired", req.t_submit,
+                            time.perf_counter(), context=req.ctx,
+                            rows=req.n, error="TimeoutError")
         return True
 
     def _batcher_loop(self):
@@ -835,15 +856,31 @@ class InferenceEngine:
 
     def _run_group(self, group: List[_Request], total: int):
         self._m_coalesce.observe(len(group))
+        # the dispatch span runs under the first traced rider's context
+        # and lists every rider's trace_id, so each request's timeline
+        # survives coalescing: its own trace keeps an inference/ride
+        # span, and the shared dispatch names all trace_ids that rode
+        lead_ctx = next((r.ctx for r in group if r.ctx is not None), None)
+        attrs: Dict[str, Any] = {}
+        if lead_ctx is not None:
+            riders = [r.ctx.trace_id for r in group if r.ctx is not None]
+            attrs["trace_ids"] = riders
+            if len(group) > 1:
+                attrs["coalesced"] = len(group)
+        t_dispatch = time.perf_counter()
         try:
             if len(group) == 1:
-                outs = self._dispatch(group[0].inputs, total)
+                inputs = group[0].inputs
             else:
                 with self._lock:
                     self._stats["coalesced"] += len(group)
-                merged = [jnp.concatenate(parts, axis=0)
+                inputs = [jnp.concatenate(parts, axis=0)
                           for parts in zip(*(r.inputs for r in group))]
-                outs = self._dispatch(merged, total)
+            if lead_ctx is not None:
+                with use_context(lead_ctx):
+                    outs = self._dispatch(inputs, total, span_attrs=attrs)
+            else:
+                outs = self._dispatch(inputs, total, span_attrs=attrs)
             lo = 0
             for r in group:
                 hi = lo + r.n
@@ -851,10 +888,32 @@ class InferenceEngine:
                     [o[lo:hi] if getattr(o, "ndim", 0) >= 1
                      and o.shape[0] == total else o for o in outs]))
                 lo = hi
+            self._record_rides(group, t_dispatch)
         except Exception as e:
             for r in group:
                 if not r.future.done():
                     r.future.set_exception(e)
+            self._record_rides(group, t_dispatch,
+                               error=type(e).__name__)
+
+    def _record_rides(self, group: List[_Request], t_dispatch: float,
+                      error: Optional[str] = None):
+        """Per-rider micro-batcher spans: each traced request gets an
+        ``inference/ride`` span in its OWN trace covering queue wait +
+        dispatch, so its timeline reads end-to-end even when another
+        request's trace holds the shared dispatch span."""
+        if not self._reg.enabled:
+            return
+        t1 = time.perf_counter()
+        for r in group:
+            if r.ctx is None:
+                continue
+            attrs = {"rows": r.n, "coalesced": len(group),
+                     "queue_s": round(t_dispatch - r.t_submit, 6)}
+            if error is not None:
+                attrs["error"] = error
+            tracer().record("inference/ride", r.t_submit, t1,
+                            context=r.ctx, **attrs)
 
     # -- observability ---------------------------------------------------
     def stats(self) -> Dict[str, Any]:

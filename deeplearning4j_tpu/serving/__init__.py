@@ -24,13 +24,25 @@ Minimal flow::
     registry.deploy("mnist", "v2", net2)  # warm-before-cutover hot swap
     registry.rollback("mnist")            # instant: v1 stayed warm
 
+Every request is trace-scoped (W3C ``traceparent`` in, ``X-Trace-Id``
+out; spans across admission/coalesce/dispatch), per-model SLOs with
+multi-window burn rates gate ``/readyz`` (``slo.SLOTracker``), and a
+``/debug/*`` endpoint family (recent requests, trace fetch, profiler
+capture, compile-cache inventory, device memory) plus a SIGTERM/SIGQUIT
+flight-recorder dump make a misbehaving replica explainable.
+
 Env knobs (``DL4J_TPU_SERVING_*``): ``MAX_CONCURRENT``, ``QUEUE_DEPTH``,
 ``HIGH_WATER``, ``TIMEOUT_S``, ``DRAIN_TIMEOUT_S``, ``RETAIN``,
-``MANIFEST_DIR``.
+``MANIFEST_DIR``; observability: ``DL4J_TPU_SLO_OBJECTIVE``,
+``DL4J_TPU_SLO_LATENCY_MS``, ``DL4J_TPU_SLO_WINDOWS``,
+``DL4J_TPU_SLO_READYZ``, ``DL4J_TPU_REQUEST_RING``,
+``DL4J_TPU_DEBUG_ENDPOINTS``, ``DL4J_TPU_PROFILE_DIR``,
+``DL4J_TPU_FLIGHT_RECORDER_DIR``.
 """
 from .admission import (AdmissionController, DeadlineExceededError,  # noqa: F401
                         ShedError)
 from .lifecycle import GracefulLifecycle  # noqa: F401
 from .registry import (READY, RETIRED, WARMING, ModelRegistry,  # noqa: F401
                        ModelVersion)
-from .server import ModelServer  # noqa: F401
+from .server import ModelServer, RequestRing  # noqa: F401
+from .slo import SLOTracker  # noqa: F401

@@ -34,7 +34,13 @@ Telemetry (``common.metrics``), labeled per model/version:
 ``dl4j_serving_requests_total{model,version,outcome}``,
 ``dl4j_serving_shed_total{model,reason}``,
 ``dl4j_serving_queue_seconds{model,version}``,
-``dl4j_serving_queue_depth{model}``, ``dl4j_serving_active{model}``.
+``dl4j_serving_queue_depth{model}``, ``dl4j_serving_active{model}``;
+the internals that drive shedding decisions are exported too —
+``dl4j_serving_ewma_service_seconds{model}`` (the EWMA behind
+``Retry-After`` hints) and ``dl4j_serving_waiters{model}`` (the backlog
+the hint is computed from). Each ``admit()`` runs inside a
+``serving/admission`` span, so a request's admission wait — and a shed
+or deadline expiry, recorded as span errors — lands in its trace.
 """
 from __future__ import annotations
 
@@ -44,6 +50,7 @@ from typing import Callable, Optional
 
 from ..common.environment import environment
 from ..common.metrics import exponential_buckets, registry
+from ..common.tracing import current_context, span
 
 
 class ShedError(RuntimeError):
@@ -143,6 +150,17 @@ class AdmissionController:
             "dl4j_serving_active",
             "Requests currently holding a dispatch slot",
             labels=("model",)).labels(model=self.model)
+        self._m_ewma = reg.gauge(
+            "dl4j_serving_ewma_service_seconds",
+            "EWMA of per-request dispatch service time (drives the "
+            "Retry-After hint on shed responses)",
+            labels=("model",)).labels(model=self.model)
+        self._m_ewma.set(self._ewma_service_s)
+        self._m_waiters = reg.gauge(
+            "dl4j_serving_waiters",
+            "Backlog behind the retry-after estimate: requests waiting "
+            "for or holding a dispatch slot",
+            labels=("model",)).labels(model=self.model)
 
     # -- introspection ----------------------------------------------------
     @property
@@ -192,7 +210,16 @@ class AdmissionController:
         deadline budget) and return the permit. Raises ``ShedError`` when
         the queue is past high-water / full / draining, and
         ``DeadlineExceededError`` when the budget expires while waiting —
-        in both cases *before* any model work happens."""
+        in both cases *before* any model work happens. The wait runs in a
+        ``serving/admission`` span of the caller's trace; shed/expired
+        admissions exit it with error status."""
+        if current_context() is not None:
+            with span("serving/admission", model=self.model):
+                return self._admit(timeout_s, version)
+        return self._admit(timeout_s, version)
+
+    def _admit(self, timeout_s: Optional[float] = "default",
+               version: str = "") -> _Permit:
         budget = (self.default_timeout_s if timeout_s == "default"
                   else timeout_s)
         deadline = (time.monotonic() + budget
@@ -215,6 +242,7 @@ class AdmissionController:
                     "retry later")
             self._queue.append(ticket)
             self._m_depth.set(len(self._queue))
+            self._m_waiters.set(len(self._queue) + self._active)
             try:
                 # FIFO: dispatch only at the queue head with a free slot
                 while (self._active >= self.max_concurrent
@@ -242,12 +270,14 @@ class AdmissionController:
             finally:
                 self._queue.remove(ticket)
                 self._m_depth.set(len(self._queue))
+                self._m_waiters.set(len(self._queue) + self._active)
                 self._cv.notify_all()  # the head may have changed
             self._active += 1
             self._m_active.set(self._active)
-        self._m_queue_lat.labels(model=self.model,
-                                 version=version).observe(
-                                     time.monotonic() - t0)
+            self._m_waiters.set(len(self._queue) + self._active)
+        ctx = current_context()
+        self._m_queue_lat.labels(model=self.model, version=version).observe(
+            time.monotonic() - t0, exemplar=ctx.trace_id if ctx else None)
         return _Permit(self, version, deadline)
 
     def _release(self, permit: _Permit, service_s: float, outcome: str):
@@ -257,8 +287,10 @@ class AdmissionController:
             if outcome == "ok":
                 self._ewma_service_s = (0.8 * self._ewma_service_s
                                         + 0.2 * service_s)
+                self._m_ewma.set(self._ewma_service_s)
             self._active -= 1
             self._m_active.set(self._active)
+            self._m_waiters.set(len(self._queue) + self._active)
             self._cv.notify_all()
 
     # -- convenience ------------------------------------------------------

@@ -17,18 +17,31 @@ and (4) leave enough state behind that its replacement starts warm. Here:
    a rolling deploy) warms the same bucket ladder before taking traffic.
 4. The HTTP socket closes last, after the work is done.
 
+On the way down (and on demand at SIGQUIT, which does *not* drain) the
+lifecycle writes a **flight recorder**: one JSON file carrying the
+recent-requests ring, the buffered trace events, the per-model SLO
+state, and the metrics snapshot — the post-mortem a dead replica can no
+longer serve from ``/debug/requests``. Dumps land in
+``DL4J_TPU_FLIGHT_RECORDER_DIR`` (default ``<cache_dir>/flight``) and
+are written atomically.
+
 ``GracefulLifecycle.install()`` wires this to SIGTERM (handler chains to
 any previously installed one); ``drain()`` can also be called directly —
 e.g. from a preStop hook or a test.
 """
 from __future__ import annotations
 
+import json
 import logging
+import os
 import signal
 import threading
+import time
 from typing import Callable, Iterable, Optional
 
 from ..common.environment import environment
+from ..common.metrics import registry as metrics_registry
+from ..common.tracing import tracer
 from .registry import ModelRegistry
 from .server import ModelServer
 
@@ -54,12 +67,19 @@ class GracefulLifecycle:
         self._previous: dict = {}
 
     # -- signal wiring ----------------------------------------------------
-    def install(self, signals: Iterable[int] = (signal.SIGTERM,)):
-        """Install the drain handler (main thread only — a CPython
-        constraint of ``signal.signal``). The previous handler is chained
+    def install(self, signals: Iterable[int] = (signal.SIGTERM,),
+                dump_signals: Iterable[int] = (
+                    (signal.SIGQUIT,) if hasattr(signal, "SIGQUIT")
+                    else ())):
+        """Install the drain handler on ``signals`` and a dump-only
+        handler on ``dump_signals`` (SIGQUIT = "show me what you were
+        doing" without shutting down). Main thread only — a CPython
+        constraint of ``signal.signal``. Previous handlers are chained
         after ours and restored by ``uninstall()``."""
         for sig in signals:
             self._previous[sig] = signal.signal(sig, self._handle)
+        for sig in dump_signals:
+            self._previous[sig] = signal.signal(sig, self._handle_dump)
         return self
 
     def uninstall(self):
@@ -77,6 +97,59 @@ class GracefulLifecycle:
         prev = self._previous.get(signum)
         if callable(prev):
             prev(signum, frame)
+
+    def _handle_dump(self, signum, frame):
+        log.info("signal %d: dumping flight recorder", signum)
+        threading.Thread(target=self.dump_flight_recorder,
+                         name="dl4j-tpu-flight-dump", daemon=True).start()
+        prev = self._previous.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+
+    # -- flight recorder ---------------------------------------------------
+    def dump_flight_recorder(self, path: Optional[str] = None
+                             ) -> Optional[str]:
+        """Write the in-memory observability state — recent-requests
+        ring, buffered trace events, SLO snapshots, metrics — as one JSON
+        file (atomic: tmp + rename). ``path`` overrides the default
+        ``<flight_recorder_dir>/flight-<utc>-<pid>.json``; returns the
+        written path, or None when the recorder is disabled (no dir
+        resolvable) or the write failed — a dump must never break the
+        drain."""
+        try:
+            if path is None:
+                d = environment().flight_recorder_dir()
+                if not d:
+                    return None
+                path = os.path.join(
+                    d, time.strftime("flight-%Y%m%d-%H%M%S",
+                                     time.gmtime())
+                    + f"-{os.getpid()}.json")
+            server = self.server
+            doc = {
+                "dumped_at": time.time(),
+                "pid": os.getpid(),
+                "draining": self._drain_started,
+                "requests": (server.request_ring.records()
+                             if server is not None else []),
+                "slo": (server.slo_snapshot()
+                        if server is not None else {}),
+                "trace_events": tracer().events(),
+                "metrics": metrics_registry().snapshot(),
+            }
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, path)
+            log.info("flight recorder written to %s (%d requests, %d "
+                     "trace events)", path, len(doc["requests"]),
+                     len(doc["trace_events"]))
+            return path
+        except Exception:
+            log.exception("flight recorder dump failed")
+            return None
 
     # -- the drain sequence -----------------------------------------------
     @property
@@ -96,6 +169,9 @@ class GracefulLifecycle:
         try:
             if self.server is not None:
                 self.server.begin_drain()  # readyz -> 503, shed new work
+            # snapshot the in-memory observability state before engines
+            # flush — the post-mortem of whatever this replica was doing
+            self.dump_flight_recorder()
             ok = self.registry.drain_all(timeout_s=self.drain_timeout_s,
                                          save_manifests=True)
             if self.server is not None:

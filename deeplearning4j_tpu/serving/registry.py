@@ -37,6 +37,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from ..common.environment import environment
 from ..common.metrics import registry as metrics_registry
+from ..common.tracing import span
 from ..runtime import compile_cache
 from ..runtime.inference import EngineClosedError, InferenceEngine
 
@@ -241,24 +242,28 @@ class ModelRegistry:
         between resolution and dispatch) is transparently retried against
         the replacement — in-flight traffic never fails on a deploy or
         rollback. TimeoutError propagates when ``timeout_s`` expires
-        before dispatch."""
-        last_exc: Optional[Exception] = None
-        for _ in range(4):
-            mv = self.get(name, version)
-            try:
+        before dispatch. Runs in a ``serving/predict`` span of the
+        caller's trace (the engine's queue/dispatch spans nest under
+        it)."""
+        with span("serving/predict", model=name,
+                  version=str(version) if version is not None else ""):
+            last_exc: Optional[Exception] = None
+            for _ in range(4):
+                mv = self.get(name, version)
                 try:
-                    return mv.engine.submit(request,
-                                            timeout_s=timeout_s).result()
-                except ValueError:
-                    # batch larger than max_batch: the chunked sync path
-                    # (re-raises genuine bad-request errors itself)
-                    return mv.engine.infer(request)
-            except EngineClosedError as e:
-                last_exc = e
-                if version is not None:
-                    raise  # pinned to a retired/closed version: surface it
-                continue  # current was swapped mid-flight; re-resolve
-        raise last_exc  # registry is shutting down (drain_all)
+                    try:
+                        return mv.engine.submit(
+                            request, timeout_s=timeout_s).result()
+                    except ValueError:
+                        # batch larger than max_batch: the chunked sync
+                        # path (re-raises genuine bad-request errors)
+                        return mv.engine.infer(request)
+                except EngineClosedError as e:
+                    last_exc = e
+                    if version is not None:
+                        raise  # pinned to a retired/closed version
+                    continue  # current swapped mid-flight; re-resolve
+            raise last_exc  # registry is shutting down (drain_all)
 
     # -- rollback / retention ---------------------------------------------
     def rollback(self, name: str,

@@ -9,9 +9,18 @@ grown into a production serving surface:
     GET  /healthz                             liveness (process is up)
     GET  /readyz                              readiness (all current
                                               versions warmed, not
-                                              draining) — 503 otherwise
+                                              draining, SLOs not fast-
+                                              burning) — 503 otherwise
     GET  /metrics, /metrics.json              shared Prometheus/JSON
                                               exposition (PR 3 registry)
+    GET  /debug/requests                      recent-requests ring with
+                                              per-request span trees
+    GET  /debug/trace/<trace_id>              one trace's span tree
+    GET  /debug/compile_cache                 executable inventory + XLA
+                                              cost analysis
+    GET  /debug/memory                        device memory stats
+    POST /debug/profile?seconds=              on-demand jax.profiler
+                                              capture
 
 Request bodies are JSON (``{"inputs": ..., "timeout_s": ...}`` — a list
 becomes one array, a dict maps input/placeholder names for graph/SameDiff
@@ -22,6 +31,16 @@ AND micro-batcher queueing; an expired request answers 504 without ever
 occupying a batch slot. Overload answers 429 with a ``Retry-After`` hint
 from the admission controller. Status mapping: 404 unknown model/version,
 400 malformed input, 409 pinned to a retired version, 503 draining.
+
+Every predict is *request-scoped traced* (Dapper-style): an inbound W3C
+``traceparent`` header joins the caller's trace, otherwise a fresh
+trace_id is minted; either way the response echoes ``X-Trace-Id`` and
+the admission wait, micro-batch coalesce, and padded dispatch all record
+spans under that trace — ``GET /debug/requests`` (or
+``/debug/trace/<id>``) reconstructs the timeline, including for requests
+that expired or were shed. Each completed request also feeds the
+per-model SLO tracker (``serving/slo.py``); a fast-burning error budget
+flips ``/readyz`` (``DL4J_TPU_SLO_READYZ``).
 """
 from __future__ import annotations
 
@@ -30,21 +49,36 @@ import json
 import logging
 import re
 import threading
-from typing import Dict, Optional
-from urllib.parse import urlparse
+import time
+from collections import deque
+from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from ..common.environment import environment
 from ..common.httpserver import (JsonRequestHandler,
-                                 QuietThreadingHTTPServer, metrics_payload)
+                                 QuietThreadingHTTPServer, handle_debug_get,
+                                 handle_debug_post, metrics_payload)
+from ..common.tracing import (context_from_traceparent, span, span_tree,
+                              tracer, use_context)
 from ..runtime.inference import EngineClosedError
 from .admission import AdmissionController, DeadlineExceededError, ShedError
 from .registry import ModelRegistry
+from .slo import SLOTracker
 
 log = logging.getLogger(__name__)
 
 _PREDICT_RE = re.compile(r"^/v1/models/([^/:]+)(?::([^/]+))?/predict$")
 _NPY_TYPES = ("application/x-npy", "application/octet-stream")
+
+#: response status -> ring/SLO outcome label
+_OUTCOMES = {200: "ok", 400: "bad_request", 404: "not_found",
+             409: "retired", 429: "shed", 500: "error", 503: "draining",
+             504: "deadline"}
+
+#: statuses that count against the serving SLO (client mistakes don't)
+_SLO_STATUSES = (200, 429, 500, 503, 504)
 
 
 def _np_cast(a: np.ndarray) -> np.ndarray:
@@ -74,18 +108,45 @@ def _jsonable_outputs(out):
     return arr(out)
 
 
+class RequestRing:
+    """Bounded ring of completed-request records (the flight recorder's
+    and ``/debug/requests``'s source). Thread-safe via deque atomics."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = environment().request_ring_size()
+        self._records: deque = deque(maxlen=max(int(capacity), 1))
+
+    def add(self, record: dict):
+        self._records.append(record)
+
+    def records(self) -> List[dict]:
+        return list(self._records)
+
+    def find(self, trace_id: str) -> Optional[dict]:
+        for rec in reversed(self._records):
+            if rec.get("trace_id") == trace_id:
+                return rec
+        return None
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+
 class ModelServer:
     """HTTP server over a ModelRegistry with per-model admission control.
 
-    One ``AdmissionController`` per model, created on first use from the
-    ``DL4J_TPU_SERVING_*`` env knobs (or the constructor overrides);
-    ``set_admission()`` swaps in a custom-tuned controller."""
+    One ``AdmissionController`` and one ``SLOTracker`` per model, created
+    on first use from the ``DL4J_TPU_SERVING_*`` / ``DL4J_TPU_SLO_*`` env
+    knobs (or the constructor overrides); ``set_admission()`` /
+    ``set_slo()`` swap in custom-tuned instances."""
 
     def __init__(self, registry: Optional[ModelRegistry] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  max_concurrent: Optional[int] = None,
                  queue_depth: Optional[int] = None,
-                 high_water: Optional[int] = None):
+                 high_water: Optional[int] = None,
+                 request_ring: Optional[int] = None):
         self.registry = registry if registry is not None else ModelRegistry()
         self.host = host
         self.port = port
@@ -95,6 +156,9 @@ class ModelServer:
                                       high_water=high_water)
         self._admission: Dict[str, AdmissionController] = {}
         self._admission_lock = threading.Lock()
+        self._slo: Dict[str, SLOTracker] = {}
+        self._slo_lock = threading.Lock()
+        self.request_ring = RequestRing(request_ring)
         self._httpd: Optional[QuietThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -114,6 +178,78 @@ class ModelServer:
         with self._admission_lock:
             self._admission[name] = controller
         return self
+
+    # -- SLO plumbing ------------------------------------------------------
+    def slo_for(self, name: str) -> SLOTracker:
+        slo = self._slo.get(name)
+        if slo is None:
+            with self._slo_lock:
+                slo = self._slo.get(name)
+                if slo is None:
+                    slo = SLOTracker(name)
+                    self._slo[name] = slo
+        return slo
+
+    def set_slo(self, name: str, tracker: SLOTracker):
+        with self._slo_lock:
+            self._slo[name] = tracker
+        return self
+
+    def slo_healthy(self) -> bool:
+        """True while no served model's error budget is fast-burning."""
+        with self._slo_lock:
+            trackers = list(self._slo.values())
+        return all(t.healthy() for t in trackers)
+
+    def slo_snapshot(self) -> Dict[str, dict]:
+        with self._slo_lock:
+            trackers = dict(self._slo)
+        return {name: t.snapshot() for name, t in sorted(trackers.items())}
+
+    # -- request accounting ------------------------------------------------
+    def _finish_request(self, name: str, version: Optional[str],
+                        trace_id: str, status: int, duration_s: float,
+                        timeout_s: Optional[float]):
+        """Ring + SLO bookkeeping for one completed predict, whatever its
+        outcome (the ring is the /debug/requests + flight-recorder
+        source)."""
+        self.request_ring.add({
+            "trace_id": trace_id, "model": name, "version": version,
+            "status": status,
+            "outcome": _OUTCOMES.get(status, str(status)),
+            "ts": time.time(), "duration_s": round(duration_s, 6),
+            "timeout_s": timeout_s})
+        if status in _SLO_STATUSES:
+            try:
+                self.slo_for(name).record(duration_s, ok=status == 200)
+            except Exception:  # SLO bookkeeping never fails a response
+                log.exception("SLO record failed for %s", name)
+
+    def debug_requests(self, query: Dict[str, List[str]]) -> dict:
+        """``GET /debug/requests``: newest-first records, each joined
+        with its span tree from the trace ring (so a deadline-expired
+        request's admission wait / queue / coalesce / dispatch timeline
+        reads in one place)."""
+        try:
+            limit = int((query.get("n") or ["50"])[0])
+        except ValueError:
+            limit = 50
+        model = (query.get("model") or [None])[0]
+        trace_id = (query.get("trace_id") or [None])[0]
+        trc = tracer()
+        out = []
+        for rec in reversed(self.request_ring.records()):
+            if model and rec.get("model") != model:
+                continue
+            if trace_id and rec.get("trace_id") != trace_id:
+                continue
+            out.append({**rec,
+                        "spans": span_tree(trc.events_for(
+                            rec["trace_id"]))})
+            if len(out) >= max(limit, 1):
+                break
+        return {"count": len(out), "ring_size": len(self.request_ring),
+                "requests": out}
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> int:
@@ -150,14 +286,31 @@ class ModelServer:
         server = self
 
         class Handler(JsonRequestHandler):
+            _trace_id: Optional[str] = None
+
+            def send_payload(self, body, content_type="text/plain",
+                             code=200, headers=()):
+                self._last_status = code
+                if self._trace_id:
+                    headers = list(headers) + [("X-Trace-Id",
+                                                self._trace_id)]
+                super().send_payload(body, content_type, code, headers)
+
             def do_GET(self):
-                path = urlparse(self.path).path
+                self._trace_id = None  # keep-alive: no stale echo
+                url = urlparse(self.path)
+                path = url.path
                 if path == "/healthz":
                     self.send_payload(b"ok", "text/plain")
                 elif path == "/readyz":
-                    ready = not server.draining and server.registry.ready()
+                    warm = not server.draining and server.registry.ready()
+                    slo_ok = server.slo_healthy()
+                    ready = warm and (slo_ok
+                                      or not environment().slo_gate_readyz())
                     self.send_json(
                         {"ready": ready, "draining": server.draining,
+                         "slo_healthy": slo_ok,
+                         "slo": server.slo_snapshot(),
                          "models": server.registry.models()},
                         200 if ready else 503)
                 elif path == "/v1/models":
@@ -166,20 +319,65 @@ class ModelServer:
                     self.send_payload(*metrics_payload())
                 elif path == "/metrics.json":
                     self.send_payload(*metrics_payload("json"))
+                elif path.startswith("/debug/"):
+                    if not environment().debug_endpoints_enabled():
+                        self.send_json(
+                            {"error": "debug endpoints disabled "
+                                      "(DL4J_TPU_DEBUG_ENDPOINTS=0)"}, 404)
+                    elif path == "/debug/requests":
+                        self.send_json(server.debug_requests(
+                            parse_qs(url.query)))
+                    elif path == "/debug/slo":
+                        self.send_json({"healthy": server.slo_healthy(),
+                                        "models": server.slo_snapshot()})
+                    elif not handle_debug_get(self, path):
+                        self.send_json({"error": "not found"}, 404)
                 else:
                     self.send_json({"error": "not found"}, 404)
 
             def do_POST(self):
-                m = _PREDICT_RE.match(urlparse(self.path).path)
+                url = urlparse(self.path)
+                path = url.path
+                if path.startswith("/debug/"):
+                    if not environment().debug_endpoints_enabled() or \
+                            not handle_debug_post(self, path,
+                                                  parse_qs(url.query)):
+                        self.send_json({"error": "not found"}, 404)
+                    return
+                m = _PREDICT_RE.match(path)
                 if not m:
                     self.send_json({"error": "not found"}, 404)
                     return
                 name, version = m.group(1), m.group(2)
+                # join the caller's W3C trace or mint a fresh one; the
+                # whole predict — admission wait, coalesce, dispatch —
+                # records spans under it, and every response (including
+                # errors) echoes X-Trace-Id
+                ctx = context_from_traceparent(
+                    self.headers.get("traceparent"))
+                self._trace_id = ctx.trace_id
+                self._last_status = 500
+                self._served_version = version
+                self._timeout_s = None
                 if server.draining:
                     self.send_json(
                         {"error": "server is draining"}, 503,
                         headers=[("Retry-After", "1")])
                     return
+                t0 = time.perf_counter()
+                try:
+                    with use_context(ctx), \
+                            span("serving/request", model=name,
+                                 version=version or ""):
+                        self._dispatch_predict(name, version)
+                finally:
+                    server._finish_request(
+                        name, self._served_version, ctx.trace_id,
+                        self._last_status, time.perf_counter() - t0,
+                        self._timeout_s)
+
+            def _dispatch_predict(self, name: str,
+                                  version: Optional[str]):
                 try:
                     self._predict(name, version)
                 except KeyError as e:
@@ -224,8 +422,10 @@ class ModelServer:
                     if doc.get("timeout_s") is not None:
                         timeout_s = float(doc["timeout_s"])
                     as_npy = False
+                self._timeout_s = timeout_s
                 # resolve first so unknown models 404 before admission
                 mv = server.registry.get(name, version)
+                self._served_version = mv.version
                 ctrl = server.admission_for(name)
                 with ctrl.admit(timeout_s if timeout_s is not None
                                 else "default",
@@ -234,6 +434,7 @@ class ModelServer:
                         name, request, version=version,
                         timeout_s=permit.remaining_s())
                     mv = server.registry.get(name, version)
+                    self._served_version = mv.version
                 if as_npy:
                     first = out
                     if isinstance(out, dict):

@@ -12,7 +12,15 @@ traces — same hygiene as the serving front end); endpoints:
   GET  /train/overview?sid=   static info + updates
   GET  /metrics               runtime telemetry, Prometheus text exposition
   GET  /metrics.json          same registry as a JSON snapshot (+quantiles)
+  GET  /debug/trace/<id>      one trace's buffered span events + tree
+  GET  /debug/compile_cache   executable inventory with XLA cost analysis
+  GET  /debug/memory          per-device memory stats
+  POST /debug/profile?seconds=  on-demand jax.profiler capture
   POST /remote/static|update  remote stats ingestion
+
+(The ``/debug/*`` family is the shared one from ``common/httpserver.py``
+— the training dashboard answers the same debugging questions as the
+serving front end, minus the serving-only recent-requests ring.)
 """
 from __future__ import annotations
 
@@ -21,8 +29,10 @@ import threading
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from ..common.environment import environment
 from ..common.httpserver import (JsonRequestHandler,
-                                 QuietThreadingHTTPServer, metrics_payload)
+                                 QuietThreadingHTTPServer, handle_debug_get,
+                                 handle_debug_post, metrics_payload)
 from .stats import BaseStatsStorage, InMemoryStatsStorage
 
 _PAGE = """<!DOCTYPE html>
@@ -256,10 +266,21 @@ class UIServer:
                         "static": server.storage.get_static_info(sid),
                         "updates": server.storage.get_updates(sid),
                     })
+                elif url.path.startswith("/debug/"):
+                    if not (environment().debug_endpoints_enabled()
+                            and handle_debug_get(self, url.path)):
+                        self.send_json({"error": "not found"}, 404)
                 else:
                     self.send_json({"error": "not found"}, 404)
 
             def do_POST(self):
+                url = urlparse(self.path)
+                if url.path.startswith("/debug/"):
+                    if not (environment().debug_endpoints_enabled()
+                            and handle_debug_post(self, url.path,
+                                                  parse_qs(url.query))):
+                        self.send_json({"error": "not found"}, 404)
+                    return
                 payload = json.loads(self.read_body() or b"{}")
                 if self.path == "/remote/static":
                     server.storage.put_static_info(payload["session"],

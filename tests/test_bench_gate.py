@@ -229,6 +229,9 @@ class TestCheckTelemetryOverhead:
         assert rec["overhead_frac"] == pytest.approx(
             1.0 - rec["metrics_on_sps"] / rec["metrics_off_sps"], abs=1e-3)
         assert rec["overhead_frac"] < 0.5  # sanity: nowhere near 2x
+        # request-scoped tracing pass (PR 6): measured and sane
+        assert rec["metrics_trace_sps"] > 0
+        assert rec["tracing_overhead_frac"] < 0.5
 
 
 def _so_record(unloaded_p99=10.0, on_p99=20.0, on_completed=50, on_shed=40,

@@ -1,0 +1,764 @@
+"""Request-scoped tracing, SLO burn-rate gating, /debug endpoints (PR 6).
+
+Covers the acceptance contract: an HTTP request carrying a W3C
+``traceparent`` shares its trace_id with the admission/dispatch spans and
+gets it echoed as ``X-Trace-Id``; a coalesced micro-batch dispatch links
+back to every rider; a deadline-expired request's full timeline is
+reconstructable from ``/debug/requests`` by trace_id; a fast-burning SLO
+flips ``/readyz``; ``/debug/profile`` produces a loadable jax profiler
+capture. Plus the satellites: span error status + counter, atomic
+``tracer().export``, admission EWMA/waiters gauges, uptime/build-info
+gauges, and histogram exemplars.
+"""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import tracing
+from deeplearning4j_tpu.common.environment import environment
+from deeplearning4j_tpu.common.metrics import (MetricsRegistry, registry,
+                                               touch_runtime_info)
+from deeplearning4j_tpu.common.tracing import (TraceContext,
+                                               context_from_traceparent,
+                                               format_traceparent,
+                                               new_trace_id,
+                                               parse_traceparent, span,
+                                               span_tree, tracer,
+                                               use_context)
+from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.runtime.inference import InferenceEngine
+from deeplearning4j_tpu.serving import (AdmissionController,
+                                        GracefulLifecycle, ModelRegistry,
+                                        ModelServer, SLOTracker)
+
+N_IN, N_OUT = 6, 3
+
+
+def _mlp(seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=N_IN, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=N_OUT))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _x(n=4, seed=0):
+    return np.random.RandomState(seed).randn(n, N_IN).astype(np.float32)
+
+
+def _get(url, timeout=10):
+    try:
+        r = urllib.request.urlopen(url, timeout=timeout)
+        return r.status, r.headers, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, e.read()
+
+
+def _post(url, data=b"", content_type="application/json", timeout=30,
+          headers=()):
+    req = urllib.request.Request(url, data=data,
+                                 headers={"Content-Type": content_type,
+                                          **dict(headers)})
+    try:
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, r.headers, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, e.read()
+
+
+def _wait_until(pred, timeout=10.0):
+    """Poll until ``pred()`` is truthy and return it. The server finishes
+    a request's bookkeeping (root span append, ring record, SLO record)
+    *after* writing the response, so a client asserting on it must give
+    the handler thread a beat."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.01)
+    return pred()
+
+
+@pytest.fixture
+def served():
+    reg = ModelRegistry(manifest_dir=None)
+    reg.deploy("mlp", "v1", _mlp(0), example=_x())
+    server = ModelServer(reg)
+    port = server.start()
+    yield reg, server, f"http://127.0.0.1:{port}"
+    server.stop()
+    reg.drain_all(save_manifests=False)
+
+
+# ---------------------------------------------------------------------------
+# trace context + W3C traceparent
+# ---------------------------------------------------------------------------
+
+class TestTraceContext:
+    def test_parse_format_roundtrip(self):
+        ctx = TraceContext(new_trace_id(), tracing.new_span_id())
+        parsed = parse_traceparent(format_traceparent(ctx))
+        assert parsed.trace_id == ctx.trace_id
+        assert parsed.span_id == ctx.span_id
+
+    @pytest.mark.parametrize("header", [
+        None, "", "garbage", "00-abc-def-01",
+        "00-" + "0" * 32 + "-" + "ab" * 8 + "-01",   # all-zero trace
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # all-zero parent
+        "00-" + "zz" * 16 + "-" + "ab" * 8 + "-01",  # non-hex
+        "ff-" + "ab" * 16 + "-" + "ab" * 8 + "-01",  # forbidden version
+    ])
+    def test_parse_rejects_malformed(self, header):
+        assert parse_traceparent(header) is None
+
+    def test_context_from_traceparent(self):
+        hdr = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        ctx = context_from_traceparent(hdr)
+        assert ctx.trace_id == "ab" * 16 and ctx.span_id == "cd" * 8
+        fresh = context_from_traceparent(None)
+        assert len(fresh.trace_id) == 32 and fresh.span_id == ""
+
+    def test_nested_spans_form_tree(self):
+        tid = new_trace_id()
+        with use_context(TraceContext(tid)):
+            with span("outer", k=1):
+                with span("inner_a"):
+                    pass
+                with span("inner_b"):
+                    pass
+        events = tracer().events_for(tid)
+        assert {e["name"] for e in events} == {"outer", "inner_a",
+                                               "inner_b"}
+        tree = span_tree(events)
+        assert len(tree) == 1 and tree[0]["name"] == "outer"
+        assert [c["name"] for c in tree[0]["children"]] == ["inner_a",
+                                                            "inner_b"]
+        assert tree[0]["args"] == {"k": 1}
+
+    def test_span_without_context_stays_flat(self):
+        with span("flat_span_xyz"):
+            pass
+        evs = [e for e in tracer().events()
+               if e["name"] == "flat_span_xyz"]
+        assert evs and "trace_id" not in evs[-1].get("args", {})
+
+    def test_record_enters_tree_cross_thread(self):
+        tid = new_trace_id()
+        ctx = TraceContext(tid, tracing.new_span_id())
+        t0 = time.perf_counter()
+        tracer().record("batcher/work", t0, t0 + 0.001, context=ctx,
+                        rows=3)
+        events = tracer().events_for(tid)
+        assert events[-1]["name"] == "batcher/work"
+        assert events[-1]["args"]["parent_span_id"] == ctx.span_id
+        assert events[-1]["args"]["rows"] == 3
+
+    def test_span_tree_orphan_becomes_root(self):
+        tid = new_trace_id()
+        ctx = TraceContext(tid, "feedfacefeedface")  # parent not buffered
+        tracer().record("orphan", 0.0, 0.001, context=ctx)
+        tree = span_tree(tracer().events_for(tid))
+        assert len(tree) == 1 and tree[0]["name"] == "orphan"
+
+    def test_disabled_tracing_noop(self):
+        reg = registry()
+        prev = reg.enabled
+        reg.set_enabled(False)
+        try:
+            tid = new_trace_id()
+            with use_context(TraceContext(tid)):
+                with span("should_not_record"):
+                    pass
+                assert tracer().record("nor_this", 0, 1) is None
+            assert tracer().events_for(tid) == []
+        finally:
+            reg.set_enabled(prev)
+
+
+# ---------------------------------------------------------------------------
+# satellite: span error status + dl4j_span_errors_total
+# ---------------------------------------------------------------------------
+
+class TestSpanErrors:
+    def test_failing_span_records_error_and_counter(self):
+        fam = registry().counter(
+            "dl4j_span_errors_total",
+            "Spans that exited with an exception, by span name",
+            labels=("name",))
+        before = fam.labels(name="err_span_test").value()
+        tid = new_trace_id()
+        with pytest.raises(ValueError):
+            with use_context(TraceContext(tid)):
+                with span("err_span_test", job=7):
+                    raise ValueError("boom")
+        ev = tracer().events_for(tid)[-1]
+        assert ev["args"]["error"] == "ValueError"
+        assert ev["args"]["job"] == 7  # original attrs survive
+        assert fam.labels(name="err_span_test").value() == before + 1
+
+    def test_clean_span_has_no_error(self):
+        tid = new_trace_id()
+        with use_context(TraceContext(tid)):
+            with span("clean_span_test"):
+                pass
+        assert "error" not in tracer().events_for(tid)[-1]["args"]
+
+    def test_record_with_error_attr_counts(self):
+        fam = registry().counter(
+            "dl4j_span_errors_total",
+            "Spans that exited with an exception, by span name",
+            labels=("name",))
+        before = fam.labels(name="rec_err_test").value()
+        tracer().record("rec_err_test", 0.0, 0.001, error="TimeoutError")
+        assert fam.labels(name="rec_err_test").value() == before + 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: atomic export with parent-dir creation
+# ---------------------------------------------------------------------------
+
+class TestExportAtomic:
+    def test_export_creates_parent_dirs(self, tmp_path):
+        with span("export_parent_test"):
+            pass
+        path = tmp_path / "a" / "b" / "trace.json"
+        n = tracer().export(str(path))
+        assert path.exists() and n >= 1
+        doc = json.loads(path.read_text())
+        assert any(e["name"] == "export_parent_test"
+                   for e in doc["traceEvents"])
+
+    def test_export_leaves_no_tmp_file(self, tmp_path):
+        path = tmp_path / "t.json"
+        tracer().export(str(path))
+        leftovers = [p for p in os.listdir(tmp_path) if ".tmp" in p]
+        assert leftovers == []
+
+    def test_export_gzip_still_works(self, tmp_path):
+        import gzip
+        path = tmp_path / "deep" / "t.json.gz"
+        tracer().export(str(path))
+        with gzip.open(path, "rt") as f:
+            assert "traceEvents" in json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# histogram exemplars
+# ---------------------------------------------------------------------------
+
+class TestExemplars:
+    def test_exemplar_recorded_per_bucket(self):
+        reg = MetricsRegistry(enabled=True)
+        h = reg.histogram("ex_h", "x", buckets=(0.1, 1.0))
+        h.observe(0.05)                       # no exemplar
+        h.observe(5.0, exemplar="tail-trace")  # +Inf bucket
+        h.observe(0.5, exemplar="mid-trace")
+        series = reg.snapshot()["ex_h"]["series"][0]
+        ex = {e["le"]: e["trace_id"] for e in series["exemplars"]}
+        assert ex == {"+Inf": "tail-trace", "1": "mid-trace"}
+        json.dumps(reg.snapshot(), allow_nan=False)  # stays strict JSON
+
+    def test_no_exemplars_key_when_none(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.histogram("plain_h", "x", buckets=(1.0,)).observe(0.5)
+        assert "exemplars" not in reg.snapshot()["plain_h"]["series"][0]
+
+    def test_engine_latency_carries_trace_exemplar(self):
+        eng = InferenceEngine(_mlp(3), max_batch=4)
+        tid = new_trace_id()
+        with use_context(TraceContext(tid)):
+            eng.infer(_x(2))
+        fam = registry().get("dl4j_inference_latency_seconds")
+        found = [e for _, child in fam.children()
+                 for e in child.exemplars() if e["trace_id"] == tid]
+        assert found, "traced dispatch should leave a latency exemplar"
+
+
+# ---------------------------------------------------------------------------
+# satellite: admission internals exported
+# ---------------------------------------------------------------------------
+
+class TestAdmissionGauges:
+    def test_ewma_and_waiters_gauges(self):
+        ctrl = AdmissionController("gauged-model", max_concurrent=2,
+                                   queue_depth=4, high_water=3,
+                                   default_timeout_s=None)
+        ctrl.run(lambda: time.sleep(0.005))
+        reg = registry()
+        ewma = reg.get("dl4j_serving_ewma_service_seconds")
+        assert ewma is not None
+        val = ewma.labels(model="gauged-model").value()
+        assert val > 0  # seeded, then EWMA-updated by the completion
+        waiters = reg.get("dl4j_serving_waiters")
+        assert waiters.labels(model="gauged-model").value() == 0
+
+    def test_waiters_counts_active_holder(self):
+        ctrl = AdmissionController("gauged-model-2", max_concurrent=1,
+                                   queue_depth=4, high_water=3,
+                                   default_timeout_s=None)
+        with ctrl.admit():
+            assert registry().get("dl4j_serving_waiters").labels(
+                model="gauged-model-2").value() == 1
+        assert registry().get("dl4j_serving_waiters").labels(
+            model="gauged-model-2").value() == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: uptime + build info
+# ---------------------------------------------------------------------------
+
+class TestRuntimeInfoGauges:
+    def test_touch_runtime_info_sets_gauges(self):
+        import jax
+        touch_runtime_info()
+        reg = registry()
+        assert reg.get("dl4j_uptime_seconds").value() > 0
+        fam = reg.get("dl4j_build_info")
+        (labels, child), = fam.children()
+        label_map = dict(zip(fam.label_names, labels))
+        assert label_map["jax_version"] == jax.__version__
+        assert label_map["platform"] == jax.default_backend()
+        assert label_map["cache"] in ("enabled", "disabled")
+        assert child.value() == 1
+
+    def test_metrics_endpoints_carry_runtime_info(self, served):
+        _, _, base = served
+        code, _, body = _get(base + "/metrics")
+        assert code == 200
+        assert b"dl4j_uptime_seconds" in body
+        assert b"dl4j_build_info" in body
+        code, _, body = _get(base + "/metrics.json")
+        doc = json.loads(body)
+        assert doc["dl4j_uptime_seconds"]["series"][0]["value"] > 0
+
+
+# ---------------------------------------------------------------------------
+# SLO tracker
+# ---------------------------------------------------------------------------
+
+class TestSLOTracker:
+    def test_burn_rate_math(self):
+        t = SLOTracker("m", objective=0.9, latency_objective_s=None,
+                       windows=((10.0, 2.0),), min_samples=1)
+        for i in range(10):
+            t.record(0.01, ok=i >= 5)  # 5 bad of 10
+        # error rate 0.5 against a 0.1 budget -> burn rate 5
+        assert t.burn_rate(10.0) == pytest.approx(5.0)
+        assert t.hit_rate(10.0) == pytest.approx(0.5)
+        assert not t.healthy()
+
+    def test_idle_model_is_healthy(self):
+        t = SLOTracker("m-idle", objective=0.999)
+        assert t.burn_rate(300.0) == 0.0
+        assert t.healthy() and t.snapshot()["healthy"]
+
+    def test_min_samples_guard(self):
+        t = SLOTracker("m-guard", objective=0.999,
+                       latency_objective_s=None,
+                       windows=((10.0, 1.0),), min_samples=5)
+        for _ in range(3):
+            t.record(0.01, ok=False)
+        assert t.healthy()  # burning hard, but not enough evidence
+        for _ in range(3):
+            t.record(0.01, ok=False)
+        assert not t.healthy()
+
+    def test_all_windows_must_burn(self):
+        clock = [1000.0]
+        t = SLOTracker("m-windows", objective=0.9,
+                       latency_objective_s=None,
+                       windows=((5.0, 1.0), (1000.0, 1.0)),
+                       min_samples=1, clock=lambda: clock[0])
+        # long-ago successes keep the long window under threshold
+        for _ in range(200):
+            t.record(0.01, ok=True)
+        clock[0] += 900.0
+        for _ in range(10):
+            t.record(0.01, ok=False)
+        assert t.burn_rate(5.0) > 1.0       # short window fully burning
+        assert t.burn_rate(1000.0) < 1.0    # long window still fine
+        assert t.healthy()
+
+    def test_latency_objective_counts_slow_ok_as_bad(self):
+        t = SLOTracker("m-lat", objective=0.5, latency_objective_s=0.05,
+                       windows=((10.0, 1.0),), min_samples=1)
+        assert t.record(0.01, ok=True) is True
+        assert t.record(0.2, ok=True) is False  # ok but too slow
+
+    def test_gauges_exported(self):
+        t = SLOTracker("m-gauges", objective=0.9,
+                       latency_objective_s=None,
+                       windows=((10.0, 1.0),), min_samples=1)
+        t.record(0.01, ok=False)
+        reg = registry()
+        assert reg.get("dl4j_slo_burn_rate").labels(
+            model="m-gauges", window=10).value() > 0
+        assert reg.get("dl4j_slo_healthy").labels(
+            model="m-gauges").value() == 0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end trace propagation over HTTP
+# ---------------------------------------------------------------------------
+
+class TestEndToEndTracing:
+    def test_traceparent_joined_and_echoed(self, served):
+        _, _, base = served
+        tid = "ab" * 16
+        code, headers, body = _post(
+            base + "/v1/models/mlp/predict",
+            json.dumps({"inputs": _x().tolist()}).encode(),
+            headers=[("traceparent", f"00-{tid}-{'cd' * 8}-01")])
+        assert code == 200
+        assert headers["X-Trace-Id"] == tid
+        # admission + dispatch spans all share the request's trace_id
+        # (the root span lands just after the response is written)
+        want = {"serving/request", "serving/admission", "serving/predict",
+                "inference/dispatch"}
+        names = _wait_until(
+            lambda: (lambda got: want <= got and got)(
+                {e["name"] for e in tracer().events_for(tid)}))
+        assert want <= set(names or ())
+
+    def test_fresh_trace_minted_without_header(self, served):
+        _, _, base = served
+        code, headers, _ = _post(
+            base + "/v1/models/mlp/predict",
+            json.dumps({"inputs": _x().tolist()}).encode())
+        assert code == 200
+        tid = headers["X-Trace-Id"]
+        assert len(tid) == 32
+        assert _wait_until(
+            lambda: any(e["name"] == "serving/request"
+                        for e in tracer().events_for(tid)))
+
+    def test_error_response_still_echoes_trace_id(self, served):
+        _, _, base = served
+        tid = "5e" * 16
+        code, headers, _ = _post(
+            base + "/v1/models/nope/predict",
+            json.dumps({"inputs": _x().tolist()}).encode(),
+            headers=[("traceparent", f"00-{tid}-{'cd' * 8}-01")])
+        assert code == 404
+        assert headers["X-Trace-Id"] == tid
+
+    def test_coalesced_dispatch_links_both_riders(self):
+        eng = InferenceEngine(_mlp(1), max_batch=8, max_delay_ms=50)
+        eng.warmup(_x(2))
+        ctx_a = TraceContext(new_trace_id())
+        ctx_b = TraceContext(new_trace_id())
+        # queue both requests before the batcher thread starts, so they
+        # deterministically coalesce into one dispatch
+        orig = eng._ensure_thread
+        eng._ensure_thread = lambda: None
+        try:
+            with use_context(ctx_a):
+                fa = eng.submit(_x(2, seed=1))
+            with use_context(ctx_b):
+                fb = eng.submit(_x(3, seed=2))
+        finally:
+            eng._ensure_thread = orig
+        eng._ensure_thread()
+        fa.result(timeout=30)
+        fb.result(timeout=30)
+        dispatches = [e for e in tracer().events()
+                      if e["name"] == "inference/dispatch"
+                      and ctx_a.trace_id in e.get("args", {}).get(
+                          "trace_ids", [])]
+        assert dispatches, "dispatch span must name its riders"
+        args = dispatches[-1]["args"]
+        assert set(args["trace_ids"]) == {ctx_a.trace_id, ctx_b.trace_id}
+        assert args["coalesced"] == 2
+        # each rider's own trace carries its ride span (queue + dispatch;
+        # recorded by the batcher just after resolving the futures)
+        for ctx in (ctx_a, ctx_b):
+            rides = _wait_until(
+                lambda: [e for e in tracer().events_for(ctx.trace_id)
+                         if e["name"] == "inference/ride"])
+            assert rides and rides[-1]["args"]["coalesced"] == 2
+            assert rides[-1]["args"]["queue_s"] >= 0
+        eng.close(5)
+
+    def test_expired_submit_leaves_error_span(self):
+        eng = InferenceEngine(_mlp(2), max_batch=4)
+        eng.warmup(_x(2))
+        ctx = TraceContext(new_trace_id())
+        orig = eng._ensure_thread
+        eng._ensure_thread = lambda: None
+        try:
+            with use_context(ctx):
+                fut = eng.submit(_x(2), timeout_s=0.0)
+            time.sleep(0.01)
+        finally:
+            eng._ensure_thread = orig
+        eng._ensure_thread()
+        with pytest.raises(TimeoutError):
+            fut.result(timeout=30)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            evs = [e for e in tracer().events_for(ctx.trace_id)
+                   if e["name"] == "inference/queue_expired"]
+            if evs:
+                break
+            time.sleep(0.01)
+        assert evs and evs[-1]["args"]["error"] == "TimeoutError"
+        eng.close(5)
+
+    def test_deadline_expired_timeline_reconstructable(self, served):
+        """Acceptance: a 504'd request's admission wait is readable from
+        /debug/requests by its trace_id."""
+        reg, server, base = served
+        ctrl = AdmissionController("mlp", max_concurrent=1, queue_depth=8,
+                                   high_water=8, default_timeout_s=None)
+        server.set_admission("mlp", ctrl)
+        tid = "dd" * 16
+        permit = ctrl.admit()  # saturate: the request waits, then expires
+        try:
+            code, headers, _ = _post(
+                base + "/v1/models/mlp/predict",
+                json.dumps({"inputs": _x().tolist(),
+                            "timeout_s": 0.05}).encode(),
+                headers=[("traceparent", f"00-{tid}-{'cd' * 8}-01")])
+            assert code == 504
+            assert headers["X-Trace-Id"] == tid
+        finally:
+            permit.__exit__(None, None, None)
+        doc = _wait_until(lambda: (lambda d: d["count"] == 1 and d)(
+            json.loads(_get(base + f"/debug/requests?trace_id={tid}")[2])))
+        assert doc and doc["count"] == 1
+        rec = doc["requests"][0]
+        assert rec["status"] == 504 and rec["outcome"] == "deadline"
+        assert rec["timeout_s"] == pytest.approx(0.05)
+        assert rec["duration_s"] >= 0.05  # the admission wait is in it
+        # the span tree shows WHERE the time went: the admission wait
+        # under serving/request, exited with error status
+        tree = rec["spans"]
+        assert tree and tree[0]["name"] == "serving/request"
+
+        def _find(nodes, name):
+            for n in nodes:
+                if n["name"] == name:
+                    return n
+                hit = _find(n["children"], name)
+                if hit is not None:
+                    return hit
+            return None
+
+        adm = _find(tree, "serving/admission")
+        assert adm is not None
+        assert adm["args"]["error"] == "DeadlineExceededError"
+        assert adm["dur"] >= 0.05 * 1e6  # waited the full budget (us)
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate -> /readyz
+# ---------------------------------------------------------------------------
+
+class TestReadyzSLOGate:
+    def test_fast_burn_flips_readyz(self, served, monkeypatch):
+        _, server, base = served
+        tracker = SLOTracker("mlp", objective=0.999,
+                             latency_objective_s=None,
+                             windows=((5.0, 1.0), (10.0, 1.0)),
+                             min_samples=5)
+        server.set_slo("mlp", tracker)
+        code, _, body = _get(base + "/readyz")
+        assert code == 200 and json.loads(body)["slo_healthy"]
+        for _ in range(10):
+            tracker.record(0.01, ok=False)
+        code, _, body = _get(base + "/readyz")
+        doc = json.loads(body)
+        assert code == 503
+        assert doc["ready"] is False and doc["slo_healthy"] is False
+        assert doc["slo"]["mlp"]["windows"][0]["burn_rate"] > 1.0
+        # the gate is an env knob: models stay warm, readyz recovers
+        monkeypatch.setenv("DL4J_TPU_SLO_READYZ", "0")
+        code, _, body = _get(base + "/readyz")
+        assert code == 200
+        assert json.loads(body)["slo_healthy"] is False  # still reported
+
+    def test_slo_fed_by_http_outcomes(self, served):
+        _, server, base = served
+        tracker = SLOTracker("mlp", objective=0.9,
+                             latency_objective_s=None,
+                             windows=((60.0, 1.0),), min_samples=1)
+        server.set_slo("mlp", tracker)
+        code, _, _ = _post(base + "/v1/models/mlp/predict",
+                           json.dumps({"inputs": _x().tolist()}).encode())
+        assert code == 200
+        _wait_until(lambda: tracker._counts(60.0)[1] == 1)
+        assert tracker.hit_rate(60.0) == 1.0
+        # a 404 (client mistake) must NOT count against the SLO
+        _post(base + "/v1/models/nope/predict",
+              json.dumps({"inputs": _x().tolist()}).encode())
+        time.sleep(0.1)  # give its (absent) bookkeeping a chance to land
+        assert tracker._counts(60.0)[1] == 1
+
+
+# ---------------------------------------------------------------------------
+# /debug endpoint family
+# ---------------------------------------------------------------------------
+
+class TestDebugEndpoints:
+    def test_debug_requests_ring(self, served):
+        _, server, base = served
+        for i in range(3):
+            _post(base + "/v1/models/mlp/predict",
+                  json.dumps({"inputs": _x(2, seed=i).tolist()}).encode())
+        _wait_until(lambda: len(server.request_ring) >= 3)
+        doc = _wait_until(lambda: (lambda d: d["count"] == 2 and d)(
+            json.loads(_get(base + "/debug/requests?n=2")[2])))
+        assert doc and doc["count"] == 2
+        rec = doc["requests"][0]
+        assert rec["model"] == "mlp" and rec["outcome"] == "ok"
+        assert rec["spans"][0]["name"] == "serving/request"
+
+    def test_debug_trace_fetch(self, served):
+        _, _, base = served
+        tid = "fa" * 16
+        _post(base + "/v1/models/mlp/predict",
+              json.dumps({"inputs": _x().tolist()}).encode(),
+              headers=[("traceparent", f"00-{tid}-{'cd' * 8}-01")])
+        doc = _wait_until(lambda: (lambda d: d["count"] >= 3 and any(
+            n["name"] == "serving/request" for n in d["tree"]) and d)(
+                json.loads(_get(base + f"/debug/trace/{tid}")[2])))
+        assert doc and doc["trace_id"] == tid
+        assert doc["tree"][0]["name"] == "serving/request"
+
+    def test_debug_slo_endpoint(self, served):
+        _, server, base = served
+        server.slo_for("mlp")
+        code, _, body = _get(base + "/debug/slo")
+        doc = json.loads(body)
+        assert code == 200 and doc["healthy"] is True
+        assert "mlp" in doc["models"]
+
+    def test_debug_compile_cache_inventory(self, served):
+        _, _, base = served
+        code, _, body = _get(base + "/debug/compile_cache")
+        doc = json.loads(body)
+        assert code == 200 and doc["enabled"]
+        # the deploy's warmup populated the store (conftest pins the dir)
+        assert doc["entry_count"] >= 1 and doc["entries"]
+        entry = doc["entries"][0]
+        assert entry["payload_bytes"] > 0 and entry["key"]
+        costed = [e for e in doc["entries"] if "cost" in e]
+        assert costed, "warmup-compiled entries carry XLA cost analysis"
+        assert costed[0]["cost"].get("flops", 0) > 0
+
+    def test_debug_memory(self, served):
+        _, _, base = served
+        code, _, body = _get(base + "/debug/memory")
+        doc = json.loads(body)
+        assert code == 200
+        assert len(doc["devices"]) >= 1
+        assert doc["devices"][0]["platform"] == "cpu"
+
+    def test_debug_profile_capture_loadable(self, served, tmp_path,
+                                            monkeypatch):
+        """Acceptance: POST /debug/profile produces a loadable jax
+        profiler capture (an .xplane.pb on disk)."""
+        monkeypatch.setenv("DL4J_TPU_PROFILE_DIR", str(tmp_path))
+        _, _, base = served
+        code, _, body = _post(base + "/debug/profile?seconds=0.2")
+        doc = json.loads(body)
+        assert code == 200, doc
+        assert os.path.isdir(doc["path"])
+        xplanes = [f for f in doc["files"]
+                   if f["file"].endswith(".xplane.pb")]
+        assert xplanes and xplanes[0]["bytes"] > 0
+        on_disk = os.path.join(doc["path"], xplanes[0]["file"])
+        assert os.path.getsize(on_disk) == xplanes[0]["bytes"]
+
+    def test_debug_profile_rejects_bad_seconds(self, served):
+        _, _, base = served
+        code, _, body = _post(base + "/debug/profile?seconds=abc")
+        assert code == 400
+
+    def test_debug_disabled_by_env(self, served, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_DEBUG_ENDPOINTS", "0")
+        _, _, base = served
+        for path in ("/debug/requests", "/debug/memory",
+                     "/debug/compile_cache"):
+            code, _, _ = _get(base + path)
+            assert code == 404
+        code, _, _ = _post(base + "/debug/profile?seconds=0.1")
+        assert code == 404
+
+    def test_ui_server_shares_debug_family(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+        ui = UIServer(port=0)
+        port = ui.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            code, _, body = _get(base + "/debug/memory")
+            assert code == 200 and json.loads(body)["devices"]
+            code, _, body = _get(base + "/debug/compile_cache")
+            assert code == 200 and json.loads(body)["enabled"]
+            tid = new_trace_id()
+            with use_context(TraceContext(tid)):
+                with span("ui_debug_probe"):
+                    pass
+            code, _, body = _get(base + f"/debug/trace/{tid}")
+            assert code == 200
+            assert json.loads(body)["tree"][0]["name"] == "ui_debug_probe"
+        finally:
+            ui.stop()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_dump_writes_ring_and_traces(self, served, tmp_path):
+        reg, server, base = served
+        tid = "bb" * 16
+        _post(base + "/v1/models/mlp/predict",
+              json.dumps({"inputs": _x().tolist()}).encode(),
+              headers=[("traceparent", f"00-{tid}-{'cd' * 8}-01")])
+        _wait_until(lambda: server.request_ring.find(tid) is not None)
+        life = GracefulLifecycle(reg, server)
+        path = str(tmp_path / "dump" / "flight.json")
+        written = life.dump_flight_recorder(path)
+        assert written == path
+        doc = json.loads(open(path).read())
+        assert any(r["trace_id"] == tid for r in doc["requests"])
+        assert any(e.get("args", {}).get("trace_id") == tid
+                   for e in doc["trace_events"])
+        assert "mlp" in doc["slo"] or doc["slo"] == {}
+        assert "dl4j_serving_requests_total" in doc["metrics"]
+
+    def test_drain_dumps_flight_recorder(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_FLIGHT_RECORDER_DIR", str(tmp_path))
+        reg = ModelRegistry(manifest_dir=None)
+        reg.deploy("mlp", "v1", _mlp(0), example=_x())
+        server = ModelServer(reg)
+        port = server.start()
+        _post(f"http://127.0.0.1:{port}/v1/models/mlp/predict",
+              json.dumps({"inputs": _x().tolist()}).encode())
+        _wait_until(lambda: len(server.request_ring) >= 1)
+        life = GracefulLifecycle(reg, server, drain_timeout_s=10)
+        assert life.drain()
+        dumps = [p for p in os.listdir(tmp_path)
+                 if p.startswith("flight-") and p.endswith(".json")]
+        assert len(dumps) == 1
+        doc = json.loads((tmp_path / dumps[0]).read_text())
+        assert doc["draining"] is True
+        assert len(doc["requests"]) >= 1
+
+    def test_disabled_without_dir(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_FLIGHT_RECORDER_DIR", "")
+        monkeypatch.setenv("DL4J_TPU_CACHE_DIR", "")
+        reg = ModelRegistry(manifest_dir=None)
+        life = GracefulLifecycle(reg, None)
+        assert life.dump_flight_recorder() is None
