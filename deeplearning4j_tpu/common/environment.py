@@ -37,6 +37,9 @@ class EnvironmentVars:
     DL4J_TPU_FLASH_MIN_SEQ = "DL4J_TPU_FLASH_MIN_SEQ"
     DL4J_TPU_INFERENCE_BUCKETING = "DL4J_TPU_INFERENCE_BUCKETING"
     DL4J_TPU_INFERENCE_MAX_BATCH = "DL4J_TPU_INFERENCE_MAX_BATCH"
+    DL4J_TPU_DECODE_SLOTS = "DL4J_TPU_DECODE_SLOTS"
+    DL4J_TPU_DECODE_MAX_CTX = "DL4J_TPU_DECODE_MAX_CTX"
+    DL4J_TPU_DECODE_MAX_TOKENS = "DL4J_TPU_DECODE_MAX_TOKENS"
     DL4J_TPU_REMAT = "DL4J_TPU_REMAT"
     DL4J_TPU_GRAD_ACCUM = "DL4J_TPU_GRAD_ACCUM"
     DL4J_TPU_ZERO1 = "DL4J_TPU_ZERO1"
@@ -76,6 +79,9 @@ class SystemProperties:
     FLASH_MIN_SEQ = "flash_min_seq"
     INFERENCE_BUCKETING = "inference_bucketing"
     INFERENCE_MAX_BATCH = "inference_max_batch"
+    DECODE_SLOTS = "decode_slots"
+    DECODE_MAX_CTX = "decode_max_ctx"
+    DECODE_MAX_TOKENS = "decode_max_tokens"
     TRAINING_REMAT = "training_remat"
     TRAINING_GRAD_ACCUM = "training_grad_accum"
     TRAINING_ZERO1 = "training_zero1"
@@ -116,6 +122,10 @@ _ENV_FOR_PROP = {
         EnvironmentVars.DL4J_TPU_INFERENCE_BUCKETING,
     SystemProperties.INFERENCE_MAX_BATCH:
         EnvironmentVars.DL4J_TPU_INFERENCE_MAX_BATCH,
+    SystemProperties.DECODE_SLOTS: EnvironmentVars.DL4J_TPU_DECODE_SLOTS,
+    SystemProperties.DECODE_MAX_CTX: EnvironmentVars.DL4J_TPU_DECODE_MAX_CTX,
+    SystemProperties.DECODE_MAX_TOKENS:
+        EnvironmentVars.DL4J_TPU_DECODE_MAX_TOKENS,
     SystemProperties.TRAINING_REMAT: EnvironmentVars.DL4J_TPU_REMAT,
     SystemProperties.TRAINING_GRAD_ACCUM: EnvironmentVars.DL4J_TPU_GRAD_ACCUM,
     SystemProperties.TRAINING_ZERO1: EnvironmentVars.DL4J_TPU_ZERO1,
@@ -160,6 +170,9 @@ _DEFAULTS = {
     SystemProperties.FLASH_MIN_SEQ: "1024",
     SystemProperties.INFERENCE_BUCKETING: "1",
     SystemProperties.INFERENCE_MAX_BATCH: "128",
+    SystemProperties.DECODE_SLOTS: "8",
+    SystemProperties.DECODE_MAX_CTX: "256",
+    SystemProperties.DECODE_MAX_TOKENS: "128",
     SystemProperties.TRAINING_REMAT: "none",
     SystemProperties.TRAINING_GRAD_ACCUM: "1",
     SystemProperties.TRAINING_ZERO1: "0",
@@ -336,6 +349,45 @@ class Environment:
 
     def set_inference_max_batch(self, n: int):
         return self.set_property(SystemProperties.INFERENCE_MAX_BATCH, int(n))
+
+    # -- generative decode knobs (runtime/generation.py) -------------------
+    def decode_slots(self) -> int:
+        """Concurrent sequences a DecodeEngine's KV cache holds — the
+        continuous-batching width (``DL4J_TPU_DECODE_SLOTS``)."""
+        v = self.property(SystemProperties.DECODE_SLOTS)
+        try:
+            return max(int(v), 1)
+        except (TypeError, ValueError):
+            return 8
+
+    def set_decode_slots(self, n: int):
+        return self.set_property(SystemProperties.DECODE_SLOTS, int(n))
+
+    def decode_max_ctx(self) -> int:
+        """Per-sequence context window (prompt + generation) of the
+        preallocated KV cache (``DL4J_TPU_DECODE_MAX_CTX``; capped by the
+        model's position-embedding table)."""
+        v = self.property(SystemProperties.DECODE_MAX_CTX)
+        try:
+            return max(int(v), 2)
+        except (TypeError, ValueError):
+            return 256
+
+    def set_decode_max_ctx(self, n: int):
+        return self.set_property(SystemProperties.DECODE_MAX_CTX, int(n))
+
+    def decode_max_tokens(self) -> int:
+        """Default/maximum generated tokens per request when the caller
+        does not pass ``max_tokens`` (``DL4J_TPU_DECODE_MAX_TOKENS``;
+        always additionally capped by the slot's remaining context)."""
+        v = self.property(SystemProperties.DECODE_MAX_TOKENS)
+        try:
+            return max(int(v), 1)
+        except (TypeError, ValueError):
+            return 128
+
+    def set_decode_max_tokens(self, n: int):
+        return self.set_property(SystemProperties.DECODE_MAX_TOKENS, int(n))
 
     # -- memory-scaled training knobs (nn/fit_fastpath.py, parallel) -------
     # Fleet-wide defaults; an explicit per-network conf.remat / conf.grad_accum

@@ -39,12 +39,21 @@ def attention_dispatch(seq_len: int) -> str:
     below the threshold flash-requesting models silently take the XLA
     path. Evaluated at trace time (shapes are static under jit), so the
     ``dl4j_attn_dispatch_total{path=}`` counter ticks once per compiled
-    executable, and the debug log fires once per process."""
+    executable, and the debug log fires once per process.
+
+    Decode-shaped queries (seq_len < 2 — the KV-cached single-token step
+    of ``runtime.generation.DecodeEngine``) take the XLA path
+    UNCONDITIONALLY, whatever ``DL4J_TPU_FLASH_MIN_SEQ`` says: a 1-row
+    query can never amortize the Pallas kernel's blocking, and the decode
+    executable must stay stable across env retunes."""
     global _dispatch_logged
     from ..common.environment import environment
 
     env = environment()
-    path = "flash" if int(seq_len) >= env.flash_min_seq() else "xla"
+    if int(seq_len) < 2:
+        path = "xla"
+    else:
+        path = "flash" if int(seq_len) >= env.flash_min_seq() else "xla"
     try:
         env.metrics().counter(
             "dl4j_attn_dispatch_total",

@@ -1,1 +1,4 @@
-"""Model zoo (deeplearning4j-zoo analog)."""
+"""Native flagship models: `bert` (encoder, TP/SP/PP training),
+`causal_lm` (decoder-only LM with cache-aware attention — the generative
+serving workload), `seq2seq` (LSTM encoder-decoder with cached greedy
+decode)."""

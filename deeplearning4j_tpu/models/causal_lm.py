@@ -1,0 +1,287 @@
+"""Decoder-only causal language model: the generative serving workload.
+
+Reference context: the serving stack built through PR 6 only does one-shot
+``predict`` — the reference ecosystem has no autoregressive serving path at
+all. This model is the minimal decoder-only transformer that exercises the
+generative fast path (``runtime.generation.DecodeEngine``): it reuses the
+BERT block layout (post-LN residual blocks, f32 layernorm/softmax
+accumulation, tied word-embedding head) with a causal mask and a
+*cache-aware* attention so the same parameters serve three call shapes:
+
+- ``forward``   — full-sequence causal forward ``[B, T] -> [B, T, V]``
+  (training/eval, and the honest "recompute the whole prefix every token"
+  reference the ``generative_decode`` bench measures against);
+- ``prefill``   — fill one slot of a preallocated KV cache from a padded
+  prompt in one fixed-shape dispatch and return the next-token logits;
+- ``decode``    — one token per active slot against the cache (the O(1)
+  per-token step; ``kernels.attention_dispatch`` routes this seq-len-1
+  shape to the XLA attention path unconditionally).
+
+KV cache layout (the vLLM-style preallocated design, ring-indexed by the
+slot allocator in ``DecodeEngine``)::
+
+    {"k": [slots, layers, max_ctx, heads, head_dim],
+     "v": [slots, layers, max_ctx, heads, head_dim]}
+
+Rows at positions ``> lengths[slot]`` are masked out of every attention —
+stale rows left by a previous occupant of the slot can never leak into a
+new request (the poison-value test in tests/test_generation.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .bert import _ln
+
+
+@dataclasses.dataclass
+class CausalLMConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def tiny() -> "CausalLMConfig":
+        """For tests/dryruns: f32 so the cached decode path is numerically
+        interchangeable with the full-recompute forward (token-identical
+        greedy continuations)."""
+        return CausalLMConfig(vocab_size=97, hidden_size=64, num_layers=2,
+                              num_heads=4, intermediate_size=128,
+                              max_position_embeddings=256,
+                              dtype=jnp.float32)
+
+
+# -- parameters ----------------------------------------------------------
+
+def init_params(key, config: CausalLMConfig) -> Dict:
+    c = config
+    dt = c.dtype
+    std = 0.02
+
+    def dense(key, shape):
+        return (std * jax.random.normal(key, shape, jnp.float32)).astype(dt)
+
+    keys = iter(jax.random.split(key, 2 + 8 * c.num_layers))
+    params = {
+        "embeddings": {
+            "word": dense(next(keys), (c.vocab_size, c.hidden_size)),
+            "position": dense(next(keys), (c.max_position_embeddings,
+                                           c.hidden_size)),
+            "ln_g": jnp.ones((c.hidden_size,), jnp.float32),
+            "ln_b": jnp.zeros((c.hidden_size,), jnp.float32),
+        },
+        "layers": [],
+    }
+    H, Dh, E, F = c.num_heads, c.head_dim, c.hidden_size, c.intermediate_size
+    for _ in range(c.num_layers):
+        params["layers"].append({
+            "attn": {
+                "wq": dense(next(keys), (E, H, Dh)),
+                "wk": dense(next(keys), (E, H, Dh)),
+                "wv": dense(next(keys), (E, H, Dh)),
+                "wo": dense(next(keys), (H, Dh, E)),
+                "bq": jnp.zeros((H, Dh), dt), "bk": jnp.zeros((H, Dh), dt),
+                "bv": jnp.zeros((H, Dh), dt), "bo": jnp.zeros((E,), dt),
+            },
+            "mlp": {
+                "w1": dense(next(keys), (E, F)), "b1": jnp.zeros((F,), dt),
+                "w2": dense(next(keys), (F, E)), "b2": jnp.zeros((E,), dt),
+            },
+            "ln1_g": jnp.ones((E,), jnp.float32),
+            "ln1_b": jnp.zeros((E,), jnp.float32),
+            "ln2_g": jnp.ones((E,), jnp.float32),
+            "ln2_b": jnp.zeros((E,), jnp.float32),
+        })
+    return params
+
+
+def init_kv_cache(config: CausalLMConfig, slots: int, max_ctx: int) -> Dict:
+    """Preallocated per-slot KV cache (see module docstring for layout)."""
+    c = config
+    if max_ctx > c.max_position_embeddings:
+        raise ValueError(
+            f"max_ctx {max_ctx} exceeds max_position_embeddings "
+            f"{c.max_position_embeddings}")
+    shape = (int(slots), c.num_layers, int(max_ctx), c.num_heads, c.head_dim)
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
+# -- shared block pieces -------------------------------------------------
+
+def _mlp_ln(layer, h, attn_out, c: CausalLMConfig):
+    """The post-attention half of a block: residual+LN, MLP, residual+LN."""
+    h = _ln(h + attn_out, layer["ln1_g"], layer["ln1_b"], c.layer_norm_eps)
+    mlp = layer["mlp"]
+    inter = jax.nn.gelu(
+        jnp.einsum("...e,ef->...f", h, mlp["w1"]) + mlp["b1"])
+    mlp_out = jnp.einsum("...f,fe->...e", inter, mlp["w2"]) + mlp["b2"]
+    return _ln(h + mlp_out, layer["ln2_g"], layer["ln2_b"], c.layer_norm_eps)
+
+
+def _embed(params, input_ids, positions, c: CausalLMConfig):
+    e = params["embeddings"]
+    h = jnp.take(e["word"], input_ids, axis=0)
+    h = h + jnp.take(e["position"], positions, axis=0)
+    return _ln(h, e["ln_g"], e["ln_b"], c.layer_norm_eps)
+
+
+def _lm_logits(params, h):
+    """Tied word-embedding head, f32 logits."""
+    return jnp.einsum("...e,ve->...v", h,
+                      params["embeddings"]["word"]).astype(jnp.float32)
+
+
+_BIG_NEG = jnp.finfo(jnp.float32).min
+
+
+def _causal_block(layer, h, c: CausalLMConfig, use_flash: bool = False):
+    """Full-sequence causal attention block. Returns (h, (k, v)) with
+    k/v [B, T, H, Dh] so prefill can bulk-write them into the cache."""
+    from ..kernels import attention_dispatch
+
+    a = layer["attn"]
+    B, T = h.shape[0], h.shape[1]
+    q = jnp.einsum("bte,ehd->bthd", h, a["wq"]) + a["bq"]
+    k = jnp.einsum("bte,ehd->bthd", h, a["wk"]) + a["bk"]
+    v = jnp.einsum("bte,ehd->bthd", h, a["wv"]) + a["bv"]
+    if use_flash and attention_dispatch(T) == "flash":
+        from ..kernels import flash_attention
+        ctx = flash_attention(q, k, v, causal=True)
+    else:
+        scale = (q.shape[-1]) ** -0.5
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        logits = jnp.where(causal[None, None], logits, _BIG_NEG)
+        probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bqhd,hde->bqe", ctx, a["wo"]) + a["bo"]
+    return _mlp_ln(layer, h, out, c), (k, v)
+
+
+# -- the three call shapes -----------------------------------------------
+
+def forward(params, input_ids, config: CausalLMConfig,
+            use_flash: bool = False):
+    """Full causal forward: token ids [B, T] -> next-token logits
+    [B, T, V] (f32). This is the recompute path — O(T²) work per generated
+    token when used for decoding, which is exactly what the KV-cached
+    ``prefill``/``decode`` pair exists to avoid."""
+    B, T = input_ids.shape
+    h = _embed(params, input_ids, jnp.arange(T)[None, :], config)
+    for layer in params["layers"]:
+        h, _ = _causal_block(layer, h, config, use_flash)
+    return _lm_logits(params, h)
+
+
+def prefill(params, cache, input_ids, slot, length, config: CausalLMConfig):
+    """Fill ``slot`` of the KV cache from a padded prompt in ONE dispatch.
+
+    ``input_ids`` [1, T] is the prompt zero-padded to its bucket; ``length``
+    (traced scalar) is the real prompt length. All T rows of the slot are
+    written — rows >= length hold padding garbage that the decode masks
+    out (and overwrites as generation proceeds). Returns
+    ``(cache, logits[V])`` with the logits taken at position length-1,
+    i.e. the distribution of the first generated token.
+    """
+    c = config
+    h = _embed(params, input_ids, jnp.arange(input_ids.shape[1])[None, :], c)
+    ks, vs = [], []
+    for layer in params["layers"]:
+        h, (k, v) = _causal_block(layer, h, c)
+        ks.append(k[0])            # [T, H, Dh]
+        vs.append(v[0])
+    upd_k = jnp.stack(ks)[None].astype(cache["k"].dtype)  # [1, L, T, H, Dh]
+    upd_v = jnp.stack(vs)[None].astype(cache["v"].dtype)
+    start = (slot, 0, 0, 0, 0)
+    cache = {"k": lax.dynamic_update_slice(cache["k"], upd_k, start),
+             "v": lax.dynamic_update_slice(cache["v"], upd_v, start)}
+    last = lax.dynamic_index_in_dim(h[0], length - 1, axis=0,
+                                    keepdims=False)
+    return cache, _lm_logits(params, last)
+
+
+def decode(params, cache, tokens, lengths, config: CausalLMConfig):
+    """One KV-cached decode step over every slot.
+
+    ``tokens`` [S] is each slot's current token (position ``lengths[s]``),
+    ``lengths`` [S] how many tokens the slot's cache already holds. The
+    step writes each token's K/V at its position and attends over
+    positions ``0..lengths[s]`` — O(max_ctx) work per token instead of a
+    full-prefix recompute. Returns ``(cache, logits[S, V])``.
+
+    The query is seq-len-1, so ``kernels.attention_dispatch`` pins this
+    step to the XLA attention path regardless of DL4J_TPU_FLASH_MIN_SEQ
+    (a 1-row query can never amortize the Pallas kernel's blocking).
+    """
+    from ..kernels import attention_dispatch
+
+    c = config
+    S = tokens.shape[0]
+    C = cache["k"].shape[2]
+    positions = jnp.clip(lengths, 0, c.max_position_embeddings - 1)
+    h = _embed(params, tokens, positions, c)            # [S, E]
+    assert attention_dispatch(1) == "xla"
+    key_mask = jnp.arange(C)[None, :] <= lengths[:, None]   # [S, C]
+    scale = c.head_dim ** -0.5
+    rows = jnp.arange(S)
+    cache_k, cache_v = cache["k"], cache["v"]
+    for i, layer in enumerate(params["layers"]):
+        a = layer["attn"]
+        q = jnp.einsum("se,ehd->shd", h, a["wq"]) + a["bq"]
+        k = jnp.einsum("se,ehd->shd", h, a["wk"]) + a["bk"]
+        v = jnp.einsum("se,ehd->shd", h, a["wv"]) + a["bv"]
+        cache_k = cache_k.at[rows, i, lengths].set(
+            k.astype(cache_k.dtype), mode="drop")
+        cache_v = cache_v.at[rows, i, lengths].set(
+            v.astype(cache_v.dtype), mode="drop")
+        att = jnp.einsum("shd,schd->shc", q, cache_k[:, i],
+                         preferred_element_type=jnp.float32) * scale
+        att = jnp.where(key_mask[:, None, :], att, _BIG_NEG)
+        probs = jax.nn.softmax(att, axis=-1).astype(h.dtype)
+        ctx = jnp.einsum("shc,schd->shd", probs, cache_v[:, i])
+        out = jnp.einsum("shd,hde->se", ctx, a["wo"]) + a["bo"]
+        h = _mlp_ln(layer, h, out, c)
+    return {"k": cache_k, "v": cache_v}, _lm_logits(params, h)
+
+
+def count_params(params) -> int:
+    return sum(int(p.size) for p in jax.tree_util.tree_leaves(params))
+
+
+class CausalLM:
+    """Config + params bundled behind the generative-model protocol the
+    serving registry and ``DecodeEngine`` duck-type on: ``init_kv_cache``,
+    ``prefill``, ``decode`` (and ``forward`` for the recompute path)."""
+
+    def __init__(self, config: Optional[CausalLMConfig] = None,
+                 params: Optional[Dict] = None, seed: int = 0):
+        self.config = config or CausalLMConfig.tiny()
+        self.params = (params if params is not None
+                       else init_params(jax.random.key(seed), self.config))
+
+    def init_kv_cache(self, slots: int, max_ctx: int) -> Dict:
+        return init_kv_cache(self.config, slots, max_ctx)
+
+    def prefill(self, params, cache, input_ids, slot, length):
+        return prefill(params, cache, input_ids, slot, length, self.config)
+
+    def decode(self, params, cache, tokens, lengths):
+        return decode(params, cache, tokens, lengths, self.config)
+
+    def forward(self, input_ids):
+        return forward(self.params, input_ids, self.config)

@@ -5,7 +5,11 @@ reference builds seq2seq as a ComputationGraph of LSTM + RnnOutputLayer
 with manual decode loops in user code (dl4j-examples
 AdditionRNN/Seq2SeqExample pattern). TPU-native: one params pytree, the
 training step is a single jitted fwd+bwd+Adam program, and autoregressive
-decode is a `lax.scan` — compiled once, no per-token Python.
+decode is a `lax.scan` carrying the decode cache (the recurrent state —
+the LSTM analog of a transformer KV cache) — compiled once, one
+``decode_step`` per token, no per-token Python and no prefix recompute
+(``greedy_decode_recompute`` keeps the naive O(T²) loop as the
+regression-test reference).
 """
 from __future__ import annotations
 
@@ -98,24 +102,63 @@ def init_opt_state(params):
     return _optim.adam_init(params)
 
 
+def decode_step(params, cache, tok):
+    """ONE cached decode step: the LSTM analog of a KV-cached transformer
+    step. ``cache`` is the carried recurrent state ``(h, cell)`` — the
+    entire summary of the prefix, so each token costs one ``lstm_cell``
+    instead of re-running the decoder over the whole prefix. Returns
+    ``(new_cache, logits [B, V])``."""
+    h, cell = cache
+    emb = jnp.take(params["embed"], tok, axis=0)           # [B, E]
+    h, cell = recurrent.lstm_cell(emb, h, cell, params["dec"]["Wx"],
+                                  params["dec"]["Wh"],
+                                  params["dec"]["b"])
+    logits = h @ params["out"]["W"] + params["out"]["b"]
+    return (h, cell), logits
+
+
 def greedy_decode(params, src_ids, max_len: int, c: Seq2SeqConfig):
-    """Autoregressive argmax decode as one lax.scan (whole loop compiled)."""
+    """Autoregressive argmax decode as one lax.scan with the decode cache
+    (the recurrent state) carried through the scan — O(T) total work,
+    the whole loop compiled. Token-identical to the naive
+    ``greedy_decode_recompute`` reference (regression-tested)."""
     B = src_ids.shape[0]
-    h0, c0 = _encode(params, src_ids)
+    cache = _encode(params, src_ids)
     bos = jnp.full((B,), c.bos_token, jnp.int32)
 
     def step(carry, _):
-        h, cell, tok = carry
-        emb = jnp.take(params["embed"], tok, axis=0)       # [B, E]
-        h, cell = recurrent.lstm_cell(emb, h, cell, params["dec"]["Wx"],
-                                      params["dec"]["Wh"],
-                                      params["dec"]["b"])
-        logits = h @ params["out"]["W"] + params["out"]["b"]
+        cache, tok = carry
+        cache, logits = decode_step(params, cache, tok)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return (h, cell, nxt), nxt
+        return (cache, nxt), nxt
 
-    _, toks = lax.scan(step, (h0, c0, bos), None, length=max_len)
+    _, toks = lax.scan(step, (cache, bos), None, length=max_len)
     return jnp.swapaxes(toks, 0, 1)                        # [B, max_len]
+
+
+def greedy_decode_recompute(params, src_ids, max_len: int, c: Seq2SeqConfig):
+    """The naive O(T²) reference: every token re-runs the decoder LSTM
+    over the ENTIRE generated prefix from the encoder state (the manual
+    decode-loop pattern of the reference's Seq2SeqExample user code, and
+    the transformer equivalent of recomputing attention over the whole
+    prefix each step). Exists so the regression test can assert
+    ``greedy_decode`` is token-identical while carrying the cache."""
+    import numpy as np
+
+    B = src_ids.shape[0]
+    h0, c0 = _encode(params, src_ids)
+    toks = np.full((B, 1), c.bos_token, np.int32)          # BOS + prefix
+    out = []
+    for _ in range(max_len):
+        emb = jnp.take(params["embed"], jnp.asarray(toks), axis=0)
+        h_seq, _, _ = recurrent.lstm_layer(emb, params["dec"]["Wx"],
+                                           params["dec"]["Wh"],
+                                           params["dec"]["b"], h0=h0, c0=c0)
+        logits = h_seq[:, -1] @ params["out"]["W"] + params["out"]["b"]
+        nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        out.append(nxt)
+        toks = np.concatenate([toks, nxt[:, None]], axis=1)
+    return jnp.asarray(np.stack(out, axis=1))              # [B, max_len]
 
 
 def fit_copy_task(c: Seq2SeqConfig = None, steps: int = 300, B: int = 32,

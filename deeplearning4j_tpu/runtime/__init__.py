@@ -1,8 +1,11 @@
 """Runtime services: memory-workspace shims (the XLA-arena-backed
 MemoryWorkspace API surface, `workspace.py`), the shape-bucketed compiled
-inference engine (`inference.py`), and the persistent AOT executable cache
-(`compile_cache.py`) that makes process restarts start warm."""
+inference engine (`inference.py`), the KV-cached generative decode engine
+with continuous batching (`generation.py`), and the persistent AOT
+executable cache (`compile_cache.py`) that makes process restarts start
+warm."""
 from . import compile_cache
+from .generation import DecodeEngine, is_generative_model, sample_tokens
 from .inference import (InferenceEngine, bucket_for, bucket_ladder,
                         counted_jit, maybe_pad_tree, pad_batch, slice_batch)
 from .workspace import (DummyWorkspace, LayerWorkspaceMgr, MemoryWorkspace,
@@ -11,6 +14,7 @@ from .workspace import (DummyWorkspace, LayerWorkspaceMgr, MemoryWorkspace,
 
 __all__ = ["DummyWorkspace", "LayerWorkspaceMgr", "MemoryWorkspace",
            "Nd4jWorkspaceManager", "WorkspaceConfiguration",
-           "workspace_manager", "InferenceEngine", "bucket_ladder",
+           "workspace_manager", "InferenceEngine", "DecodeEngine",
+           "is_generative_model", "sample_tokens", "bucket_ladder",
            "bucket_for", "pad_batch", "slice_batch", "maybe_pad_tree",
            "counted_jit", "compile_cache"]

@@ -41,6 +41,20 @@ histogram are labeled ``cache=hit|miss|bypass`` (hit = loaded from the
 store; miss = compiled and stored; bypass = caching disabled or entry not
 eligible for serialization). Disable everything with
 ``DL4J_TPU_CACHE_DIR=""``.
+
+**Donated-KV-cache decode steps are store-ineligible by design.** The
+generative fast path (``runtime.generation.DecodeEngine``) donates its
+preallocated KV cache into every prefill/decode step so the cache updates
+in place; a raw stored executable bypasses jax's donation bookkeeping, so
+``_eligible`` refuses these entries and they dispatch through the live
+jit. They are NOT silently missing from telemetry: ``counted_jit`` still
+records one compile event per signature with ``cache=bypass`` on both
+``dl4j_compiles_total`` and the ``dl4j_compile_seconds`` histogram
+(asserted in tests/test_generation.py). On accelerator backends the
+``jax_compilation_cache_dir`` backstop at ``<dir>/xla`` still shortens
+their restart compiles; on CPU the backstop stays off (see
+``_backstop_wanted``) and decode steps recompile on restart — bounded at
+one prefill per prompt bucket plus one decode executable.
 """
 from __future__ import annotations
 

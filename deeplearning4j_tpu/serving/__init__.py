@@ -24,6 +24,15 @@ Minimal flow::
     registry.deploy("mnist", "v2", net2)  # warm-before-cutover hot swap
     registry.rollback("mnist")            # instant: v1 stayed warm
 
+Generative models (the ``models.causal_lm.CausalLM`` protocol) deploy
+the same way but behind a KV-cached, continuous-batching
+``runtime.generation.DecodeEngine`` and serve via
+``POST /v1/models/<name>/generate`` (optionally streaming tokens as
+chunked ndjson); the SLO latency fed per request is time-to-first-token::
+
+    registry.deploy("lm", "v1", causal_lm)           # warms prefill
+    registry.generate("lm", [1, 5, 9], max_tokens=32)  # ladder + decode
+
 Every request is trace-scoped (W3C ``traceparent`` in, ``X-Trace-Id``
 out; spans across admission/coalesce/dispatch), per-model SLOs with
 multi-window burn rates gate ``/readyz`` (``slo.SLOTracker``), and a

@@ -39,6 +39,7 @@ from ..common.environment import environment
 from ..common.metrics import registry as metrics_registry
 from ..common.tracing import span
 from ..runtime import compile_cache
+from ..runtime.generation import DecodeEngine, is_generative_model
 from ..runtime.inference import EngineClosedError, InferenceEngine
 
 log = logging.getLogger(__name__)
@@ -69,7 +70,8 @@ class ModelVersion:
         return {"version": self.version, "state": self.state,
                 "deployed_at": self.deployed_at,
                 "buckets": list(self.engine.ladder),
-                "max_batch": self.engine.max_batch}
+                "max_batch": self.engine.max_batch,
+                "generative": isinstance(self.engine, DecodeEngine)}
 
 
 class ModelRegistry:
@@ -131,7 +133,11 @@ class ModelRegistry:
                warm: bool = True,
                example=None,
                batch_sizes: Optional[Sequence[int]] = None,
-               drain_timeout_s: Optional[float] = None) -> ModelVersion:
+               drain_timeout_s: Optional[float] = None,
+               decode_slots: Optional[int] = None,
+               decode_max_ctx: Optional[int] = None,
+               decode_prompt_buckets: Optional[Sequence[int]] = None,
+               decode_eos_token: Optional[int] = None) -> ModelVersion:
         """Deploy ``model`` as ``name``:``version`` with warm-before-
         cutover; returns the new (current) ModelVersion.
 
@@ -142,7 +148,16 @@ class ModelRegistry:
         warmup manifest. ``warm=False`` cuts over immediately in the
         ``warming`` state — ``/readyz`` stays false until ``warm()``
         runs. The outgoing version drains in-flight requests and is
-        parked warm for rollback."""
+        parked warm for rollback.
+
+        A *generative* model (the ``models.causal_lm.CausalLM`` protocol:
+        ``init_kv_cache``/``prefill``/``decode``) deploys behind a
+        ``DecodeEngine`` instead of an ``InferenceEngine`` — served via
+        ``generate()`` / ``POST /v1/models/<name>/generate``; the
+        ``decode_*`` knobs size its slot count, context window, prompt
+        bucket ladder, and default EOS (env defaults otherwise). Warmup
+        compiles one prefill executable per prompt bucket plus the single
+        decode-step executable."""
         name, version = str(name), str(version)
         with self._lock:
             if self._draining:
@@ -154,10 +169,17 @@ class ModelRegistry:
                         "deployed (versions are immutable; bump the "
                         "version)")
             outgoing = self._current.get(name)
-        engine = InferenceEngine(model, max_batch=max_batch,
-                                 buckets=buckets, max_delay_ms=max_delay_ms,
-                                 outputs=outputs,
-                                 manifest_path=self.manifest_path(name))
+        if is_generative_model(model):
+            engine = DecodeEngine(model, slots=decode_slots,
+                                  max_ctx=decode_max_ctx,
+                                  prompt_buckets=decode_prompt_buckets,
+                                  eos_token=decode_eos_token)
+        else:
+            engine = InferenceEngine(model, max_batch=max_batch,
+                                     buckets=buckets,
+                                     max_delay_ms=max_delay_ms,
+                                     outputs=outputs,
+                                     manifest_path=self.manifest_path(name))
         mv = ModelVersion(name, version, engine)
         if warm:
             self._warm_engine(engine, outgoing, example, batch_sizes)
@@ -181,9 +203,12 @@ class ModelRegistry:
                  f", replacing {outgoing.version}" if outgoing else "")
         return mv
 
-    def _warm_engine(self, engine: InferenceEngine,
-                     outgoing: Optional[ModelVersion], example,
-                     batch_sizes) -> List[int]:
+    def _warm_engine(self, engine, outgoing: Optional[ModelVersion],
+                     example, batch_sizes) -> List[int]:
+        if isinstance(engine, DecodeEngine):
+            # generative warmup is fully shape-determined: prefill bucket
+            # ladder + the one decode step; nothing to replay from traffic
+            return engine.warmup()
         if example is not None:
             return engine.warmup(example, batch_sizes=batch_sizes)
         if outgoing is not None:
@@ -250,6 +275,10 @@ class ModelRegistry:
             last_exc: Optional[Exception] = None
             for _ in range(4):
                 mv = self.get(name, version)
+                if isinstance(mv.engine, DecodeEngine):
+                    raise TypeError(
+                        f"model '{name}' is generative; use generate() "
+                        "(POST /v1/models/<name>/generate)")
                 try:
                     try:
                         return mv.engine.submit(
@@ -264,6 +293,35 @@ class ModelRegistry:
                         raise  # pinned to a retired/closed version
                     continue  # current swapped mid-flight; re-resolve
             raise last_exc  # registry is shutting down (drain_all)
+
+    # -- generation -------------------------------------------------------
+    def generate(self, name: str, prompt,
+                 version: Optional[str] = None,
+                 timeout_s: Optional[float] = None, **opts):
+        """Route one generation request to the resolved version's
+        ``DecodeEngine`` and block for the result dict. Same hot-swap
+        contract as ``predict()``: a request that races a cutover is
+        transparently retried against the replacement. ``timeout_s``
+        bounds the wait for a decode slot; ``opts`` pass through to
+        ``DecodeEngine.generate`` (max_tokens, temperature, top_k,
+        eos_token, on_token)."""
+        with span("serving/generate", model=name,
+                  version=str(version) if version is not None else ""):
+            last_exc: Optional[Exception] = None
+            for _ in range(4):
+                mv = self.get(name, version)
+                if not isinstance(mv.engine, DecodeEngine):
+                    raise TypeError(
+                        f"model '{name}' is not generative; use predict()")
+                try:
+                    return mv.engine.generate(
+                        prompt, timeout_s=timeout_s, **opts).result()
+                except EngineClosedError as e:
+                    last_exc = e
+                    if version is not None:
+                        raise  # pinned to a retired/closed version
+                    continue  # current swapped mid-flight; re-resolve
+            raise last_exc
 
     # -- rollback / retention ---------------------------------------------
     def rollback(self, name: str,

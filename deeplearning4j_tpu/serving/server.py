@@ -5,6 +5,10 @@ grown into a production serving surface:
 
     POST /v1/models/<name>/predict            current version
     POST /v1/models/<name>:<version>/predict  pinned version
+    POST /v1/models/<name>/generate           autoregressive generation
+                                              (KV-cached decode engine;
+                                              optional chunked token
+                                              streaming)
     GET  /v1/models                           registry listing
     GET  /healthz                             liveness (process is up)
     GET  /readyz                              readiness (all current
@@ -32,6 +36,16 @@ occupying a batch slot. Overload answers 429 with a ``Retry-After`` hint
 from the admission controller. Status mapping: 404 unknown model/version,
 400 malformed input, 409 pinned to a retired version, 503 draining.
 
+``/generate`` serves models deployed behind a ``DecodeEngine``
+(``{"prompt": [ids...], "max_tokens", "temperature", "top_k",
+"eos_token", "stream", "timeout_s"}``): requests ride the same admission
+controller and trace context as predict; the per-request SLO latency fed
+to the tracker is **time-to-first-token**, the generative latency
+objective. With ``"stream": true`` the response is
+``application/x-ndjson`` over chunked transfer encoding — one
+``{"token": id}`` line per sampled token, then a final
+``{"done": true, ...}`` summary line.
+
 Every predict is *request-scoped traced* (Dapper-style): an inbound W3C
 ``traceparent`` header joins the caller's trace, otherwise a fresh
 trace_id is minted; either way the response echoes ``X-Trace-Id`` and
@@ -57,7 +71,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from ..common.environment import environment
-from ..common.httpserver import (JsonRequestHandler,
+from ..common.httpserver import (CLIENT_DISCONNECTS, JsonRequestHandler,
                                  QuietThreadingHTTPServer, handle_debug_get,
                                  handle_debug_post, metrics_payload)
 from ..common.tracing import (context_from_traceparent, span, span_tree,
@@ -70,6 +84,7 @@ from .slo import SLOTracker
 log = logging.getLogger(__name__)
 
 _PREDICT_RE = re.compile(r"^/v1/models/([^/:]+)(?::([^/]+))?/predict$")
+_GENERATE_RE = re.compile(r"^/v1/models/([^/:]+)(?::([^/]+))?/generate$")
 _NPY_TYPES = ("application/x-npy", "application/octet-stream")
 
 #: response status -> ring/SLO outcome label
@@ -209,19 +224,25 @@ class ModelServer:
     # -- request accounting ------------------------------------------------
     def _finish_request(self, name: str, version: Optional[str],
                         trace_id: str, status: int, duration_s: float,
-                        timeout_s: Optional[float]):
-        """Ring + SLO bookkeeping for one completed predict, whatever its
+                        timeout_s: Optional[float],
+                        kind: str = "predict",
+                        latency_s: Optional[float] = None):
+        """Ring + SLO bookkeeping for one completed request, whatever its
         outcome (the ring is the /debug/requests + flight-recorder
-        source)."""
+        source). ``latency_s`` overrides the SLO-fed latency — generate
+        requests feed time-to-first-token, the generative latency
+        objective, while ``duration_s`` in the ring stays wall time."""
         self.request_ring.add({
             "trace_id": trace_id, "model": name, "version": version,
-            "status": status,
+            "kind": kind, "status": status,
             "outcome": _OUTCOMES.get(status, str(status)),
             "ts": time.time(), "duration_s": round(duration_s, 6),
             "timeout_s": timeout_s})
         if status in _SLO_STATUSES:
             try:
-                self.slo_for(name).record(duration_s, ok=status == 200)
+                self.slo_for(name).record(
+                    latency_s if latency_s is not None else duration_s,
+                    ok=status == 200)
             except Exception:  # SLO bookkeeping never fails a response
                 log.exception("SLO record failed for %s", name)
 
@@ -344,21 +365,26 @@ class ModelServer:
                                                   parse_qs(url.query)):
                         self.send_json({"error": "not found"}, 404)
                     return
+                kind = "predict"
                 m = _PREDICT_RE.match(path)
-                if not m:
+                if m is None:
+                    m = _GENERATE_RE.match(path)
+                    kind = "generate"
+                if m is None:
                     self.send_json({"error": "not found"}, 404)
                     return
                 name, version = m.group(1), m.group(2)
                 # join the caller's W3C trace or mint a fresh one; the
-                # whole predict — admission wait, coalesce, dispatch —
-                # records spans under it, and every response (including
-                # errors) echoes X-Trace-Id
+                # whole request — admission wait, prefill/decode or
+                # coalesce/dispatch — records spans under it, and every
+                # response (including errors) echoes X-Trace-Id
                 ctx = context_from_traceparent(
                     self.headers.get("traceparent"))
                 self._trace_id = ctx.trace_id
                 self._last_status = 500
                 self._served_version = version
                 self._timeout_s = None
+                self._latency_s = None
                 if server.draining:
                     self.send_json(
                         {"error": "server is draining"}, 503,
@@ -368,18 +394,22 @@ class ModelServer:
                 try:
                     with use_context(ctx), \
                             span("serving/request", model=name,
-                                 version=version or ""):
-                        self._dispatch_predict(name, version)
+                                 version=version or "", kind=kind):
+                        self._dispatch_request(kind, name, version)
                 finally:
                     server._finish_request(
                         name, self._served_version, ctx.trace_id,
                         self._last_status, time.perf_counter() - t0,
-                        self._timeout_s)
+                        self._timeout_s, kind=kind,
+                        latency_s=self._latency_s)
 
-            def _dispatch_predict(self, name: str,
+            def _dispatch_request(self, kind: str, name: str,
                                   version: Optional[str]):
                 try:
-                    self._predict(name, version)
+                    if kind == "generate":
+                        self._generate(name, version)
+                    else:
+                        self._predict(name, version)
                 except KeyError as e:
                     self.send_json({"error": str(e.args[0])}, 404)
                 except ShedError as e:
@@ -450,5 +480,120 @@ class ModelServer:
                 else:
                     self.send_json({"model": name, "version": mv.version,
                                     "outputs": _jsonable_outputs(out)})
+
+            # -- generation (KV-cached decode engine) ---------------------
+            def _generate(self, name: str, version: Optional[str]):
+                doc = json.loads(self.read_body() or b"{}")
+                if "prompt" not in doc:
+                    raise ValueError('JSON body must carry "prompt" '
+                                     "(a list of token ids)")
+                prompt = doc["prompt"]
+                if not isinstance(prompt, (list, tuple)) or not all(
+                        isinstance(t, int) for t in prompt):
+                    raise ValueError('"prompt" must be a flat list of '
+                                     "integer token ids")
+                timeout_s = None
+                hdr = self.headers.get("X-Request-Timeout-S")
+                if hdr:
+                    timeout_s = float(hdr)
+                if doc.get("timeout_s") is not None:
+                    timeout_s = float(doc["timeout_s"])
+                self._timeout_s = timeout_s
+                opts = {}
+                if doc.get("max_tokens") is not None:
+                    opts["max_tokens"] = int(doc["max_tokens"])
+                if doc.get("temperature") is not None:
+                    opts["temperature"] = float(doc["temperature"])
+                if doc.get("top_k") is not None:
+                    opts["top_k"] = int(doc["top_k"])
+                if "eos_token" in doc:
+                    opts["eos_token"] = doc["eos_token"]
+                stream = bool(doc.get("stream"))
+                # resolve first so unknown models 404 before admission
+                mv = server.registry.get(name, version)
+                self._served_version = mv.version
+                ctrl = server.admission_for(name)
+                with ctrl.admit(timeout_s if timeout_s is not None
+                                else "default",
+                                version=mv.version) as permit:
+                    if stream:
+                        self._stream_generate(name, version, prompt,
+                                              opts, permit)
+                        return
+                    res = server.registry.generate(
+                        name, prompt, version=version,
+                        timeout_s=permit.remaining_s(), **opts)
+                mv = server.registry.get(name, version)
+                self._served_version = mv.version
+                self._latency_s = res.get("ttft_s")
+                self.send_json({"model": name, "version": mv.version,
+                                **res})
+
+            def _stream_generate(self, name, version, prompt, opts,
+                                 permit):
+                """Chunked token streaming: one ndjson line per sampled
+                token from the decode loop, then a summary line. The
+                engine's on_token callback feeds a queue the handler
+                thread drains — sockets are written from one thread
+                only."""
+                import queue
+
+                mv = server.registry.get(name, version)
+                from ..runtime.generation import DecodeEngine
+                if not isinstance(mv.engine, DecodeEngine):
+                    raise TypeError(f"model '{name}' is not generative; "
+                                    "use predict()")
+                q: "queue.Queue" = queue.Queue()
+                fut = mv.engine.generate(
+                    prompt, timeout_s=permit.remaining_s(),
+                    on_token=q.put, **opts)
+                self.begin_chunked("application/x-ndjson")
+                try:
+                    while True:
+                        try:
+                            tok = q.get(timeout=0.05)
+                        except queue.Empty:
+                            if fut.done() and q.empty():
+                                break
+                            continue
+                        self.write_chunk(json.dumps(
+                            {"token": tok}).encode() + b"\n")
+                    try:
+                        res = fut.result()
+                        tail = {"done": True, "model": name,
+                                "version": mv.version, **res}
+                        self._latency_s = res.get("ttft_s")
+                    except Exception as e:  # headers are out: in-band error
+                        self._last_status = 500
+                        tail = {"done": True,
+                                "error": f"{type(e).__name__}: {e}"}
+                    self.write_chunk(json.dumps(tail).encode() + b"\n")
+                finally:
+                    self.end_chunked()
+
+            # chunked transfer-encoding plumbing (streaming responses)
+            def begin_chunked(self, content_type, code=200, headers=()):
+                self._last_status = code
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Transfer-Encoding", "chunked")
+                if self._trace_id:
+                    self.send_header("X-Trace-Id", self._trace_id)
+                for k, v in headers:
+                    self.send_header(k, str(v))
+                self.end_headers()
+
+            def write_chunk(self, body: bytes):
+                try:
+                    self.wfile.write(b"%X\r\n" % len(body) + body + b"\r\n")
+                    self.wfile.flush()
+                except CLIENT_DISCONNECTS:
+                    self.close_connection = True
+
+            def end_chunked(self):
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except CLIENT_DISCONNECTS:
+                    self.close_connection = True
 
         return Handler

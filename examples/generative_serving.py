@@ -1,0 +1,108 @@
+"""Generative serving: KV-cached decode + continuous batching over HTTP.
+
+Run: python examples/generative_serving.py
+
+Deploys a tiny decoder-only causal LM behind the serving subsystem's
+DecodeEngine (prefill/decode split over a preallocated per-slot KV
+cache), then exercises POST /v1/models/lm/generate with plain urllib:
+a greedy completion, a temperature/top-k sampled one, a streamed one
+(chunked ndjson, one line per token), and a burst of mixed-length
+requests decoded concurrently through continuous batching — short
+generations finish while long ones are still running.
+"""
+import json
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from deeplearning4j_tpu.models import causal_lm
+from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+
+
+def post(url, doc):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    r = urllib.request.urlopen(req, timeout=60)
+    return r, r.read()
+
+
+def main():
+    model = causal_lm.CausalLM(causal_lm.CausalLMConfig.tiny(), seed=0)
+    registry = ModelRegistry(manifest_dir=None)
+    print("deploying (warms one prefill executable per prompt bucket "
+          "+ one decode executable)...")
+    registry.deploy("lm", "v1", model, decode_slots=4, decode_max_ctx=128,
+                    decode_prompt_buckets=[16, 64])
+    server = ModelServer(registry)
+    port = server.start()
+    base = f"http://127.0.0.1:{port}/v1/models/lm/generate"
+    rng = np.random.RandomState(0)
+
+    prompt = [int(t) for t in rng.randint(0, 97, 8)]
+    r, body = post(base, {"prompt": prompt, "max_tokens": 12})
+    doc = json.loads(body)
+    print(f"greedy: tokens={doc['tokens']} finish={doc['finish_reason']} "
+          f"ttft={doc['ttft_s'] * 1e3:.1f}ms trace="
+          f"{r.headers['X-Trace-Id'][:8]}..")
+
+    r, body = post(base, {"prompt": prompt, "max_tokens": 12,
+                          "temperature": 0.8, "top_k": 10})
+    print(f"sampled (T=0.8, top_k=10): {json.loads(body)['tokens']}")
+
+    req = urllib.request.Request(
+        base, data=json.dumps({"prompt": prompt, "max_tokens": 8,
+                               "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    r = urllib.request.urlopen(req, timeout=60)
+    print("streamed:", end=" ", flush=True)
+    for line in r:
+        line = line.strip()
+        if not line:
+            continue
+        doc = json.loads(line)
+        if "token" in doc:
+            print(doc["token"], end=" ", flush=True)
+        else:
+            print(f"| done ({doc['finish_reason']})")
+
+    print("continuous batching: 6 mixed-length requests at once...")
+    results = {}
+
+    def one(i, plen, gen):
+        p = [int(t) for t in rng.randint(0, 97, plen)]
+        t0 = time.perf_counter()
+        _, body = post(base, {"prompt": p, "max_tokens": gen})
+        results[i] = (gen, time.perf_counter() - t0,
+                      json.loads(body)["ttft_s"])
+
+    threads = [threading.Thread(target=one, args=(i, p, g))
+               for i, (p, g) in enumerate(
+                   zip([4, 24, 8, 40, 12, 32], [40, 6, 24, 8, 32, 4]))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    total = sum(g for g, _, _ in results.values())
+    for i in sorted(results):
+        g, dt, ttft = results[i]
+        print(f"  req {i}: {g:3d} tokens in {dt * 1e3:7.1f}ms "
+              f"(ttft {ttft * 1e3:6.1f}ms)")
+    print(f"aggregate: {total} tokens in {wall * 1e3:.0f}ms "
+          f"({total / wall:.0f} tokens/sec across 4 decode slots)")
+
+    server.stop()
+    registry.drain_all(save_manifests=False)
+    print("drained. bye")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,669 @@
+"""Generative serving fast path (models/causal_lm + runtime/generation +
+serving /generate).
+
+Covers the acceptance contract of the generative PR: KV-cached
+prefill/decode is token-identical to the full-recompute forward;
+continuous batching admits/leaves per token (no head-of-line blocking,
+deterministic under concurrency, no stale-KV leakage across slot reuse);
+steady-state decode performs zero recompiles after warmup (one prefill
+executable per prompt bucket + one decode executable); seq-len-1 decode
+shapes always dispatch to the XLA attention path; donated-cache steps
+record cache=bypass instead of silently missing from compile telemetry;
+and POST /v1/models/<name>/generate works end-to-end through admission +
+trace context with reconstructable prefill/decode spans.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.environment import environment
+from deeplearning4j_tpu.common.metrics import registry
+from deeplearning4j_tpu.models import causal_lm
+from deeplearning4j_tpu.runtime import compile_cache
+from deeplearning4j_tpu.runtime.generation import (DecodeEngine,
+                                                   is_generative_model,
+                                                   sample_tokens)
+from deeplearning4j_tpu.runtime.inference import EngineClosedError
+
+CFG = causal_lm.CausalLMConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def model():
+    return causal_lm.CausalLM(CFG, seed=0)
+
+
+@pytest.fixture(scope="module")
+def shared_engine(model):
+    """One warmed engine shared by the read-only decode tests (engine
+    construction compiles executables; lifecycle/poison tests build their
+    own)."""
+    eng = DecodeEngine(model, slots=3, max_ctx=64, prompt_buckets=[32])
+    yield eng
+    eng.close(10)
+
+
+def _wait_until(fn, timeout=5.0):
+    """Poll for an eventually-true read (ring records are written after
+    the response bytes reach the client)."""
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.02)
+    return fn()
+
+
+def _prompt(n, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, CFG.vocab_size, n).astype(np.int32)
+
+
+_REF_JIT = {}
+
+
+def _ref_greedy(model, prompt, n):
+    """Greedy continuation via the full-recompute forward (the O(T²)
+    reference the cached path must match token for token). One fixed
+    [1, 64] executable per model so the whole module pays one compile."""
+    fwd = _REF_JIT.get(id(model))
+    if fwd is None:
+        fwd = jax.jit(lambda ids: causal_lm.forward(model.params, ids,
+                                                    model.config))
+        _REF_JIT[id(model)] = fwd
+    toks = [int(t) for t in prompt]
+    out = []
+    for _ in range(n):
+        ids = np.zeros((1, 64), np.int32)
+        ids[0, :len(toks)] = toks
+        logits = fwd(jnp.asarray(ids))
+        tok = int(jnp.argmax(logits[0, len(toks) - 1]))
+        out.append(tok)
+        toks.append(tok)
+    return out
+
+
+def _engine(model, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_ctx", 64)
+    kw.setdefault("prompt_buckets", [32])
+    return DecodeEngine(model, **kw)
+
+
+# ---------------------------------------------------------------------------
+# model: causal forward + cache-aware attention
+# ---------------------------------------------------------------------------
+
+class TestCausalLM:
+    def test_forward_shapes_and_dtype(self, model):
+        logits = model.forward(jnp.zeros((2, 5), jnp.int32))
+        assert logits.shape == (2, 5, CFG.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_causality(self, model):
+        """Changing a later token must not change earlier positions'
+        logits — the causal-mask contract autoregression rests on."""
+        ids = _prompt(10, seed=1)
+        a = model.forward(jnp.asarray(ids[None]))
+        ids2 = ids.copy()
+        ids2[7] = (ids2[7] + 1) % CFG.vocab_size
+        b = model.forward(jnp.asarray(ids2[None]))
+        np.testing.assert_allclose(np.asarray(a[0, :7]),
+                                   np.asarray(b[0, :7]), atol=1e-5)
+        assert not np.allclose(np.asarray(a[0, 7:]), np.asarray(b[0, 7:]))
+
+    def test_prefill_then_decode_matches_forward(self, model):
+        """prefill(padded prompt) + N cached decode steps == the full
+        forward's greedy continuation, token for token."""
+        prompt = _prompt(6, seed=2)
+        ref = _ref_greedy(model, prompt, 6)
+        cache = model.init_kv_cache(slots=2, max_ctx=32)
+        ids = np.zeros((1, 16), np.int32)
+        ids[0, :6] = prompt
+        cache, logits = model.prefill(
+            model.params, cache, jnp.asarray(ids),
+            jnp.asarray(1, jnp.int32), jnp.asarray(6, jnp.int32))
+        got = [int(jnp.argmax(logits))]
+        decode = jax.jit(model.decode)  # one executable for the loop
+        tokens = np.zeros(2, np.int32)
+        lengths = np.zeros(2, np.int32)
+        for i in range(5):
+            tokens[1], lengths[1] = got[-1], 6 + i
+            cache, logits = decode(model.params, cache,
+                                   jnp.asarray(tokens),
+                                   jnp.asarray(lengths))
+            got.append(int(jnp.argmax(logits[1])))
+        assert got == ref
+
+    def test_kv_cache_shape_and_ctx_cap(self, model):
+        cache = model.init_kv_cache(slots=3, max_ctx=16)
+        assert cache["k"].shape == (3, CFG.num_layers, 16, CFG.num_heads,
+                                    CFG.head_dim)
+        with pytest.raises(ValueError, match="max_position_embeddings"):
+            model.init_kv_cache(slots=1,
+                                max_ctx=CFG.max_position_embeddings + 1)
+
+    def test_protocol_detection(self, model):
+        assert is_generative_model(model)
+        assert not is_generative_model(object())
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+
+class TestSampling:
+    def test_greedy_at_zero_temperature(self):
+        logits = jnp.asarray(np.random.RandomState(0).randn(3, 17),
+                             jnp.float32)
+        toks = sample_tokens(logits, jnp.zeros(3), jnp.zeros(3, jnp.int32),
+                             jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(toks),
+                                      np.argmax(np.asarray(logits), -1))
+
+    def test_top_k_one_is_greedy(self):
+        logits = jnp.asarray(np.random.RandomState(1).randn(4, 11),
+                             jnp.float32)
+        toks = sample_tokens(logits, jnp.ones(4),
+                             jnp.ones(4, jnp.int32),
+                             jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(np.asarray(toks),
+                                      np.argmax(np.asarray(logits), -1))
+
+    def test_top_k_restricts_support(self):
+        logits = jnp.asarray(np.random.RandomState(2).randn(1, 50),
+                             jnp.float32)
+        top3 = set(np.argsort(np.asarray(logits[0]))[-3:])
+        for seed in range(20):
+            t = sample_tokens(logits, jnp.ones(1) * 2.0,
+                              jnp.full(1, 3, jnp.int32),
+                              jax.random.PRNGKey(seed))
+            assert int(t[0]) in top3
+
+    def test_per_slot_mixed_configs(self):
+        # slot 0 greedy, slot 1 sampled — one call, fixed shapes
+        logits = jnp.asarray(np.random.RandomState(3).randn(2, 29),
+                             jnp.float32)
+        toks = sample_tokens(logits, jnp.asarray([0.0, 1.5]),
+                             jnp.asarray([0, 0], jnp.int32),
+                             jax.random.PRNGKey(11))
+        assert int(toks[0]) == int(np.argmax(np.asarray(logits[0])))
+        assert 0 <= int(toks[1]) < 29
+
+
+# ---------------------------------------------------------------------------
+# DecodeEngine: correctness, continuous batching, lifecycle
+# ---------------------------------------------------------------------------
+
+class TestDecodeEngine:
+    def test_greedy_matches_recompute_reference(self, model,
+                                                shared_engine):
+        prompt = _prompt(7, seed=3)
+        ref = _ref_greedy(model, prompt, 8)
+        res = shared_engine.generate(prompt, max_tokens=8).result(
+            timeout=60)
+        assert res["tokens"] == ref
+        assert res["finish_reason"] == "length"
+        assert res["prompt_tokens"] == 7
+        assert res["completion_tokens"] == 8
+        assert res["ttft_s"] > 0
+
+    def test_eos_stop(self, model, shared_engine):
+        prompt = _prompt(5, seed=4)
+        ref = _ref_greedy(model, prompt, 1)
+        res = shared_engine.generate(prompt, max_tokens=16,
+                                     eos_token=ref[0]).result(timeout=60)
+        assert res["tokens"] == ref[:1]
+        assert res["finish_reason"] == "eos"
+
+    def test_concurrent_equals_sequential(self, model, shared_engine):
+        """Continuous batching must not change outputs: N requests
+        submitted together decode to exactly what each decodes alone."""
+        prompts = [_prompt(n, seed=10 + n) for n in (4, 9, 14)]
+        refs = [_ref_greedy(model, p, 5) for p in prompts]
+        futs = [shared_engine.generate(p, max_tokens=5) for p in prompts]
+        for fut, ref in zip(futs, refs):
+            assert fut.result(timeout=60)["tokens"] == ref
+
+    def test_no_head_of_line_blocking(self, model, shared_engine):
+        """A short request admitted after a long one must finish first —
+        the whole point of per-token join/leave."""
+        done = []
+        long_fut = shared_engine.generate(_prompt(4, seed=20),
+                                          max_tokens=30)
+        long_fut.add_done_callback(lambda f: done.append("long"))
+        short_fut = shared_engine.generate(_prompt(4, seed=21),
+                                           max_tokens=3)
+        short_fut.add_done_callback(lambda f: done.append("short"))
+        short_fut.result(timeout=60)
+        long_fut.result(timeout=60)
+        assert done[0] == "short", done
+
+    def test_slot_recycling_no_stale_kv_leakage(self, model):
+        """Poison-value check: after a slot is recycled, rows a previous
+        occupant wrote (and rows poisoned outright) must never reach a
+        new request's attention — lengths-masking is the containment."""
+        prompt = _prompt(6, seed=30)
+        ref = _ref_greedy(model, prompt, 6)
+        eng = _engine(model, slots=1)
+        try:
+            # occupy and release the only slot
+            eng.generate(_prompt(10, seed=31), max_tokens=8).result(60)
+            # poison EVERY cache row outright: only masking (not luck)
+            # can keep the next request clean; prefill overwrites rows
+            # [0, bucket) and decode masks everything past `lengths`
+            with eng._dispatch_lock:
+                eng._cache = {k: jnp.full_like(v, 1e9)
+                              for k, v in eng._cache.items()}
+            res = eng.generate(prompt, max_tokens=6).result(timeout=60)
+            assert res["tokens"] == ref
+        finally:
+            eng.close(10)
+
+    def test_streaming_callback(self, model, shared_engine):
+        seen = []
+        res = shared_engine.generate(_prompt(5, seed=40), max_tokens=5,
+                                     on_token=seen.append).result(
+            timeout=60)
+        assert seen == res["tokens"]
+
+    def test_prompt_validation(self, model, shared_engine):
+        with pytest.raises(ValueError, match="at least one"):
+            shared_engine.generate([])
+        with pytest.raises(ValueError, match="no room"):
+            shared_engine.generate(list(range(64)))  # == max_ctx
+
+    def test_max_tokens_capped_by_context(self, model):
+        eng = _engine(model, max_ctx=16, prompt_buckets=[8])
+        try:
+            res = eng.generate(_prompt(8, seed=41),
+                               max_tokens=500).result(timeout=60)
+            # cap = max_ctx - prompt_len
+            assert res["completion_tokens"] == 8
+            assert res["finish_reason"] == "length"
+        finally:
+            eng.close(10)
+
+    def test_drain_rejects_and_start_reopens(self, model):
+        eng = _engine(model)
+        eng.generate(_prompt(4, seed=42), max_tokens=2).result(60)
+        assert eng.drain(timeout_s=30)
+        with pytest.raises(EngineClosedError):
+            eng.generate(_prompt(4, seed=42))
+        eng.start()
+        assert eng.generate(_prompt(4, seed=42),
+                            max_tokens=2).result(60)["tokens"]
+        assert eng.close(30)
+        with pytest.raises(EngineClosedError):
+            eng.start()
+
+    def test_admission_timeout_expires_queued_request(self, model):
+        """A request whose deadline passes before a slot frees must fail
+        with TimeoutError without any model work."""
+        eng = _engine(model, slots=1, max_ctx=128, prompt_buckets=[8])
+        try:
+            blocker = eng.generate(_prompt(4, seed=43), max_tokens=80)
+            late = eng.generate(_prompt(4, seed=44), max_tokens=2,
+                                timeout_s=0.0)
+            with pytest.raises(TimeoutError):
+                late.result(timeout=60)
+            blocker.result(timeout=60)
+        finally:
+            eng.close(10)
+
+    def test_stats_surface(self, model, shared_engine):
+        before = shared_engine.stats()
+        shared_engine.generate(_prompt(4, seed=45), max_tokens=3).result(60)
+        s = shared_engine.stats()
+        assert s["requests"] == before["requests"] + 1
+        assert s["tokens"] == before["tokens"] + 3
+        assert s["prefills"] == before["prefills"] + 1
+        assert s["slots"] == 3
+        assert s["prompt_buckets"] == [32]
+
+
+class TestCompileCounting:
+    def test_one_executable_per_bucket_plus_one_decode(self, model):
+        """Warmup compiles exactly len(ladder) prefill executables + 1
+        decode executable; steady-state traffic then compiles NOTHING —
+        the zero-recompile acceptance invariant."""
+        env = environment()
+        eng = DecodeEngine(model, slots=2, max_ctx=64,
+                           prompt_buckets=[8, 32])
+        try:
+            env.reset_compile_count()
+            eng.warmup()
+            assert env.compile_count() == 3  # prefill x2 + decode x1
+            eng.warmup()  # idempotent
+            assert env.compile_count() == 3
+            env.reset_compile_count()
+            futs = [eng.generate(_prompt(n, seed=50 + n), max_tokens=4)
+                    for n in (3, 8, 20, 5)]
+            for f in futs:
+                f.result(timeout=60)
+            assert env.compile_count() == 0
+        finally:
+            eng.close(10)
+            env.reset_compile_count()
+
+
+# ---------------------------------------------------------------------------
+# satellite: decode shapes always dispatch to the XLA attention path
+# ---------------------------------------------------------------------------
+
+class TestDecodeAttentionDispatch:
+    def test_seq_len_one_always_xla(self):
+        from deeplearning4j_tpu.kernels import attention_dispatch
+        env = environment()
+        prev = env.flash_min_seq()
+        try:
+            # even a threshold that would send EVERYTHING to flash must
+            # not move the decode shape off the XLA path
+            env.set_flash_min_seq(1)
+            assert attention_dispatch(1) == "xla"
+            assert attention_dispatch(0) == "xla"
+            assert attention_dispatch(2) == "flash"
+        finally:
+            env.set_flash_min_seq(prev)
+
+    def test_decode_shape_ticks_dispatch_counter(self, model):
+        """Tracing the decode step records dl4j_attn_dispatch_total with
+        path=xla (once per compiled executable)."""
+        from deeplearning4j_tpu.kernels import attention_dispatch
+
+        fam = registry().counter(
+            "dl4j_attn_dispatch_total",
+            "Attention path decisions for flash=True configs",
+            labels=("path",))
+        before = fam.labels(path="xla").value()
+        env = environment()
+        prev = env.flash_min_seq()
+        try:
+            env.set_flash_min_seq(1)  # adversarial: flash for everything
+            assert attention_dispatch(1) == "xla"
+        finally:
+            env.set_flash_min_seq(prev)
+        assert fam.labels(path="xla").value() == before + 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: donated-cache steps are store-ineligible, never silent
+# ---------------------------------------------------------------------------
+
+class TestDonatedDecodeCompileCache:
+    def test_decode_steps_bypass_store_with_histogram_evidence(self, model):
+        """Donated-KV-cache prefill/decode entries must (a) never land in
+        the raw executable store and (b) still record cache=bypass on the
+        dl4j_compile_seconds histogram — observable, not silently
+        missing."""
+        fam = registry().histogram(
+            "dl4j_compile_seconds",
+            "Wall time to materialize + first-run an executable, by cache "
+            "outcome", labels=("kind", "cache"))
+
+        def bypass_count(kind):
+            return sum(child.count() for key, child in fam.children()
+                       if key == (kind, "bypass"))
+
+        pre_prefill = bypass_count("prefill")
+        pre_decode = bypass_count("decode")
+        eng = DecodeEngine(model, slots=2, max_ctx=64,
+                           prompt_buckets=[16])
+        try:
+            eng.warmup()
+        finally:
+            eng.close(10)
+        assert bypass_count("prefill") == pre_prefill + 1
+        assert bypass_count("decode") == pre_decode + 1
+        inv = compile_cache.inventory()
+        assert inv["enabled"]  # conftest pins a live per-run cache dir
+        kinds = {e.get("tag_kind") for e in inv["entries"]}
+        assert "prefill" not in kinds and "decode" not in kinds
+
+
+# ---------------------------------------------------------------------------
+# serving: registry + HTTP /generate end to end
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=10):
+    try:
+        r = urllib.request.urlopen(url, timeout=timeout)
+        return r.status, r.headers, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, e.read()
+
+
+def _post(url, doc, timeout=30, headers=()):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json", **dict(headers)})
+    try:
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, r.headers, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, e.read()
+
+
+@pytest.fixture(scope="module")
+def served_lm(model):
+    """One served registry shared by the endpoint tests (each deploy
+    compiles executables; the hot-swap test runs last and restores v1)."""
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+
+    reg = ModelRegistry(manifest_dir=None, retain=1)
+    reg.deploy("lm", "v1", model, decode_slots=2, decode_max_ctx=64,
+               decode_prompt_buckets=[32])
+    srv = ModelServer(reg)
+    port = srv.start()
+    yield reg, srv, f"http://127.0.0.1:{port}"
+    srv.stop()
+    reg.drain_all(save_manifests=False)
+
+
+class TestRegistryGenerate:
+    def test_deploy_detects_generative_and_describes(self, model):
+        from deeplearning4j_tpu.serving import ModelRegistry
+
+        reg = ModelRegistry(manifest_dir=None, retain=0)
+        try:
+            mv = reg.deploy("lm", "v1", model, decode_slots=2,
+                            decode_max_ctx=64,
+                            decode_prompt_buckets=[8])
+            assert isinstance(mv.engine, DecodeEngine)
+            assert mv.describe()["generative"] is True
+            assert reg.ready()
+            prompt = _prompt(5, seed=60)
+            ref = _ref_greedy(model, prompt, 4)
+            res = reg.generate("lm", prompt, max_tokens=4)
+            assert res["tokens"] == ref
+            with pytest.raises(TypeError, match="generative"):
+                reg.predict("lm", np.zeros((1, 4), np.float32))
+        finally:
+            reg.drain_all(save_manifests=False)
+
+    def test_generate_on_non_generative_raises(self):
+        from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.serving import ModelRegistry
+
+        conf = (NeuralNetConfiguration.builder().seed(0).list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        reg = ModelRegistry(manifest_dir=None, retain=0)
+        try:
+            reg.deploy("mlp", "v1", net,
+                       example=np.zeros((2, 4), np.float32))
+            with pytest.raises(TypeError, match="not generative"):
+                reg.generate("mlp", [1, 2, 3])
+        finally:
+            reg.drain_all(save_manifests=False)
+
+
+class TestGenerateEndpoint:
+    def test_end_to_end_with_trace_and_debug_spans(self, served_lm, model):
+        """The acceptance path: POST /generate through admission + trace
+        context; the response echoes X-Trace-Id and the request's
+        prefill/decode spans are reconstructable via /debug/requests."""
+        reg, srv, base = served_lm
+        prompt = _prompt(5, seed=70)
+        ref = _ref_greedy(model, prompt, 6)
+        status, headers, body = _post(
+            base + "/v1/models/lm/generate",
+            {"prompt": [int(t) for t in prompt], "max_tokens": 6})
+        assert status == 200
+        trace_id = headers.get("X-Trace-Id")
+        assert trace_id
+        doc = json.loads(body)
+        assert doc["tokens"] == ref
+        assert doc["model"] == "lm" and doc["version"] == "v1"
+        assert doc["finish_reason"] == "length"
+        assert doc["ttft_s"] > 0
+
+        # the ring record lands after the response bytes reach the
+        # client: poll, same as the PR-6 tracing tests
+        doc = _wait_until(lambda: (lambda d: d["count"] == 1 and d)(
+            json.loads(_get(
+                base + f"/debug/requests?trace_id={trace_id}")[2])))
+        assert doc and doc["count"] == 1
+        rec = doc["requests"][0]
+        assert rec["kind"] == "generate"
+        names = []
+
+        def walk(spans):
+            for s in spans:
+                names.append(s["name"])
+                walk(s.get("children", []))
+
+        walk(rec["spans"])
+        assert "serving/request" in names
+        assert "serving/admission" in names
+        assert "generation/prefill" in names
+        assert "generation/decode" in names
+
+    def test_traceparent_joined(self, served_lm, model):
+        reg, srv, base = served_lm
+        tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        status, headers, _ = _post(
+            base + "/v1/models/lm/generate",
+            {"prompt": [1, 2, 3], "max_tokens": 2},
+            headers={"traceparent": tp})
+        assert status == 200
+        assert headers.get("X-Trace-Id") == "ab" * 16
+
+    def test_streaming_chunks(self, served_lm, model):
+        reg, srv, base = served_lm
+        prompt = _prompt(4, seed=71)
+        ref = _ref_greedy(model, prompt, 5)
+        req = urllib.request.Request(
+            base + "/v1/models/lm/generate",
+            data=json.dumps({"prompt": [int(t) for t in prompt],
+                             "max_tokens": 5, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        r = urllib.request.urlopen(req, timeout=30)
+        assert r.status == 200
+        assert r.headers.get("X-Trace-Id")
+        assert "ndjson" in r.headers.get("Content-Type", "")
+        lines = [json.loads(l) for l in r.read().splitlines() if l.strip()]
+        streamed = [l["token"] for l in lines if "token" in l]
+        assert streamed == ref
+        tail = lines[-1]
+        assert tail["done"] is True and tail["tokens"] == ref
+
+    def test_error_mapping(self, served_lm):
+        reg, srv, base = served_lm
+        status, _, _ = _post(base + "/v1/models/nope/generate",
+                             {"prompt": [1]})
+        assert status == 404
+        status, _, body = _post(base + "/v1/models/lm/generate", {})
+        assert status == 400 and b"prompt" in body
+        status, _, _ = _post(base + "/v1/models/lm/generate",
+                             {"prompt": "not ids"})
+        assert status == 400
+        # predict on a generative model is a client error, not a 500
+        status, _, body = _post(base + "/v1/models/lm/predict",
+                                {"inputs": [[1.0]]})
+        assert status == 400 and b"generative" in body
+
+    def test_sampled_generation_within_vocab(self, served_lm):
+        reg, srv, base = served_lm
+        status, _, body = _post(
+            base + "/v1/models/lm/generate",
+            {"prompt": [3, 7], "max_tokens": 6, "temperature": 0.8,
+             "top_k": 10})
+        assert status == 200
+        toks = json.loads(body)["tokens"]
+        assert len(toks) == 6
+        assert all(0 <= t < CFG.vocab_size for t in toks)
+
+    def test_generate_feeds_slo_with_ttft(self, served_lm):
+        reg, srv, base = served_lm
+        _post(base + "/v1/models/lm/generate",
+              {"prompt": [1, 2], "max_tokens": 2})
+        assert _wait_until(lambda: any(
+            w["total"] >= 1
+            for w in srv.slo_for("lm").snapshot()["windows"]))
+
+    def test_hot_swap_generative_version(self, served_lm, model):
+        """Warm-before-cutover + rollback work for DecodeEngine versions
+        exactly as for predict engines."""
+        reg, srv, base = served_lm
+        model2 = causal_lm.CausalLM(CFG, seed=9)
+        reg.deploy("lm", "v2", model2, decode_slots=2, decode_max_ctx=64,
+                   decode_prompt_buckets=[32])
+        status, _, body = _post(base + "/v1/models/lm/generate",
+                                {"prompt": [4, 4, 4], "max_tokens": 3})
+        assert status == 200
+        assert json.loads(body)["version"] == "v2"
+        reg.rollback("lm")
+        status, _, body = _post(base + "/v1/models/lm/generate",
+                                {"prompt": [4, 4, 4], "max_tokens": 3})
+        assert status == 200
+        assert json.loads(body)["version"] == "v1"
+
+
+class TestDecodeEnvKnobs:
+    def test_defaults_and_overrides(self):
+        env = environment()
+        assert env.decode_slots() == 8
+        assert env.decode_max_ctx() == 256
+        assert env.decode_max_tokens() == 128
+        try:
+            env.set_decode_slots(3)
+            env.set_decode_max_ctx(64)
+            env.set_decode_max_tokens(16)
+            assert env.decode_slots() == 3
+            assert env.decode_max_ctx() == 64
+            assert env.decode_max_tokens() == 16
+        finally:
+            from deeplearning4j_tpu.common.environment import \
+                SystemProperties
+            env.clear_property(SystemProperties.DECODE_SLOTS)
+            env.clear_property(SystemProperties.DECODE_MAX_CTX)
+            env.clear_property(SystemProperties.DECODE_MAX_TOKENS)
+
+    def test_engine_reads_env_defaults(self, model):
+        env = environment()
+        try:
+            env.set_decode_slots(3)
+            env.set_decode_max_ctx(48)
+            eng = DecodeEngine(model)
+            assert eng.slots == 3
+            assert eng.max_ctx == 48
+            eng.close(5)
+        finally:
+            from deeplearning4j_tpu.common.environment import \
+                SystemProperties
+            env.clear_property(SystemProperties.DECODE_SLOTS)
+            env.clear_property(SystemProperties.DECODE_MAX_CTX)

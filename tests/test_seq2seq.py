@@ -30,3 +30,44 @@ class TestSeq2Seq:
         assert logits.shape == (4, 7, c.vocab_size)
         out = seq2seq.greedy_decode(params, src, 9, c)
         assert out.shape == (4, 9)
+
+
+class TestCachedDecodeRegression:
+    """The KV-cached-style decode (recurrent state carried through
+    lax.scan, one lstm_cell per token) must be token-identical to the
+    naive loop that re-runs the decoder over the whole prefix each token
+    — the O(T) fast path may never change outputs."""
+
+    def test_cached_decode_matches_recompute_loop(self):
+        c = seq2seq.Seq2SeqConfig.tiny()
+        params = seq2seq.init_params(jax.random.key(3), c)
+        src = jnp.asarray(np.random.RandomState(7)
+                          .randint(2, c.vocab_size, (8, 6)), jnp.int32)
+        cached = np.asarray(seq2seq.greedy_decode(params, src, 12, c))
+        naive = np.asarray(
+            seq2seq.greedy_decode_recompute(params, src, 12, c))
+        np.testing.assert_array_equal(cached, naive)
+
+    def test_cached_decode_matches_on_trained_model(self):
+        c = seq2seq.Seq2SeqConfig.tiny()
+        params, _ = seq2seq.fit_copy_task(c, steps=40, B=16, S=5, seed=1)
+        src = jnp.asarray(np.random.RandomState(11)
+                          .randint(2, c.vocab_size, (4, 5)), jnp.int32)
+        np.testing.assert_array_equal(
+            np.asarray(seq2seq.greedy_decode(params, src, 8, c)),
+            np.asarray(seq2seq.greedy_decode_recompute(params, src, 8, c)))
+
+    def test_decode_step_is_incremental(self):
+        # one decode_step from the encoder state equals the first column
+        # of the full teacher-forcing forward fed BOS
+        c = seq2seq.Seq2SeqConfig.tiny()
+        params = seq2seq.init_params(jax.random.key(5), c)
+        src = jnp.asarray(np.random.RandomState(2)
+                          .randint(2, c.vocab_size, (3, 4)), jnp.int32)
+        cache = seq2seq._encode(params, src)
+        bos = jnp.full((3,), c.bos_token, jnp.int32)
+        _, logits = seq2seq.decode_step(params, cache, bos)
+        tf = seq2seq.teacher_forcing_logits(params, src, bos[:, None])
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(tf[:, 0]), rtol=1e-5,
+                                   atol=1e-5)
