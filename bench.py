@@ -25,6 +25,13 @@ chip + MFU (BASELINE config 3; north-star acceptance 35% MFU → vs_baseline
                            per-request batching; gated: KV >= 3x,
                            continuous >= 1.5x, token-identical greedy,
                            zero steady-state recompiles)
+  - serving_resilience    (self-healing under deterministic fault
+                           injection: 5% dispatch faults + batcher
+                           crashes; gated: >= 99% of non-poison requests
+                           succeed, admitted p99 <= 3x fault-free, zero
+                           engine-thread permadeaths, and the circuit
+                           breaker re-closes within its probe window
+                           after injection stops)
 Config 5 (multi-chip scaling) needs >1 chip; the driver's multichip dryrun
 covers correctness, scaling numbers await real multi-chip hardware.
 
@@ -1062,6 +1069,227 @@ def check_generative_decode(rec, min_kv_speedup=3.0, min_cb_speedup=1.5):
     return True, "ok"
 
 
+def bench_serving_resilience(jax, jnp, tiny):
+    """Self-healing serving under deterministic fault injection (the
+    resilience subsystem's headline). Four phases over one deployed
+    model:
+
+    1. **fault-free** — client threads through ``registry.predict`` (the
+       breaker-accounted micro-batcher path); p99 is the baseline.
+    2. **5% dispatch faults** — ``engine.dispatch`` armed at rate 0.05.
+       A failed coalesced dispatch re-dispatches its riders individually
+       once, so requests only fail when BOTH their group and their
+       isolated retry draw a fault (quarantined). The gate: >= 99% of
+       non-quarantined requests succeed and the admitted p99 stays
+       within 3x of the fault-free run — injected faults must degrade
+       the tail, not the service.
+    3. **batcher crashes** — ``engine.batcher`` armed; the supervised
+       worker restarts with backoff and every queued request survives.
+       Zero permadeaths (worker_dead) allowed.
+    4. **breaker** — rate-1.0 faults until the version's breaker opens
+       (fail-fast BreakerOpenError), then injection stops and the
+       half-open probe must re-close the breaker within its probe
+       window.
+    """
+    import threading
+
+    from deeplearning4j_tpu.common import faults
+    from deeplearning4j_tpu.common.metrics import registry as mreg
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.serving import (BreakerOpenError, ModelRegistry,
+                                            PoisonRequestError)
+
+    n_in, hidden, n_out, B = ((64, 256, 8, 16) if tiny
+                              else (128, 1024, 32, 32))
+    n_threads = 4 if tiny else 8
+    per_thread = 25 if tiny else 80
+    probe_s = 0.2
+
+    b = NeuralNetConfiguration.builder().seed(0).list()
+    b.layer(DenseLayer(n_in=n_in, n_out=hidden, activation="relu"))
+    conf = b.layer(OutputLayer(n_in=hidden, n_out=n_out)).build()
+    net = MultiLayerNetwork(conf).init()
+    registry = ModelRegistry(manifest_dir=None, retain=0,
+                             breaker_threshold=5, breaker_probe_s=probe_s)
+    x = jnp.asarray(np.random.RandomState(0).randn(B, n_in)
+                    .astype(np.float32))
+    registry.deploy("bench", "v1", net, example=x, max_batch=B,
+                    max_delay_ms=0.5)
+    engine = registry.get("bench").engine
+
+    def storm():
+        ok, quarantined, failed, lat = [0], [0], [0], []
+        lock = threading.Lock()
+
+        def client(seed):
+            xs = jnp.asarray(np.random.RandomState(seed)
+                             .randn(2, n_in).astype(np.float32))
+            for _ in range(per_thread):
+                t0 = time.perf_counter()
+                try:
+                    jax.block_until_ready(
+                        registry.predict("bench", xs).jax())
+                except PoisonRequestError:
+                    with lock:
+                        quarantined[0] += 1
+                    continue
+                except Exception:
+                    with lock:
+                        failed[0] += 1
+                    continue
+                dt = time.perf_counter() - t0
+                with lock:
+                    ok[0] += 1
+                    lat.append(dt)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        offered = n_threads * per_thread
+        eligible = max(offered - quarantined[0], 1)
+        return {"offered": offered, "ok": ok[0],
+                "quarantined": quarantined[0], "failed_other": failed[0],
+                "ok_rate_of_nonpoison": round(ok[0] / eligible, 5),
+                "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3)
+                if lat else None,
+                "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3)
+                if lat else None}
+
+    def injected_count():
+        fam = mreg().get("dl4j_faults_injected_total")
+        if fam is None:
+            return 0.0
+        return sum(c.value() for _, c in fam.children())
+
+    restart_fam = mreg().counter(
+        "dl4j_engine_restarts_total",
+        "Supervised engine worker-thread restarts after a crash",
+        labels=("engine",)).labels(engine="inference")
+
+    try:
+        rec = {"threads": n_threads,
+               "requests_per_phase": n_threads * per_thread,
+               "fault_rate": 0.05}
+        rec["fault_free"] = storm()
+
+        # phase 2: 5% deterministic dispatch faults
+        faults.clear()
+        rule = faults.inject("engine.dispatch", rate=0.05, seed=11)
+        before_inj = injected_count()
+        rec["faulted"] = storm()
+        faults.remove(rule)
+        rec["faulted"]["injected"] = int(injected_count() - before_inj)
+
+        # phase 3: batcher thread crashes under traffic
+        r0 = restart_fam.value()
+        with faults.injected("engine.batcher", rate=1.0, times=3):
+            futs = [engine.submit(x) for _ in range(6)]
+            crash_survivors = sum(
+                1 for f in futs if f.result(timeout=60) is not None)
+        rec["batcher_crash"] = {
+            "restarts": int(restart_fam.value() - r0),
+            "survivors": crash_survivors, "submitted": len(futs),
+            "permadeaths": int(bool(engine.worker_dead))}
+
+        # phase 4: open the breaker, stop injecting, time the re-close
+        rule = faults.inject("engine.dispatch", rate=1.0, seed=3)
+        opened = False
+        for _ in range(32):
+            try:
+                registry.predict("bench", x)
+            except BreakerOpenError:
+                opened = True
+                break
+            except Exception:
+                continue
+        faults.remove(rule)
+        t_open = time.perf_counter()
+        reclosed = False
+        while time.perf_counter() - t_open < probe_s * 10:
+            try:
+                registry.predict("bench", x)
+                reclosed = True
+                break
+            except BreakerOpenError:
+                time.sleep(probe_s / 10)
+            except Exception:
+                time.sleep(probe_s / 10)
+        rec["breaker"] = {
+            "opened": opened, "reclosed": reclosed,
+            "probe_s": probe_s,
+            "reclose_s": round(time.perf_counter() - t_open, 3),
+            "state": registry.breaker_for("bench", "v1").state}
+    finally:
+        faults.clear()
+        registry.drain_all(save_manifests=False)
+    ok, reason = check_serving_resilience(rec)
+    rec["gate_ok"], rec["gate_reason"] = ok, reason
+    return rec
+
+
+def check_serving_resilience(rec, min_ok_rate=0.99, max_p99_ratio=3.0):
+    """(ok, reason): gates a serving_resilience record must pass.
+
+    - faults must actually have been injected (a resilience record
+      measured against zero faults proves nothing);
+    - >= ``min_ok_rate`` (99%) of non-quarantined requests succeed under
+      5% dispatch faults — isolated retry absorbs the fault for a poison
+      request's innocent riders, and transient faults for everyone;
+    - the faulted-run admitted p99 stays within ``max_p99_ratio`` (3x)
+      of the fault-free p99 — recovery must not stall the service;
+    - zero engine-thread permadeaths, and the supervised batcher must
+      have actually restarted (the crash phase exercised it);
+    - the circuit breaker must have opened under sustained faults AND
+      re-closed once injection stopped, within its probe window (x3
+      slack for scheduling)."""
+    f = rec["faulted"]
+    if not f.get("injected"):
+        return False, ("no faults were injected in the faulted phase: "
+                       "the resilience claim is untested")
+    if f["ok_rate_of_nonpoison"] < min_ok_rate:
+        return False, (
+            f"only {f['ok_rate_of_nonpoison']:.4f} of non-quarantined "
+            f"requests succeeded under injected faults "
+            f"(gate: >= {min_ok_rate}): recovery is losing innocent "
+            "requests")
+    if f["p99_ms"] and rec["fault_free"]["p99_ms"]:
+        limit = max_p99_ratio * rec["fault_free"]["p99_ms"]
+        if f["p99_ms"] > limit:
+            return False, (
+                f"faulted-run p99 {f['p99_ms']:.3f}ms > {limit:.3f}ms "
+                f"({max_p99_ratio}x fault-free "
+                f"{rec['fault_free']['p99_ms']:.3f}ms): recovery is "
+                "stalling the admitted tail")
+    bc = rec["batcher_crash"]
+    if bc["permadeaths"] != 0:
+        return False, (f"{bc['permadeaths']} engine-thread permadeath(s): "
+                       "the supervisor gave up under the crash budget")
+    if bc["restarts"] < 1:
+        return False, ("the batcher never restarted: the crash phase did "
+                       "not exercise the supervisor")
+    if bc["survivors"] != bc["submitted"]:
+        return False, (
+            f"only {bc['survivors']}/{bc['submitted']} requests survived "
+            "the batcher crash: queued work is being lost on restart")
+    br = rec["breaker"]
+    if not br["opened"]:
+        return False, ("the breaker never opened under rate-1.0 faults: "
+                       "consecutive dispatch failures are not tripping it")
+    if not br["reclosed"]:
+        return False, ("the breaker did not re-close after injection "
+                       "stopped: the half-open probe path is broken")
+    if br["reclose_s"] > br["probe_s"] * 3 + 0.5:
+        return False, (
+            f"breaker took {br['reclose_s']:.3f}s to re-close (probe "
+            f"window {br['probe_s']}s): probes are not firing on time")
+    return True, "ok"
+
+
 def check_serving_overload(rec, max_p99_ratio=3.0):
     """(ok, reason): gates a serving_overload record must pass.
 
@@ -1308,6 +1536,12 @@ def main():
                                                                tiny)
         except Exception as e:
             out["generative_decode"] = f"error: {type(e).__name__}"
+        _release()
+        try:
+            out["serving_resilience"] = bench_serving_resilience(jax, jnp,
+                                                                 tiny)
+        except Exception as e:
+            out["serving_resilience"] = f"error: {type(e).__name__}"
         _release()
         try:
             fwd, train = bench_flash_attention(jax, jnp, tiny)

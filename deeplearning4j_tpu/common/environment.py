@@ -58,6 +58,13 @@ class EnvironmentVars:
     DL4J_TPU_SLO_READYZ = "DL4J_TPU_SLO_READYZ"
     DL4J_TPU_REQUEST_RING = "DL4J_TPU_REQUEST_RING"
     DL4J_TPU_DEBUG_ENDPOINTS = "DL4J_TPU_DEBUG_ENDPOINTS"
+    DL4J_TPU_FAULTS = "DL4J_TPU_FAULTS"
+    DL4J_TPU_BREAKER_THRESHOLD = "DL4J_TPU_BREAKER_THRESHOLD"
+    DL4J_TPU_BREAKER_PROBE_S = "DL4J_TPU_BREAKER_PROBE_S"
+    DL4J_TPU_AUTO_ROLLBACK = "DL4J_TPU_AUTO_ROLLBACK"
+    DL4J_TPU_AUTO_ROLLBACK_OPENS = "DL4J_TPU_AUTO_ROLLBACK_OPENS"
+    DL4J_TPU_ENGINE_MAX_RESTARTS = "DL4J_TPU_ENGINE_MAX_RESTARTS"
+    DL4J_TPU_WATCHDOG_FACTOR = "DL4J_TPU_WATCHDOG_FACTOR"
     DL4J_TPU_PROFILE_DIR = "DL4J_TPU_PROFILE_DIR"
     DL4J_TPU_FLIGHT_RECORDER_DIR = "DL4J_TPU_FLIGHT_RECORDER_DIR"
     XLA_FLAGS = "XLA_FLAGS"
@@ -100,6 +107,13 @@ class SystemProperties:
     SLO_READYZ = "slo_readyz"
     REQUEST_RING = "request_ring"
     DEBUG_ENDPOINTS = "debug_endpoints"
+    FAULTS = "faults"
+    BREAKER_THRESHOLD = "breaker_threshold"
+    BREAKER_PROBE_S = "breaker_probe_s"
+    AUTO_ROLLBACK = "auto_rollback"
+    AUTO_ROLLBACK_OPENS = "auto_rollback_opens"
+    ENGINE_MAX_RESTARTS = "engine_max_restarts"
+    WATCHDOG_FACTOR = "watchdog_factor"
     PROFILE_DIR = "profile_dir"
     FLIGHT_RECORDER_DIR = "flight_recorder_dir"
 
@@ -152,6 +166,18 @@ _ENV_FOR_PROP = {
     SystemProperties.REQUEST_RING: EnvironmentVars.DL4J_TPU_REQUEST_RING,
     SystemProperties.DEBUG_ENDPOINTS:
         EnvironmentVars.DL4J_TPU_DEBUG_ENDPOINTS,
+    SystemProperties.FAULTS: EnvironmentVars.DL4J_TPU_FAULTS,
+    SystemProperties.BREAKER_THRESHOLD:
+        EnvironmentVars.DL4J_TPU_BREAKER_THRESHOLD,
+    SystemProperties.BREAKER_PROBE_S:
+        EnvironmentVars.DL4J_TPU_BREAKER_PROBE_S,
+    SystemProperties.AUTO_ROLLBACK: EnvironmentVars.DL4J_TPU_AUTO_ROLLBACK,
+    SystemProperties.AUTO_ROLLBACK_OPENS:
+        EnvironmentVars.DL4J_TPU_AUTO_ROLLBACK_OPENS,
+    SystemProperties.ENGINE_MAX_RESTARTS:
+        EnvironmentVars.DL4J_TPU_ENGINE_MAX_RESTARTS,
+    SystemProperties.WATCHDOG_FACTOR:
+        EnvironmentVars.DL4J_TPU_WATCHDOG_FACTOR,
     SystemProperties.PROFILE_DIR: EnvironmentVars.DL4J_TPU_PROFILE_DIR,
     SystemProperties.FLIGHT_RECORDER_DIR:
         EnvironmentVars.DL4J_TPU_FLIGHT_RECORDER_DIR,
@@ -191,6 +217,13 @@ _DEFAULTS = {
     SystemProperties.SLO_READYZ: "1",
     SystemProperties.REQUEST_RING: "256",
     SystemProperties.DEBUG_ENDPOINTS: "1",
+    SystemProperties.FAULTS: "",               # "" = no injection (prod)
+    SystemProperties.BREAKER_THRESHOLD: "5",
+    SystemProperties.BREAKER_PROBE_S: "1",
+    SystemProperties.AUTO_ROLLBACK: "0",
+    SystemProperties.AUTO_ROLLBACK_OPENS: "2",
+    SystemProperties.ENGINE_MAX_RESTARTS: "5",
+    SystemProperties.WATCHDOG_FACTOR: "3",
     SystemProperties.PROFILE_DIR: "",          # "" = <cache_dir>/profiles
     SystemProperties.FLIGHT_RECORDER_DIR: "",  # "" = <cache_dir>/flight
 }
@@ -580,6 +613,76 @@ class Environment:
             return os.path.expanduser(d)
         base = self.cache_dir()
         return os.path.join(base, "flight") if base else None
+
+    # -- resilience knobs (common/faults.py, serving/resilience.py) --------
+
+    def faults_spec(self) -> str:
+        """Raw fault-injection spec (``DL4J_TPU_FAULTS`` =
+        ``"site:kind:rate:seed,..."``); "" (default) = no injection and
+        zero overhead at every site."""
+        return self.property(SystemProperties.FAULTS) or ""
+
+    def breaker_threshold(self) -> int:
+        """Consecutive dispatch failures that open a model-version's
+        circuit breaker (``DL4J_TPU_BREAKER_THRESHOLD``)."""
+        v = self.property(SystemProperties.BREAKER_THRESHOLD)
+        try:
+            return max(int(v), 1)
+        except (TypeError, ValueError):
+            return 5
+
+    def breaker_probe_s(self) -> float:
+        """How long an open breaker fails fast before letting one
+        half-open probe through (``DL4J_TPU_BREAKER_PROBE_S``)."""
+        v = self.property(SystemProperties.BREAKER_PROBE_S)
+        try:
+            return max(float(v), 0.001)
+        except (TypeError, ValueError):
+            return 1.0
+
+    def auto_rollback(self) -> bool:
+        """Whether a persistently open breaker with a warm parked
+        previous version triggers ``ModelRegistry.rollback()``
+        (``DL4J_TPU_AUTO_ROLLBACK``, off by default — degraded service
+        beats no service, but changing the served version is an operator
+        decision until opted in)."""
+        return self.property(SystemProperties.AUTO_ROLLBACK) not in (
+            "0", "false", None)
+
+    def set_auto_rollback(self, v: bool):
+        return self.set_property(SystemProperties.AUTO_ROLLBACK,
+                                 "1" if v else "0")
+
+    def auto_rollback_opens(self) -> int:
+        """Consecutive breaker opens (open -> probe fails -> reopen)
+        that count as "persistently open" for auto-rollback
+        (``DL4J_TPU_AUTO_ROLLBACK_OPENS``)."""
+        v = self.property(SystemProperties.AUTO_ROLLBACK_OPENS)
+        try:
+            return max(int(v), 1)
+        except (TypeError, ValueError):
+            return 2
+
+    def engine_max_restarts(self) -> int:
+        """Supervised-restart burst budget for engine worker threads
+        (``DL4J_TPU_ENGINE_MAX_RESTARTS``); <= 0 = unbounded. The budget
+        covers crash *bursts* — it resets after a healthy minute."""
+        v = self.property(SystemProperties.ENGINE_MAX_RESTARTS)
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return 5
+
+    def watchdog_factor(self) -> float:
+        """Dispatch-watchdog budget as a multiple of the default serving
+        deadline (``DL4J_TPU_WATCHDOG_FACTOR``): a dispatch stuck past
+        ``deadline * factor`` marks its engine unhealthy and flips
+        ``/readyz``. <= 0 disables the watchdog."""
+        v = self.property(SystemProperties.WATCHDOG_FACTOR)
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 3.0
 
     # -- telemetry (common/metrics.py, common/tracing.py) ------------------
     def metrics(self):

@@ -1,0 +1,388 @@
+"""Deterministic fault injection + the shared retry/backoff policy.
+
+Production serving treats failure as an *input*: Clipper (NSDI '17)
+isolates and falls back across model containers, Clockwork (OSDI '20)
+cancels and quarantines work that misbehaves. You cannot claim either
+property without a way to *produce* the failures on demand — this module
+is that substrate. Every recovery path in the runtime/serving stack
+(supervised engine restart, poison-request quarantine, circuit breakers,
+compile-cache corruption recovery) is tested and benched against faults
+injected here, never against luck.
+
+**Injection sites** are string names threaded through the hot paths:
+
+    ``engine.dispatch``   InferenceEngine padded dispatch
+    ``engine.batcher``    InferenceEngine micro-batcher loop (thread crash)
+    ``decode.prefill``    DecodeEngine prompt prefill
+    ``decode.step``       DecodeEngine batched decode step
+    ``decode.loop``       DecodeEngine scheduler loop (thread crash)
+    ``cache.load``        compile-cache entry read
+    ``cache.deserialize`` compile-cache executable deserialization
+    ``http.handler``      serving HTTP request handler
+
+**Configuration** is env-first and deterministic:
+
+    DL4J_TPU_FAULTS="site:kind:rate:seed,site2:kind:rate:seed"
+
+``kind`` is ``error`` (raise :class:`InjectedFault`) or ``delayNNN``
+(sleep NNN ms); ``rate`` in [0,1] is evaluated against a per-rule seeded
+PRNG stream, so the same spec produces the same fault sequence on every
+run. Tests and the bench use the programmatic :func:`inject` /
+:func:`injected` API (which additionally supports a bounded ``times``
+budget and a ``predicate`` over call-site context — e.g. "fail only when
+the request payload carries NaN", the poison-request scenario).
+
+**Zero overhead when off** (the default): every instrumented call site
+guards with ``if faults.active():`` — one module-global bool read — so
+an uninstrumented production process pays nothing (the
+``telemetry_overhead`` bench gate holds with the sites in place).
+
+The module also owns the **one** exponential-backoff-with-jitter policy
+(:class:`ExponentialBackoff`, :class:`RetryPolicy`) shared by the engine
+supervisors (`runtime/inference.py`, `runtime/generation.py`) and the
+fault-tolerant trainer (`parallel/fault_tolerance.py`), so every retry
+loop in the codebase backs off the same way and carries a max-restart
+budget.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed ``error`` fault rule. Deliberately a plain
+    RuntimeError subclass: recovery paths must treat it exactly like the
+    real dispatch/IO faults it stands in for."""
+
+    def __init__(self, site: str, message: Optional[str] = None):
+        super().__init__(message or f"injected fault at site '{site}'")
+        self.site = site
+
+
+class _FaultRule:
+    """One armed rule at one site."""
+
+    __slots__ = ("site", "kind", "rate", "seed", "delay_s", "times",
+                 "predicate", "_rng", "triggered", "checked", "_lock")
+
+    def __init__(self, site: str, kind: str = "error", rate: float = 1.0,
+                 seed: int = 0, delay_s: float = 0.0,
+                 times: Optional[int] = None,
+                 predicate: Optional[Callable[[Dict[str, Any]], bool]] = None):
+        self.site = str(site)
+        self.kind = str(kind)
+        self.rate = float(rate)
+        self.seed = int(seed)
+        self.delay_s = float(delay_s)
+        self.times = times if times is None else int(times)
+        self.predicate = predicate
+        self._rng = random.Random(self.seed)
+        self.triggered = 0
+        self.checked = 0
+        self._lock = threading.Lock()
+
+    def fire(self, ctx: Dict[str, Any]) -> Optional[str]:
+        """Evaluate the rule; returns the kind to apply or None. The
+        draw is taken under a lock so the seeded stream stays a single
+        deterministic sequence even under concurrent checks."""
+        if self.predicate is not None:
+            try:
+                if not self.predicate(ctx):
+                    return None
+            except Exception:
+                return None  # a broken predicate must never inject
+        with self._lock:
+            self.checked += 1
+            if self.times is not None and self.triggered >= self.times:
+                return None
+            if self.rate < 1.0 and self._rng.random() >= self.rate:
+                return None
+            self.triggered += 1
+        return self.kind
+
+    def describe(self) -> Dict[str, Any]:
+        return {"site": self.site, "kind": self.kind, "rate": self.rate,
+                "seed": self.seed, "times": self.times,
+                "checked": self.checked, "triggered": self.triggered}
+
+
+#: site -> armed rules. `_active` mirrors bool(_RULES) so hot paths pay
+#: one module-global read when injection is off (the common case).
+_RULES: Dict[str, List[_FaultRule]] = {}
+_RULES_LOCK = threading.Lock()
+_active = False
+
+
+def active() -> bool:
+    """True when any fault rule is armed — THE hot-path guard. Call
+    sites do ``if faults.active(): faults.check(site, **ctx)`` so the
+    off state costs one global read and no argument packing."""
+    return _active
+
+
+def _refresh_active():
+    global _active
+    _active = bool(_RULES)
+
+
+def inject(site: str, kind: str = "error", rate: float = 1.0,
+           seed: int = 0, delay_s: float = 0.05,
+           times: Optional[int] = None,
+           predicate: Optional[Callable[[Dict[str, Any]], bool]] = None
+           ) -> _FaultRule:
+    """Arm one rule programmatically (tests / the resilience bench);
+    returns the rule so the caller can inspect ``triggered``/``checked``
+    or pass it to :func:`remove`."""
+    rule = _FaultRule(site, kind, rate, seed, delay_s, times, predicate)
+    with _RULES_LOCK:
+        _RULES.setdefault(rule.site, []).append(rule)
+        _refresh_active()
+    return rule
+
+
+def remove(rule: _FaultRule):
+    with _RULES_LOCK:
+        rules = _RULES.get(rule.site)
+        if rules and rule in rules:
+            rules.remove(rule)
+            if not rules:
+                _RULES.pop(rule.site, None)
+        _refresh_active()
+
+
+class injected:
+    """Scoped injection: ``with faults.injected("engine.dispatch",
+    times=1): ...`` arms on entry, disarms on exit (exception-safe)."""
+
+    def __init__(self, site: str, **kw):
+        self._args = (site, kw)
+        self.rule: Optional[_FaultRule] = None
+
+    def __enter__(self) -> _FaultRule:
+        site, kw = self._args
+        self.rule = inject(site, **kw)
+        return self.rule
+
+    def __exit__(self, *exc):
+        if self.rule is not None:
+            remove(self.rule)
+        return False
+
+
+def clear(site: Optional[str] = None):
+    """Disarm every rule (or just ``site``'s)."""
+    with _RULES_LOCK:
+        if site is None:
+            _RULES.clear()
+        else:
+            _RULES.pop(site, None)
+        _refresh_active()
+
+
+def configure(spec: Optional[str]) -> int:
+    """Replace the armed rule set from a ``DL4J_TPU_FAULTS``-format
+    string (``site:kind:rate:seed,...``; rate and seed optional).
+    Malformed entries are skipped with a warning — a typo'd fault spec
+    must degrade to "no injection", never crash serving startup.
+    Returns the number of rules armed."""
+    clear()
+    if not spec:
+        return 0
+    n = 0
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        try:
+            site = fields[0]
+            kind = fields[1] if len(fields) > 1 and fields[1] else "error"
+            rate = float(fields[2]) if len(fields) > 2 and fields[2] else 1.0
+            seed = int(fields[3]) if len(fields) > 3 and fields[3] else 0
+            delay_s = 0.05
+            if kind.startswith("delay"):
+                ms = kind[len("delay"):]
+                delay_s = (float(ms) / 1e3) if ms else 0.05
+                kind = "delay"
+            elif kind != "error":
+                raise ValueError(f"unknown fault kind '{kind}'")
+            if not site:
+                raise ValueError("empty site")
+            inject(site, kind=kind, rate=rate, seed=seed, delay_s=delay_s)
+            n += 1
+        except (ValueError, IndexError) as e:
+            log.warning("ignoring malformed DL4J_TPU_FAULTS entry %r (%s)",
+                        part, e)
+    return n
+
+
+def load_env() -> int:
+    """(Re)load the armed rules from the environment layer
+    (``DL4J_TPU_FAULTS`` via the layered property system)."""
+    from .environment import environment
+    return configure(environment().faults_spec())
+
+
+def check(site: str, **ctx):
+    """Evaluate ``site``'s armed rules; raises :class:`InjectedFault`
+    (or sleeps, for delay rules) when one fires. Call sites MUST guard
+    with :func:`active` so this is never reached when injection is off."""
+    if not _active:
+        return
+    rules = _RULES.get(site)
+    if not rules:
+        return
+    for rule in list(rules):
+        kind = rule.fire(ctx)
+        if kind is None:
+            continue
+        try:
+            from .metrics import registry
+            registry().counter(
+                "dl4j_faults_injected_total",
+                "Faults fired by the injection registry, by site",
+                labels=("site",)).labels(site=site).inc()
+        except Exception:
+            pass
+        if kind == "delay":
+            time.sleep(rule.delay_s)
+        else:
+            raise InjectedFault(site)
+
+
+def stats() -> List[Dict[str, Any]]:
+    """Describe every armed rule (checked/triggered counts included)."""
+    with _RULES_LOCK:
+        return [r.describe() for rules in _RULES.values() for r in rules]
+
+
+# ---------------------------------------------------------------------------
+# the shared retry/backoff policy
+# ---------------------------------------------------------------------------
+
+class ExponentialBackoff:
+    """Exponential backoff with deterministic full jitter.
+
+    ``next_delay()`` returns ``min(base * factor**attempt, max_s)``
+    scaled by a seeded jitter draw in ``[1-jitter, 1]`` — the standard
+    thundering-herd guard, reproducible under a fixed seed. ``reset()``
+    re-arms after a healthy period so one crash a day never escalates to
+    the max delay."""
+
+    def __init__(self, base_s: float = 0.05, factor: float = 2.0,
+                 max_s: float = 5.0, jitter: float = 0.5,
+                 seed: Optional[int] = 0):
+        if base_s <= 0 or factor < 1.0:
+            raise ValueError("base_s must be > 0 and factor >= 1")
+        self.base_s = float(base_s)
+        self.factor = float(factor)
+        self.max_s = float(max_s)
+        self.jitter = min(max(float(jitter), 0.0), 1.0)
+        self.attempt = 0
+        self._rng = random.Random(seed)
+
+    def peek(self) -> float:
+        return min(self.base_s * (self.factor ** self.attempt), self.max_s)
+
+    def next_delay(self) -> float:
+        d = self.peek()
+        self.attempt += 1
+        if self.jitter > 0.0:
+            d *= 1.0 - self.jitter * self._rng.random()
+        return d
+
+    def reset(self):
+        self.attempt = 0
+        return self
+
+
+class RetryBudgetExceeded(RuntimeError):
+    """A supervised retry loop exhausted its max-restart budget; carries
+    the last underlying failure as ``__cause__``."""
+
+
+class RetryPolicy:
+    """Max-restart budget + backoff, the unit every supervised loop
+    shares (engine batcher/decode supervisors, FaultTolerantTrainer).
+
+    ``sleep(attempt)`` sleeps the attempt's backoff delay; ``admit(n)``
+    is True while restart ``n`` (1-based) is within budget. A
+    ``healthy_reset_s`` window (default 60s) zeroes the budget after the
+    loop ran that long without failing — a long-lived worker's budget
+    bounds crash *bursts*, not its lifetime restart count."""
+
+    def __init__(self, max_restarts: int = 5, *, base_s: float = 0.05,
+                 factor: float = 2.0, max_s: float = 5.0,
+                 jitter: float = 0.5, seed: Optional[int] = 0,
+                 healthy_reset_s: float = 60.0,
+                 clock=time.monotonic, sleep=time.sleep):
+        self.max_restarts = int(max_restarts)
+        self.backoff = ExponentialBackoff(base_s, factor, max_s, jitter,
+                                          seed)
+        self.healthy_reset_s = float(healthy_reset_s)
+        self._clock = clock
+        self._sleep = sleep
+        self._restarts = 0
+        self._last_failure: Optional[float] = None
+
+    @property
+    def restarts(self) -> int:
+        return self._restarts
+
+    def note_failure(self) -> int:
+        """Record one failure; returns the restart ordinal (1-based).
+        A failure after a healthy window resets the burst budget."""
+        now = self._clock()
+        if (self._last_failure is not None
+                and now - self._last_failure > self.healthy_reset_s):
+            self._restarts = 0
+            self.backoff.reset()
+        self._last_failure = now
+        self._restarts += 1
+        return self._restarts
+
+    def exhausted(self) -> bool:
+        return self.max_restarts > 0 and self._restarts > self.max_restarts
+
+    def sleep(self):
+        self._sleep(self.backoff.next_delay())
+
+    def reset(self):
+        self._restarts = 0
+        self._last_failure = None
+        self.backoff.reset()
+        return self
+
+
+def retry_call(fn: Callable, *, policy: Optional[RetryPolicy] = None,
+               retry_on=Exception,
+               on_retry: Optional[Callable[[BaseException, int], None]] = None):
+    """Call ``fn()`` under a :class:`RetryPolicy`: retried with backoff
+    on ``retry_on`` until the budget runs out, then
+    :class:`RetryBudgetExceeded` chained to the last failure."""
+    policy = policy if policy is not None else RetryPolicy()
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            n = policy.note_failure()
+            if policy.exhausted():
+                raise RetryBudgetExceeded(
+                    f"retry budget ({policy.max_restarts}) exhausted"
+                ) from e
+            if on_retry is not None:
+                on_retry(e, n)
+            policy.sleep()
+
+
+# arm any env-configured rules at import (off — and zero-cost — when
+# DL4J_TPU_FAULTS is unset, the default)
+if os.environ.get("DL4J_TPU_FAULTS"):
+    load_env()

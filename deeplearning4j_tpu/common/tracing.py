@@ -52,7 +52,7 @@ import json
 import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from contextlib import contextmanager
 from typing import Dict, List, NamedTuple, Optional
 
@@ -406,6 +406,41 @@ def capture_profile(seconds: float, log_dir: Optional[str] = None) -> dict:
                 pass
     return {"path": path, "seconds": seconds,
             "files": sorted(files, key=lambda f: f["file"])}
+
+
+# ---------------------------------------------------------------------------
+# per-trace failure dispositions (resilience post-mortems)
+# ---------------------------------------------------------------------------
+# The engines record WHAT the resilience machinery did to a request
+# (``retried`` — rescued by an isolated re-dispatch; ``quarantined`` —
+# designated poison; ``engine_restart`` — failed by a crashed worker
+# dispatch; the serving layer adds ``breaker_open``). The HTTP server
+# pops the disposition into the request ring / flight recorder, so a
+# post-mortem can distinguish shed load from faulted load by trace id.
+# Bounded dict, oldest-first eviction; keyed by trace_id.
+
+_DISPOSITIONS: "OrderedDict[str, str]" = OrderedDict()
+_DISPOSITIONS_LOCK = threading.Lock()
+_DISPOSITIONS_CAP = 4096
+
+
+def record_disposition(trace_id: Optional[str], disposition: str):
+    """Stamp a failure disposition on ``trace_id`` (no-op without one)."""
+    if not trace_id:
+        return
+    with _DISPOSITIONS_LOCK:
+        _DISPOSITIONS[trace_id] = disposition
+        _DISPOSITIONS.move_to_end(trace_id)
+        while len(_DISPOSITIONS) > _DISPOSITIONS_CAP:
+            _DISPOSITIONS.popitem(last=False)
+
+
+def pop_disposition(trace_id: Optional[str]) -> Optional[str]:
+    """Consume the disposition recorded for ``trace_id``, if any."""
+    if not trace_id:
+        return None
+    with _DISPOSITIONS_LOCK:
+        return _DISPOSITIONS.pop(trace_id, None)
 
 
 _TRACER: Optional[Tracer] = None

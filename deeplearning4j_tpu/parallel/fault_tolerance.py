@@ -16,11 +16,11 @@ across mesh shapes) and resumes from the last checkpoint. The
 """
 from __future__ import annotations
 
-import time
 from typing import Callable, Optional, Sequence
 
 import jax
 
+from ..common.faults import RetryPolicy
 from ..nn.checkpoint import ShardedCheckpointer
 from .mesh import MeshConfig, make_mesh
 
@@ -50,21 +50,37 @@ class FaultTolerantTrainer:
 
     fit_fn(net, epoch) trains one epoch (raising on failure); on exception
     the trainer re-meshes over live devices, restores the latest checkpoint,
-    and retries — up to `max_restarts`.
+    and retries. The retry loop rides the shared
+    ``common.faults.RetryPolicy`` — the same exponential-backoff-with-
+    jitter + max-restart budget the serving engine supervisors use — so
+    crash loops back off instead of hammering a sick device, and a crash
+    *burst* past ``max_restarts`` propagates instead of retrying forever
+    (the budget resets after ``healthy_reset_s`` of clean epochs, so a
+    long job's budget bounds bursts, not lifetime restarts).
     """
 
     def __init__(self, net, checkpoint_dir: str,
                  mesh_config: Optional[MeshConfig] = None,
                  checkpoint_every_epochs: int = 1, keep_last: int = 2,
                  max_restarts: int = 3,
-                 on_restart: Optional[Callable] = None):
+                 on_restart: Optional[Callable] = None,
+                 retry_policy: Optional[RetryPolicy] = None):
         self.net = net
         self.ckpt = ShardedCheckpointer(checkpoint_dir, keep_last=keep_last)
         self.mesh_config = mesh_config
         self.every = checkpoint_every_epochs
-        self.max_restarts = max_restarts
         self.on_restart = on_restart
-        self.restarts = 0
+        # backoff sized for training epochs (seconds, not the engines'
+        # milliseconds); an explicit policy overrides budget AND backoff
+        self.policy = (retry_policy if retry_policy is not None
+                       else RetryPolicy(max_restarts, base_s=0.05,
+                                        max_s=30.0, seed=0,
+                                        healthy_reset_s=600.0))
+        self.max_restarts = self.policy.max_restarts
+
+    @property
+    def restarts(self) -> int:
+        return self.policy.restarts
 
     def fit(self, fit_fn: Callable, num_epochs: int):
         epoch = 0
@@ -80,11 +96,12 @@ class FaultTolerantTrainer:
                 if epoch % self.every == 0 or epoch == num_epochs:
                     self.ckpt.save(self.net._iteration, self.net)
             except Exception as e:  # noqa: BLE001 — supervised retry scope
-                self.restarts += 1
-                if self.restarts > self.max_restarts:
+                n = self.policy.note_failure()
+                if self.policy.exhausted():
                     raise
                 if self.on_restart is not None:
-                    self.on_restart(e, self.restarts)
+                    self.on_restart(e, n)
+                self.policy.sleep()  # exponential backoff + jitter
                 self._restore()
                 epoch = self.net._epoch
         return self.net

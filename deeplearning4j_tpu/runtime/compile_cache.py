@@ -66,6 +66,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..common import faults
 from ..common.environment import environment
 
 log = logging.getLogger(__name__)
@@ -184,6 +185,10 @@ class AOTCompileCache:
                 self.stats["misses"] += 1
             return None
         try:
+            if faults.active():
+                # injected read fault: exercises the corrupt-entry
+                # recovery path (drop + warn + recompile) on demand
+                faults.check("cache.load", key=key)
             with open(meta_p, "r") as f:
                 meta = json.load(f)
             if meta.get("format") != FORMAT_VERSION:
@@ -533,6 +538,10 @@ def _load_executor(payload: bytes, meta: dict, lowered) -> Optional[Callable]:
     import jax.numpy as jnp
 
     try:
+        if faults.active():
+            # injected deserialize fault: the caller must fall back to a
+            # live recompile, never surface the failure to a request
+            faults.check("cache.deserialize")
         backend = jax.devices()[0].client
         exe = backend.deserialize_executable(payload)
         kept = meta["kept_var_idx"]

@@ -49,9 +49,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common import faults
 from ..common.environment import environment
 from ..common.metrics import exponential_buckets, registry
-from ..common.tracing import current_context, tracer
+from ..common.tracing import current_context, record_disposition, tracer
 from .inference import (EngineClosedError, bucket_for, bucket_ladder,
                         counted_jit)
 
@@ -179,6 +180,10 @@ class DecodeEngine:
         self._stopping = False
         self._draining = False
         self._closed = False
+        # resilience: supervised-loop state + watchdog-readable dispatch
+        # timestamp (serving/resilience.py polls these from outside)
+        self._worker_dead = False
+        self._dispatch_started_at: Optional[float] = None
         # registry-compat surface (manifest machinery is predict-only)
         self.max_batch = self.slots
         self.manifest_path = None
@@ -210,6 +215,18 @@ class DecodeEngine:
         self._m_expired = reg.counter(
             "dl4j_decode_expired_total",
             "Generation requests whose deadline expired before a slot")
+        self._m_restarts = reg.counter(
+            "dl4j_engine_restarts_total",
+            "Supervised engine worker-thread restarts after a crash",
+            labels=("engine",)).labels(engine="decode")
+        self._m_slot_leaks = reg.counter(
+            "dl4j_decode_slot_leaks_total",
+            "KV-cache slots found leaked (occupied without a live rider) "
+            "and reclaimed by the per-iteration accounting check")
+        self._m_cancelled = reg.counter(
+            "dl4j_decode_cancelled_total",
+            "Riders whose future was cancelled mid-decode; their slot is "
+            "freed immediately")
 
     # -- jitted steps ------------------------------------------------------
     def _build_steps(self):
@@ -239,28 +256,41 @@ class DecodeEngine:
         self._decode = counted_jit(decode_fn, "decode", donate_argnums=(1,))
 
     def _run_prefill(self, ids, slot, length, temperature, top_k):
+        if faults.active():
+            faults.check("decode.prefill", slot=slot, length=length)
         with self._dispatch_lock:
-            cache, tok = self._prefill(
-                self._params, self._cache, jnp.asarray(ids),
-                jnp.asarray(slot, jnp.int32), jnp.asarray(length, jnp.int32),
-                jnp.asarray(temperature, jnp.float32),
-                jnp.asarray(top_k, jnp.int32),
-                jnp.asarray(self._seed, jnp.int32),
-                jnp.asarray(self._step, jnp.int32))
-            self._cache = cache
-            self._step += 1
+            self._dispatch_started_at = time.monotonic()
+            try:
+                cache, tok = self._prefill(
+                    self._params, self._cache, jnp.asarray(ids),
+                    jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(length, jnp.int32),
+                    jnp.asarray(temperature, jnp.float32),
+                    jnp.asarray(top_k, jnp.int32),
+                    jnp.asarray(self._seed, jnp.int32),
+                    jnp.asarray(self._step, jnp.int32))
+                self._cache = cache
+                self._step += 1
+            finally:
+                self._dispatch_started_at = None
         return int(tok)
 
     def _run_decode(self, active):
+        if faults.active():
+            faults.check("decode.step", active=int(np.sum(active)))
         with self._dispatch_lock:
-            cache, nxt = self._decode(
-                self._params, self._cache, jnp.asarray(self._tokens),
-                jnp.asarray(self._lengths), jnp.asarray(active),
-                jnp.asarray(self._temps), jnp.asarray(self._topks),
-                jnp.asarray(self._seed, jnp.int32),
-                jnp.asarray(self._step, jnp.int32))
-            self._cache = cache
-            self._step += 1
+            self._dispatch_started_at = time.monotonic()
+            try:
+                cache, nxt = self._decode(
+                    self._params, self._cache, jnp.asarray(self._tokens),
+                    jnp.asarray(self._lengths), jnp.asarray(active),
+                    jnp.asarray(self._temps), jnp.asarray(self._topks),
+                    jnp.asarray(self._seed, jnp.int32),
+                    jnp.asarray(self._step, jnp.int32))
+                self._cache = cache
+                self._step += 1
+            finally:
+                self._dispatch_started_at = None
         return np.asarray(nxt)
 
     # -- warmup ------------------------------------------------------------
@@ -323,10 +353,12 @@ class DecodeEngine:
         req = _GenRequest(ids, max_tokens, temperature, top_k, eos,
                           on_token, deadline, current_context())
         with self._cv:
-            if self._draining or self._closed:
+            if self._draining or self._closed or self._worker_dead:
                 raise EngineClosedError(
                     "DecodeEngine is "
-                    + ("closed" if self._closed else "draining")
+                    + ("closed" if self._closed else
+                       "draining" if self._draining else
+                       "dead (worker restart budget exhausted)")
                     + "; it no longer accepts requests")
             self._pending.append(req)
             depth = len(self._pending)
@@ -344,17 +376,67 @@ class DecodeEngine:
     # -- the continuous-batching loop --------------------------------------
     def _ensure_thread(self):
         with self._cv:
-            if self._draining or self._closed:
+            if self._draining or self._closed or self._worker_dead:
                 return
             if self._thread is None or not self._thread.is_alive():
                 self._stopping = False
                 self._thread = threading.Thread(
-                    target=self._loop, name="dl4j-tpu-decode-loop",
+                    target=self._loop_main, name="dl4j-tpu-decode-loop",
                     daemon=True)
                 self._thread.start()
 
+    @property
+    def worker_dead(self) -> bool:
+        """True once the supervised decode loop exhausted its restart
+        budget (the watchdog reports this engine unhealthy)."""
+        return self._worker_dead
+
+    def _loop_main(self):
+        """Supervised decode loop: a crash that escapes the per-iteration
+        handler (scheduler-state corruption, not a dispatch fault) is
+        counted and the loop restarts with exponential backoff + jitter
+        instead of silently killing generation for every later request.
+        A crash burst past ``DL4J_TPU_ENGINE_MAX_RESTARTS`` declares the
+        worker dead and fails everything queued."""
+        policy = faults.RetryPolicy(
+            max_restarts=environment().engine_max_restarts(),
+            base_s=0.01, max_s=2.0, seed=0)
+        while True:
+            try:
+                self._loop()
+                return  # normal stop
+            except Exception:
+                log.exception("decode loop crashed; restarting")
+                policy.note_failure()
+                self._m_restarts.inc()
+                if policy.exhausted():
+                    self._worker_died()
+                    return
+                time.sleep(policy.backoff.next_delay())
+
+    def _worker_died(self):
+        with self._cv:
+            self._worker_dead = True
+            pending, self._pending = self._pending, []
+            if self._thread is threading.current_thread():
+                self._thread = None
+            self._cv.notify_all()
+        log.error("decode loop exceeded its restart budget; engine "
+                  "refuses new work (worker_dead)")
+        exc = EngineClosedError(
+            "DecodeEngine worker thread permanently failed "
+            "(restart budget exhausted)")
+        for req in pending:
+            if not req.future.done():
+                req.future.set_exception(exc)
+        self._fail_dispatch_riders(exc)
+
     def _loop(self):
         while True:
+            # deliberate thread-crash site: raises OUTSIDE the
+            # per-iteration handler so only the supervisor catches it
+            if faults.active():
+                faults.check("decode.loop")
             with self._cv:
                 while (not self._pending and self._active_n == 0
                        and not self._stopping):
@@ -368,21 +450,46 @@ class DecodeEngine:
                 self._admit_pending()
                 if self._active_n > 0:
                     self._decode_once()
-            except Exception as e:  # a model fault must not strand futures
-                log.exception("decode loop iteration failed")
-                self._fail_all(e)
+            except Exception as e:  # a dispatch fault must not strand
+                # futures — but it fails only THIS dispatch's riders
+                # (the active slots); queued requests stay queued and
+                # are admitted fresh on the next iteration
+                log.exception("decode dispatch failed; failing its "
+                              "riders only")
+                self._fail_dispatch_riders(e)
+            self._reconcile_slots()
 
-    def _fail_all(self, exc: Exception):
-        with self._cv:
-            pending, self._pending = self._pending, []
-        for req in pending:
-            if not req.future.done():
-                req.future.set_exception(exc)
-        for slot, req in enumerate(self._slot_req):
+    def _fail_dispatch_riders(self, exc: Exception):
+        """Fail + release only the sequences that rode the failed
+        dispatch (every active slot); pending requests survive."""
+        for slot, req in enumerate(list(self._slot_req)):
             if req is not None:
                 if not req.future.done():
                     req.future.set_exception(exc)
+                if req.ctx is not None:
+                    record_disposition(req.ctx.trace_id, "engine_restart")
                 self._release_slot(slot)
+
+    def _reconcile_slots(self):
+        """Slot-lifecycle assertion: every occupied slot must hold a
+        rider whose future is still undelivered or just-finished — a
+        cancelled/leaked rider is reclaimed here and counted, so a KV
+        slot can never stay occupied forever (the regression the
+        ``dl4j_decode_slot_leaks_total`` counter exists to catch)."""
+        leaked = []
+        with self._cv:
+            occupied = sum(1 for r in self._slot_req if r is not None)
+            if occupied != self._active_n:
+                leaked.append(("accounting", occupied - self._active_n))
+                self._active_n = occupied
+        for slot, req in enumerate(list(self._slot_req)):
+            if req is not None and req.future.cancelled():
+                self._m_cancelled.inc()
+                self._release_slot(slot)
+        if leaked:
+            self._m_slot_leaks.inc(abs(leaked[0][1]))
+            log.warning("decode slot accounting drifted by %d; repaired",
+                        leaked[0][1])
 
     def _admit_pending(self):
         """Fill free slots from the queue (the per-iteration join half of

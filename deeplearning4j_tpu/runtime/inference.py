@@ -41,9 +41,11 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..common import faults
 from ..common.environment import environment
 from ..common.metrics import linear_buckets, registry
-from ..common.tracing import current_context, span, tracer, use_context
+from ..common.tracing import (current_context, record_disposition, span,
+                              tracer, use_context)
 
 
 # ---------------------------------------------------------------------------
@@ -318,6 +320,18 @@ class EngineClosedError(RuntimeError):
     replaced this one; everyone else surfaces the error)."""
 
 
+class PoisonRequestError(RuntimeError):
+    """A request that failed its coalesced dispatch AND its one isolated
+    re-dispatch: the failure follows the request, not the batch, so it is
+    quarantined (HTTP 422 with trace id) instead of re-killing every
+    micro-batch it rides in. Carries the underlying dispatch error as
+    ``__cause__``-style ``cause``."""
+
+    def __init__(self, message: str, cause: Optional[BaseException] = None):
+        super().__init__(message)
+        self.cause = cause
+
+
 class _Request:
     __slots__ = ("inputs", "n", "sig", "future", "deadline", "ctx",
                  "t_submit")
@@ -389,6 +403,10 @@ class InferenceEngine:
         self._draining = False
         self._closed = False
         self._inflight = 0  # synchronous infer() calls currently running
+        # resilience: the supervised batcher's restart budget state and
+        # the watchdog-readable in-flight dispatch timestamp
+        self._worker_dead = False
+        self._dispatch_started_at: Optional[float] = None
         # stats
         self._lock = threading.Lock()
         self._stats = {"requests": 0, "dispatches": 0, "rows_real": 0,
@@ -420,6 +438,17 @@ class InferenceEngine:
         self._m_expired = self._reg.counter(
             "dl4j_inference_deadline_expired_total",
             "submit() requests whose deadline expired before dispatch")
+        self._m_restarts = self._reg.counter(
+            "dl4j_engine_restarts_total",
+            "Supervised engine worker-thread restarts after a crash",
+            labels=("engine",)).labels(engine="inference")
+        self._m_quarantined = self._reg.counter(
+            "dl4j_quarantined_requests_total",
+            "Poison requests quarantined after a failed isolated retry")
+        self._m_isolated = self._reg.counter(
+            "dl4j_inference_isolated_retries_total",
+            "Riders of a failed coalesced dispatch re-dispatched "
+            "individually, by outcome", labels=("outcome",))
 
     # -- core dispatch ---------------------------------------------------
     def _dispatch(self, inputs: List[jax.Array], n: int,
@@ -430,22 +459,28 @@ class InferenceEngine:
         any active trace context; ``span_attrs`` lets the micro-batcher
         stamp the coalesced riders' trace_ids onto it."""
         b = bucket_for(n, self.ladder)
+        if faults.active():
+            faults.check("engine.dispatch", inputs=inputs, rows=n, bucket=b)
         padded = [pad_batch(x, b) for x in inputs]
-        if self._reg.enabled:
-            ctx = current_context()
-            t0 = time.perf_counter()
-            with span("inference/dispatch", bucket=b, rows=n,
-                      **(span_attrs or {})):
+        self._dispatch_started_at = time.monotonic()  # watchdog-readable
+        try:
+            if self._reg.enabled:
+                ctx = current_context()
+                t0 = time.perf_counter()
+                with span("inference/dispatch", bucket=b, rows=n,
+                          **(span_attrs or {})):
+                    outs = self._adapter.run(padded)
+                lat = self._m_latency.get(b)
+                if lat is not None:
+                    # tail observations carry the request's trace_id as an
+                    # exemplar, linking the histogram back to /debug/trace
+                    lat.observe(time.perf_counter() - t0,
+                                exemplar=ctx.trace_id if ctx else None)
+                    self._m_padding[b].observe((b - n) / b)
+            else:
                 outs = self._adapter.run(padded)
-            lat = self._m_latency.get(b)
-            if lat is not None:
-                # tail observations carry the request's trace_id as an
-                # exemplar, linking the histogram back to /debug/trace
-                lat.observe(time.perf_counter() - t0,
-                            exemplar=ctx.trace_id if ctx else None)
-                self._m_padding[b].observe((b - n) / b)
-        else:
-            outs = self._adapter.run(padded)
+        finally:
+            self._dispatch_started_at = None
         with self._lock:
             s = self._stats
             s["dispatches"] += 1
@@ -480,10 +515,12 @@ class InferenceEngine:
     def infer(self, request):
         """Synchronous bucketed inference for one request."""
         with self._cv:
-            if self._draining or self._closed:
+            if self._draining or self._closed or self._worker_dead:
                 raise EngineClosedError(
                     "InferenceEngine is "
-                    + ("closed" if self._closed else "draining")
+                    + ("closed" if self._closed else
+                       "draining" if self._draining else
+                       "dead (worker restart budget exhausted)")
                     + "; it no longer accepts requests")
             self._inflight += 1
         try:
@@ -696,10 +733,12 @@ class InferenceEngine:
         deadline = (time.monotonic() + timeout_s
                     if timeout_s is not None else None)
         with self._cv:
-            if self._draining or self._closed:
+            if self._draining or self._closed or self._worker_dead:
                 raise EngineClosedError(
                     "InferenceEngine is "
-                    + ("closed" if self._closed else "draining")
+                    + ("closed" if self._closed else
+                       "draining" if self._draining else
+                       "dead (worker restart budget exhausted)")
                     + "; it no longer accepts requests")
             self._pending.append(_Request(inputs, sig, fut, deadline,
                                           ctx=current_context()))
@@ -714,14 +753,65 @@ class InferenceEngine:
 
     def _ensure_thread(self):
         with self._cv:
-            if self._draining or self._closed:
+            if self._draining or self._closed or self._worker_dead:
                 return  # a drain in progress must never be un-stopped
             if self._thread is None or not self._thread.is_alive():
                 self._stopping = False
                 self._thread = threading.Thread(
-                    target=self._batcher_loop,
+                    target=self._batcher_main,
                     name="dl4j-tpu-inference-batcher", daemon=True)
                 self._thread.start()
+
+    @property
+    def worker_dead(self) -> bool:
+        """True once the supervised batcher exhausted its restart budget
+        (the watchdog reports this engine unhealthy; submits fail fast)."""
+        return self._worker_dead
+
+    def _batcher_main(self):
+        """Supervised batcher: a crash anywhere in the loop fails at most
+        the dispatch it was running (``_run_group`` already fails only
+        its riders), is counted, and the loop resumes after exponential
+        backoff with jitter — one uncaught exception must never silently
+        kill the dispatch path for every subsequent request. A crash
+        *burst* past ``DL4J_TPU_ENGINE_MAX_RESTARTS`` declares the
+        worker dead: queued requests fail fast with ``EngineClosedError``
+        and the watchdog flips ``/readyz``."""
+        policy = faults.RetryPolicy(
+            max_restarts=environment().engine_max_restarts(),
+            base_s=0.01, max_s=2.0, seed=0)
+        while True:
+            try:
+                self._batcher_loop()
+                return  # normal stop (drain / idle exit)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "inference batcher crashed; restarting the loop")
+                policy.note_failure()
+                self._m_restarts.inc()
+                if policy.exhausted():
+                    self._worker_died()
+                    return
+                time.sleep(policy.backoff.next_delay())
+
+    def _worker_died(self):
+        """Restart budget exhausted: fail everything queued, refuse new
+        work, leave the process alive (the registry / operator decides
+        what happens next — rollback, redeploy, or drain)."""
+        with self._cv:
+            self._worker_dead = True
+            leftovers, self._pending = self._pending, []
+            if self._thread is threading.current_thread():
+                self._thread = None
+            self._cv.notify_all()
+        logging.getLogger(__name__).error(
+            "inference batcher exceeded its restart budget; engine "
+            "refuses new work (worker_dead)")
+        for r in leftovers:
+            if not r.future.done():
+                r.future.set_exception(EngineClosedError(
+                    "InferenceEngine worker thread permanently failed "
+                    "(restart budget exhausted)"))
 
     def start(self):
         """(Re)open the engine for requests: reverses drain() — a parked
@@ -818,6 +908,11 @@ class InferenceEngine:
 
     def _batcher_loop(self):
         while True:
+            # the crash site sits BEFORE any request is popped, so an
+            # injected batcher crash loses no queued work — the
+            # supervisor restarts the loop and the queue survives
+            if faults.active():
+                faults.check("engine.batcher")
             with self._cv:
                 while not self._pending and not self._stopping:
                     self._cv.wait()
@@ -890,11 +985,50 @@ class InferenceEngine:
                 lo = hi
             self._record_rides(group, t_dispatch)
         except Exception as e:
+            self._rescue_group(group, e, t_dispatch)
+
+    def _rescue_group(self, group: List[_Request], exc: Exception,
+                      t_dispatch: float):
+        """Poison isolation: a failed coalesced dispatch re-dispatches
+        each rider individually ONCE, so the one request actually
+        carrying the fault is quarantined (``PoisonRequestError`` → 4xx
+        with trace id) while its innocent riders succeed — instead of
+        the poison re-killing every batch it rides in. An
+        ``EngineClosedError`` (drain race) is not a model fault and
+        fails the group as before so the registry's swap retry fires."""
+        if isinstance(exc, EngineClosedError):
             for r in group:
                 if not r.future.done():
-                    r.future.set_exception(e)
+                    r.future.set_exception(exc)
             self._record_rides(group, t_dispatch,
-                               error=type(e).__name__)
+                               error=type(exc).__name__)
+            return
+        for r in group:
+            if r.future.done():
+                continue
+            trace_id = r.ctx.trace_id if r.ctx is not None else None
+            try:
+                outs = self._dispatch(r.inputs, r.n,
+                                      span_attrs={"isolated_retry": True})
+            except Exception as e2:
+                self._m_isolated.labels(outcome="quarantined").inc()
+                self._m_quarantined.inc()
+                record_disposition(trace_id, "quarantined")
+                if r.ctx is not None and self._reg.enabled:
+                    tracer().record(
+                        "inference/quarantine", t_dispatch,
+                        time.perf_counter(), context=r.ctx, rows=r.n,
+                        error=type(e2).__name__)
+                r.future.set_exception(PoisonRequestError(
+                    f"request quarantined: dispatch failed coalesced "
+                    f"({type(exc).__name__}: {exc}) and again isolated "
+                    f"({type(e2).__name__}: {e2})", cause=e2))
+            else:
+                self._m_isolated.labels(outcome="ok").inc()
+                record_disposition(trace_id, "retried")
+                r.future.set_result(self._adapter.package(outs))
+        self._record_rides(group, t_dispatch,
+                           error=type(exc).__name__)
 
     def _record_rides(self, group: List[_Request], t_dispatch: float,
                       error: Optional[str] = None):

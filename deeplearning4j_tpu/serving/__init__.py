@@ -48,10 +48,13 @@ Env knobs (``DL4J_TPU_SERVING_*``): ``MAX_CONCURRENT``, ``QUEUE_DEPTH``,
 ``DL4J_TPU_DEBUG_ENDPOINTS``, ``DL4J_TPU_PROFILE_DIR``,
 ``DL4J_TPU_FLIGHT_RECORDER_DIR``.
 """
+from ..runtime.inference import PoisonRequestError  # noqa: F401
 from .admission import (AdmissionController, DeadlineExceededError,  # noqa: F401
                         ShedError)
 from .lifecycle import GracefulLifecycle  # noqa: F401
 from .registry import (READY, RETIRED, WARMING, ModelRegistry,  # noqa: F401
                        ModelVersion)
+from .resilience import (BreakerOpenError, CircuitBreaker,  # noqa: F401
+                         EngineWatchdog, HealthRegistry, health, watchdog)
 from .server import ModelServer, RequestRing  # noqa: F401
 from .slo import SLOTracker  # noqa: F401

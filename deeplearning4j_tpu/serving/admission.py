@@ -174,11 +174,17 @@ class AdmissionController:
     def retry_after_hint(self) -> float:
         """How long a shed client should back off: the time the current
         backlog needs to clear at the recent service rate, floored so
-        clients never hot-loop."""
+        clients never hot-loop. Also the ``Retry-After`` source for
+        breaker-open 503s (merged with the probe window)."""
         with self._cv:
             backlog = len(self._queue) + self._active
         est = backlog * self._ewma_service_s / max(self.max_concurrent, 1)
         return min(max(est, 0.05), 30.0)
+
+    def ewma_service_s(self) -> float:
+        """The service-time EWMA behind the retry-after estimate."""
+        with self._cv:
+            return self._ewma_service_s
 
     # -- lifecycle --------------------------------------------------------
     def close(self):

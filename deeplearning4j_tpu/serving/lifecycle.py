@@ -39,9 +39,11 @@ import threading
 import time
 from typing import Callable, Iterable, Optional
 
+from ..common import faults
 from ..common.environment import environment
 from ..common.metrics import registry as metrics_registry
 from ..common.tracing import tracer
+from . import resilience
 from .registry import ModelRegistry
 from .server import ModelServer
 
@@ -134,6 +136,13 @@ class GracefulLifecycle:
                              if server is not None else []),
                 "slo": (server.slo_snapshot()
                         if server is not None else {}),
+                # resilience state: which breakers were open, which
+                # engines were flagged unhealthy, and what faults were
+                # armed — the ring's per-request dispositions only make
+                # sense next to these
+                "breakers": self.registry.breaker_snapshot(),
+                "engine_health": resilience.health().snapshot(),
+                "faults": faults.stats(),
                 "trace_events": tracer().events(),
                 "metrics": metrics_registry().snapshot(),
             }

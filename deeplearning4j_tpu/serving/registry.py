@@ -41,6 +41,8 @@ from ..common.tracing import span
 from ..runtime import compile_cache
 from ..runtime.generation import DecodeEngine, is_generative_model
 from ..runtime.inference import EngineClosedError, InferenceEngine
+from . import resilience
+from .resilience import CircuitBreaker
 
 log = logging.getLogger(__name__)
 
@@ -78,7 +80,9 @@ class ModelRegistry:
     """Named, versioned models behind one object; thread-safe."""
 
     def __init__(self, *, retain: Optional[int] = None,
-                 manifest_dir: Optional[str] = "auto"):
+                 manifest_dir: Optional[str] = "auto",
+                 breaker_threshold: Optional[int] = None,
+                 breaker_probe_s: Optional[float] = None):
         self.retain = (environment().serving_retain()
                        if retain is None else int(retain))
         # "auto" = ride the executable cache volume; None disables disk
@@ -89,12 +93,22 @@ class ModelRegistry:
         self._versions: Dict[str, List[ModelVersion]] = {}
         self._current: Dict[str, ModelVersion] = {}
         self._draining = False
+        # per-version circuit breakers (None knobs = env defaults) and
+        # the one-shot auto-rollback guard per (model, version)
+        self._breaker_threshold = breaker_threshold
+        self._breaker_probe_s = breaker_probe_s
+        self._breakers: Dict[tuple, CircuitBreaker] = {}
+        self._auto_rolled: set = set()
         reg = metrics_registry()
         self._m_deploys = reg.counter(
             "dl4j_serving_deploys_total", "Model versions deployed",
             labels=("model",))
         self._m_rollbacks = reg.counter(
             "dl4j_serving_rollbacks_total", "Model rollbacks",
+            labels=("model",))
+        self._m_auto_rollbacks = reg.counter(
+            "dl4j_auto_rollbacks_total",
+            "Rollbacks triggered by a persistently open circuit breaker",
             labels=("model",))
 
     # -- manifests --------------------------------------------------------
@@ -192,12 +206,14 @@ class ModelRegistry:
             self._versions.setdefault(name, []).append(mv)
             self._current[name] = mv
         self._m_deploys.labels(model=name).inc()
+        self._watch(mv)
         # the outgoing engine finishes its in-flight work, then parks
         if outgoing is not None:
             outgoing.engine.drain(
                 drain_timeout_s if drain_timeout_s is not None
                 else environment().serving_drain_timeout_s())
             outgoing.state = RETIRED
+            self._unwatch(outgoing)
         self._prune(name)
         log.info("deployed %s:%s (%s)%s", name, version, mv.state,
                  f", replacing {outgoing.version}" if outgoing else "")
@@ -258,6 +274,106 @@ class ModelRegistry:
             return not self._draining and all(
                 mv.state == READY for mv in self._current.values())
 
+    # -- dispatch watchdog -------------------------------------------------
+    @staticmethod
+    def _watch(mv: ModelVersion):
+        """Register the (now current) version's engine with the dispatch
+        watchdog: a dispatch stuck past deadline × factor marks it
+        unhealthy and flips /readyz. No-op when the watchdog is disabled
+        (DL4J_TPU_WATCHDOG_FACTOR <= 0)."""
+        budget = resilience.watchdog_budget_s()
+        if budget is not None:
+            resilience.watchdog().register(f"{mv.name}:{mv.version}",
+                                           mv.engine, budget)
+
+    @staticmethod
+    def _unwatch(mv: ModelVersion):
+        resilience.watchdog().unregister(f"{mv.name}:{mv.version}")
+
+    # -- circuit breakers -------------------------------------------------
+    def breaker_for(self, name: str, version: str) -> CircuitBreaker:
+        """The (lazily created) circuit breaker of one model version."""
+        key = (str(name), str(version))
+        br = self._breakers.get(key)
+        if br is None:
+            with self._lock:
+                br = self._breakers.get(key)
+                if br is None:
+                    br = CircuitBreaker(
+                        key[0], key[1],
+                        threshold=self._breaker_threshold,
+                        probe_s=self._breaker_probe_s)
+                    self._breakers[key] = br
+        return br
+
+    def breaker_snapshot(self) -> Dict[str, dict]:
+        """Every breaker's state, for /readyz, /debug and the flight
+        recorder."""
+        with self._lock:
+            breakers = dict(self._breakers)
+        return {f"{n}:{v}": br.snapshot()
+                for (n, v), br in sorted(breakers.items())}
+
+    #: dispatch outcomes that must NOT count as breaker failures: drain
+    #: races (the swap retry handles them), deadline/shed pressure (load,
+    #: not fault), quarantined poison (the request's own fault), and
+    #: client-side input errors
+    _BREAKER_EXEMPT = (EngineClosedError, TimeoutError, KeyError, TypeError,
+                       ValueError)
+
+    def _dispatch_guarded(self, mv: ModelVersion, fn):
+        """One breaker-accounted dispatch attempt against ``mv``. A
+        quarantined poison request counts as a failure too — a *flood*
+        of consecutive quarantines with no success in between is a sick
+        executable, and failing fast beats grinding through isolated
+        retries — but any success in between resets the count, so one
+        poison rider never opens a healthy version's breaker."""
+        br = self.breaker_for(mv.name, mv.version)
+        br.preflight()
+        try:
+            out = fn()
+        except self._BREAKER_EXEMPT:
+            raise
+        except Exception:
+            if br.record_failure():
+                self._maybe_auto_rollback(mv.name, br)
+            raise
+        br.record_success()
+        return out
+
+    def _maybe_auto_rollback(self, name: str, br: CircuitBreaker):
+        """Env-gated last resort: a breaker that re-opens
+        ``auto_rollback_opens`` times in a row while a warm parked
+        previous version exists repoints to that version — degraded
+        service beats no service. Fires at most once per (model,
+        version)."""
+        env = environment()
+        if not env.auto_rollback():
+            return
+        if br.consecutive_opens < env.auto_rollback_opens():
+            return
+        key = (name, br.version)
+        with self._lock:
+            if key in self._auto_rolled:
+                return
+            versions = self._versions.get(name, [])
+            cur = self._current.get(name)
+            if cur is None or cur.version != br.version:
+                return  # an older version's breaker; nothing to do
+            idx = versions.index(cur)
+            target = versions[idx - 1] if idx > 0 else None
+            if target is None or target.engine.closed:
+                return  # no warm parked version to fall back to
+            self._auto_rolled.add(key)
+        log.error("auto-rollback: %s:%s breaker persistently open "
+                  "(%d consecutive opens); rolling back", name,
+                  br.version, br.consecutive_opens)
+        try:
+            self.rollback(name)
+            self._m_auto_rollbacks.labels(model=name).inc()
+        except Exception:
+            log.exception("auto-rollback of %s failed", name)
+
     # -- prediction -------------------------------------------------------
     def predict(self, name: str, request,
                 version: Optional[str] = None,
@@ -269,7 +385,9 @@ class ModelRegistry:
         rollback. TimeoutError propagates when ``timeout_s`` expires
         before dispatch. Runs in a ``serving/predict`` span of the
         caller's trace (the engine's queue/dispatch spans nest under
-        it)."""
+        it). Each attempt is accounted against the version's circuit
+        breaker: an open breaker fails fast with ``BreakerOpenError``
+        (503 + Retry-After at the HTTP layer)."""
         with span("serving/predict", model=name,
                   version=str(version) if version is not None else ""):
             last_exc: Optional[Exception] = None
@@ -279,7 +397,8 @@ class ModelRegistry:
                     raise TypeError(
                         f"model '{name}' is generative; use generate() "
                         "(POST /v1/models/<name>/generate)")
-                try:
+
+                def attempt(mv=mv):
                     try:
                         return mv.engine.submit(
                             request, timeout_s=timeout_s).result()
@@ -287,6 +406,9 @@ class ModelRegistry:
                         # batch larger than max_batch: the chunked sync
                         # path (re-raises genuine bad-request errors)
                         return mv.engine.infer(request)
+
+                try:
+                    return self._dispatch_guarded(mv, attempt)
                 except EngineClosedError as e:
                     last_exc = e
                     if version is not None:
@@ -314,8 +436,9 @@ class ModelRegistry:
                     raise TypeError(
                         f"model '{name}' is not generative; use predict()")
                 try:
-                    return mv.engine.generate(
-                        prompt, timeout_s=timeout_s, **opts).result()
+                    return self._dispatch_guarded(
+                        mv, lambda mv=mv: mv.engine.generate(
+                            prompt, timeout_s=timeout_s, **opts).result())
                 except EngineClosedError as e:
                     last_exc = e
                     if version is not None:
@@ -343,9 +466,11 @@ class ModelRegistry:
             target.engine.start()  # reverse the park-drain
             target.state = READY
             self._current[name] = target
+        self._watch(target)
         cur.engine.drain(drain_timeout_s if drain_timeout_s is not None
                          else environment().serving_drain_timeout_s())
         cur.state = RETIRED
+        self._unwatch(cur)
         self._m_rollbacks.labels(model=name).inc()
         log.info("rolled back %s: %s -> %s", name, cur.version,
                  target.version)
@@ -380,6 +505,7 @@ class ModelRegistry:
         for mv in versions:
             mv.engine.close(t)
             mv.state = RETIRED
+            self._unwatch(mv)
         return self
 
     # -- graceful drain ---------------------------------------------------
@@ -399,4 +525,5 @@ class ModelRegistry:
         for mv in versions:
             ok = mv.engine.close(t) and ok
             mv.state = RETIRED
+            self._unwatch(mv)
         return ok

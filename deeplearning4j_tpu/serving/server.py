@@ -70,15 +70,18 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from ..common import faults
 from ..common.environment import environment
 from ..common.httpserver import (CLIENT_DISCONNECTS, JsonRequestHandler,
                                  QuietThreadingHTTPServer, handle_debug_get,
                                  handle_debug_post, metrics_payload)
-from ..common.tracing import (context_from_traceparent, span, span_tree,
-                              tracer, use_context)
-from ..runtime.inference import EngineClosedError
+from ..common.tracing import (context_from_traceparent, pop_disposition,
+                              span, span_tree, tracer, use_context)
+from ..runtime.inference import EngineClosedError, PoisonRequestError
+from . import resilience
 from .admission import AdmissionController, DeadlineExceededError, ShedError
 from .registry import ModelRegistry
+from .resilience import BreakerOpenError
 from .slo import SLOTracker
 
 log = logging.getLogger(__name__)
@@ -89,10 +92,13 @@ _NPY_TYPES = ("application/x-npy", "application/octet-stream")
 
 #: response status -> ring/SLO outcome label
 _OUTCOMES = {200: "ok", 400: "bad_request", 404: "not_found",
-             409: "retired", 429: "shed", 500: "error", 503: "draining",
-             504: "deadline"}
+             409: "retired", 422: "quarantined", 429: "shed",
+             500: "error", 503: "draining", 504: "deadline"}
 
-#: statuses that count against the serving SLO (client mistakes don't)
+#: statuses that count against the serving SLO (client mistakes don't:
+#: a quarantined poison request — 422 — is the request's own fault and
+#: must not burn the replica's error budget; it is counted separately
+#: via ``SLOTracker.record_excluded`` and the request ring disposition)
 _SLO_STATUSES = (200, 429, 500, 503, 504)
 
 
@@ -226,16 +232,27 @@ class ModelServer:
                         trace_id: str, status: int, duration_s: float,
                         timeout_s: Optional[float],
                         kind: str = "predict",
-                        latency_s: Optional[float] = None):
+                        latency_s: Optional[float] = None,
+                        disposition: Optional[str] = None):
         """Ring + SLO bookkeeping for one completed request, whatever its
         outcome (the ring is the /debug/requests + flight-recorder
         source). ``latency_s`` overrides the SLO-fed latency — generate
         requests feed time-to-first-token, the generative latency
-        objective, while ``duration_s`` in the ring stays wall time."""
+        objective, while ``duration_s`` in the ring stays wall time.
+        ``disposition`` records what the resilience machinery did to the
+        request (``quarantined|retried|breaker_open|engine_restart``);
+        when the handler did not set one, the engine-recorded
+        disposition for this trace id is consumed — so a post-mortem can
+        tell shed load from faulted load by trace id."""
+        if disposition is None:
+            disposition = pop_disposition(trace_id)
+        else:
+            pop_disposition(trace_id)  # handler's verdict wins; drop ours
         self.request_ring.add({
             "trace_id": trace_id, "model": name, "version": version,
             "kind": kind, "status": status,
             "outcome": _OUTCOMES.get(status, str(status)),
+            "disposition": disposition,
             "ts": time.time(), "duration_s": round(duration_s, 6),
             "timeout_s": timeout_s})
         if status in _SLO_STATUSES:
@@ -326,11 +343,16 @@ class ModelServer:
                 elif path == "/readyz":
                     warm = not server.draining and server.registry.ready()
                     slo_ok = server.slo_healthy()
-                    ready = warm and (slo_ok
-                                      or not environment().slo_gate_readyz())
+                    health = resilience.health()
+                    engines_ok = health.healthy()
+                    ready = (warm and engines_ok
+                             and (slo_ok
+                                  or not environment().slo_gate_readyz()))
                     self.send_json(
                         {"ready": ready, "draining": server.draining,
                          "slo_healthy": slo_ok,
+                         "engines_healthy": engines_ok,
+                         "engine_health": health.snapshot(),
                          "slo": server.slo_snapshot(),
                          "models": server.registry.models()},
                         200 if ready else 503)
@@ -351,6 +373,15 @@ class ModelServer:
                     elif path == "/debug/slo":
                         self.send_json({"healthy": server.slo_healthy(),
                                         "models": server.slo_snapshot()})
+                    elif path == "/debug/resilience":
+                        self.send_json({
+                            "breakers":
+                                server.registry.breaker_snapshot(),
+                            "engine_health":
+                                resilience.health().snapshot(),
+                            "watchdog":
+                                resilience.watchdog().watched(),
+                            "faults": faults.stats()})
                     elif not handle_debug_get(self, path):
                         self.send_json({"error": "not found"}, 404)
                 else:
@@ -385,6 +416,7 @@ class ModelServer:
                 self._served_version = version
                 self._timeout_s = None
                 self._latency_s = None
+                self._disposition = None
                 if server.draining:
                     self.send_json(
                         {"error": "server is draining"}, 503,
@@ -401,11 +433,18 @@ class ModelServer:
                         name, self._served_version, ctx.trace_id,
                         self._last_status, time.perf_counter() - t0,
                         self._timeout_s, kind=kind,
-                        latency_s=self._latency_s)
+                        latency_s=self._latency_s,
+                        disposition=self._disposition)
 
             def _dispatch_request(self, kind: str, name: str,
                                   version: Optional[str]):
                 try:
+                    if faults.active():
+                        # handler-level injection site: an InjectedFault
+                        # here maps to 500 and burns the SLO like any
+                        # other server fault (that is the point)
+                        faults.check("http.handler", model=name,
+                                     kind=kind)
                     if kind == "generate":
                         self._generate(name, version)
                     else:
@@ -418,9 +457,37 @@ class ModelServer:
                         {"error": str(e),
                          "retry_after_s": round(e.retry_after_s, 3)},
                         429, headers=[("Retry-After", retry)])
+                except BreakerOpenError as e:
+                    # fail-fast: the version's breaker is open; hint the
+                    # client off for the larger of the probe window and
+                    # the admission backlog estimate
+                    self._disposition = "breaker_open"
+                    hint = e.retry_after_s
+                    try:
+                        hint = max(hint, server.admission_for(name)
+                                   .retry_after_hint())
+                    except Exception:
+                        pass
+                    self.send_json(
+                        {"error": str(e),
+                         "retry_after_s": round(hint, 3)},
+                        503, headers=[("Retry-After",
+                                       max(1, int(np.ceil(hint))))])
                 except (DeadlineExceededError, TimeoutError) as e:
                     self.send_json({"error": f"deadline exceeded: {e}"},
                                    504)
+                except PoisonRequestError as e:
+                    # quarantined: failed its coalesced dispatch AND the
+                    # one isolated retry — the fault follows the request,
+                    # so answer 4xx with the trace id and keep serving
+                    self._disposition = "quarantined"
+                    try:
+                        server.slo_for(name).record_excluded("quarantined")
+                    except Exception:
+                        pass
+                    self.send_json(
+                        {"error": str(e), "quarantined": True,
+                         "trace_id": self._trace_id}, 422)
                 except EngineClosedError as e:
                     # a version pinned to a retired/drained engine: a
                     # routine routing miss, not a server fault

@@ -90,6 +90,11 @@ class SLOTracker:
             "1 while the model's SLO is not fast-burning, else 0",
             labels=("model",)).labels(model=self.model)
         self._m_healthy.set(1)
+        self._m_excluded = reg.counter(
+            "dl4j_slo_excluded_total",
+            "Requests excluded from the SLO as client faults, by reason "
+            "(e.g. quarantined poison requests)",
+            labels=("model", "reason"))
 
     # -- recording ---------------------------------------------------------
     def record(self, latency_s: float, ok: bool = True):
@@ -111,6 +116,15 @@ class SLOTracker:
                                 good="true" if good else "false").inc()
         self._refresh_gauges()
         return good
+
+    def record_excluded(self, reason: str):
+        """Count a request deliberately NOT fed into the objective (a
+        quarantined poison request is the request's fault, not the
+        replica's) so the exclusion itself stays observable — a replica
+        quarantining half its traffic should look odd on a dashboard
+        even while its SLO reads healthy."""
+        self._m_excluded.labels(model=self.model,
+                                reason=str(reason)).inc()
 
     # -- evaluation --------------------------------------------------------
     def _counts(self, window_s: float) -> Tuple[int, int]:

@@ -26,6 +26,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavier chaos/perf loops excluded from the tier-1 run "
+        "(-m 'not slow')")
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _compile_cache_tmpdir(tmp_path_factory):
     """Point the AOT executable cache (DL4J_TPU_CACHE_DIR) at a per-run

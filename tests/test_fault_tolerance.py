@@ -84,6 +84,53 @@ class TestFaultTolerantTrainer:
         np.testing.assert_allclose(net2._epoch, 5)
 
 
+class TestSharedRetryPolicy:
+    """The trainer's supervised retry now rides the shared
+    ``common.faults.RetryPolicy`` (the same backoff + max-restart budget
+    the serving engine supervisors use)."""
+
+    def test_trainer_backs_off_between_restarts(self, tmp_path):
+        from deeplearning4j_tpu.common.faults import RetryPolicy
+
+        x, y = _data()
+        net = _net()
+        sleeps = []
+        policy = RetryPolicy(max_restarts=3, base_s=0.05, jitter=0.0,
+                             sleep=sleeps.append)
+        fails = [0]
+
+        def fit_fn(n, epoch):
+            if epoch == 1 and fails[0] < 2:
+                fails[0] += 1
+                raise RuntimeError("flaky device")
+            n.fit(x, y)
+
+        trainer = FaultTolerantTrainer(net, str(tmp_path / "bo"),
+                                       retry_policy=policy)
+        trainer.fit(fit_fn, num_epochs=3)
+        # exponential: the second restart waited twice the first
+        assert sleeps == [pytest.approx(0.05), pytest.approx(0.1)]
+        assert trainer.restarts == 2
+        assert trainer.max_restarts == 3  # budget surfaced from policy
+
+    def test_explicit_policy_budget_wins(self, tmp_path):
+        from deeplearning4j_tpu.common.faults import RetryPolicy
+
+        net = _net()
+        policy = RetryPolicy(max_restarts=1, base_s=0.001,
+                             sleep=lambda s: None)
+        trainer = FaultTolerantTrainer(net, str(tmp_path / "bp"),
+                                       max_restarts=99,  # overridden
+                                       retry_policy=policy)
+
+        def always_fail(n, epoch):
+            raise RuntimeError("permanent failure")
+
+        with pytest.raises(RuntimeError, match="permanent"):
+            trainer.fit(always_fail, num_epochs=2)
+        assert trainer.restarts == 2  # initial + budget of 1
+
+
 class TestRebuildMesh:
     def test_uses_live_devices(self):
         import jax

@@ -1,0 +1,976 @@
+"""Self-healing serving: fault injection, supervised engine recovery,
+circuit breakers, poison-request quarantine.
+
+The acceptance contract of the resilience PR: deterministic fault
+injection (``common.faults``) drives every recovery path — a poison
+request is quarantined after one isolated retry while its coalesced
+riders succeed (asserted end-to-end through the HTTP server with trace
+ids); a batcher/decode-loop crash restarts the worker under the shared
+backoff policy and loses no queued work; a per-version circuit breaker
+opens on consecutive dispatch failures, fails fast with Retry-After,
+re-closes via a half-open probe, and (env-gated) rolls back to the warm
+parked previous version when persistently open; the dispatch watchdog
+flips /readyz; and the DecodeEngine slot lifecycle never leaks a KV slot
+across injected mid-decode failures or cancelled riders.
+"""
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common import faults
+from deeplearning4j_tpu.common.environment import environment
+from deeplearning4j_tpu.common.metrics import registry as metrics_registry
+from deeplearning4j_tpu.common.tracing import (pop_disposition,
+                                               record_disposition)
+from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.runtime.inference import (EngineClosedError,
+                                                  InferenceEngine,
+                                                  PoisonRequestError)
+from deeplearning4j_tpu.serving import (BreakerOpenError, CircuitBreaker,
+                                        GracefulLifecycle, ModelRegistry,
+                                        ModelServer)
+from deeplearning4j_tpu.serving import resilience
+
+N_IN, N_OUT = 6, 3
+
+
+def _mlp(seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=N_IN, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=N_OUT))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _x(n=4, seed=0):
+    return np.random.RandomState(seed).randn(n, N_IN).astype(np.float32)
+
+
+def _nan_predicate(ctx):
+    """Poison marker: the dispatch's inputs carry a NaN."""
+    return any(np.isnan(np.asarray(i)).any()
+               for i in ctx.get("inputs", ()))
+
+
+def _post(url, data, timeout=30, headers=()):
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json", **dict(headers)})
+    try:
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, r.headers, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, e.read()
+
+
+def _get(url, timeout=10):
+    try:
+        r = urllib.request.urlopen(url, timeout=timeout)
+        return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _wait_for(cond, timeout_s=10.0):
+    """Poll ``cond()`` until truthy: the HTTP response is written before
+    the handler's ring/SLO bookkeeping runs, so post-response asserts on
+    server-side state must tolerate that window."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.02)
+    return cond()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_health():
+    """Every test starts and ends with no armed faults, no unhealthy
+    engines, and no watchdog registrations — resilience state is
+    process-global by design and must never leak between tests."""
+    faults.clear()
+    resilience.health().reset()
+    yield
+    faults.clear()
+    resilience.health().reset()
+    resilience.watchdog().stop()
+
+
+# ---------------------------------------------------------------------------
+# common.faults: the injection registry
+# ---------------------------------------------------------------------------
+
+class TestFaultRegistry:
+    def test_off_by_default_and_zero_rules(self):
+        assert not faults.active()
+        faults.check("engine.dispatch")  # no-op, must not raise
+
+    def test_inject_and_clear(self):
+        rule = faults.inject("x.y", times=1)
+        assert faults.active()
+        with pytest.raises(faults.InjectedFault) as ei:
+            faults.check("x.y")
+        assert ei.value.site == "x.y"
+        faults.check("x.y")  # times budget spent: no longer fires
+        assert rule.triggered == 1
+        faults.clear()
+        assert not faults.active()
+
+    def test_scoped_injection_context_manager(self):
+        with faults.injected("a.b") as rule:
+            with pytest.raises(faults.InjectedFault):
+                faults.check("a.b")
+        assert rule.triggered == 1
+        assert not faults.active()
+        faults.check("a.b")  # disarmed on exit
+
+    def test_rate_is_deterministic_per_seed(self):
+        def run(seed):
+            faults.clear()
+            rule = faults.inject("s", rate=0.3, seed=seed)
+            fired = []
+            for i in range(50):
+                try:
+                    faults.check("s")
+                    fired.append(False)
+                except faults.InjectedFault:
+                    fired.append(True)
+            faults.remove(rule)
+            return fired
+
+        a, b, c = run(7), run(7), run(8)
+        assert a == b            # same seed, same fault sequence
+        assert a != c            # different seed, different sequence
+        assert 5 <= sum(a) <= 25  # ~30% of 50
+
+    def test_predicate_gates_injection(self):
+        faults.inject("p", predicate=lambda ctx: ctx.get("rows") == 3)
+        faults.check("p", rows=2)  # predicate False: no fault
+        with pytest.raises(faults.InjectedFault):
+            faults.check("p", rows=3)
+
+    def test_delay_kind_sleeps_not_raises(self):
+        faults.inject("d", kind="delay", delay_s=0.05, times=1)
+        t0 = time.perf_counter()
+        faults.check("d")
+        assert time.perf_counter() - t0 >= 0.04
+
+    def test_env_spec_parsing(self):
+        n = faults.configure("engine.dispatch:error:0.05:7,"
+                             "decode.step:delay100:1.0:3")
+        assert n == 2
+        specs = {s["site"]: s for s in faults.stats()}
+        assert specs["engine.dispatch"]["rate"] == 0.05
+        assert specs["engine.dispatch"]["seed"] == 7
+        assert specs["decode.step"]["kind"] == "delay"
+
+    def test_env_spec_defaults_and_malformed_entries(self):
+        # rate/seed optional; junk entries skipped, not fatal
+        n = faults.configure("a.site,b.site:error,::junk::,c:bogus:x")
+        assert n == 2
+        sites = {s["site"] for s in faults.stats()}
+        assert sites == {"a.site", "b.site"}
+
+    def test_load_env_via_property_layer(self):
+        env = environment()
+        env.set_property("faults", "q.z:error:1.0:0")
+        try:
+            assert faults.load_env() == 1
+            with pytest.raises(faults.InjectedFault):
+                faults.check("q.z")
+        finally:
+            env.clear_property("faults")
+            faults.clear()
+
+    def test_injected_metric_counted(self):
+        fam = metrics_registry().counter(
+            "dl4j_faults_injected_total", "", labels=("site",))
+        child = fam.labels(site="m.site")
+        before = child.value()
+        faults.inject("m.site", times=2)
+        for _ in range(2):
+            with pytest.raises(faults.InjectedFault):
+                faults.check("m.site")
+        assert child.value() == before + 2
+
+
+class TestBackoffAndRetry:
+    def test_exponential_growth_and_cap(self):
+        b = faults.ExponentialBackoff(base_s=0.1, factor=2.0, max_s=0.5,
+                                      jitter=0.0)
+        assert [round(b.next_delay(), 3) for _ in range(5)] == \
+            [0.1, 0.2, 0.4, 0.5, 0.5]
+        b.reset()
+        assert b.next_delay() == pytest.approx(0.1)
+
+    def test_jitter_deterministic_and_bounded(self):
+        a = faults.ExponentialBackoff(base_s=1.0, jitter=0.5, seed=1)
+        b = faults.ExponentialBackoff(base_s=1.0, jitter=0.5, seed=1)
+        da = [a.next_delay() for _ in range(4)]
+        db = [b.next_delay() for _ in range(4)]
+        assert da == db
+        assert all(0.5 * min(1.0 * 2 ** i, 5.0) <= d <= min(1.0 * 2 ** i, 5.0)
+                   for i, d in enumerate(da))
+
+    def test_retry_policy_budget(self):
+        sleeps = []
+        p = faults.RetryPolicy(max_restarts=2, base_s=0.01,
+                               sleep=sleeps.append)
+        calls = [0]
+
+        def always_fail():
+            calls[0] += 1
+            raise RuntimeError("boom")
+
+        with pytest.raises(faults.RetryBudgetExceeded):
+            faults.retry_call(always_fail, policy=p)
+        assert calls[0] == 3  # initial + 2 retries
+        assert len(sleeps) == 2
+
+    def test_retry_policy_healthy_window_resets_budget(self):
+        now = [0.0]
+        p = faults.RetryPolicy(max_restarts=1, healthy_reset_s=10.0,
+                               clock=lambda: now[0], sleep=lambda s: None)
+        p.note_failure()
+        assert not p.exhausted()
+        now[0] += 60.0  # a healthy minute passes
+        p.note_failure()  # burst counter restarted, not accumulated
+        assert not p.exhausted()
+        p.note_failure()
+        assert p.exhausted()
+
+    def test_retry_call_succeeds_after_transient(self):
+        attempts = [0]
+
+        def flaky():
+            attempts[0] += 1
+            if attempts[0] < 3:
+                raise ValueError("transient")
+            return "done"
+
+        p = faults.RetryPolicy(max_restarts=5, base_s=0.001)
+        assert faults.retry_call(flaky, policy=p) == "done"
+
+
+# ---------------------------------------------------------------------------
+# InferenceEngine: quarantine + supervised batcher
+# ---------------------------------------------------------------------------
+
+class TestPoisonQuarantine:
+    def test_poison_rider_quarantined_innocents_succeed(self):
+        """The tentpole contract: one malformed request inside a
+        coalesced micro-batch fails ONLY itself — the group's failure
+        triggers one isolated re-dispatch per rider, the poison rider is
+        quarantined, its riders get their answers."""
+        eng = InferenceEngine(_mlp(), max_batch=16, max_delay_ms=50.0)
+        eng.warmup(_x())
+        faults.inject("engine.dispatch", predicate=_nan_predicate)
+        poison = _x(2, seed=1)
+        poison[0, 0] = np.nan
+        q = metrics_registry().counter("dl4j_quarantined_requests_total")
+        q_before = q.value()
+        f_poison = eng.submit(poison)
+        f_a = eng.submit(_x(2, seed=2))
+        f_b = eng.submit(_x(2, seed=3))
+        out_a = f_a.result(timeout=30)
+        out_b = f_b.result(timeout=30)
+        assert np.asarray(out_a.jax()).shape == (2, N_OUT)
+        assert np.asarray(out_b.jax()).shape == (2, N_OUT)
+        with pytest.raises(PoisonRequestError, match="quarantined"):
+            f_poison.result(timeout=30)
+        assert q.value() == q_before + 1
+        eng.close()
+
+    def test_innocent_rider_result_matches_solo_run(self):
+        eng = InferenceEngine(_mlp(), max_batch=16, max_delay_ms=50.0)
+        eng.warmup(_x())
+        expected = np.asarray(eng.infer(_x(2, seed=2)).jax())
+        faults.inject("engine.dispatch", predicate=_nan_predicate)
+        poison = _x(2, seed=1)
+        poison[1, 2] = np.nan
+        f_poison = eng.submit(poison)
+        f_ok = eng.submit(_x(2, seed=2))
+        np.testing.assert_allclose(np.asarray(f_ok.result(30).jax()),
+                                   expected, rtol=1e-5)
+        with pytest.raises(PoisonRequestError):
+            f_poison.result(timeout=30)
+        eng.close()
+
+    def test_transient_fault_retried_disposition_recorded(self):
+        # a fault that does NOT follow the request: the isolated retry
+        # succeeds, the rider's answer arrives, disposition = retried
+        eng = InferenceEngine(_mlp(), max_batch=16, max_delay_ms=20.0)
+        eng.warmup(_x())
+        from deeplearning4j_tpu.common.tracing import (new_span_id,
+                                                       new_trace_id,
+                                                       TraceContext,
+                                                       use_context)
+        ctx = TraceContext(new_trace_id(), new_span_id(), None)
+        faults.inject("engine.dispatch", times=1)  # first dispatch only
+        with use_context(ctx):
+            fut = eng.submit(_x(2, seed=4))
+        out = fut.result(timeout=30)
+        assert np.asarray(out.jax()).shape == (2, N_OUT)
+        assert pop_disposition(ctx.trace_id) == "retried"
+        eng.close()
+
+    def test_drain_race_is_not_quarantined(self):
+        # EngineClosedError through a group failure must stay
+        # EngineClosedError (the registry's swap retry depends on it)
+        eng = InferenceEngine(_mlp(), max_batch=8)
+        eng.drain()
+        with pytest.raises(EngineClosedError):
+            eng.submit(_x())
+
+
+class TestSupervisedBatcher:
+    def test_batcher_crash_restarts_and_serves(self):
+        eng = InferenceEngine(_mlp(), max_batch=8, max_delay_ms=1.0)
+        eng.warmup(_x())
+        fam = metrics_registry().counter(
+            "dl4j_engine_restarts_total", "", labels=("engine",))
+        child = fam.labels(engine="inference")
+        before = child.value()
+        with faults.injected("engine.batcher", times=2):
+            outs = [eng.submit(_x(2, seed=i)).result(timeout=30)
+                    for i in range(3)]
+        assert all(np.asarray(o.jax()).shape == (2, N_OUT) for o in outs)
+        assert child.value() >= before + 1
+        assert not eng.worker_dead
+        eng.close()
+
+    def test_queued_requests_survive_crash(self):
+        # the crash site sits before the queue pop: nothing is lost
+        eng = InferenceEngine(_mlp(), max_batch=8, max_delay_ms=5.0)
+        eng.warmup(_x())
+        with faults.injected("engine.batcher", times=1):
+            futs = [eng.submit(_x(2, seed=i)) for i in range(4)]
+            assert all(f.result(timeout=30) is not None for f in futs)
+        eng.close()
+
+    def test_restart_budget_exhaustion_kills_worker_not_process(self):
+        env = environment()
+        env.set_property("engine_max_restarts", 1)
+        try:
+            eng = InferenceEngine(_mlp(), max_batch=8)
+            eng.warmup(_x())
+            with faults.injected("engine.batcher"):  # rate 1.0, forever
+                fut = eng.submit(_x())
+                with pytest.raises(EngineClosedError,
+                                   match="restart budget"):
+                    fut.result(timeout=30)
+            assert eng.worker_dead
+            with pytest.raises(EngineClosedError, match="dead"):
+                eng.submit(_x())
+        finally:
+            env.clear_property("engine_max_restarts")
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_consecutive_failures(self):
+        br = CircuitBreaker("m", "v1", threshold=3, probe_s=60.0)
+        for _ in range(2):
+            assert not br.record_failure()
+        assert br.state == "closed"
+        assert br.record_failure()  # third opens
+        assert br.state == "open"
+        with pytest.raises(BreakerOpenError) as ei:
+            br.preflight()
+        assert ei.value.retry_after_s <= 60.0
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker("m", "v1", threshold=2, probe_s=60.0)
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == "closed"  # never 2 in a row
+
+    def test_half_open_probe_recloses(self):
+        now = [0.0]
+        br = CircuitBreaker("m", "v1", threshold=1, probe_s=1.0,
+                            clock=lambda: now[0])
+        br.record_failure()
+        assert br.state == "open"
+        now[0] = 1.5  # probe window elapsed
+        br.preflight()  # this caller is the probe: no raise
+        br.record_success()
+        assert br.state == "closed"
+        assert br.consecutive_opens == 0
+
+    def test_probe_failure_reopens_and_counts(self):
+        now = [0.0]
+        br = CircuitBreaker("m", "v1", threshold=1, probe_s=1.0,
+                            clock=lambda: now[0])
+        br.record_failure()
+        now[0] = 1.5
+        br.preflight()
+        br.record_failure()  # probe failed
+        assert br.state == "open"
+        assert br.consecutive_opens == 2
+
+    def test_concurrent_callers_fail_fast_during_probe(self):
+        now = [0.0]
+        br = CircuitBreaker("m", "v1", threshold=1, probe_s=1.0,
+                            clock=lambda: now[0])
+        br.record_failure()
+        now[0] = 1.5
+        br.preflight()  # probe in flight
+        with pytest.raises(BreakerOpenError):
+            br.preflight()  # second caller does not double-probe
+
+    def test_state_gauge_exported(self):
+        br = CircuitBreaker("gauge-model", "v9", threshold=1, probe_s=60.0)
+        fam = metrics_registry().get("dl4j_breaker_state")
+        assert fam is not None
+        br.record_failure()
+        series = dict(fam.children())
+        assert series[("gauge-model", "v9")].value() == 2  # OPEN
+
+
+class TestRegistryBreaker:
+    def test_breaker_opens_and_fails_fast_then_recloses(self):
+        reg = ModelRegistry(manifest_dir=None, breaker_threshold=3,
+                            breaker_probe_s=0.1)
+        reg.deploy("m", "v1", _mlp(), example=_x())
+        faults.inject("engine.dispatch")  # every dispatch fails
+        seen_open = False
+        for _ in range(12):
+            try:
+                reg.predict("m", _x())
+            except PoisonRequestError:
+                continue
+            except BreakerOpenError:
+                seen_open = True
+                break
+        assert seen_open
+        faults.clear()
+        deadline = time.monotonic() + 5.0
+        ok = False
+        while time.monotonic() < deadline:
+            try:
+                reg.predict("m", _x())
+                ok = True
+                break
+            except BreakerOpenError:
+                time.sleep(0.02)
+        assert ok, "breaker never re-closed after faults stopped"
+        assert reg.breaker_for("m", "v1").state == "closed"
+        reg.drain_all(save_manifests=False)
+
+    def test_deadline_and_closed_do_not_trip_breaker(self):
+        reg = ModelRegistry(manifest_dir=None, breaker_threshold=1,
+                            breaker_probe_s=60.0)
+        reg.deploy("m", "v1", _mlp(), example=_x())
+        # deadline expiry: TimeoutError is load, not a dispatch fault
+        with pytest.raises(TimeoutError):
+            reg.predict("m", _x(), timeout_s=0.0)
+        assert reg.breaker_for("m", "v1").state == "closed"
+        reg.drain_all(save_manifests=False)
+
+    def test_auto_rollback_to_parked_version(self):
+        env = environment()
+        env.set_auto_rollback(True)
+        env.set_property("auto_rollback_opens", 2)
+        try:
+            reg = ModelRegistry(manifest_dir=None, breaker_threshold=2,
+                                breaker_probe_s=0.05)
+            reg.deploy("m", "v1", _mlp(0), example=_x())
+            reg.deploy("m", "v2", _mlp(1), example=_x())
+            assert reg.get("m").version == "v2"
+            faults.inject("engine.dispatch")
+            deadline = time.monotonic() + 10.0
+            while (reg.get("m").version == "v2"
+                   and time.monotonic() < deadline):
+                try:
+                    reg.predict("m", _x())
+                except (PoisonRequestError, BreakerOpenError):
+                    time.sleep(0.02)
+            faults.clear()
+            assert reg.get("m").version == "v1"  # rolled back
+            out = reg.predict("m", _x())  # v1 serves (warm, re-admitted)
+            np.testing.assert_allclose(
+                np.asarray(out.jax()),
+                np.asarray(_mlp(0).output(_x()).jax()), rtol=1e-5)
+            fam = metrics_registry().get("dl4j_auto_rollbacks_total")
+            assert dict(fam.children())[("m",)].value() >= 1
+            reg.drain_all(save_manifests=False)
+        finally:
+            env.clear_property("auto_rollback")
+            env.clear_property("auto_rollback_opens")
+
+    def test_no_auto_rollback_when_env_off(self):
+        reg = ModelRegistry(manifest_dir=None, breaker_threshold=2,
+                            breaker_probe_s=0.05)
+        reg.deploy("m", "v1", _mlp(0), example=_x())
+        reg.deploy("m", "v2", _mlp(1), example=_x())
+        faults.inject("engine.dispatch")
+        for _ in range(12):
+            try:
+                reg.predict("m", _x())
+            except (PoisonRequestError, BreakerOpenError):
+                time.sleep(0.02)
+        faults.clear()
+        assert reg.get("m").version == "v2"  # stayed put (default off)
+        reg.drain_all(save_manifests=False)
+
+
+# ---------------------------------------------------------------------------
+# DecodeEngine: dispatch-scoped failure + slot lifecycle
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def decode_engine():
+    from deeplearning4j_tpu.models import causal_lm
+    from deeplearning4j_tpu.runtime.generation import DecodeEngine
+
+    cfg = causal_lm.CausalLMConfig(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+        intermediate_size=64, max_position_embeddings=128,
+        dtype=jnp.float32)
+    eng = DecodeEngine(causal_lm.CausalLM(cfg, seed=0), slots=2,
+                       max_ctx=128, prompt_buckets=[8])
+    eng.warmup()
+    yield eng
+    faults.clear()
+    eng.close(10.0)
+
+
+class TestDecodeResilience:
+    def test_mid_decode_fault_frees_slot_and_spares_pending(self,
+                                                            decode_engine):
+        """The slot-lifecycle regression: an injected mid-decode failure
+        fails the riding sequences but ALWAYS frees their KV slots, and
+        queued requests survive to be served next iteration."""
+        eng = decode_engine
+        leaks = metrics_registry().counter("dl4j_decode_slot_leaks_total")
+        leaks_before = leaks.value()
+        with faults.injected("decode.step", times=1):
+            fut = eng.generate([1, 2, 3], max_tokens=8, eos_token=None)
+            with pytest.raises(faults.InjectedFault):
+                fut.result(timeout=30)
+        deadline = time.monotonic() + 10
+        while eng.stats()["active_slots"] and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert eng.stats()["active_slots"] == 0  # slot freed
+        assert leaks.value() == leaks_before     # freed properly, no repair
+        r = eng.generate([4, 5], max_tokens=3, eos_token=None).result(30)
+        assert len(r["tokens"]) == 3             # engine still serves
+        assert not eng.worker_dead
+
+    def test_prefill_fault_fails_only_that_request(self, decode_engine):
+        eng = decode_engine
+        with faults.injected("decode.prefill", times=1):
+            bad = eng.generate([1, 2], max_tokens=2, eos_token=None)
+            with pytest.raises(faults.InjectedFault):
+                bad.result(timeout=30)
+        ok = eng.generate([3, 4], max_tokens=2, eos_token=None).result(30)
+        assert len(ok["tokens"]) == 2
+
+    def test_cancelled_rider_releases_slot(self, decode_engine):
+        eng = decode_engine
+        # occupy a slot with a long generation, then cancel its future
+        fut = eng.generate([1, 2, 3], max_tokens=120, eos_token=None)
+        deadline = time.monotonic() + 10
+        while not eng.stats()["active_slots"] \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        fut.cancel()
+        deadline = time.monotonic() + 10
+        while eng.stats()["active_slots"] and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert eng.stats()["active_slots"] == 0
+        cancelled = metrics_registry().counter(
+            "dl4j_decode_cancelled_total")
+        assert cancelled.value() >= 1
+
+    def test_loop_crash_supervised_restart(self, decode_engine):
+        eng = decode_engine
+        fam = metrics_registry().counter(
+            "dl4j_engine_restarts_total", "", labels=("engine",))
+        child = fam.labels(engine="decode")
+        before = child.value()
+        with faults.injected("decode.loop", times=1):
+            # enough tokens that the crash fires mid-generation (the
+            # site sits at the top of each scheduler iteration)
+            r = eng.generate([9, 8], max_tokens=6,
+                             eos_token=None).result(timeout=30)
+        assert len(r["tokens"]) == 6  # generation survived the crash
+        deadline = time.monotonic() + 10
+        while child.value() < before + 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert child.value() >= before + 1
+        assert not eng.worker_dead
+
+
+# ---------------------------------------------------------------------------
+# compile-cache fault sites: recovery, never a request failure
+# ---------------------------------------------------------------------------
+
+class TestCacheFaultRecovery:
+    def test_injected_load_fault_recompiles(self):
+        from deeplearning4j_tpu.runtime import compile_cache
+        cc = compile_cache.cache()
+        if cc is None:
+            pytest.skip("compile cache disabled")
+        cc.put("resil-test-key", b"payload", {"tag_kind": "t"})
+        assert cc.get("resil-test-key") is not None
+        cc.put("resil-test-key", b"payload", {"tag_kind": "t"})
+        with faults.injected("cache.load", times=1):
+            assert cc.get("resil-test-key") is None  # dropped + miss
+        # the recovery path deleted the entry; a fresh put works
+        assert cc.put("resil-test-key", b"payload", {"tag_kind": "t"})
+
+    def test_injected_deserialize_fault_falls_back_to_recompile(self):
+        # end-to-end: a warmed engine whose store read is poisoned still
+        # serves (live recompile), never surfaces the fault
+        eng = InferenceEngine(_mlp(7), max_batch=4)
+        with faults.injected("cache.deserialize"):
+            out = eng.infer(_x(3, seed=9))
+        assert np.asarray(out.jax()).shape == (3, N_OUT)
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# watchdog + health + /readyz
+# ---------------------------------------------------------------------------
+
+class TestWatchdogHealth:
+    def test_overdue_dispatch_flips_health_and_recovers(self):
+        eng = InferenceEngine(_mlp(), max_batch=4)
+        wd = resilience.watchdog()
+        wd.register("m:v1", eng, budget_s=0.5)
+        try:
+            eng._dispatch_started_at = time.monotonic() - 10.0
+            wd.check_now()
+            assert not resilience.health().healthy()
+            assert "m:v1" in resilience.health().snapshot()
+            eng._dispatch_started_at = None
+            wd.check_now()
+            assert resilience.health().healthy()
+        finally:
+            wd.unregister("m:v1")
+            eng.close()
+
+    def test_dead_worker_flips_health(self):
+        eng = InferenceEngine(_mlp(), max_batch=4)
+        wd = resilience.watchdog()
+        wd.register("m:v2", eng, budget_s=30.0)
+        try:
+            eng._worker_dead = True
+            wd.check_now()
+            assert not resilience.health().healthy()
+        finally:
+            wd.unregister("m:v2")
+
+    def test_registry_registers_current_version_with_watchdog(self):
+        reg = ModelRegistry(manifest_dir=None)
+        reg.deploy("wm", "v1", _mlp(), example=_x())
+        assert "wm:v1" in resilience.watchdog().watched()
+        reg.deploy("wm", "v2", _mlp(1), example=_x())
+        watched = resilience.watchdog().watched()
+        assert "wm:v2" in watched and "wm:v1" not in watched
+        reg.drain_all(save_manifests=False)
+        assert "wm:v2" not in resilience.watchdog().watched()
+
+    def test_unhealthy_engine_flips_readyz(self):
+        reg = ModelRegistry(manifest_dir=None)
+        reg.deploy("m", "v1", _mlp(), example=_x())
+        server = ModelServer(reg)
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            code, body = _get(base + "/readyz")
+            assert code == 200
+            resilience.health().set_unhealthy("m:v1", "stuck dispatch")
+            code, body = _get(base + "/readyz")
+            assert code == 503
+            doc = json.loads(body)
+            assert doc["engines_healthy"] is False
+            assert "m:v1" in doc["engine_health"]
+            resilience.health().clear("m:v1")
+            code, _ = _get(base + "/readyz")
+            assert code == 200
+        finally:
+            server.stop()
+            reg.drain_all(save_manifests=False)
+
+
+# ---------------------------------------------------------------------------
+# HTTP end-to-end: quarantine with trace ids, breaker 503, dispositions
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def served():
+    reg = ModelRegistry(manifest_dir=None, breaker_threshold=4,
+                        breaker_probe_s=0.1)
+    reg.deploy("mlp", "v1", _mlp(0), example=_x())
+    server = ModelServer(reg)
+    port = server.start()
+    yield reg, server, f"http://127.0.0.1:{port}"
+    faults.clear()
+    server.stop()
+    reg.drain_all(save_manifests=False)
+
+
+class TestHTTPQuarantine:
+    def test_poison_request_422_riders_succeed_with_trace_ids(self,
+                                                              served):
+        """The acceptance bar, end-to-end through the HTTP server: a
+        poison request (raises inside dispatch) is quarantined after one
+        isolated retry — 422 + trace id — and its coalesced riders all
+        answer 200."""
+        reg, server, base = served
+        # widen the coalesce window so concurrent posts ride together
+        reg.get("mlp").engine.max_delay_ms = 50.0
+        faults.inject("engine.dispatch", predicate=_nan_predicate)
+        poison = _x(2, seed=1).tolist()
+        poison[0][0] = float("nan")
+        results = {}
+        lock = threading.Lock()
+
+        def post(name, payload):
+            code, headers, body = _post(
+                base + "/v1/models/mlp/predict",
+                json.dumps({"inputs": payload}).encode())
+            with lock:
+                results[name] = (code, headers.get("X-Trace-Id"), body)
+
+        threads = [threading.Thread(target=post, args=("poison", poison))]
+        threads += [threading.Thread(
+            target=post, args=(f"ok{i}", _x(2, seed=2 + i).tolist()))
+            for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        code, trace_id, body = results["poison"]
+        assert code == 422
+        doc = json.loads(body)
+        assert doc["quarantined"] is True
+        assert doc["trace_id"] == trace_id and trace_id
+        for i in range(2):
+            code_i, trace_i, _ = results[f"ok{i}"]
+            assert code_i == 200, results[f"ok{i}"]
+            assert trace_i and trace_i != trace_id
+        # the ring records the disposition by trace id
+        rec = _wait_for(lambda: server.request_ring.find(trace_id))
+        assert rec is not None
+        assert rec["disposition"] == "quarantined"
+        assert rec["outcome"] == "quarantined"
+
+    def test_quarantine_excluded_from_slo(self, served):
+        reg, server, base = served
+        faults.inject("engine.dispatch", predicate=_nan_predicate)
+        poison = _x(1, seed=1).tolist()
+        poison[0][0] = float("nan")
+        code, _, _ = _post(base + "/v1/models/mlp/predict",
+                           json.dumps({"inputs": poison}).encode())
+        assert code == 422
+        fam = metrics_registry().get("dl4j_slo_excluded_total")
+        assert _wait_for(lambda: dict(fam.children())
+                         .get(("mlp", "quarantined"))) is not None
+        assert dict(fam.children())[("mlp", "quarantined")].value() >= 1
+        # no SLO-eligible sample was recorded for the quarantine
+        snap = server.slo_for("mlp").snapshot()
+        assert all(w["total"] == 0 for w in snap["windows"])
+
+    def test_breaker_open_503_with_retry_after(self, served):
+        reg, server, base = served
+        faults.inject("engine.dispatch")
+        payload = json.dumps({"inputs": _x().tolist()}).encode()
+        code = None
+        for _ in range(12):
+            code, headers, body = _post(
+                base + "/v1/models/mlp/predict", payload)
+            if code == 503:
+                break
+        assert code == 503
+        assert int(headers["Retry-After"]) >= 1
+        doc = json.loads(body)
+        assert "breaker" in doc["error"]
+        trace_id = headers.get("X-Trace-Id")
+        rec = _wait_for(lambda: server.request_ring.find(trace_id))
+        assert rec["disposition"] == "breaker_open"
+        faults.clear()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            code, _, _ = _post(base + "/v1/models/mlp/predict", payload)
+            if code == 200:
+                break
+            time.sleep(0.05)
+        assert code == 200  # breaker re-closed over HTTP too
+
+    def test_handler_fault_maps_to_500_and_burns_slo(self, served):
+        reg, server, base = served
+        with faults.injected("http.handler", times=1):
+            code, _, _ = _post(base + "/v1/models/mlp/predict",
+                               json.dumps({"inputs": _x().tolist()})
+                               .encode())
+        assert code == 500
+        assert _wait_for(lambda: server.slo_for("mlp").snapshot()
+                         ["windows"][0]["total"] >= 1)  # burns the SLO
+
+    def test_retried_disposition_visible_in_debug_requests(self, served):
+        reg, server, base = served
+        reg.get("mlp").engine.max_delay_ms = 1.0
+        faults.inject("engine.dispatch", times=1)  # transient
+        code, headers, _ = _post(base + "/v1/models/mlp/predict",
+                                 json.dumps({"inputs": _x().tolist()})
+                                 .encode())
+        assert code == 200
+        trace_id = headers.get("X-Trace-Id")
+        _wait_for(lambda: server.request_ring.find(trace_id))
+        code, body = _get(base + f"/debug/requests?trace_id={trace_id}")
+        assert code == 200
+        reqs = json.loads(body)["requests"]
+        assert reqs and reqs[0]["disposition"] == "retried"
+
+    def test_debug_resilience_endpoint(self, served):
+        reg, server, base = served
+        reg.predict("mlp", _x())
+        code, body = _get(base + "/debug/resilience")
+        assert code == 200
+        doc = json.loads(body)
+        assert "mlp:v1" in doc["breakers"]
+        assert doc["breakers"]["mlp:v1"]["state"] == "closed"
+        assert "engine_health" in doc and "watchdog" in doc
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: SIGTERM drain racing a hot swap under injected faults
+# ---------------------------------------------------------------------------
+
+def _chaos_run(tmp_path, n_clients, per_client, fault_rate):
+    prev_flight = os.environ.get("DL4J_TPU_FLIGHT_RECORDER_DIR")
+    os.environ["DL4J_TPU_FLIGHT_RECORDER_DIR"] = str(tmp_path / "flight")
+    reg = ModelRegistry(manifest_dir=str(tmp_path / "manifests"),
+                        breaker_threshold=50, breaker_probe_s=0.1)
+    reg.deploy("m", "v1", _mlp(0), example=_x())
+    server = ModelServer(reg)
+    port = server.start()
+    base = f"http://127.0.0.1:{port}"
+    lc = GracefulLifecycle(reg, server, drain_timeout_s=15)
+    lc.install()
+    statuses = []
+    lock = threading.Lock()
+    stop = threading.Event()
+    payload = json.dumps({"inputs": _x(2).tolist()}).encode()
+
+    def client():
+        for _ in range(per_client):
+            if stop.is_set():
+                return
+            try:
+                code, _, _ = _post(base + "/v1/models/m/predict", payload,
+                                   timeout=20)
+            except Exception as e:  # socket closed post-drain: fine
+                code = f"conn:{type(e).__name__}"
+            with lock:
+                statuses.append(code)
+
+    faults.inject("engine.dispatch", rate=fault_rate, seed=5)
+    threads = [threading.Thread(target=client) for _ in range(n_clients)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.15)
+        # hot swap mid-storm; warmup dispatches draw faults too, so the
+        # deploy itself may fail and is retried (operator behavior)
+        for _ in range(10):
+            try:
+                reg.deploy("m", "v2", _mlp(1))
+                break
+            except faults.InjectedFault:
+                continue
+        time.sleep(0.15)
+        signal.raise_signal(signal.SIGTERM)  # drain races the traffic
+        assert lc.wait_drained(30)
+        stop.set()
+        for t in threads:
+            t.join(30)
+    finally:
+        faults.clear()
+        lc.uninstall()
+        if prev_flight is None:
+            os.environ.pop("DL4J_TPU_FLIGHT_RECORDER_DIR", None)
+        else:
+            os.environ["DL4J_TPU_FLIGHT_RECORDER_DIR"] = prev_flight
+    flights = sorted((tmp_path / "flight").glob("flight-*.json"))
+    return statuses, flights
+
+
+class TestChaosE2E:
+    def test_sigterm_drain_races_hot_swap_under_faults(self, tmp_path):
+        """Satellite: SIGTERM graceful drain racing a concurrent
+        ``deploy()`` hot swap under injected faults must end with zero
+        in-flight requests failed BY THE SWAP (allowed outcomes: 200,
+        shed/draining 503/429, deadline 504, quarantined 422, routing
+        409, connection refused after the socket closed) and a clean
+        flight-recorder dump."""
+        statuses, flights = _chaos_run(tmp_path, n_clients=4,
+                                       per_client=20, fault_rate=0.05)
+        assert statuses, "no client traffic recorded"
+        allowed = {200, 409, 422, 429, 503, 504}
+        swap_failures = [s for s in statuses
+                         if not (s in allowed
+                                 or isinstance(s, str))]  # conn errors ok
+        assert swap_failures == [], f"requests failed by the swap: " \
+                                    f"{swap_failures}"
+        assert statuses.count(200) > 0  # traffic actually flowed
+        # clean flight recorder: parseable, carries ring + resilience
+        assert flights, "no flight recorder dump written"
+        doc = json.load(open(flights[-1]))
+        assert doc["requests"], "flight recorder lost the request ring"
+        assert "disposition" in doc["requests"][-1]
+        assert "breakers" in doc and "engine_health" in doc
+        assert isinstance(doc["faults"], list)
+
+    @pytest.mark.slow
+    def test_chaos_loop_heavy(self, tmp_path):
+        """The heavier chaos loop (tier-2): more clients, more rounds,
+        higher fault rate."""
+        for round_ in range(3):
+            statuses, flights = _chaos_run(
+                tmp_path / f"r{round_}", n_clients=8, per_client=60,
+                fault_rate=0.1)
+            allowed = {200, 409, 422, 429, 503, 504}
+            assert all(s in allowed or isinstance(s, str)
+                       for s in statuses)
+            assert flights
+
+
+# ---------------------------------------------------------------------------
+# disposition plumbing
+# ---------------------------------------------------------------------------
+
+class TestDispositions:
+    def test_record_and_pop(self):
+        record_disposition("t-1", "retried")
+        assert pop_disposition("t-1") == "retried"
+        assert pop_disposition("t-1") is None
+        assert pop_disposition(None) is None
+        record_disposition(None, "x")  # no-op, no explosion
+
+    def test_bounded(self):
+        from deeplearning4j_tpu.common import tracing
+        for i in range(tracing._DISPOSITIONS_CAP + 10):
+            record_disposition(f"cap-{i}", "retried")
+        assert len(tracing._DISPOSITIONS) <= tracing._DISPOSITIONS_CAP
+        assert pop_disposition("cap-0") is None  # oldest evicted
+        tracing._DISPOSITIONS.clear()
