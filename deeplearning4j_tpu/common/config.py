@@ -7,45 +7,40 @@ singleton reads env vars once; runtime-mutable knobs are plain attributes.
 """
 from __future__ import annotations
 
-import os
 import threading
 
 
-def _env_bool(name: str, default: bool = False) -> bool:
-    v = os.environ.get(name)
-    if v is None:
-        return default
-    return v.lower() in ("1", "true", "yes", "on")
-
-
-def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name)
-    return int(v) if v is not None else default
-
-
 class Environment:
-    """Process-wide knobs. `Nd4j.getEnvironment()` analog."""
+    """Process-wide knobs. `Nd4j.getEnvironment()` analog.
+
+    Attribute values are *snapshots* resolved once through the layered
+    property system (common/environment.py: programmatic override > env
+    var > default — DL102) and stay runtime-mutable as plain attributes,
+    exactly as before the knobs moved onto the registry."""
 
     _instance = None
     _lock = threading.Lock()
 
     def __init__(self):
+        from .environment import Environment as _Layered
+        lay = _Layered.get()
         # Reference: DEBUG/VERBOSE in sd::Environment
-        self.debug = _env_bool("DL4J_TPU_DEBUG")
-        self.verbose = _env_bool("DL4J_TPU_VERBOSE")
+        self.debug = lay.is_debug()
+        self.verbose = lay.is_verbose()
         # Reference: ND4J_DTYPE default dtype property
-        self.default_float_dtype = os.environ.get("DL4J_TPU_DTYPE", "float32")
+        # (DL4J_TPU_DEFAULT_DTYPE, legacy DL4J_TPU_DTYPE honored)
+        self.default_float_dtype = lay.default_float_dtype()
         # MXU-native compute dtype for matmul/conv accumulation inputs.
-        self.matmul_precision = os.environ.get("DL4J_TPU_MATMUL_PRECISION", "default")
+        self.matmul_precision = lay.matmul_precision()
         # NAN/INF panic modes (reference OpExecutioner.ProfilingMode)
-        self.nan_panic = _env_bool("DL4J_TPU_NAN_PANIC")
-        self.inf_panic = _env_bool("DL4J_TPU_INF_PANIC")
+        self.nan_panic = lay.nan_panic()
+        self.inf_panic = lay.inf_panic()
         # Profiling
-        self.profiling = _env_bool("DL4J_TPU_PROFILING")
+        self.profiling = lay.profiling_enabled()
         # Max host threads for the ETL/data pipeline (native Threads analog)
-        self.max_threads = _env_int("DL4J_TPU_MAX_THREADS", os.cpu_count() or 1)
+        self.max_threads = lay.max_threads()
         # Eager-op jit cache toggle
-        self.eager_jit = _env_bool("DL4J_TPU_EAGER_JIT", True)
+        self.eager_jit = lay.eager_jit()
 
     @classmethod
     def get(cls) -> "Environment":

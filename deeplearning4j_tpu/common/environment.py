@@ -29,7 +29,20 @@ class EnvironmentVars:
     DL4J_TPU_MAX_THREADS = "DL4J_TPU_MAX_THREADS"
     DL4J_TPU_PLATFORM = "JAX_PLATFORMS"
     DL4J_TPU_DEFAULT_DTYPE = "DL4J_TPU_DEFAULT_DTYPE"
+    #: legacy spelling of DEFAULT_DTYPE, still honored (second in line)
+    DL4J_TPU_DTYPE = "DL4J_TPU_DTYPE"
     DL4J_TPU_MATMUL_PRECISION = "DL4J_TPU_MATMUL_PRECISION"
+    DL4J_TPU_NAN_PANIC = "DL4J_TPU_NAN_PANIC"
+    DL4J_TPU_INF_PANIC = "DL4J_TPU_INF_PANIC"
+    DL4J_TPU_PROFILING = "DL4J_TPU_PROFILING"
+    DL4J_TPU_EAGER_JIT = "DL4J_TPU_EAGER_JIT"
+    DL4J_TPU_HOME = "DL4J_TPU_HOME"
+    #: dataset download root (datasets/fetchers.py) and the native-lib
+    #: build cache (native/__init__.py) — declared here for the DL102
+    #: knob registry; both are resolved by their owning modules
+    DL4J_TPU_DATA = "DL4J_TPU_DATA"
+    DL4J_TPU_NATIVE_CACHE = "DL4J_TPU_NATIVE_CACHE"
+    DL4J_TPU_LOCK_CHECK = "DL4J_TPU_LOCK_CHECK"
     DL4J_TPU_CACHE_DIR = "DL4J_TPU_CACHE_DIR"
     DL4J_TPU_CACHE_MAX_BYTES = "DL4J_TPU_CACHE_MAX_BYTES"
     DL4J_TPU_XLA_CACHE = "DL4J_TPU_XLA_CACHE"
@@ -77,6 +90,12 @@ class SystemProperties:
     VERBOSE = "verbose"
     MAX_THREADS = "max_threads"
     MATMUL_PRECISION = "matmul_precision"
+    NAN_PANIC = "nan_panic"
+    INF_PANIC = "inf_panic"
+    PROFILING = "profiling"
+    EAGER_JIT = "eager_jit"
+    HOME = "home"
+    LOCK_CHECK = "lock_check"
     RESOURCES_DIR = "resources_dir"
     LOG_INITIALIZATION = "log_initialization"
     CACHE_DIR = "cache_dir"
@@ -119,12 +138,20 @@ class SystemProperties:
 
 
 _ENV_FOR_PROP = {
-    SystemProperties.DTYPE: EnvironmentVars.DL4J_TPU_DEFAULT_DTYPE,
+    # a tuple means "first name set wins" (legacy spellings trail)
+    SystemProperties.DTYPE: (EnvironmentVars.DL4J_TPU_DEFAULT_DTYPE,
+                             EnvironmentVars.DL4J_TPU_DTYPE),
     SystemProperties.DEBUG: EnvironmentVars.DL4J_TPU_DEBUG,
     SystemProperties.VERBOSE: EnvironmentVars.DL4J_TPU_VERBOSE,
     SystemProperties.MAX_THREADS: EnvironmentVars.DL4J_TPU_MAX_THREADS,
     SystemProperties.MATMUL_PRECISION:
         EnvironmentVars.DL4J_TPU_MATMUL_PRECISION,
+    SystemProperties.NAN_PANIC: EnvironmentVars.DL4J_TPU_NAN_PANIC,
+    SystemProperties.INF_PANIC: EnvironmentVars.DL4J_TPU_INF_PANIC,
+    SystemProperties.PROFILING: EnvironmentVars.DL4J_TPU_PROFILING,
+    SystemProperties.EAGER_JIT: EnvironmentVars.DL4J_TPU_EAGER_JIT,
+    SystemProperties.HOME: EnvironmentVars.DL4J_TPU_HOME,
+    SystemProperties.LOCK_CHECK: EnvironmentVars.DL4J_TPU_LOCK_CHECK,
     SystemProperties.RESOURCES_DIR: EnvironmentVars.ND4J_RESOURCES_DIR,
     SystemProperties.CACHE_DIR: EnvironmentVars.DL4J_TPU_CACHE_DIR,
     SystemProperties.CACHE_MAX_BYTES:
@@ -188,6 +215,12 @@ _DEFAULTS = {
     SystemProperties.DEBUG: "0",
     SystemProperties.VERBOSE: "0",
     SystemProperties.MATMUL_PRECISION: "default",
+    SystemProperties.NAN_PANIC: "0",
+    SystemProperties.INF_PANIC: "0",
+    SystemProperties.PROFILING: "0",
+    SystemProperties.EAGER_JIT: "1",
+    SystemProperties.HOME: "~/.deeplearning4j_tpu",
+    SystemProperties.LOCK_CHECK: "0",
     SystemProperties.LOG_INITIALIZATION: "1",
     SystemProperties.CACHE_DIR: "~/.cache/deeplearning4j_tpu",
     SystemProperties.CACHE_MAX_BYTES: str(2 << 30),  # 2 GiB
@@ -257,9 +290,12 @@ class Environment:
     def property(self, key: str, default: Optional[str] = None) -> Optional[str]:
         if key in self._overrides:
             return self._overrides[key]
-        env_name = _ENV_FOR_PROP.get(key)
-        if env_name and env_name in os.environ:
-            return os.environ[env_name]
+        env_names = _ENV_FOR_PROP.get(key) or ()
+        if isinstance(env_names, str):
+            env_names = (env_names,)
+        for env_name in env_names:
+            if env_name in os.environ:
+                return os.environ[env_name]
         return _DEFAULTS.get(key, default)
 
     def set_property(self, key: str, value: Any):
@@ -306,6 +342,37 @@ class Environment:
 
     def matmul_precision(self) -> str:
         return self.property(SystemProperties.MATMUL_PRECISION)
+
+    def _flag(self, key: str) -> bool:
+        return self.property(key) not in ("0", "false", "", None)
+
+    def nan_panic(self) -> bool:
+        """Halt on NaN outputs (reference OpExecutioner.ProfilingMode)."""
+        return self._flag(SystemProperties.NAN_PANIC)
+
+    def inf_panic(self) -> bool:
+        return self._flag(SystemProperties.INF_PANIC)
+
+    def profiling_enabled(self) -> bool:
+        """Op-level profiling collection (DL4J_TPU_PROFILING)."""
+        return self._flag(SystemProperties.PROFILING)
+
+    def eager_jit(self) -> bool:
+        """Per-op jit cache for the eager executioner
+        (DL4J_TPU_EAGER_JIT, on by default)."""
+        return self._flag(SystemProperties.EAGER_JIT)
+
+    def home_dir(self) -> str:
+        """Root of user-local artifacts — pretrained model cache etc.
+        (``DL4J_TPU_HOME``, default ``~/.deeplearning4j_tpu``)."""
+        return os.path.expanduser(
+            self.property(SystemProperties.HOME) or "~/.deeplearning4j_tpu")
+
+    def lock_check(self) -> bool:
+        """Whether the ``common.locks`` runtime lock-order tracker is
+        armed (``DL4J_TPU_LOCK_CHECK``; the tracker itself caches this
+        at import — flip at runtime via ``locks.set_lock_check``)."""
+        return self._flag(SystemProperties.LOCK_CHECK)
 
     # -- AOT compile cache (runtime/compile_cache.py) ----------------------
     def cache_dir(self) -> Optional[str]:

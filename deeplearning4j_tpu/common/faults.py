@@ -53,6 +53,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from .locks import ordered_lock
+
 log = logging.getLogger(__name__)
 
 
@@ -86,7 +88,7 @@ class _FaultRule:
         self._rng = random.Random(self.seed)
         self.triggered = 0
         self.checked = 0
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("faults.registry")
 
     def fire(self, ctx: Dict[str, Any]) -> Optional[str]:
         """Evaluate the rule; returns the kind to apply or None. The
@@ -116,7 +118,7 @@ class _FaultRule:
 #: site -> armed rules. `_active` mirrors bool(_RULES) so hot paths pay
 #: one module-global read when injection is off (the common case).
 _RULES: Dict[str, List[_FaultRule]] = {}
-_RULES_LOCK = threading.Lock()
+_RULES_LOCK = ordered_lock("faults.rules")
 _active = False
 
 

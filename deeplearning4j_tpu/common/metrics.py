@@ -31,6 +31,8 @@ aggregate metrics back to individual traces.
 from __future__ import annotations
 
 import math
+
+from .locks import ordered_lock
 import os
 import threading
 import time
@@ -457,7 +459,7 @@ def touch_runtime_info(reg: Optional[MetricsRegistry] = None):
 
 
 _REGISTRY: Optional[MetricsRegistry] = None
-_REGISTRY_LOCK = threading.Lock()
+_REGISTRY_LOCK = ordered_lock("metrics.singleton")
 
 
 def registry() -> MetricsRegistry:

@@ -56,6 +56,7 @@ from collections import OrderedDict, deque
 from contextlib import contextmanager
 from typing import Dict, List, NamedTuple, Optional
 
+from .locks import ordered_lock
 from .metrics import registry
 
 # device-profile-active probe; resolved lazily so importing tracing never
@@ -243,7 +244,10 @@ class Tracer:
 
     def __init__(self, capacity: Optional[int] = None):
         if capacity is None:
-            capacity = int(os.environ.get("DL4J_TPU_TRACE_BUFFER", "16384"))
+            # layered resolution (DL102): programmatic
+            # set_property(TRACE_BUFFER) > DL4J_TPU_TRACE_BUFFER > default
+            from .environment import environment
+            capacity = environment().trace_buffer()
         self.capacity = max(int(capacity), 1)
         self.pid = os.getpid()
         self._events: deque = deque(maxlen=self.capacity)
@@ -361,7 +365,7 @@ def span_tree(events: List[dict]) -> List[dict]:
 # on-demand device profiling (the /debug/profile endpoint)
 # ---------------------------------------------------------------------------
 
-_PROFILE_CAPTURE_LOCK = threading.Lock()
+_PROFILE_CAPTURE_LOCK = ordered_lock("tracing.profile_capture")
 
 
 class ProfileBusyError(RuntimeError):
@@ -420,7 +424,7 @@ def capture_profile(seconds: float, log_dir: Optional[str] = None) -> dict:
 # Bounded dict, oldest-first eviction; keyed by trace_id.
 
 _DISPOSITIONS: "OrderedDict[str, str]" = OrderedDict()
-_DISPOSITIONS_LOCK = threading.Lock()
+_DISPOSITIONS_LOCK = ordered_lock("tracing.dispositions")
 _DISPOSITIONS_CAP = 4096
 
 
@@ -444,7 +448,7 @@ def pop_disposition(trace_id: Optional[str]) -> Optional[str]:
 
 
 _TRACER: Optional[Tracer] = None
-_TRACER_LOCK = threading.Lock()
+_TRACER_LOCK = ordered_lock("tracing.singleton")
 
 
 def tracer() -> Tracer:

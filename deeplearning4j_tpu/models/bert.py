@@ -298,10 +298,15 @@ def _make_loss_fn(config, mesh, seq_parallel, remat, use_flash):
 def _jit_step(fn, config, mesh, seq_parallel):
     """jit a ``(params, opt_state, batch, scalar) -> (params, opt_state,
     aux)`` step with donated params/state and, when a mesh is given, the
-    TP/FSDP/SP shardings from param_specs."""
+    TP/FSDP/SP shardings from param_specs. Routed through ``counted_jit``
+    (DL101) so BERT training shares the recompile counters and — for the
+    unsharded step — the persistent executable store."""
+    from ..runtime.inference import counted_jit
+
     donate = (0, 1)
     if mesh is None:
-        return jax.jit(fn, donate_argnums=donate)
+        return counted_jit(fn, tag=f"bert_train:{id(fn)}",
+                           donate_argnums=donate)
     specs = param_specs(config)
     param_sh = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), specs,
@@ -314,8 +319,8 @@ def _jit_step(fn, config, mesh, seq_parallel):
                                      SEQ if seq_parallel else None))
     # batch_sh is a pytree *prefix*: it applies to every entry of the batch
     # dict, whatever keys the caller provides (token_type_ids included)
-    return jax.jit(
-        fn, donate_argnums=donate,
+    return counted_jit(
+        fn, tag=f"bert_train:{id(fn)}", donate_argnums=donate,
         in_shardings=(param_sh, opt_sh, batch_sh, None),
         out_shardings=(param_sh, opt_sh, None))
 
@@ -429,7 +434,9 @@ def make_qa_train_step(config: BertConfig, mesh: Optional[Mesh] = None,
             all_params, grads, opt_state, learning_rate, iteration)
         return new_params, opt_state, loss
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    from ..runtime.inference import counted_jit
+    return counted_jit(step, tag=f"bert_qa:{id(step)}",
+                       donate_argnums=(0, 1))
 
 
 # -- pipeline parallelism (dp x pp) --------------------------------------
@@ -595,7 +602,9 @@ def make_pipeline_train_step(config: BertConfig, mesh: Mesh,
             params, grads, opt_state, learning_rate, iteration)
         return new_params, opt_state, loss
 
-    step = jax.jit(step, donate_argnums=(0, 1))
+    from ..runtime.inference import counted_jit
+    step = counted_jit(step, tag=f"bert_pipeline:{id(loss_fn)}",
+                       donate_argnums=(0, 1))
     step.loss_fn = loss_fn  # exposed for grad-level parity tests
     return step
 

@@ -95,7 +95,10 @@ def make_train_step(c: Seq2SeqConfig, learning_rate: float = 1e-2):
             params, grads, opt_state, learning_rate, iteration)
         return new_params, opt_state, loss
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    # counted_jit (DL101): compile events + AOT-store routing
+    from ..runtime.inference import counted_jit
+    return counted_jit(step, tag=f"seq2seq_train:{id(step)}",
+                       donate_argnums=(0, 1))
 
 
 def init_opt_state(params):

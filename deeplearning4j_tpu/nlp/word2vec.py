@@ -280,7 +280,8 @@ class ParagraphVectors(Word2Vec):
             return -(jnp.sum(jax.nn.log_sigmoid(pos))
                      + jnp.sum(jax.nn.log_sigmoid(-neg) * neg_mask)) / len(ids)
 
-        grad = jax.jit(jax.grad(loss_fn))
+        from ..runtime.inference import counted_jit
+        grad = counted_jit(jax.grad(loss_fn), tag=f"pv_infer:{id(self)}")
         for _ in range(steps):
             negs = self._sv._negatives((len(ids), self.config.negative), rng)
             v = v - lr * grad(v, negs)
@@ -361,7 +362,6 @@ class FastText:
         # the exact (padded) batch or remainder pairs are dropped
         S = SequenceVectors.micro_chunk(cfg.batch_size)
 
-        @jax.jit
         def step(w_in, w_out, c, x, negs, lr):
             C = c.shape[0] // S
             chunks = (c[:C * S].reshape(C, S), x[:C * S].reshape(C, S),
@@ -376,6 +376,11 @@ class FastText:
 
             (w_in, w_out), losses = jax.lax.scan(body, (w_in, w_out), chunks)
             return w_in, w_out, jnp.sum(losses) / (C * S)
+
+        # counted_jit (DL101): the FastText SGNS step records compile
+        # events like the SequenceVectors fast path
+        from ..runtime.inference import counted_jit
+        step = counted_jit(step, tag=f"fasttext:{id(self)}")
 
         idx_streams = [np.array([self.vocab.index_of(t) for t in s
                                  if self.vocab.index_of(t) >= 0], np.int64)

@@ -49,7 +49,11 @@ def _make_flat_loss(net, x, y):
     flat0 = jnp.concatenate([trainable[i][k].ravel()
                              for i, k, _, _ in spec]) if spec else \
         jnp.zeros((0,))
-    return jax.jit(jax.value_and_grad(loss)), flat0, unflatten
+    # counted_jit (DL101): solver line searches hammer this entry; the
+    # compile counter + AOT store cover it like every other jitted loss
+    from ..runtime.inference import counted_jit
+    return counted_jit(jax.value_and_grad(loss),
+                       tag=f"solver:{id(net)}"), flat0, unflatten
 
 
 def backtrack_line_search(vg: Callable, x0, f0, g0, direction,

@@ -87,9 +87,14 @@ def fit_accumulated(net, batches: List, accumulation_steps: int = None,
         return x, y
 
     # loss over explicit (trainable, states) — nothing baked as constants;
-    # aux carries the stateful-layer inputs for the running-stat refresh
-    grad_fn = jax.jit(jax.value_and_grad(net._loss_with_bn, has_aux=True))
-    apply_fn = jax.jit(net._apply_update)
+    # aux carries the stateful-layer inputs for the running-stat refresh.
+    # counted_jit (DL101): both entries record compile events and resolve
+    # through the persistent executable store.
+    from ..runtime.inference import counted_jit
+    grad_fn = counted_jit(
+        jax.value_and_grad(net._loss_with_bn, has_aux=True),
+        tag=f"accum_grad:{id(net)}")
+    apply_fn = counted_jit(net._apply_update, tag=f"accum_apply:{id(net)}")
 
     losses = []
     acc = GradientsAccumulator(threshold=threshold)

@@ -263,8 +263,12 @@ class ParallelInference:
         self.batch_limit = batch_limit
         batch_sh = NamedSharding(self.mesh, P((DATA, FSDP)))
         repl = NamedSharding(self.mesh, P())
-        self._fn = jax.jit(
+        # counted_jit (DL101): sharded inference registers compile events
+        # (cache=bypass, same note as ParallelWrapper._build_step)
+        from ..runtime.inference import counted_jit
+        self._fn = counted_jit(
             lambda params, x: net._forward(params, x, training=False),
+            tag=f"parallel_infer:{id(self)}",
             in_shardings=(repl, batch_sh), out_shardings=batch_sh)
 
     def output(self, x) -> NDArray:

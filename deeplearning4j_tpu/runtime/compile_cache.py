@@ -68,6 +68,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..common import faults
 from ..common.environment import environment
+from ..common.locks import ordered_lock
 
 log = logging.getLogger(__name__)
 
@@ -148,7 +149,7 @@ class AOTCompileCache:
         self.base_dir = base_dir
         self.aot_dir = os.path.join(base_dir, "aot")
         self.max_bytes = int(max_bytes)
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("cache.store")
         self._warned_keys: set = set()
         self.stats = {"hits": 0, "misses": 0, "puts": 0, "corrupt": 0,
                       "evictions": 0, "put_errors": 0}
@@ -298,7 +299,7 @@ class AOTCompileCache:
 
 _CACHE: Optional[AOTCompileCache] = None
 _CACHE_DIR_USED: Optional[str] = None
-_CACHE_LOCK = threading.Lock()
+_CACHE_LOCK = ordered_lock("cache.global")
 _BACKSTOP_DIR: Optional[str] = None
 
 

@@ -51,6 +51,8 @@ import numpy as np
 
 from ..common import faults
 from ..common.environment import environment
+from ..common.locks import (ordered_condition, ordered_lock,
+                            ordered_rlock)
 from ..common.metrics import exponential_buckets, registry
 from ..common.tracing import current_context, record_disposition, tracer
 from .inference import (EngineClosedError, bucket_for, bucket_ladder,
@@ -171,10 +173,10 @@ class DecodeEngine:
         self._slot_req: List[Optional[_GenRequest]] = [None] * S
         self._active_n = 0
         # dispatch serialization: warmup and the loop both step the cache
-        self._dispatch_lock = threading.RLock()
+        self._dispatch_lock = ordered_rlock("decode.dispatch")
         self._warmed: set = set()
         # scheduler state
-        self._cv = threading.Condition()
+        self._cv = ordered_condition("decode.scheduler")
         self._pending: List[_GenRequest] = []
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
@@ -187,7 +189,7 @@ class DecodeEngine:
         # registry-compat surface (manifest machinery is predict-only)
         self.max_batch = self.slots
         self.manifest_path = None
-        self._stats_lock = threading.Lock()
+        self._stats_lock = ordered_lock("decode.stats")
         self._stats = {"requests": 0, "tokens": 0, "decode_steps": 0,
                        "prefills": 0, "expired": 0}
         self._build_steps()

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 
 from ..common import faults
 from ..common.environment import environment
+from ..common.locks import ordered_condition, ordered_lock
 from ..common.metrics import linear_buckets, registry
 from ..common.tracing import (current_context, record_disposition, span,
                               tracer, use_context)
@@ -387,13 +388,16 @@ class InferenceEngine:
         # actually dispatched, auto-persisted when manifest_path is set so
         # a restarted server can replay yesterday's buckets before taking
         # traffic.
-        self._warm_lock = threading.Lock()
+        # DL105: tracked locks — names are the class-level ordering
+        # identity the runtime lock-order tracker (common.locks) and the
+        # static pass both reason about
+        self._warm_lock = ordered_lock("inference.warm")
         self._warmed: set = set()
         self._warming: Dict[Any, threading.Event] = {}
         self.manifest_path = manifest_path
         self._observed: Dict[Tuple, set] = {}
         # micro-batcher state
-        self._cv = threading.Condition()
+        self._cv = ordered_condition("inference.batcher")
         self._pending: List[_Request] = []
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
@@ -408,7 +412,7 @@ class InferenceEngine:
         self._worker_dead = False
         self._dispatch_started_at: Optional[float] = None
         # stats
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("inference.stats")
         self._stats = {"requests": 0, "dispatches": 0, "rows_real": 0,
                        "rows_padded": 0, "coalesced": 0,
                        "bucket_dispatches": {}}

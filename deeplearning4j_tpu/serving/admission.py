@@ -49,6 +49,7 @@ import time
 from typing import Callable, Optional
 
 from ..common.environment import environment
+from ..common.locks import ordered_condition
 from ..common.metrics import exponential_buckets, registry
 from ..common.tracing import current_context, span
 
@@ -121,7 +122,7 @@ class AdmissionController:
         self.default_timeout_s = (env.serving_default_timeout_s()
                                   if default_timeout_s == "env"
                                   else default_timeout_s)
-        self._cv = threading.Condition()
+        self._cv = ordered_condition("admission")
         self._active = 0
         self._queue: list = []  # FIFO waiter tickets (bounded: high_water)
         self._closed = False

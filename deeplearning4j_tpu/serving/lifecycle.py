@@ -41,6 +41,7 @@ from typing import Callable, Iterable, Optional
 
 from ..common import faults
 from ..common.environment import environment
+from ..common.locks import ordered_lock
 from ..common.metrics import registry as metrics_registry
 from ..common.tracing import tracer
 from . import resilience
@@ -63,7 +64,7 @@ class GracefulLifecycle:
                                 if drain_timeout_s is not None
                                 else environment().serving_drain_timeout_s())
         self.on_drained = on_drained
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("lifecycle")
         self._drain_started = False
         self._drained = threading.Event()
         self._previous: dict = {}

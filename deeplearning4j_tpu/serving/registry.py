@@ -36,6 +36,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from ..common.environment import environment
+from ..common.locks import ordered_rlock
 from ..common.metrics import registry as metrics_registry
 from ..common.tracing import span
 from ..runtime import compile_cache
@@ -89,7 +90,7 @@ class ModelRegistry:
         # manifests entirely (hot-swap handoff still works in-process)
         self._manifest_dir = (compile_cache.serving_manifest_dir()
                               if manifest_dir == "auto" else manifest_dir)
-        self._lock = threading.RLock()
+        self._lock = ordered_rlock("registry")
         self._versions: Dict[str, List[ModelVersion]] = {}
         self._current: Dict[str, ModelVersion] = {}
         self._draining = False

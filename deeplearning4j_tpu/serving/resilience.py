@@ -40,6 +40,7 @@ import time
 from typing import Dict, Optional, Tuple
 
 from ..common.environment import environment
+from ..common.locks import ordered_lock
 from ..common.metrics import registry as metrics_registry
 
 log = logging.getLogger(__name__)
@@ -74,7 +75,7 @@ class CircuitBreaker:
         self.probe_s = (env.breaker_probe_s() if probe_s is None
                         else float(probe_s))
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("breaker")
         self._state = CLOSED
         self._failures = 0          # consecutive, reset on success
         self._opened_at: Optional[float] = None
@@ -190,7 +191,7 @@ class HealthRegistry:
     so ``/readyz`` and the flight recorder can say *why*."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("health")
         self._unhealthy: Dict[str, str] = {}
         self._m = metrics_registry().gauge(
             "dl4j_engine_healthy",
@@ -226,7 +227,7 @@ class HealthRegistry:
 
 
 _HEALTH: Optional[HealthRegistry] = None
-_HEALTH_LOCK = threading.Lock()
+_HEALTH_LOCK = ordered_lock("resilience.health_singleton")
 
 
 def health() -> HealthRegistry:
@@ -255,7 +256,7 @@ class EngineWatchdog:
 
     def __init__(self, poll_s: float = 0.25):
         self.poll_s = float(poll_s)
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("watchdog")
         self._watched: Dict[str, Tuple[object, float]] = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -321,7 +322,7 @@ class EngineWatchdog:
 
 
 _WATCHDOG: Optional[EngineWatchdog] = None
-_WATCHDOG_LOCK = threading.Lock()
+_WATCHDOG_LOCK = ordered_lock("resilience.watchdog_singleton")
 
 
 def watchdog() -> EngineWatchdog:

@@ -72,6 +72,7 @@ import numpy as np
 
 from ..common import faults
 from ..common.environment import environment
+from ..common.locks import ordered_lock
 from ..common.httpserver import (CLIENT_DISCONNECTS, JsonRequestHandler,
                                  QuietThreadingHTTPServer, handle_debug_get,
                                  handle_debug_post, metrics_payload)
@@ -176,9 +177,9 @@ class ModelServer:
                                       queue_depth=queue_depth,
                                       high_water=high_water)
         self._admission: Dict[str, AdmissionController] = {}
-        self._admission_lock = threading.Lock()
+        self._admission_lock = ordered_lock("server.admission")
         self._slo: Dict[str, SLOTracker] = {}
-        self._slo_lock = threading.Lock()
+        self._slo_lock = ordered_lock("server.slo")
         self.request_ring = RequestRing(request_ring)
         self._httpd: Optional[QuietThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None

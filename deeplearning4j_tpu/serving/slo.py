@@ -35,6 +35,7 @@ from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..common.environment import environment
+from ..common.locks import ordered_lock
 from ..common.metrics import registry
 
 
@@ -67,7 +68,7 @@ class SLOTracker:
         self.bucket_s = max(self.windows[0][0] / 30.0, 0.05)
         maxlen = int(self.windows[-1][0] / self.bucket_s) + 2
         self._buckets: deque = deque(maxlen=maxlen)  # [idx, good, total]
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("slo")
         reg = registry()
         self._m_requests = reg.counter(
             "dl4j_slo_requests_total",

@@ -61,11 +61,11 @@ def get_url_string(relative: str) -> str:
 
 
 def cache_dir() -> str:
-    """Local artifact cache (reference: ~/.deeplearning4j/models)."""
-    root = os.environ.get("DL4J_TPU_HOME",
-                          os.path.join(os.path.expanduser("~"),
-                                       ".deeplearning4j_tpu"))
-    return os.path.join(root, "models")
+    """Local artifact cache (reference: ~/.deeplearning4j/models).
+    Rooted at ``Environment.home_dir()`` (``DL4J_TPU_HOME``, layered
+    resolution — DL102)."""
+    from ..common.environment import environment
+    return os.path.join(environment().home_dir(), "models")
 
 
 def adler32_file(path: str) -> int:

@@ -33,6 +33,40 @@ def pytest_configure(config):
         "(-m 'not slow')")
 
 
+#: modules that exercise the concurrent serving stack hard enough to
+#: double as deadlock detectors: the DL105 runtime lock-order tracker
+#: (common.locks, DL4J_TPU_LOCK_CHECK) is armed for them and any
+#: recorded order inversion fails the module at teardown
+_LOCK_CHECK_MODULES = {"test_serving.py", "test_resilience.py",
+                       "test_generation.py"}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lock_order_check(request):
+    name = os.path.basename(str(request.node.fspath))
+    if name not in _LOCK_CHECK_MODULES:
+        yield
+        return
+    from deeplearning4j_tpu.common import locks
+    locks.clear_violations()
+    prev_env = os.environ.get("DL4J_TPU_LOCK_CHECK")
+    os.environ["DL4J_TPU_LOCK_CHECK"] = "1"
+    prev = locks.set_lock_check(True)
+    try:
+        yield
+    finally:
+        locks.set_lock_check(prev)
+        if prev_env is None:
+            os.environ.pop("DL4J_TPU_LOCK_CHECK", None)
+        else:
+            os.environ["DL4J_TPU_LOCK_CHECK"] = prev_env
+        found = locks.violations()
+        locks.clear_violations()
+    assert not found, (
+        f"lock-order inversions recorded while running {name} "
+        f"(DL4J_TPU_LOCK_CHECK): {found}")
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _compile_cache_tmpdir(tmp_path_factory):
     """Point the AOT executable cache (DL4J_TPU_CACHE_DIR) at a per-run
