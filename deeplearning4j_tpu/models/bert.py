@@ -27,6 +27,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import DATA, FSDP, PIPE, SEQ, TENSOR
+from ..quant.transforms import (dequant_matmul, dequantize, take_rows,
+                                tied_logits)
 from . import _optim
 from ..parallel.ring_attention import blockwise_attention, ring_attention
 
@@ -178,9 +180,12 @@ def _attention(layer_params, h, attention_mask, config: BertConfig,
         h_in = tp_copy(h, tp_axis)
     else:
         h_in = h
-    q = jnp.einsum("bte,ehd->bthd", h_in, a["wq"]) + a["bq"]
-    k = jnp.einsum("bte,ehd->bthd", h_in, a["wk"]) + a["bk"]
-    v = jnp.einsum("bte,ehd->bthd", h_in, a["wv"]) + a["bv"]
+    q = jnp.einsum("bte,ehd->bthd", h_in,
+                   dequantize(a["wq"], h_in.dtype)) + a["bq"]
+    k = jnp.einsum("bte,ehd->bthd", h_in,
+                   dequantize(a["wk"], h_in.dtype)) + a["bk"]
+    v = jnp.einsum("bte,ehd->bthd", h_in,
+                   dequantize(a["wv"], h_in.dtype)) + a["bv"]
     if seq_parallel and mesh is not None:
         # use_flash composes with SP: the Pallas kernel computes each
         # K/V block inside the ring (VERDICT r4 #4 / SURVEY §5)
@@ -199,7 +204,7 @@ def _attention(layer_params, h, attention_mask, config: BertConfig,
                                logits, big_neg)
         probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-    out = jnp.einsum("bqhd,hde->bqe", ctx, a["wo"])
+    out = jnp.einsum("bqhd,hde->bqe", ctx, dequantize(a["wo"], ctx.dtype))
     if tp_axis is not None:
         from ..parallel.pipeline import tp_reduce
         out = tp_reduce(out, tp_axis)
@@ -213,7 +218,7 @@ def encode(params, input_ids, token_type_ids=None, attention_mask=None, *,
     c = config
     e = params["embeddings"]
     B, T = input_ids.shape
-    h = jnp.take(e["word"], input_ids, axis=0)
+    h = take_rows(e["word"], input_ids, dtype=c.dtype)
     h = h + e["position"][None, :T]
     if token_type_ids is not None:
         h = h + jnp.take(e["token_type"], token_type_ids, axis=0)
@@ -230,13 +235,13 @@ def encode(params, input_ids, token_type_ids=None, attention_mask=None, *,
                               use_flash)
         h = _ln(h + attn_out, layer["ln1_g"], layer["ln1_b"], c.layer_norm_eps)
         mlp = layer["mlp"]
-        inter = jax.nn.gelu(jnp.einsum("bte,ef->btf", h, mlp["w1"]) + mlp["b1"])
+        inter = jax.nn.gelu(dequant_matmul(h, mlp["w1"]) + mlp["b1"])
         if mesh is not None:
             inter = lax.with_sharding_constraint(
                 inter, NamedSharding(
                     mesh, P((DATA, FSDP), SEQ if seq_parallel else None,
                             TENSOR)))
-        mlp_out = jnp.einsum("btf,fe->bte", inter, mlp["w2"]) + mlp["b2"]
+        mlp_out = dequant_matmul(inter, mlp["w2"]) + mlp["b2"]
         h = _ln(h + mlp_out, layer["ln2_g"], layer["ln2_b"], c.layer_norm_eps)
         if mesh is not None:
             h = lax.with_sharding_constraint(
@@ -248,16 +253,16 @@ def encode(params, input_ids, token_type_ids=None, attention_mask=None, *,
 def mlm_logits(params, encodings, config: BertConfig):
     """Masked-LM head with tied decoder weights."""
     m = params["mlm"]
-    h = jax.nn.gelu(jnp.einsum("bte,ef->btf", encodings, m["dense"])
-                    + m["dense_b"])
+    h = jax.nn.gelu(dequant_matmul(encodings, m["dense"]) + m["dense_b"])
     h = _ln(h, m["ln_g"], m["ln_b"], config.layer_norm_eps)
-    logits = jnp.einsum("bte,ve->btv", h, params["embeddings"]["word"])
-    return logits.astype(jnp.float32) + m["bias"]
+    # tied decoder: per-row scales of a quantized word table fold into the
+    # f32 logits
+    return tied_logits(h, params["embeddings"]["word"]) + m["bias"]
 
 
 def pooled(params, encodings):
-    return jnp.tanh(jnp.einsum("be,eh->bh", encodings[:, 0],
-                               params["pooler"]["w"]) + params["pooler"]["b"])
+    return jnp.tanh(dequant_matmul(encodings[:, 0], params["pooler"]["w"])
+                    + params["pooler"]["b"])
 
 
 def mlm_loss(params, batch, config: BertConfig, mesh=None,

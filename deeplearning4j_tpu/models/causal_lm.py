@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..quant.transforms import (dequant_matmul, dequantize, take_rows,
+                                tied_logits)
 from .bert import _ln
 
 
@@ -126,23 +128,24 @@ def _mlp_ln(layer, h, attn_out, c: CausalLMConfig):
     """The post-attention half of a block: residual+LN, MLP, residual+LN."""
     h = _ln(h + attn_out, layer["ln1_g"], layer["ln1_b"], c.layer_norm_eps)
     mlp = layer["mlp"]
-    inter = jax.nn.gelu(
-        jnp.einsum("...e,ef->...f", h, mlp["w1"]) + mlp["b1"])
-    mlp_out = jnp.einsum("...f,fe->...e", inter, mlp["w2"]) + mlp["b2"]
+    # dequant_matmul == einsum("...e,ef->...f") for plain weights, and the
+    # int8/fp8-at-rest contraction for a quantized twin
+    inter = jax.nn.gelu(dequant_matmul(h, mlp["w1"]) + mlp["b1"])
+    mlp_out = dequant_matmul(inter, mlp["w2"]) + mlp["b2"]
     return _ln(h + mlp_out, layer["ln2_g"], layer["ln2_b"], c.layer_norm_eps)
 
 
 def _embed(params, input_ids, positions, c: CausalLMConfig):
     e = params["embeddings"]
-    h = jnp.take(e["word"], input_ids, axis=0)
+    h = take_rows(e["word"], input_ids, dtype=c.dtype)
     h = h + jnp.take(e["position"], positions, axis=0)
     return _ln(h, e["ln_g"], e["ln_b"], c.layer_norm_eps)
 
 
 def _lm_logits(params, h):
-    """Tied word-embedding head, f32 logits."""
-    return jnp.einsum("...e,ve->...v", h,
-                      params["embeddings"]["word"]).astype(jnp.float32)
+    """Tied word-embedding head, f32 logits (per-row scales of a
+    quantized word table multiply the logits)."""
+    return tied_logits(h, params["embeddings"]["word"])
 
 
 _BIG_NEG = jnp.finfo(jnp.float32).min
@@ -155,9 +158,9 @@ def _causal_block(layer, h, c: CausalLMConfig, use_flash: bool = False):
 
     a = layer["attn"]
     B, T = h.shape[0], h.shape[1]
-    q = jnp.einsum("bte,ehd->bthd", h, a["wq"]) + a["bq"]
-    k = jnp.einsum("bte,ehd->bthd", h, a["wk"]) + a["bk"]
-    v = jnp.einsum("bte,ehd->bthd", h, a["wv"]) + a["bv"]
+    q = jnp.einsum("bte,ehd->bthd", h, dequantize(a["wq"], h.dtype)) + a["bq"]
+    k = jnp.einsum("bte,ehd->bthd", h, dequantize(a["wk"], h.dtype)) + a["bk"]
+    v = jnp.einsum("bte,ehd->bthd", h, dequantize(a["wv"], h.dtype)) + a["bv"]
     if use_flash and attention_dispatch(T) == "flash":
         from ..kernels import flash_attention
         ctx = flash_attention(q, k, v, causal=True)
@@ -169,7 +172,8 @@ def _causal_block(layer, h, c: CausalLMConfig, use_flash: bool = False):
         logits = jnp.where(causal[None, None], logits, _BIG_NEG)
         probs = jax.nn.softmax(logits, axis=-1).astype(h.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-    out = jnp.einsum("bqhd,hde->bqe", ctx, a["wo"]) + a["bo"]
+    out = jnp.einsum("bqhd,hde->bqe", ctx,
+                     dequantize(a["wo"], h.dtype)) + a["bo"]
     return _mlp_ln(layer, h, out, c), (k, v)
 
 
@@ -242,9 +246,12 @@ def decode(params, cache, tokens, lengths, config: CausalLMConfig):
     cache_k, cache_v = cache["k"], cache["v"]
     for i, layer in enumerate(params["layers"]):
         a = layer["attn"]
-        q = jnp.einsum("se,ehd->shd", h, a["wq"]) + a["bq"]
-        k = jnp.einsum("se,ehd->shd", h, a["wk"]) + a["bk"]
-        v = jnp.einsum("se,ehd->shd", h, a["wv"]) + a["bv"]
+        q = jnp.einsum("se,ehd->shd", h, dequantize(a["wq"], h.dtype)) \
+            + a["bq"]
+        k = jnp.einsum("se,ehd->shd", h, dequantize(a["wk"], h.dtype)) \
+            + a["bk"]
+        v = jnp.einsum("se,ehd->shd", h, dequantize(a["wv"], h.dtype)) \
+            + a["bv"]
         cache_k = cache_k.at[rows, i, lengths].set(
             k.astype(cache_k.dtype), mode="drop")
         cache_v = cache_v.at[rows, i, lengths].set(
@@ -254,7 +261,8 @@ def decode(params, cache, tokens, lengths, config: CausalLMConfig):
         att = jnp.where(key_mask[:, None, :], att, _BIG_NEG)
         probs = jax.nn.softmax(att, axis=-1).astype(h.dtype)
         ctx = jnp.einsum("shc,schd->shd", probs, cache_v[:, i])
-        out = jnp.einsum("shd,hde->se", ctx, a["wo"]) + a["bo"]
+        out = jnp.einsum("shd,hde->se", ctx,
+                         dequantize(a["wo"], h.dtype)) + a["bo"]
         h = _mlp_ln(layer, h, out, c)
     return {"k": cache_k, "v": cache_v}, _lm_logits(params, h)
 

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ...ops import conv_ops, nn_ops, recurrent
+from ...quant.transforms import QuantizedTensor, dequant_matmul, dequantize
 from ..activations import get_activation
 from ..losses import get_loss
 from ..weights import init_weights
@@ -74,7 +75,9 @@ class DenseLayer(Layer):
         return p
 
     def forward(self, params, x, training=False, key=None):
-        out = jnp.matmul(x, params["W"])
+        # dequant_matmul == jnp.matmul for plain weights, int8/fp8-at-rest
+        # contraction when a quantized twin substituted the weight
+        out = dequant_matmul(x, params["W"])
         if self.has_bias:
             out = out + params["b"]
         out = get_activation(self.activation)(out)
@@ -149,7 +152,10 @@ class ConvolutionLayer(Layer):
         return p
 
     def forward(self, params, x, training=False, key=None):
-        out = conv_ops.conv2d(x, params["W"], params.get("b"),
+        W = params["W"]
+        if isinstance(W, QuantizedTensor):
+            W = dequantize(W, x.dtype)
+        out = conv_ops.conv2d(x, W, params.get("b"),
                               strides=_pair(self.stride),
                               padding=self._padding_arg(),
                               dilation=_pair(self.dilation),

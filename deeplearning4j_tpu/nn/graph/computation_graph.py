@@ -469,7 +469,13 @@ class ComputationGraph(FitFastPathMixin):
                 acts = self._forward(params, ind, training)
                 return [acts[o] for o in self.conf.outputs]
 
-            fn = counted_jit(fwd, tag=f"cg:{id(self)}:{int(training)}")
+            # quantized twins get a dtype-tagged cache key (see
+            # multilayer._output_jit)
+            tag = f"cg:{id(self)}:{int(training)}"
+            prec = getattr(self, "_precision", None)
+            if prec:
+                tag += f":{prec}"
+            fn = counted_jit(fwd, tag=tag)
             self._out_fns[training] = fn
         return fn
 

@@ -195,8 +195,17 @@ class MultiLayerNetwork(FitFastPathMixin):
         fn = self._out_fns.get(training)
         if fn is None:
             from ..runtime.inference import counted_jit
+            # a quantized twin (quant/transforms.quantize_model) carries
+            # _precision; tagging it keeps the persistent compile-cache key
+            # of the twin distinct from its full-precision original even
+            # though both share this class (suffix position matters: the
+            # first tag segment is the `kind` metric label)
+            tag = f"mln:{id(self)}:{int(training)}"
+            prec = getattr(self, "_precision", None)
+            if prec:
+                tag += f":{prec}"
             fn = counted_jit(lambda p, x: self._forward(p, x, training),
-                             tag=f"mln:{id(self)}:{int(training)}")
+                             tag=tag)
             self._out_fns[training] = fn
         return fn
 

@@ -1,0 +1,42 @@
+"""Post-training quantization (PTQ) as a pure params -> params transform.
+
+The paper's TPU-native answer to libnd4j's hand-tuned low-precision
+kernels: per-channel symmetric int8 (LLM.int8()-style, Dettmers et al.,
+2022) or fp8 weight quantization expressed entirely in XLA-friendly ops —
+weights live int8/fp8 *at rest* and dequantize inside the jitted forward,
+so the compiler fuses the dequant into the matmul epilogue and the HBM
+footprint (and weight-streaming bandwidth) drops ~4x with zero custom
+kernels. The AQT-style ``dequant_matmul`` keeps the per-output-channel
+scale out of the contraction so accuracy survives the 8-bit weights.
+
+Three modules:
+
+- ``transforms``  — ``QuantizedTensor`` (a pytree leaf holding q + scale),
+  ``quantize_params``/``quantize_model`` recipes for MLN/CG dense+conv
+  layers, BERT blocks and ``CausalLM``, and the dequantizing compute ops
+  (``dequant_matmul``, ``dequantize``, ``take_rows``, ``tied_logits``).
+- ``calibrate``   — activation-range calibration (absmax + percentile)
+  from a user-supplied sample batch, producing a serializable
+  ``QuantSpec``.
+- ``validate``    — the max-divergence gate ``ModelRegistry.deploy(
+  quantize=...)`` runs between warmup and cutover: logits max-abs-err +
+  top-1 agreement on the calibration batch (per-token agreement for
+  generative models). A failing gate raises ``QuantizationRejectedError``
+  and the swap aborts with the full-precision version still live.
+"""
+from .calibrate import QuantSpec, calibrate
+from .transforms import (QuantizedTensor, default_act_dtype, dequant_matmul,
+                         dequantize, fp8_supported, param_bytes_of,
+                         precision_of, precision_of_model, quantize_model,
+                         quantize_params, quantize_tensor, take_rows,
+                         tied_logits)
+from .validate import (QuantizationRejectedError, divergence_report,
+                       validate)
+
+__all__ = [
+    "QuantSpec", "calibrate", "QuantizedTensor", "default_act_dtype",
+    "dequant_matmul", "dequantize", "fp8_supported", "param_bytes_of",
+    "precision_of", "precision_of_model", "quantize_model",
+    "quantize_params", "quantize_tensor", "take_rows", "tied_logits",
+    "QuantizationRejectedError", "divergence_report", "validate",
+]

@@ -1,0 +1,132 @@
+"""Activation-range calibration -> a serializable ``QuantSpec``.
+
+Weight quantization needs no data (the scales come from the weights
+themselves); what the *calibration batch* buys is (a) recorded activation
+ranges per observation site — absmax or a percentile, the classic
+outlier-robust choice — so an operator can see whether the traffic the
+gate judged resembles production before trusting the top-1 agreement
+number, and (b) a batch fingerprint binding the spec to the data the
+divergence gate validated on. The spec is plain JSON either way: it
+travels with the deploy request, lands in the flight recorder, and
+round-trips byte-identically (``from_json(to_json(s)) == s``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+_METHODS = ("absmax", "percentile")
+_MODES = ("int8", "fp8")
+
+
+@dataclasses.dataclass
+class QuantSpec:
+    """Everything a quantized deploy needs, serializable.
+
+    - ``mode``            — ``int8`` or ``fp8`` storage;
+    - ``act_dtype``       — activation compute dtype of the twin (None =
+      platform default: bf16 on accelerators, f32 on CPU);
+    - ``method``/``percentile`` — activation-range statistic collected at
+      calibration (absmax, or the given percentile of ``|a|``);
+    - ``min_size``/``skip_keys``/``embedding_keys`` — eligibility knobs
+      of :func:`~deeplearning4j_tpu.quant.transforms.quantize_params`;
+    - ``act_ranges``      — the calibrated per-site ranges;
+    - ``batch_fingerprint`` — shape/dtype signature of the calibration
+      batch the ranges (and the divergence gate) were computed on;
+    - ``scale_overrides`` — path-substring -> scale multiplier, the
+      deliberate-mis-scale hook for gate drills and tests.
+    """
+
+    mode: str = "int8"
+    act_dtype: Optional[str] = None
+    method: str = "absmax"
+    percentile: float = 99.9
+    min_size: int = 256
+    skip_keys: Tuple[str, ...] = ("position", "token_type")
+    embedding_keys: Tuple[str, ...] = ("word",)
+    act_ranges: Dict[str, float] = dataclasses.field(default_factory=dict)
+    batch_fingerprint: Optional[str] = None
+    scale_overrides: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+
+    def __post_init__(self):
+        if self.mode not in _MODES:
+            raise ValueError(f"QuantSpec.mode must be one of {_MODES}, "
+                             f"got {self.mode!r}")
+        if self.method not in _METHODS:
+            raise ValueError(f"QuantSpec.method must be one of {_METHODS}, "
+                             f"got {self.method!r}")
+        self.skip_keys = tuple(self.skip_keys)
+        self.embedding_keys = tuple(self.embedding_keys)
+
+    # -- serde ------------------------------------------------------------
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["skip_keys"] = list(self.skip_keys)
+        d["embedding_keys"] = list(self.embedding_keys)
+        return json.dumps(d, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "QuantSpec":
+        d = json.loads(s)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+def _fingerprint(batch) -> str:
+    arrs = (list(batch.values()) if isinstance(batch, dict)
+            else list(batch) if isinstance(batch, (list, tuple))
+            else [batch])
+    parts = []
+    for a in arrs:
+        a = np.asarray(a)
+        parts.append(f"{a.dtype}{list(a.shape)}")
+    return "+".join(parts)
+
+
+def _range_of(a, method: str, percentile: float) -> float:
+    mag = np.abs(np.asarray(a, dtype=np.float32))
+    if method == "percentile":
+        return float(np.percentile(mag, percentile))
+    return float(np.max(mag)) if mag.size else 0.0
+
+
+def calibrate(model, batch, *, mode: str = "int8",
+              act_dtype: Optional[str] = None, method: str = "absmax",
+              percentile: float = 99.9, **spec_kwargs) -> QuantSpec:
+    """Run ``model`` over ``batch`` (eagerly — calibration is a deploy-time
+    operation, never traced) and return a :class:`QuantSpec` carrying the
+    observed activation ranges.
+
+    Observation sites by model family: layer-API networks record every
+    layer activation via ``feed_forward`` (``layer0..layerN``); generative
+    models (``CausalLM`` protocol) record the full-sequence forward logits
+    (``logits``); anything else with an ``output`` callable records its
+    output."""
+    ranges: Dict[str, float] = {}
+    if all(callable(getattr(model, m, None))
+           for m in ("init_kv_cache", "forward")):
+        import jax.numpy as jnp
+        logits = model.forward(jnp.asarray(np.asarray(batch)))
+        ranges["logits"] = _range_of(logits, method, percentile)
+    elif callable(getattr(model, "feed_forward", None)):
+        acts = model.feed_forward(batch)
+        for i, a in enumerate(acts):
+            ranges[f"layer{i}"] = _range_of(
+                a.jax() if hasattr(a, "jax") else a, method, percentile)
+    elif callable(getattr(model, "output", None)):
+        out = model.output(batch)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        ranges["output"] = _range_of(
+            out.jax() if hasattr(out, "jax") else out, method, percentile)
+    else:
+        raise TypeError(
+            f"cannot calibrate {type(model).__name__}: expected a model "
+            "with forward/feed_forward/output")
+    return QuantSpec(mode=mode, act_dtype=act_dtype, method=method,
+                     percentile=percentile, act_ranges=ranges,
+                     batch_fingerprint=_fingerprint(batch), **spec_kwargs)

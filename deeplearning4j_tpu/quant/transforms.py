@@ -1,0 +1,370 @@
+"""Params -> params quantization transforms and the dequantizing ops.
+
+Weight-only PTQ in the per-channel symmetric recipe: for a weight ``W``
+the scale is ``absmax(W, reduction_axes) / qmax`` (one scale per output
+channel, never per tensor) and the stored value is ``round(W / scale)``
+in int8 (or ``W / scale`` cast to fp8). Weights stay quantized *at rest*
+— in the params pytree, in HBM, in the engine — and every consumer
+dequantizes inside its jitted forward, where XLA folds the
+``q.astype(f32) * scale`` into the surrounding dot/conv. The activations
+are NOT quantized (bf16/f32 per ``QuantSpec.act_dtype``), except on the
+optional fp8 path where ``dequant_matmul`` dynamically scales the
+activation tensor and issues a real fp8 ``dot_general`` with
+``preferred_element_type`` (platform-gated by :func:`fp8_supported`).
+
+``QuantizedTensor`` is a registered pytree node so quantized params flow
+through ``jax.jit``/``tree_map``/donation unchanged; it exposes enough of
+the array protocol (``shape``/``ndim``/``dtype``/``astype``) for the
+mixed-precision casting helpers to pass it through untouched.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+#: saturation range of the two storage formats
+_INT8_QMAX = 127.0
+_FP8_QMAX = 448.0  # float8_e4m3fn finite max
+
+_FP8_PROBED: list = []  # [bool] once probed (module-lifetime memo)
+
+
+def fp8_supported() -> bool:
+    """Whether this jax/platform pair can run an fp8 ``dot_general`` with
+    ``preferred_element_type`` — probed once, eagerly, never in a trace."""
+    if _FP8_PROBED:
+        return _FP8_PROBED[0]
+    ok = hasattr(jnp, "float8_e4m3fn")
+    if ok:
+        try:
+            x = jnp.ones((2, 2), jnp.float8_e4m3fn)
+            jax.block_until_ready(jax.lax.dot_general(
+                x, x, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        except Exception:
+            ok = False
+    _FP8_PROBED.append(ok)
+    return ok
+
+
+def default_act_dtype() -> str:
+    """Activation dtype for quantized twins when the spec leaves it to the
+    platform: bf16 where the MXU/tensor cores eat it natively, f32 on CPU
+    (XLA:CPU emulates bf16 arithmetic — measurably *slower* than f32)."""
+    return "float32" if jax.default_backend() == "cpu" else "bfloat16"
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """One quantized weight: ``q`` (int8/fp8, full shape) + ``scale``
+    (f32, keepdims-broadcast over the reduction axes). Dequantized value
+    is ``q * scale`` in f32, cast to the consumer's compute dtype."""
+
+    __slots__ = ("q", "scale", "orig_dtype")
+
+    def __init__(self, q, scale, orig_dtype: str = "float32"):
+        self.q = q
+        self.scale = scale
+        self.orig_dtype = str(orig_dtype)
+
+    # -- pytree protocol --------------------------------------------------
+    def tree_flatten(self):
+        return (self.q, self.scale), self.orig_dtype
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], orig_dtype=aux)
+
+    # -- enough array protocol for tree-walking params code ---------------
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def size(self):
+        return self.q.size
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return int(getattr(self.q, "nbytes", 0)) + \
+            int(getattr(self.scale, "nbytes", 0))
+
+    def astype(self, dtype):
+        """No-op: quantized storage is compute-dtype-invariant — the cast
+        happens at dequantization, inside the consuming op. Keeps the
+        mixed-precision param-casting helpers from corrupting the int8
+        payload."""
+        return self
+
+    @property
+    def mode(self) -> str:
+        return "fp8" if "float8" in str(self.q.dtype) else "int8"
+
+    def __repr__(self):
+        return (f"QuantizedTensor(shape={tuple(self.shape)}, "
+                f"mode={self.mode}, scale={tuple(self.scale.shape)})")
+
+
+def quantize_tensor(w, axes=None, mode: str = "int8") -> QuantizedTensor:
+    """Per-channel symmetric quantization of one weight.
+
+    ``axes`` are the *reduction* axes of the absmax (default: every axis
+    but the last, i.e. one scale per output channel of an ``x @ W``-style
+    weight; embedding tables pass ``range(1, ndim)`` for per-row scales
+    that serve both the lookup and the tied logits head).
+    """
+    if isinstance(w, QuantizedTensor):
+        return w
+    orig = str(w.dtype)
+    w32 = jnp.asarray(w).astype(jnp.float32)
+    if axes is None:
+        axes = tuple(range(w32.ndim - 1))
+    amax = jnp.maximum(jnp.max(jnp.abs(w32), axis=axes, keepdims=True),
+                       1e-12)
+    if mode == "int8":
+        scale = amax / _INT8_QMAX
+        q = jnp.clip(jnp.round(w32 / scale),
+                     -_INT8_QMAX, _INT8_QMAX).astype(jnp.int8)
+    elif mode == "fp8":
+        if not fp8_supported():
+            raise ValueError(
+                "fp8 quantization requested but this jax/platform cannot "
+                "run an fp8 dot_general (fp8_supported() is False)")
+        scale = amax / _FP8_QMAX
+        q = (w32 / scale).astype(jnp.float8_e4m3fn)
+    else:
+        raise ValueError(f"unknown quantization mode {mode!r} "
+                         "(expected 'int8' or 'fp8')")
+    return QuantizedTensor(q, scale, orig_dtype=orig)
+
+
+# ---------------------------------------------------------------------------
+# dequantizing compute ops (called inside jitted forwards; every op is a
+# transparent identity for plain arrays, so one code path serves both the
+# full-precision model and its quantized twin)
+# ---------------------------------------------------------------------------
+
+def dequantize(w, dtype=None):
+    """``w`` as a plain array in ``dtype`` (f32 dequant, then cast). Plain
+    arrays pass through (cast only when a dtype is given)."""
+    if not isinstance(w, QuantizedTensor):
+        return w if dtype is None else jnp.asarray(w).astype(dtype)
+    out = w.q.astype(jnp.float32) * w.scale
+    return out.astype(dtype if dtype is not None else w.orig_dtype)
+
+
+def dequant_matmul(x, w):
+    """``x @ W`` with int8/fp8-at-rest ``W`` (last-dim contraction, any
+    leading ``x`` dims). int8: the matmul runs in ``x.dtype`` against the
+    casted payload and the per-output-channel scale multiplies the
+    *result* — the dequant never materializes a full-precision weight
+    copy. fp8: the activation is dynamically scaled per tensor and the
+    contraction is a real fp8 ``dot_general`` accumulated in f32 via
+    ``preferred_element_type``."""
+    if not isinstance(w, QuantizedTensor):
+        return jnp.matmul(x, w)
+    if w.ndim != 2 or w.scale.shape[0] != 1:
+        # not a per-output-channel 2D weight: dequantize then contract
+        return jnp.matmul(x, dequantize(w, x.dtype))
+    out_scale = w.scale.reshape(-1)  # [n_out]
+    if w.mode == "fp8":
+        sx = jnp.maximum(jnp.max(jnp.abs(x)), 1e-12) / _FP8_QMAX
+        xq = (x / sx).astype(w.q.dtype)
+        out = jax.lax.dot_general(
+            xq, w.q, (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return (out * (sx * out_scale)).astype(x.dtype)
+    out = jnp.matmul(x, w.q.astype(x.dtype))
+    return (out * out_scale.astype(x.dtype)).astype(x.dtype)
+
+
+def take_rows(w, ids, dtype=None):
+    """Row lookup (``jnp.take(w, ids, axis=0)``) through a per-row-scaled
+    quantized table: gather the int8 rows AND their scales, multiply."""
+    if not isinstance(w, QuantizedTensor):
+        out = jnp.take(w, ids, axis=0)
+        return out if dtype is None else out.astype(dtype)
+    rows = jnp.take(w.q, ids, axis=0).astype(jnp.float32)
+    scales = jnp.take(w.scale, ids, axis=0)
+    return (rows * scales).astype(dtype if dtype is not None
+                                  else w.orig_dtype)
+
+
+def tied_logits(h, w):
+    """Tied word-embedding head ``einsum('...e,ve->...v')`` in f32 against
+    a per-row-scaled quantized table: the row scale IS the output-channel
+    scale of the transposed contraction, so it multiplies the logits."""
+    if not isinstance(w, QuantizedTensor):
+        return jnp.einsum("...e,ve->...v", h, w).astype(jnp.float32)
+    out = jnp.einsum("...e,ve->...v", h,
+                     w.q.astype(h.dtype)).astype(jnp.float32)
+    return out * w.scale.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# params -> params recipes
+# ---------------------------------------------------------------------------
+
+def _spec_field(spec, name, default):
+    return getattr(spec, name, default) if spec is not None else default
+
+
+def quantize_params(params, spec=None):
+    """Quantize every eligible weight leaf of a params pytree, preserving
+    structure. Eligible: floating, ndim >= 2, ``size >= spec.min_size``,
+    key not in ``spec.skip_keys`` and not a ``state_*`` running stat.
+    Keys in ``spec.embedding_keys`` get per-row scales (reduction axes
+    ``1..ndim``); everything else per-output-channel (axes ``0..ndim-1``).
+    ``spec.scale_overrides`` maps a path substring to a multiplier applied
+    to the matching tensors' scales — the deliberate-mis-scale hook the
+    divergence-gate tests (and chaos drills) use."""
+    mode = _spec_field(spec, "mode", "int8")
+    min_size = int(_spec_field(spec, "min_size", 256))
+    skip = tuple(_spec_field(spec, "skip_keys", ("position", "token_type")))
+    emb = tuple(_spec_field(spec, "embedding_keys", ("word",)))
+    overrides = dict(_spec_field(spec, "scale_overrides", {}) or {})
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            return {k: walk(v, path + (str(k),)) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            seq = [walk(v, path + (str(i),)) for i, v in enumerate(node)]
+            return seq if isinstance(node, list) else tuple(seq)
+        if isinstance(node, QuantizedTensor):
+            return node
+        key = path[-1] if path else ""
+        if (not hasattr(node, "dtype")
+                or not jnp.issubdtype(node.dtype, jnp.floating)
+                or getattr(node, "ndim", 0) < 2
+                or int(getattr(node, "size", 0)) < min_size
+                or key in skip or key.startswith("state_")):
+            return node
+        axes = (tuple(range(1, node.ndim)) if key in emb
+                else tuple(range(node.ndim - 1)))
+        qt = quantize_tensor(node, axes=axes, mode=mode)
+        dotted = ".".join(path)
+        for frag, factor in overrides.items():
+            if frag in dotted:
+                qt = QuantizedTensor(qt.q, qt.scale * float(factor),
+                                     orig_dtype=qt.orig_dtype)
+        return qt
+
+    return walk(params, ())
+
+
+def _resolved_act_dtype(spec) -> str:
+    act = _spec_field(spec, "act_dtype", None)
+    return str(act) if act else default_act_dtype()
+
+
+def quantize_model(model, spec=None):
+    """The model-level transform: returns an *inference-only quantized
+    twin* of ``model`` with int8/fp8 params at rest and activations in
+    ``spec.act_dtype`` (platform default when unset). Dispatches on the
+    duck-typed model families the serving stack knows:
+
+    - ``CausalLM`` protocol (``init_kv_cache``/``prefill``/``decode``) —
+      a new instance of the same class over quantized params, config
+      dtype flipped to the activation dtype (KV cache included);
+    - layer-API networks (MLN/CG: ``conf`` + ``_params``) — a twin
+      network over the same layer configs with quantized params and the
+      conf compute dtype flipped (dense/conv forwards dequantize via
+      ``dequant_matmul``);
+    - a bare params pytree — ``quantize_params``.
+
+    The twin is a distinct object, so ``counted_jit``'s per-model tags
+    (and the StableHLO-keyed persistent executable store) key its
+    executables separately from the full-precision original's.
+    """
+    import copy
+    import dataclasses
+
+    act = _resolved_act_dtype(spec)
+    if all(callable(getattr(model, m, None))
+           for m in ("init_kv_cache", "prefill", "decode")) \
+            and hasattr(model, "params") and hasattr(model, "config"):
+        qp = quantize_params(model.params, spec)
+        cfg = dataclasses.replace(model.config, dtype=jnp.dtype(act))
+        twin = type(model)(cfg, params=qp)
+        twin._precision = precision_of(qp)
+        return twin
+    if hasattr(model, "_params") and hasattr(model, "conf"):
+        twin = type(model)(copy.copy(model.conf))
+        twin.conf.dtype = act
+        twin._params = quantize_params(model._params, spec)
+        twin._updater_state = None  # inference-only: no optimizer state
+        twin._initialized = True
+        twin._precision = precision_of(twin._params)
+        return twin
+    if isinstance(model, (dict, list)):
+        return quantize_params(model, spec)
+    raise TypeError(
+        f"don't know how to quantize {type(model).__name__}: expected a "
+        "CausalLM-protocol model, a layer-API network (conf + _params), "
+        "or a bare params pytree")
+
+
+# ---------------------------------------------------------------------------
+# introspection (serving metadata: /v1/models precision + param-bytes)
+# ---------------------------------------------------------------------------
+
+def _leaves(params):
+    return jax.tree_util.tree_leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+
+
+def precision_of(params) -> str:
+    """Dominant storage precision of a params pytree: ``int8``/``fp8``
+    when any leaf is quantized, else the widest floating dtype seen."""
+    seen = set()
+    for leaf in _leaves(params):
+        if isinstance(leaf, QuantizedTensor):
+            return leaf.mode
+        dt = getattr(leaf, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.floating):
+            seen.add(str(dt))
+    for dt in ("float64", "float32", "bfloat16", "float16"):
+        if dt in seen:
+            return dt
+    return "float32"
+
+
+def _params_of(model):
+    if hasattr(model, "params") and not callable(model.params):
+        return model.params
+    if hasattr(model, "_params"):
+        return model._params
+    return model if isinstance(model, (dict, list)) else None
+
+
+def precision_of_model(model) -> str:
+    p = _params_of(model)
+    return precision_of(p) if p is not None else "float32"
+
+
+def param_bytes(params) -> int:
+    """At-rest parameter bytes (quantized leaves count q + scale)."""
+    total = 0
+    for leaf in _leaves(params):
+        if isinstance(leaf, QuantizedTensor):
+            total += leaf.nbytes
+        elif hasattr(leaf, "nbytes"):  # covers bf16, which numpy can't name
+            total += int(leaf.nbytes)
+        elif hasattr(leaf, "dtype") and hasattr(leaf, "size"):
+            total += int(np.dtype(str(leaf.dtype)).itemsize) * int(leaf.size)
+    return total
+
+
+def param_bytes_of(model) -> int:
+    p = _params_of(model)
+    return param_bytes(p) if p is not None else 0

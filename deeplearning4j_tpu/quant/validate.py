@@ -1,0 +1,135 @@
+"""The max-divergence gate between warmup and cutover.
+
+A quantized twin that compiles and warms is not yet safe to serve: a
+mis-scaled spec produces confidently wrong logits at full speed. So
+``ModelRegistry.deploy(quantize=...)`` runs this gate AFTER the incoming
+engine warms and BEFORE the pointer swap — the full-precision and
+quantized models both run the calibration batch eagerly, and the twin
+must stay within the divergence budget:
+
+- ``max_abs_err``  — worst logit absolute error <= ``max_divergence``
+  (``DL4J_TPU_QUANT_MAX_DIVERGENCE``);
+- ``top1_agreement`` — argmax agreement >= ``min_top1``
+  (``DL4J_TPU_QUANT_MIN_TOP1``); for generative models this is the
+  next-token agreement at the last position, and ``per_token_agreement``
+  (argmax at every position) is additionally gated — the quantity that
+  actually predicts greedy-decode drift.
+
+Failure raises :class:`QuantizationRejectedError`; deploy aborts, the
+incoming engine closes, and the full-precision version never stops
+serving. The measured divergence is exported either way on the
+``dl4j_quant_divergence{model,version}`` gauge, so dashboards see how
+close passing deploys run to the budget.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..common.environment import environment
+from ..common.metrics import registry as metrics_registry
+
+log = logging.getLogger(__name__)
+
+
+class QuantizationRejectedError(RuntimeError):
+    """The quantized twin diverged past the gate budget; the swap was
+    aborted with the full-precision version still live."""
+
+
+_GAUGE = None
+
+
+def _divergence_gauge():
+    global _GAUGE
+    if _GAUGE is None:
+        _GAUGE = metrics_registry().gauge(
+            "dl4j_quant_divergence",
+            "Max logit abs error of the last gated quantized deploy",
+            labels=("model", "version"))
+    return _GAUGE
+
+
+def _logits_of(model, batch) -> np.ndarray:
+    """Eager forward of either model family over the gate batch, as a f32
+    numpy array: ``[B, T, V]`` for generative models (full-sequence
+    forward), ``[B, n_out]`` for predict models."""
+    import jax.numpy as jnp
+
+    if all(callable(getattr(model, m, None))
+           for m in ("init_kv_cache", "forward")):
+        out = model.forward(jnp.asarray(np.asarray(batch)))
+    else:
+        out = model.output(batch)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+    if hasattr(out, "jax"):
+        out = out.jax()
+    return np.asarray(out, dtype=np.float32)
+
+
+def divergence_report(full_model, quant_model, batch) -> Dict[str, float]:
+    """Compare the two models on ``batch``. Keys: ``max_abs_err``,
+    ``mean_abs_err``, ``top1_agreement``, ``generative``, and (generative
+    only) ``per_token_agreement``."""
+    a = _logits_of(full_model, batch)
+    b = _logits_of(quant_model, batch)
+    if a.shape != b.shape:
+        raise ValueError(
+            f"model outputs disagree in shape: full {a.shape} vs "
+            f"quantized {b.shape} — not the same model family")
+    err = np.abs(a - b)
+    generative = a.ndim >= 3
+    rep = {
+        "max_abs_err": float(np.max(err)) if err.size else 0.0,
+        "mean_abs_err": float(np.mean(err)) if err.size else 0.0,
+        "generative": generative,
+    }
+    am, bm = np.argmax(a, axis=-1), np.argmax(b, axis=-1)
+    if generative:
+        rep["per_token_agreement"] = float(np.mean(am == bm))
+        rep["top1_agreement"] = float(np.mean(am[..., -1] == bm[..., -1]))
+    else:
+        rep["top1_agreement"] = float(np.mean(am == bm))
+    return rep
+
+
+def validate(full_model, quant_model, batch, *,
+             max_divergence: Optional[float] = None,
+             min_top1: Optional[float] = None,
+             model_name: str = "", version: str = "") -> Dict[str, float]:
+    """Run the gate; returns the divergence report on success, raises
+    :class:`QuantizationRejectedError` past budget. Env defaults:
+    ``DL4J_TPU_QUANT_MAX_DIVERGENCE`` / ``DL4J_TPU_QUANT_MIN_TOP1``."""
+    env = environment()
+    if max_divergence is None:
+        max_divergence = env.quant_max_divergence()
+    if min_top1 is None:
+        min_top1 = env.quant_min_top1()
+    rep = divergence_report(full_model, quant_model, batch)
+    _divergence_gauge().labels(
+        model=model_name or "unnamed",
+        version=version or "unversioned").set(rep["max_abs_err"])
+    failures = []
+    if rep["max_abs_err"] > max_divergence:
+        failures.append(
+            f"max logit abs error {rep['max_abs_err']:.4g} > budget "
+            f"{max_divergence:.4g}")
+    if rep["top1_agreement"] < min_top1:
+        failures.append(
+            f"top-1 agreement {rep['top1_agreement']:.4f} < required "
+            f"{min_top1:.4f}")
+    if rep.get("per_token_agreement", 1.0) < min_top1:
+        failures.append(
+            f"per-token agreement {rep['per_token_agreement']:.4f} < "
+            f"required {min_top1:.4f}")
+    if failures:
+        raise QuantizationRejectedError(
+            "quantized model rejected by the divergence gate ("
+            + "; ".join(failures) + ") — full-precision version stays live")
+    log.info("quantization gate passed for %s:%s (max_abs_err=%.4g, "
+             "top1=%.4f)", model_name, version, rep["max_abs_err"],
+             rep["top1_agreement"])
+    return rep

@@ -253,9 +253,16 @@ class DecodeEngine:
         # in place (on backends that honor donation) — these entries are
         # deliberately ineligible for the raw executable store and show up
         # as cache=bypass on dl4j_compile_seconds (see compile_cache docs)
-        self._prefill = counted_jit(prefill_fn, "prefill",
+        # a quantized twin (quant/transforms.quantize_model) carries
+        # _precision — suffix the tag so its executables never collide with
+        # the full-precision model's in the persistent store (the first tag
+        # segment stays "prefill"/"decode": it is the kind metric label)
+        prec = getattr(model, "_precision", None)
+        suffix = f":{prec}" if prec else ""
+        self._prefill = counted_jit(prefill_fn, "prefill" + suffix,
                                     donate_argnums=(1,))
-        self._decode = counted_jit(decode_fn, "decode", donate_argnums=(1,))
+        self._decode = counted_jit(decode_fn, "decode" + suffix,
+                                   donate_argnums=(1,))
 
     def _run_prefill(self, ids, slot, length, temperature, top_k):
         if faults.active():

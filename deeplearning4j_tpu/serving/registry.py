@@ -39,6 +39,10 @@ from ..common.environment import environment
 from ..common.locks import ordered_rlock
 from ..common.metrics import registry as metrics_registry
 from ..common.tracing import span
+from ..quant.calibrate import QuantSpec, calibrate as quant_calibrate
+from ..quant.transforms import (param_bytes_of, precision_of_model,
+                                quantize_model)
+from ..quant.validate import validate as quant_validate
 from ..runtime import compile_cache
 from ..runtime.generation import DecodeEngine, is_generative_model
 from ..runtime.inference import EngineClosedError, InferenceEngine
@@ -60,7 +64,8 @@ def _safe_name(name: str) -> str:
 class ModelVersion:
     """One deployed (name, version) pair and its serving engine."""
 
-    __slots__ = ("name", "version", "engine", "state", "deployed_at")
+    __slots__ = ("name", "version", "engine", "state", "deployed_at",
+                 "precision", "param_bytes", "divergence")
 
     def __init__(self, name: str, version: str, engine: InferenceEngine):
         self.name = name
@@ -68,13 +73,24 @@ class ModelVersion:
         self.engine = engine
         self.state = WARMING
         self.deployed_at = time.time()
+        #: serving precision ("float32"/"bfloat16"/"int8"/"fp8") and param
+        #: footprint, filled by deploy() for every version (quantized or
+        #: not); divergence is the gate report of a quantized deploy
+        self.precision: Optional[str] = None
+        self.param_bytes: Optional[int] = None
+        self.divergence: Optional[Dict[str, float]] = None
 
     def describe(self) -> Dict[str, Any]:
-        return {"version": self.version, "state": self.state,
-                "deployed_at": self.deployed_at,
-                "buckets": list(self.engine.ladder),
-                "max_batch": self.engine.max_batch,
-                "generative": isinstance(self.engine, DecodeEngine)}
+        d = {"version": self.version, "state": self.state,
+             "deployed_at": self.deployed_at,
+             "buckets": list(self.engine.ladder),
+             "max_batch": self.engine.max_batch,
+             "generative": isinstance(self.engine, DecodeEngine),
+             "precision": self.precision,
+             "param_bytes": self.param_bytes}
+        if self.divergence is not None:
+            d["quant_divergence"] = self.divergence
+        return d
 
 
 class ModelRegistry:
@@ -111,6 +127,14 @@ class ModelRegistry:
             "dl4j_auto_rollbacks_total",
             "Rollbacks triggered by a persistently open circuit breaker",
             labels=("model",))
+        self._m_model_bytes = reg.gauge(
+            "dl4j_model_bytes",
+            "Parameter bytes at rest of a deployed model version",
+            labels=("model", "version"))
+        self._m_quant_deploys = reg.counter(
+            "dl4j_quant_deploys_total",
+            "Quantized deploys that passed the divergence gate",
+            labels=("model", "mode"))
 
     # -- manifests --------------------------------------------------------
     def manifest_path(self, name: str) -> Optional[str]:
@@ -152,7 +176,11 @@ class ModelRegistry:
                decode_slots: Optional[int] = None,
                decode_max_ctx: Optional[int] = None,
                decode_prompt_buckets: Optional[Sequence[int]] = None,
-               decode_eos_token: Optional[int] = None) -> ModelVersion:
+               decode_eos_token: Optional[int] = None,
+               quantize=None,
+               calibration_batch=None,
+               quant_max_divergence: Optional[float] = None,
+               quant_min_top1: Optional[float] = None) -> ModelVersion:
         """Deploy ``model`` as ``name``:``version`` with warm-before-
         cutover; returns the new (current) ModelVersion.
 
@@ -172,7 +200,19 @@ class ModelRegistry:
         ``decode_*`` knobs size its slot count, context window, prompt
         bucket ladder, and default EOS (env defaults otherwise). Warmup
         compiles one prefill executable per prompt bucket plus the single
-        decode-step executable."""
+        decode-step executable.
+
+        ``quantize`` opts this deploy into post-training quantization
+        (quant/): ``True``/``"int8"``/``"fp8"`` pick the storage mode, a
+        :class:`~deeplearning4j_tpu.quant.QuantSpec` is used as-is,
+        ``None`` defers to ``DL4J_TPU_QUANT`` (off by default), ``False``
+        forces full precision. A quantized deploy REQUIRES a gate batch —
+        ``calibration_batch`` or ``example`` — and runs the max-divergence
+        gate (quant/validate.py) between warmup and cutover:
+        ``QuantizationRejectedError`` aborts the swap with the incoming
+        engine closed and the full-precision current version still live.
+        ``quant_max_divergence``/``quant_min_top1`` override the env
+        budgets for this deploy only."""
         name, version = str(name), str(version)
         with self._lock:
             if self._draining:
@@ -184,6 +224,29 @@ class ModelRegistry:
                         "deployed (versions are immutable; bump the "
                         "version)")
             outgoing = self._current.get(name)
+        # -- optional PTQ: quantize BEFORE the engine is built, fail closed
+        # on a missing gate batch (nothing allocated yet)
+        full_model, spec, mode = model, None, quantize
+        if isinstance(mode, QuantSpec):
+            spec, mode = mode, mode.mode
+        if mode is None:
+            mode = environment().quant_mode() or None
+        if mode is True:
+            mode = "int8"
+        elif mode is False or mode == "":
+            mode = None
+        gate_batch = (calibration_batch if calibration_batch is not None
+                      else example)
+        if mode:
+            if gate_batch is None:
+                raise ValueError(
+                    f"deploy of '{name}:{version}' with quantize={mode!r} "
+                    "needs a calibration_batch (or example) to run the "
+                    "divergence gate — refusing to serve an unvalidated "
+                    "quantized model")
+            if spec is None:
+                spec = quant_calibrate(full_model, gate_batch, mode=mode)
+            model = quantize_model(full_model, spec)
         if is_generative_model(model):
             engine = DecodeEngine(model, slots=decode_slots,
                                   max_ctx=decode_max_ctx,
@@ -196,9 +259,31 @@ class ModelRegistry:
                                      outputs=outputs,
                                      manifest_path=self.manifest_path(name))
         mv = ModelVersion(name, version, engine)
+        mv.precision = precision_of_model(model)
+        mv.param_bytes = param_bytes_of(model)
         if warm:
-            self._warm_engine(engine, outgoing, example, batch_sizes)
+            try:
+                self._warm_engine(engine, outgoing, example, batch_sizes)
+            except BaseException:
+                # a deploy that dies mid-warmup must not leak the incoming
+                # engine's worker thread / decode slots — it never became
+                # current, so nobody else will ever close it
+                engine.close(0.0)
+                raise
             mv.state = READY
+        if mode:
+            # the divergence gate runs AFTER warmup and BEFORE cutover: a
+            # rejected twin aborts the swap (engine closed, nothing
+            # registered) with the full-precision current version live
+            try:
+                mv.divergence = quant_validate(
+                    full_model, model, gate_batch,
+                    max_divergence=quant_max_divergence,
+                    min_top1=quant_min_top1,
+                    model_name=name, version=version)
+            except BaseException:
+                engine.close(0.0)
+                raise
         # atomic cutover: one pointer swap under the lock
         with self._lock:
             if self._draining:
@@ -207,6 +292,11 @@ class ModelRegistry:
             self._versions.setdefault(name, []).append(mv)
             self._current[name] = mv
         self._m_deploys.labels(model=name).inc()
+        if mv.param_bytes is not None:
+            self._m_model_bytes.labels(
+                model=name, version=version).set(mv.param_bytes)
+        if mode:
+            self._m_quant_deploys.labels(model=name, mode=mode).inc()
         self._watch(mv)
         # the outgoing engine finishes its in-flight work, then parks
         if outgoing is not None:

@@ -234,7 +234,8 @@ class ModelServer:
                         timeout_s: Optional[float],
                         kind: str = "predict",
                         latency_s: Optional[float] = None,
-                        disposition: Optional[str] = None):
+                        disposition: Optional[str] = None,
+                        precision: Optional[str] = None):
         """Ring + SLO bookkeeping for one completed request, whatever its
         outcome (the ring is the /debug/requests + flight-recorder
         source). ``latency_s`` overrides the SLO-fed latency — generate
@@ -254,6 +255,7 @@ class ModelServer:
             "kind": kind, "status": status,
             "outcome": _OUTCOMES.get(status, str(status)),
             "disposition": disposition,
+            "precision": precision,
             "ts": time.time(), "duration_s": round(duration_s, 6),
             "timeout_s": timeout_s})
         if status in _SLO_STATUSES:
@@ -418,6 +420,7 @@ class ModelServer:
                 self._timeout_s = None
                 self._latency_s = None
                 self._disposition = None
+                self._precision = None
                 if server.draining:
                     self.send_json(
                         {"error": "server is draining"}, 503,
@@ -435,7 +438,8 @@ class ModelServer:
                         self._last_status, time.perf_counter() - t0,
                         self._timeout_s, kind=kind,
                         latency_s=self._latency_s,
-                        disposition=self._disposition)
+                        disposition=self._disposition,
+                        precision=self._precision)
 
             def _dispatch_request(self, kind: str, name: str,
                                   version: Optional[str]):
@@ -524,6 +528,7 @@ class ModelServer:
                 # resolve first so unknown models 404 before admission
                 mv = server.registry.get(name, version)
                 self._served_version = mv.version
+                self._precision = mv.precision
                 ctrl = server.admission_for(name)
                 with ctrl.admit(timeout_s if timeout_s is not None
                                 else "default",
@@ -533,6 +538,7 @@ class ModelServer:
                         timeout_s=permit.remaining_s())
                     mv = server.registry.get(name, version)
                     self._served_version = mv.version
+                    self._precision = mv.precision
                 if as_npy:
                     first = out
                     if isinstance(out, dict):
@@ -580,6 +586,7 @@ class ModelServer:
                 # resolve first so unknown models 404 before admission
                 mv = server.registry.get(name, version)
                 self._served_version = mv.version
+                self._precision = mv.precision
                 ctrl = server.admission_for(name)
                 with ctrl.admit(timeout_s if timeout_s is not None
                                 else "default",
@@ -593,6 +600,7 @@ class ModelServer:
                         timeout_s=permit.remaining_s(), **opts)
                 mv = server.registry.get(name, version)
                 self._served_version = mv.version
+                self._precision = mv.precision
                 self._latency_s = res.get("ttft_s")
                 self.send_json({"model": name, "version": mv.version,
                                 **res})

@@ -498,6 +498,102 @@ class TestCheckGenerativeDecode:
         assert rec["gate_ok"], rec["gate_reason"]
 
 
+def _qi_record(speedup=1.8, top1=1.0, bytes_ratio=0.26, rejected=True,
+               status=200, served="v1", current="v1"):
+    return {
+        "top1_agreement": top1,
+        "max_abs_err": 0.0003,
+        "param_bytes_full": 1000000,
+        "param_bytes_quant": int(1000000 * bytes_ratio),
+        "bytes_ratio": bytes_ratio,
+        "f32_sps": 9000.0,
+        "bf16_sps": 4000.0,
+        "quantized_sps": 4000.0 * speedup,
+        "quant_speedup_vs_bf16": speedup,
+        "misscale_rejected": rejected,
+        "post_reject_predict_status": status,
+        "post_reject_served_version": served,
+        "current_version": current,
+    }
+
+
+class TestCheckQuantizedInference:
+    """Gate logic for the quantized_inference metric: the int8 twin must
+    be >= 1.2x the bf16 baseline and >= 99% top-1-consistent with f32,
+    and the mis-scaled-spec drill must end with the gate rejecting the
+    deploy and the full-precision version still serving."""
+
+    def test_accepts_good_record(self):
+        ok, reason = bench.check_quantized_inference(_qi_record())
+        assert ok, reason
+
+    def test_rejects_insufficient_speedup(self):
+        ok, reason = bench.check_quantized_inference(
+            _qi_record(speedup=1.1))
+        assert not ok
+        assert "bf16 baseline" in reason
+
+    def test_boundary_at_1_2x(self):
+        ok, _ = bench.check_quantized_inference(_qi_record(speedup=1.21))
+        assert ok
+        ok, _ = bench.check_quantized_inference(_qi_record(speedup=1.19))
+        assert not ok
+
+    def test_rejects_low_top1_agreement(self):
+        ok, reason = bench.check_quantized_inference(
+            _qi_record(top1=0.95))
+        assert not ok
+        assert "top-1" in reason
+
+    def test_rejects_unshrunk_params(self):
+        # a "quantized" twin that is still f32-sized never stored int8
+        ok, reason = bench.check_quantized_inference(
+            _qi_record(bytes_ratio=1.0))
+        assert not ok
+        assert "at rest" in reason
+
+    def test_rejects_unguarded_misscale_deploy(self):
+        ok, reason = bench.check_quantized_inference(
+            _qi_record(rejected=False))
+        assert not ok
+        assert "gate" in reason
+
+    def test_rejects_disturbed_live_version(self):
+        # the aborted swap must leave v1 current and answering
+        ok, reason = bench.check_quantized_inference(
+            _qi_record(status=503))
+        assert not ok
+        assert "aborted swap" in reason
+        ok, _ = bench.check_quantized_inference(_qi_record(current="v2"))
+        assert not ok
+
+    def test_custom_thresholds(self):
+        rec = _qi_record(speedup=1.1, top1=0.97)
+        ok, _ = bench.check_quantized_inference(rec, min_speedup=1.05,
+                                                min_top1=0.95)
+        assert ok
+
+    def test_tiny_live_measurement_passes_gate(self):
+        """The full metric end-to-end on CPU. The deterministic legs ARE
+        asserted in CI (top-1 agreement on the margin-filtered batch,
+        int8-at-rest byte shrink, the mis-scale rejection with v1 still
+        answering /predict); the 1.2x throughput gate has wide margin at
+        the tiny sizing (measured ~1.8x: XLA:CPU emulates bf16, the twin
+        computes in f32 with folded dequant)."""
+        import jax
+        import jax.numpy as jnp
+
+        rec = bench.bench_quantized_inference(jax, jnp, tiny=True)
+        assert rec["top1_agreement"] >= 0.99
+        assert rec["bytes_ratio"] < 0.6
+        assert rec["misscale_rejected"]
+        assert rec["post_reject_predict_status"] == 200
+        assert rec["post_reject_served_version"] == "v1"
+        assert rec["current_version"] == "v1"
+        assert rec["current_precision"] == "float32"
+        assert "gate_ok" in rec and "gate_reason" in rec
+
+
 def _cs_record(cold_ttfi=0.5, warm_ttfi=0.1, warm_hits=4):
     return {
         "cold": {"ttfi_s": cold_ttfi, "warmup_s": 1.0, "cache_hits": 0},

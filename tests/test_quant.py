@@ -1,0 +1,513 @@
+"""Post-training quantization subsystem (quant/) + its serving thread.
+
+Covers the acceptance contract of the quantized-serving PR: the
+params->params transforms (per-channel symmetric int8, per-row embedding
+scales, fp8 gating), QuantSpec calibration + byte-identical serde, the
+max-divergence gate between warmup and cutover (a mis-scaled spec must
+abort the swap with the full-precision version still live, end-to-end
+over HTTP), deploy metadata (precision + param-bytes in /v1/models and
+the /debug/requests ring), the env knobs, and the warm-failure
+no-leak satellite (a deploy that dies mid-warmup must close the incoming
+engine instead of leaking its worker thread).
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.environment import environment
+from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.quant import (QuantizationRejectedError, QuantSpec,
+                                      QuantizedTensor, calibrate,
+                                      dequant_matmul, dequantize,
+                                      divergence_report, param_bytes_of,
+                                      precision_of, precision_of_model,
+                                      quantize_model, quantize_params,
+                                      quantize_tensor, take_rows,
+                                      tied_logits, validate)
+from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+
+N_IN, N_OUT = 16, 4
+
+
+def _mlp(seed=0, hidden=32):
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=N_IN, n_out=hidden, activation="gelu"))
+            .layer(OutputLayer(n_in=hidden, n_out=N_OUT))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _x(n=8, seed=0):
+    return np.random.RandomState(seed).randn(n, N_IN).astype(np.float32)
+
+
+def _decisive_batch(model, n=16, seed=0):
+    """Calibration inputs whose f32 top-2 logit margin is largest, so
+    top-1 agreement measures quantization error, not coin flips."""
+    cands = np.random.RandomState(seed).randn(4 * n, N_IN) \
+        .astype(np.float32)
+    logits = np.asarray(model.output(cands).jax())
+    part = np.partition(logits, -2, axis=-1)
+    margin = part[:, -1] - part[:, -2]
+    return cands[np.argsort(margin)[-n:]]
+
+
+def _get(url, timeout=10):
+    try:
+        r = urllib.request.urlopen(url, timeout=timeout)
+        return r.status, r.headers, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, e.read()
+
+
+def _post(url, data, content_type="application/json", timeout=30):
+    req = urllib.request.Request(url, data=data,
+                                 headers={"Content-Type": content_type})
+    try:
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, r.headers, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, e.read()
+
+
+# ---------------------------------------------------------------------------
+# tensor-level transforms
+# ---------------------------------------------------------------------------
+
+class TestQuantizeTensor:
+    def test_per_channel_scales_and_error_bound(self):
+        w = np.random.RandomState(0).randn(64, 32).astype(np.float32)
+        qt = quantize_tensor(jnp.asarray(w))
+        assert qt.q.dtype == jnp.int8
+        assert qt.scale.shape == (1, 32)  # one scale per output channel
+        deq = np.asarray(dequantize(qt))
+        # symmetric rounding: per-element error <= scale/2 per channel
+        bound = np.asarray(qt.scale)[0] / 2 + 1e-7
+        assert (np.abs(deq - w) <= bound[None, :]).all()
+
+    def test_embedding_axes_give_per_row_scales(self):
+        w = np.random.RandomState(1).randn(100, 16).astype(np.float32)
+        qt = quantize_tensor(jnp.asarray(w), axes=(1,))
+        assert qt.scale.shape == (100, 1)
+        rows = np.asarray(take_rows(qt, jnp.asarray([3, 7])))
+        ref = np.asarray(take_rows(jnp.asarray(w), jnp.asarray([3, 7])))
+        assert np.abs(rows - ref).max() < float(np.abs(w).max()) / 100
+
+    def test_dequant_matmul_matches_reference(self):
+        rng = np.random.RandomState(2)
+        w = rng.randn(32, 8).astype(np.float32)
+        x = rng.randn(4, 32).astype(np.float32)
+        ref = x @ w
+        out = np.asarray(dequant_matmul(jnp.asarray(x),
+                                        quantize_tensor(jnp.asarray(w))))
+        assert np.abs(ref - out).max() < 0.05 * np.abs(ref).max() + 0.05
+
+    def test_tied_logits_fold_row_scales(self):
+        rng = np.random.RandomState(3)
+        w = rng.randn(50, 16).astype(np.float32)  # [V, E] table
+        h = rng.randn(2, 5, 16).astype(np.float32)
+        ref = np.asarray(tied_logits(jnp.asarray(h), jnp.asarray(w)))
+        qt = quantize_tensor(jnp.asarray(w), axes=(1,))
+        out = np.asarray(tied_logits(jnp.asarray(h), qt))
+        assert out.dtype == np.float32
+        assert np.abs(ref - out).max() < 0.05 * np.abs(ref).max() + 0.05
+
+    def test_astype_is_a_noop_guarding_mixed_precision_casts(self):
+        # the fastpath param-casting helpers call astype on every leaf;
+        # quantized storage must pass through uncorrupted
+        qt = quantize_tensor(jnp.ones((4, 4)))
+        assert qt.astype(jnp.bfloat16) is qt
+
+    def test_pytree_roundtrip_through_jit(self):
+        qt = quantize_tensor(jnp.asarray(
+            np.random.RandomState(4).randn(16, 8).astype(np.float32)))
+        out = jax.jit(lambda p, x: dequant_matmul(x, p))(
+            qt, jnp.ones((2, 16)))
+        assert out.shape == (2, 8)
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="mode"):
+            quantize_tensor(jnp.ones((4, 4)), mode="int4")
+
+
+class TestQuantizeParams:
+    def test_eligibility_rules(self):
+        params = {
+            "W": jnp.ones((32, 32)),            # eligible
+            "b": jnp.ones((32,)),               # 1-D: skipped
+            "small": jnp.ones((2, 2)),          # < min_size: skipped
+            "state_mean": jnp.ones((32, 32)),   # running stat: skipped
+            "position": jnp.ones((32, 32)),     # skip_keys: skipped
+            "ints": jnp.ones((32, 32), jnp.int32),  # not floating
+        }
+        q = quantize_params(params)
+        assert isinstance(q["W"], QuantizedTensor)
+        for k in ("b", "small", "state_mean", "position", "ints"):
+            assert not isinstance(q[k], QuantizedTensor), k
+
+    def test_scale_override_mis_scales_matching_paths(self):
+        params = {"layer": {"W": jnp.ones((32, 32))}}
+        good = quantize_params(params)
+        bad = quantize_params(
+            params, QuantSpec(scale_overrides={"layer.W": 8.0}))
+        ratio = np.asarray(bad["layer"]["W"].scale) \
+            / np.asarray(good["layer"]["W"].scale)
+        assert ratio == pytest.approx(8.0)
+
+    def test_precision_and_bytes(self):
+        params = {"W": jnp.ones((32, 32)), "b": jnp.ones((32,))}
+        assert precision_of(params) == "float32"
+        q = quantize_params(params)
+        assert precision_of(q) == "int8"
+        # int8 payload + f32 scales + the f32 bias < the f32 original
+        full = 32 * 32 * 4 + 32 * 4
+        quant = 32 * 32 * 1 + 32 * 4 + 32 * 4
+        assert param_bytes_of(q) == quant < full == param_bytes_of(params)
+
+
+# ---------------------------------------------------------------------------
+# QuantSpec serde + calibration
+# ---------------------------------------------------------------------------
+
+class TestQuantSpec:
+    def test_serde_roundtrip_is_identity(self):
+        spec = QuantSpec(mode="int8", act_dtype="float32",
+                         method="percentile", percentile=99.0,
+                         act_ranges={"layer0": 1.5},
+                         batch_fingerprint="float32[8, 16]",
+                         scale_overrides={"W": 2.0})
+        assert QuantSpec.from_json(spec.to_json()) == spec
+        # and byte-identical on a second trip (sorted keys)
+        assert QuantSpec.from_json(spec.to_json()).to_json() \
+            == spec.to_json()
+
+    def test_from_json_ignores_unknown_fields(self):
+        s = QuantSpec.from_json(
+            '{"mode": "int8", "future_knob": true}')
+        assert s.mode == "int8"
+
+    def test_invalid_mode_and_method_rejected(self):
+        with pytest.raises(ValueError, match="mode"):
+            QuantSpec(mode="int3")
+        with pytest.raises(ValueError, match="method"):
+            QuantSpec(method="minmax")
+
+    def test_calibrate_records_layer_ranges_and_fingerprint(self):
+        m = _mlp()
+        xb = _x()
+        spec = calibrate(m, xb, method="percentile", percentile=99.0)
+        assert spec.batch_fingerprint == "float32[8, 16]"
+        assert spec.act_ranges  # one range per observed layer site
+        assert all(v > 0 for v in spec.act_ranges.values())
+        assert QuantSpec.from_json(spec.to_json()) == spec
+
+
+# ---------------------------------------------------------------------------
+# model-level twins
+# ---------------------------------------------------------------------------
+
+class TestQuantizedMLN:
+    def test_twin_is_close_small_and_int8_at_rest(self):
+        m = _mlp()
+        xb = _x(16)
+        full = np.asarray(m.output(xb).jax())
+        qm = quantize_model(m)
+        q_out = np.asarray(qm.output(xb).jax())
+        assert np.abs(full - q_out).max() < 0.05
+        assert precision_of_model(qm) == "int8"
+        assert precision_of_model(m) == "float32"
+        assert param_bytes_of(qm) < 0.6 * param_bytes_of(m)
+        # weights stayed quantized at rest through the jitted forward
+        assert isinstance(qm._params[0]["W"], QuantizedTensor)
+
+    def test_twin_does_not_mutate_the_original(self):
+        m = _mlp()
+        quantize_model(m)
+        assert precision_of_model(m) == "float32"
+        assert getattr(m.conf, "dtype", "float32") in ("float32", None)
+
+    def test_decisive_batch_agrees_at_99pct(self):
+        m = _mlp()
+        batch = _decisive_batch(m, n=32)
+        qm = quantize_model(m)
+        rep = divergence_report(m, qm, batch)
+        assert rep["top1_agreement"] >= 0.99
+
+
+class TestQuantizedCausalLM:
+    def test_twin_generates_and_agrees_per_token(self):
+        from deeplearning4j_tpu.models.causal_lm import (CausalLM,
+                                                         CausalLMConfig)
+        from deeplearning4j_tpu.runtime.generation import DecodeEngine
+
+        cfg = CausalLMConfig.tiny()
+        m = CausalLM(cfg, seed=0)
+        ids = np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (2, 12)).astype(np.int32)
+        qm = quantize_model(m)
+        rep = divergence_report(m, qm, ids)
+        assert rep["generative"]
+        assert rep["per_token_agreement"] >= 0.99
+        assert qm._precision == "int8"
+        eng = DecodeEngine(qm, slots=2, max_ctx=32)
+        try:
+            eng.warmup()
+            res = eng.generate([1, 2, 3], max_tokens=4,
+                               temperature=0.0).result()
+            assert len(res["tokens"]) == 4
+        finally:
+            eng.close(5.0)
+
+
+# ---------------------------------------------------------------------------
+# the divergence gate + env knobs
+# ---------------------------------------------------------------------------
+
+class TestValidateGate:
+    def test_good_twin_passes_and_reports(self):
+        m = _mlp()
+        batch = _decisive_batch(m)
+        rep = validate(m, quantize_model(m), batch, min_top1=0.9)
+        assert rep["max_abs_err"] < 0.25
+
+    def test_mis_scaled_twin_rejected(self):
+        m = _mlp()
+        batch = _decisive_batch(m)
+        bad = quantize_model(m, QuantSpec(scale_overrides={"": 64.0}))
+        with pytest.raises(QuantizationRejectedError,
+                           match="full-precision version stays live"):
+            validate(m, bad, batch)
+
+    def test_budget_overrides(self):
+        m = _mlp()
+        batch = _decisive_batch(m)
+        qm = quantize_model(m)
+        with pytest.raises(QuantizationRejectedError, match="budget"):
+            validate(m, qm, batch, max_divergence=0.0, min_top1=0.0)
+
+    def test_env_knobs(self):
+        env = environment()
+        prev = (env.quant_mode(), env.quant_max_divergence(),
+                env.quant_min_top1())
+        try:
+            assert env.quant_mode() == ""          # opt-in: off by default
+            assert env.quant_max_divergence() == pytest.approx(0.25)
+            assert env.quant_min_top1() == pytest.approx(0.99)
+            env.set_quant_mode("1")
+            assert env.quant_mode() == "int8"      # truthy -> default mode
+            env.set_quant_mode("fp8")
+            assert env.quant_mode() == "fp8"
+            env.set_quant_mode("off")
+            assert env.quant_mode() == ""
+            env.set_quant_min_top1(2.0)
+            assert env.quant_min_top1() == 1.0     # clamped to [0, 1]
+        finally:
+            env.set_quant_mode(prev[0])
+            env.set_quant_max_divergence(prev[1])
+            env.set_quant_min_top1(prev[2])
+
+
+# ---------------------------------------------------------------------------
+# registry deploy thread
+# ---------------------------------------------------------------------------
+
+class TestRegistryQuantizedDeploy:
+    def test_deploy_metadata_and_quantized_serving(self):
+        reg = ModelRegistry(manifest_dir=None)
+        try:
+            m = _mlp()
+            batch = _decisive_batch(m)
+            mv1 = reg.deploy("q", "v1", m, example=batch)
+            assert mv1.precision == "float32"
+            assert mv1.param_bytes and mv1.param_bytes > 0
+            mv2 = reg.deploy("q", "v2", _mlp(), example=batch,
+                             quantize=True)
+            assert mv2.precision == "int8"
+            assert mv2.param_bytes < mv1.param_bytes
+            assert mv2.divergence["top1_agreement"] >= 0.99
+            d = reg.models()["q"]["versions"][1]
+            assert d["precision"] == "int8"
+            assert d["param_bytes"] == mv2.param_bytes
+            assert d["quant_divergence"]["max_abs_err"] >= 0
+            out = reg.predict("q", batch[:4])
+            assert np.asarray(out.jax()).shape == (4, N_OUT)
+            # rollback works unchanged on/around the quantized twin
+            assert reg.rollback("q").version == "v1"
+            assert reg.get("q").precision == "float32"
+        finally:
+            reg.drain_all(5.0)
+
+    def test_quantize_requires_gate_batch_fail_closed(self):
+        reg = ModelRegistry(manifest_dir=None)
+        try:
+            with pytest.raises(ValueError, match="calibration_batch"):
+                reg.deploy("q", "v1", _mlp(), quantize="int8")
+            with pytest.raises(KeyError):
+                reg.get("q")  # nothing half-deployed
+        finally:
+            reg.drain_all(5.0)
+
+    def test_mis_scaled_spec_aborts_swap_leaving_v1_live(self):
+        reg = ModelRegistry(manifest_dir=None)
+        try:
+            m = _mlp()
+            batch = _decisive_batch(m)
+            reg.deploy("q", "v1", m, example=batch)
+            # prime v1's lazily-started batcher thread so the baseline
+            # thread count is the steady serving state
+            reg.predict("q", batch[:2])
+            before = threading.active_count()
+            with pytest.raises(QuantizationRejectedError):
+                reg.deploy("q", "v2", _mlp(), example=batch,
+                           quantize=QuantSpec(scale_overrides={"": 64.0}))
+            assert reg.get("q").version == "v1"
+            assert [v["version"] for v in reg.models()["q"]["versions"]] \
+                == ["v1"]
+            out = reg.predict("q", batch[:2])
+            assert np.asarray(out.jax()).shape == (2, N_OUT)
+            deadline = time.time() + 5
+            while threading.active_count() > before \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            assert threading.active_count() <= before  # engine closed
+        finally:
+            reg.drain_all(5.0)
+
+    def test_env_knob_opts_deploys_into_quantization(self):
+        env = environment()
+        prev = env.quant_mode()
+        reg = ModelRegistry(manifest_dir=None)
+        try:
+            env.set_quant_mode("int8")
+            m = _mlp()
+            mv = reg.deploy("q", "v1", m, example=_decisive_batch(m))
+            assert mv.precision == "int8"
+            # explicit False overrides the env opt-in
+            mv2 = reg.deploy("q", "v2", _mlp(), example=_x(),
+                             quantize=False)
+            assert mv2.precision == "float32"
+        finally:
+            env.set_quant_mode(prev)
+            reg.drain_all(5.0)
+
+
+class TestWarmFailureDoesNotLeakEngine:
+    def test_failed_warmup_closes_incoming_engine(self):
+        reg = ModelRegistry(manifest_dir=None)
+        try:
+            m = _mlp()
+            reg.deploy("w", "v1", m, example=_x())
+            reg.predict("w", _x(2))  # prime v1's lazily-started batcher
+            before = threading.active_count()
+            # an example whose feature width cannot feed the first matmul
+            # makes warmup raise mid-compile; the incoming engine was
+            # already allocated (worker thread running) at that point
+            bad = np.zeros((4, N_IN + 3), np.float32)
+            with pytest.raises(Exception):
+                reg.deploy("w", "v2", _mlp(), example=bad)
+            assert reg.get("w").version == "v1"
+            assert [v["version"] for v in reg.models()["w"]["versions"]] \
+                == ["v1"]
+            deadline = time.time() + 5
+            while threading.active_count() > before \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            assert threading.active_count() <= before, \
+                "failed warmup leaked the incoming engine's worker thread"
+            out = reg.predict("w", _x(2))
+            assert np.asarray(out.jax()).shape == (2, N_OUT)
+        finally:
+            reg.drain_all(5.0)
+
+
+# ---------------------------------------------------------------------------
+# HTTP end-to-end (satellite: /v1/models + /debug/requests metadata, gate
+# abort observable from the outside)
+# ---------------------------------------------------------------------------
+
+class TestQuantizedServingHTTP:
+    def test_gate_abort_and_metadata_over_http(self):
+        reg = ModelRegistry(manifest_dir=None)
+        server = ModelServer(reg)
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            m = _mlp()
+            batch = _decisive_batch(m)
+            reg.deploy("q", "v1", m, example=batch)
+            with pytest.raises(QuantizationRejectedError):
+                reg.deploy("q", "v2", _mlp(), example=batch,
+                           quantize=QuantSpec(scale_overrides={"": 64.0}))
+
+            # the rejected deploy is invisible: v1 current, f32 metadata
+            st, _, body = _get(base + "/v1/models")
+            assert st == 200
+            doc = json.loads(body)["models"]["q"]
+            assert doc["current"] == "v1"
+            assert [v["version"] for v in doc["versions"]] == ["v1"]
+            assert doc["versions"][0]["precision"] == "float32"
+            assert doc["versions"][0]["param_bytes"] > 0
+
+            # /predict still answers from v1, trace id echoed
+            st, hdrs, body = _post(
+                base + "/v1/models/q/predict",
+                json.dumps({"inputs": batch[:2].tolist()}).encode())
+            assert st == 200
+            assert json.loads(body)["version"] == "v1"
+            trace_id = hdrs["X-Trace-Id"]
+            assert trace_id
+
+            # the request ring carries the served precision
+            st, _, body = _get(
+                base + f"/debug/requests?trace_id={trace_id}")
+            assert st == 200
+            recs = json.loads(body)["requests"]
+            assert len(recs) == 1
+            assert recs[0]["precision"] == "float32"
+            assert recs[0]["version"] == "v1"
+
+            # a PASSING quantized deploy flips the served precision
+            reg.deploy("q", "v3", _mlp(), example=batch, quantize="int8")
+            st, _, body = _get(base + "/v1/models")
+            doc = json.loads(body)["models"]["q"]
+            assert doc["current"] == "v3"
+            v3 = [v for v in doc["versions"] if v["version"] == "v3"][0]
+            assert v3["precision"] == "int8"
+            assert v3["quant_divergence"]["top1_agreement"] >= 0.99
+            st, hdrs, body = _post(
+                base + "/v1/models/q/predict",
+                json.dumps({"inputs": batch[:2].tolist()}).encode())
+            assert st == 200
+            assert json.loads(body)["version"] == "v3"
+            st, _, body = _get(
+                base + f"/debug/requests?trace_id={hdrs['X-Trace-Id']}")
+            assert json.loads(body)["requests"][0]["precision"] == "int8"
+        finally:
+            server.stop()
+            reg.drain_all(5.0)
+
+    def test_divergence_gauge_exported(self):
+        from deeplearning4j_tpu.common.metrics import registry as metrics
+        reg = ModelRegistry(manifest_dir=None)
+        try:
+            m = _mlp()
+            batch = _decisive_batch(m)
+            reg.deploy("g", "v1", m, example=batch, quantize=True)
+            text = metrics().prometheus_text()
+            assert "dl4j_quant_divergence" in text
+            assert 'model="g"' in text
+            assert "dl4j_model_bytes" in text
+            assert "dl4j_quant_deploys_total" in text
+            assert 'mode="int8"' in text
+        finally:
+            reg.drain_all(5.0)
