@@ -919,12 +919,33 @@ def bench_generative_decode(jax, jnp, tiny):
        per token) vs strictly one at a time. p99 TTFT is reported from
        the concurrent run.
 
+    4. **Paged vs slab KV footprint** — the same mixed short/long
+       workload through a paged engine (small blocks) and a slab-layout
+       engine (block_size == max_ctx: one block per slot, the pre-paging
+       reservation policy), sampling reserved KV rows per committed token
+       at every emitted token. Reported as bytes-per-active-token and
+       the paged/slab ratio.
+    5. **Batched prefill** — a burst of mixed-length prompts ingested
+       with same-bucket prompts coalesced into one prefill dispatch
+       (prefill_batch=4) vs one dispatch per prompt (prefill_batch=1):
+       prompt throughput, speedup, and batched p99 TTFT.
+    6. **Speculative decoding** — a 1-layer weight-shared draft proposes
+       k tokens per step, the target verifies them in one pass: greedy
+       output must be token-identical to the engine's own
+       non-speculative run; tokens/sec and draft acceptance rate are
+       reported.
+
     The greedy KV-cached continuation must be token-identical to the
     recompute reference, and the steady-state run must record ZERO new
-    compiles after warmup (one prefill executable per bucket + one decode
-    executable) — both gated by ``check_generative_decode`` alongside the
-    >= 3x KV and >= 1.5x continuous-batching speedups.
+    compiles after warmup (one prefill executable per (bucket, batch
+    rung) + one decode executable) — both gated by
+    ``check_generative_decode`` alongside the >= 3x KV and >= 1.5x
+    continuous-batching speedups, the <= 0.6x paged-vs-slab
+    bytes-per-active-token ratio, the >= 1.3x batched-prefill prompt
+    throughput, and speculative token-identity.
     """
+    import dataclasses
+
     from deeplearning4j_tpu.common.environment import environment
     from deeplearning4j_tpu.models import causal_lm
     from deeplearning4j_tpu.runtime.generation import DecodeEngine
@@ -938,6 +959,12 @@ def bench_generative_decode(jax, jnp, tiny):
         buckets = [16, 64]
         prompts = [4, 24, 8, 40, 12, 32]
         gens = [24, 8, 16, 12, 20, 8]
+        kv_block = 16
+        mix_lens = [16, 128, 16, 16, 128, 16, 16, 128]
+        mix_gens = [16, 24, 12, 16, 16, 12, 16, 24]
+        burst_lens = [14, 60, 9, 44, 16, 52, 12, 30,
+                      7, 61, 15, 40, 11, 58, 13, 33]
+        spec_k, spec_tokens = 3, 32
     else:
         cfg = causal_lm.CausalLMConfig(
             vocab_size=8192, hidden_size=512, num_layers=6, num_heads=8,
@@ -947,6 +974,12 @@ def bench_generative_decode(jax, jnp, tiny):
         buckets = [64, 256, 512]
         prompts = [16, 200, 48, 320, 64, 128, 24, 256]
         gens = [96, 32, 64, 48, 80, 24, 112, 40]
+        kv_block = 32
+        mix_lens = [32, 256, 32, 32, 256, 32, 32, 256]
+        mix_gens = [32, 48, 24, 32, 32, 24, 32, 48]
+        burst_lens = [30, 120, 20, 90, 34, 100, 26, 60,
+                      16, 122, 32, 80, 24, 116, 28, 70]
+        spec_k, spec_tokens = 3, 64
     model = causal_lm.CausalLM(cfg, seed=0)
     env = environment()
     rng = np.random.RandomState(0)
@@ -973,7 +1006,7 @@ def bench_generative_decode(jax, jnp, tiny):
         return toks
 
     engine = DecodeEngine(model, slots=slots, max_ctx=max_ctx,
-                          prompt_buckets=buckets)
+                          prompt_buckets=buckets, kv_block_size=kv_block)
     engine.warmup()
 
     def kv_decode():
@@ -1027,6 +1060,132 @@ def bench_generative_decode(jax, jnp, tiny):
         rec["serial"] = {"tokens_per_sec": round(total / serial_dt, 2)}
         rec["cb_speedup"] = round(serial_dt / cont_dt, 3)
 
+        # -- paged vs slab KV bytes-per-active-token: the same mixed
+        # short/long workload through a small-block pool and a
+        # slab-layout pool (block_size == max_ctx reserves a sequence's
+        # whole context window up front — the pre-paging policy). Reserved
+        # rows and committed tokens are sampled from the on_token
+        # callback, which the decode loop thread calls synchronously, so
+        # the host-side tables are race-free to read.
+        c = cfg
+        row_bytes = (2 * c.num_layers * c.num_heads * c.head_dim
+                     * np.dtype(c.dtype).itemsize)
+        mixed = [(rng.randint(0, c.vocab_size, l).astype(np.int32), g)
+                 for l, g in zip(mix_lens, mix_gens)]
+
+        def kv_bytes_per_token(block_size):
+            eng = DecodeEngine(model, slots=slots, max_ctx=max_ctx,
+                               prompt_buckets=sorted(set(mix_lens)),
+                               kv_block_size=block_size)
+            eng.warmup()
+            acc = {"rows": 0, "tokens": 0, "samples": 0}
+
+            def cb(_tok):
+                acc["rows"] += int(eng._nblocks.sum()) * eng.block_size
+                acc["tokens"] += int(eng._lengths.sum())
+                acc["samples"] += 1
+
+            futs = [eng.generate(p, max_tokens=g, eos_token=None,
+                                 on_token=cb) for p, g in mixed]
+            for f in futs:
+                f.result()
+            eng.close(10.0)
+            return (acc["rows"] / max(acc["tokens"], 1)) * row_bytes
+
+        paged_bpt = kv_bytes_per_token(kv_block)
+        slab_bpt = kv_bytes_per_token(max_ctx)
+        rec["paged_kv"] = {
+            "block_size": kv_block,
+            "paged_bytes_per_token": round(paged_bpt, 1),
+            "slab_bytes_per_token": round(slab_bpt, 1),
+            "bytes_ratio": round(paged_bpt / slab_bpt, 4),
+        }
+
+        # -- batched prefill: burst of mixed-length prompts, coalesced
+        # same-bucket prefill dispatches vs one dispatch per prompt
+        # (max_tokens=1 isolates prompt ingest)
+        burst = [rng.randint(0, c.vocab_size, l).astype(np.int32)
+                 for l in burst_lens]
+
+        def prefill_burst(batch, runs=3):
+            # median of `runs` bursts — a single burst is a handful of
+            # milliseconds on the tiny sizing and one scheduler hiccup
+            # can swamp the dispatch-coalescing win being measured
+            eng = DecodeEngine(model, slots=slots * 2, max_ctx=max_ctx,
+                               prompt_buckets=buckets,
+                               kv_block_size=kv_block,
+                               prefill_batch=batch)
+            eng.warmup()
+            times, dispatches, ttfts = [], 0, []
+            for i in range(runs):
+                d0 = eng.stats()["prefill_dispatches"]
+                t0 = time.perf_counter()
+                futs = [eng.generate(p, max_tokens=1, eos_token=None)
+                        for p in burst]
+                results = [f.result() for f in futs]
+                times.append(time.perf_counter() - t0)
+                if i == 0:
+                    dispatches = (eng.stats()["prefill_dispatches"]
+                                  - d0)
+                    ttfts = [r["ttft_s"] for r in results
+                             if r["ttft_s"] is not None]
+            eng.close(10.0)
+            times.sort()
+            dt = times[len(times) // 2]
+            return len(burst) / dt, dispatches, ttfts
+
+        batched_thr, batched_disp, batched_ttfts = prefill_burst(4)
+        serial_thr, serial_disp, _ = prefill_burst(1)
+        rec["batched_prefill"] = {
+            "prompts": len(burst),
+            "batched_prompts_per_sec": round(batched_thr, 2),
+            "serial_prompts_per_sec": round(serial_thr, 2),
+            "batched_dispatches": batched_disp,
+            "serial_dispatches": serial_disp,
+            "speedup": round(batched_thr / serial_thr, 3),
+            "p99_ttft_ms": round(
+                float(np.percentile(batched_ttfts, 99)) * 1e3, 3),
+        }
+
+        # -- speculative decoding: 1-layer weight-shared draft proposes
+        # spec_k tokens per step; greedy output must match the engine's
+        # own non-speculative run token for token
+        dcfg = dataclasses.replace(cfg, num_layers=1)
+        draft = causal_lm.CausalLM(dcfg, params={
+            "embeddings": model.params["embeddings"],
+            "layers": model.params["layers"][:1]})
+        spec_reqs = [(rng.randint(0, c.vocab_size, l).astype(np.int32),
+                      spec_tokens) for l in prompts[:4]]
+
+        def spec_run(draft_model, k):
+            eng = DecodeEngine(model, slots=4, max_ctx=max_ctx,
+                               prompt_buckets=buckets,
+                               kv_block_size=kv_block,
+                               draft_model=draft_model, spec_k=k)
+            eng.warmup()
+            t0 = time.perf_counter()
+            futs = [eng.generate(p, max_tokens=g, eos_token=None)
+                    for p, g in spec_reqs]
+            toks = [f.result()["tokens"] for f in futs]
+            dt = time.perf_counter() - t0
+            st = eng.stats()
+            eng.close(10.0)
+            total_toks = sum(len(t) for t in toks)
+            return toks, total_toks / dt, st
+
+        plain_toks, plain_thr, _ = spec_run(None, 0)
+        spec_toks, spec_thr, spec_stats = spec_run(draft, spec_k)
+        rec["speculative"] = {
+            "k": spec_k,
+            "decode_match": spec_toks == plain_toks,
+            "tokens_per_sec": round(spec_thr, 2),
+            "plain_tokens_per_sec": round(plain_thr, 2),
+            "speedup": round(spec_thr / plain_thr, 3),
+            "acceptance_rate": spec_stats.get("spec_acceptance"),
+            "proposed": spec_stats.get("spec_proposed"),
+            "accepted": spec_stats.get("spec_accepted"),
+        }
+
         ok, reason = check_generative_decode(rec)
         if ok or attempt == 1:
             break
@@ -1036,21 +1195,32 @@ def bench_generative_decode(jax, jnp, tiny):
     return rec
 
 
-def check_generative_decode(rec, min_kv_speedup=3.0, min_cb_speedup=1.5):
+def check_generative_decode(rec, min_kv_speedup=3.0, min_cb_speedup=1.5,
+                            max_kv_bytes_ratio=0.6,
+                            min_prefill_speedup=1.3):
     """(ok, reason): gates a generative_decode record must pass.
 
     - the KV-cached greedy continuation must be token-identical to the
       full-recompute reference (a fast decode that decodes something
       else is not a speedup);
     - the steady state must have recorded ZERO new compiles after warmup
-      (one prefill per bucket + one decode executable is the entire
-      executable set — per-token retracing is the failure mode this
-      architecture exists to kill);
+      (one prefill per (bucket, batch rung) + one decode executable is
+      the entire executable set — per-token retracing is the failure
+      mode this architecture exists to kill);
     - KV-cached decode must be >= ``min_kv_speedup`` (3x) tokens/sec over
       recomputing the whole prefix each token;
     - continuous batching must yield >= ``min_cb_speedup`` (1.5x)
       aggregate tokens/sec over serving the same mixed-length requests
-      one at a time."""
+      one at a time;
+    - paged KV must reserve <= ``max_kv_bytes_ratio`` (0.6x) of the slab
+      layout's bytes-per-active-token on the mixed short/long workload
+      (blocks proportional to actual sequence length, not max_ctx);
+    - batched prefill must ingest prompts >= ``min_prefill_speedup``
+      (1.3x) faster than one dispatch per prompt;
+    - speculative greedy output must be token-identical to the engine's
+      own non-speculative run, with a measured acceptance rate reported
+      (speculation that changes tokens is a correctness bug, whatever
+      its speed)."""
     if not rec.get("decode_match"):
         return False, ("KV-cached greedy tokens differ from the "
                        "full-recompute reference: the cached decode is "
@@ -1070,6 +1240,35 @@ def check_generative_decode(rec, min_kv_speedup=3.0, min_cb_speedup=1.5):
             f"continuous batching only {rec['cb_speedup']:.2f}x "
             f"per-request serving (gate: >= {min_cb_speedup}x): requests "
             "are not actually sharing decode steps")
+    paged = rec.get("paged_kv") or {}
+    ratio = paged.get("bytes_ratio")
+    if ratio is None:
+        return False, ("record has no paged_kv.bytes_ratio: the paged-"
+                       "vs-slab footprint comparison did not run")
+    if ratio > max_kv_bytes_ratio:
+        return False, (
+            f"paged KV holds {ratio:.2f}x the slab layout's bytes per "
+            f"active token (gate: <= {max_kv_bytes_ratio}x): blocks are "
+            "not tracking actual sequence length")
+    bp = rec.get("batched_prefill") or {}
+    if bp.get("speedup") is None:
+        return False, ("record has no batched_prefill.speedup: the "
+                       "prompt-ingest comparison did not run")
+    if bp["speedup"] < min_prefill_speedup:
+        return False, (
+            f"batched prefill only {bp['speedup']:.2f}x per-prompt "
+            f"dispatch (gate: >= {min_prefill_speedup}x): same-bucket "
+            "prompts are not sharing a dispatch")
+    spec = rec.get("speculative") or {}
+    if not spec.get("decode_match"):
+        return False, (
+            "speculative greedy tokens differ from the engine's own "
+            "non-speculative run: accepted-prefix verification is "
+            "broken")
+    if spec.get("acceptance_rate") is None:
+        return False, ("speculative run reported no acceptance rate: "
+                       "the draft never proposed (spec path not "
+                       "exercised)")
     return True, "ok"
 
 

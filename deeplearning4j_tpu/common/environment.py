@@ -53,6 +53,8 @@ class EnvironmentVars:
     DL4J_TPU_DECODE_SLOTS = "DL4J_TPU_DECODE_SLOTS"
     DL4J_TPU_DECODE_MAX_CTX = "DL4J_TPU_DECODE_MAX_CTX"
     DL4J_TPU_DECODE_MAX_TOKENS = "DL4J_TPU_DECODE_MAX_TOKENS"
+    DL4J_TPU_KV_BLOCK_SIZE = "DL4J_TPU_KV_BLOCK_SIZE"
+    DL4J_TPU_SPEC_DRAFT_K = "DL4J_TPU_SPEC_DRAFT_K"
     DL4J_TPU_QUANT = "DL4J_TPU_QUANT"
     DL4J_TPU_QUANT_MAX_DIVERGENCE = "DL4J_TPU_QUANT_MAX_DIVERGENCE"
     DL4J_TPU_QUANT_MIN_TOP1 = "DL4J_TPU_QUANT_MIN_TOP1"
@@ -111,6 +113,8 @@ class SystemProperties:
     DECODE_SLOTS = "decode_slots"
     DECODE_MAX_CTX = "decode_max_ctx"
     DECODE_MAX_TOKENS = "decode_max_tokens"
+    KV_BLOCK_SIZE = "kv_block_size"
+    SPEC_DRAFT_K = "spec_draft_k"
     QUANT = "quant"
     QUANT_MAX_DIVERGENCE = "quant_max_divergence"
     QUANT_MIN_TOP1 = "quant_min_top1"
@@ -173,6 +177,8 @@ _ENV_FOR_PROP = {
     SystemProperties.DECODE_MAX_CTX: EnvironmentVars.DL4J_TPU_DECODE_MAX_CTX,
     SystemProperties.DECODE_MAX_TOKENS:
         EnvironmentVars.DL4J_TPU_DECODE_MAX_TOKENS,
+    SystemProperties.KV_BLOCK_SIZE: EnvironmentVars.DL4J_TPU_KV_BLOCK_SIZE,
+    SystemProperties.SPEC_DRAFT_K: EnvironmentVars.DL4J_TPU_SPEC_DRAFT_K,
     SystemProperties.QUANT: EnvironmentVars.DL4J_TPU_QUANT,
     SystemProperties.QUANT_MAX_DIVERGENCE:
         EnvironmentVars.DL4J_TPU_QUANT_MAX_DIVERGENCE,
@@ -502,6 +508,34 @@ class Environment:
 
     def set_decode_max_tokens(self, n: int):
         return self.set_property(SystemProperties.DECODE_MAX_TOKENS, int(n))
+
+    def kv_block_size(self) -> int:
+        """Rows per KV-cache block of the paged decode cache
+        (``DL4J_TPU_KV_BLOCK_SIZE``). A sequence holds
+        ``ceil(len/block_size)`` blocks instead of reserving ``max_ctx``
+        rows; engines clamp the value to their context window, so
+        setting it >= max_ctx reproduces the legacy slab layout."""
+        v = self.property(SystemProperties.KV_BLOCK_SIZE)
+        try:
+            return max(int(v), 1)
+        except (TypeError, ValueError):
+            return 16
+
+    def set_kv_block_size(self, n: int):
+        return self.set_property(SystemProperties.KV_BLOCK_SIZE, int(n))
+
+    def spec_draft_k(self) -> int:
+        """Draft tokens proposed per speculative-decoding step
+        (``DL4J_TPU_SPEC_DRAFT_K``). 0 (default) disables speculation;
+        an engine additionally needs a ``draft_model`` to speculate."""
+        v = self.property(SystemProperties.SPEC_DRAFT_K)
+        try:
+            return max(int(v), 0)
+        except (TypeError, ValueError):
+            return 0
+
+    def set_spec_draft_k(self, n: int):
+        return self.set_property(SystemProperties.SPEC_DRAFT_K, int(n))
 
     # -- quantized-serving knobs (quant/, serving/registry.py) -------------
     def quant_mode(self) -> str:

@@ -29,9 +29,18 @@ __all__ = ["flash_attention", "flash_attention_with_lse",
 _dispatch_logged = False
 
 
-def attention_dispatch(seq_len: int) -> str:
-    """Auto-dispatch for ``flash=True`` attention configs: "flash" or
-    "xla".
+def attention_dispatch(seq_len: int, paged: bool = False) -> str:
+    """Auto-dispatch for ``flash=True`` attention configs: "flash",
+    "xla", or "paged".
+
+    ``paged=True`` marks the block-table gather-attention path of the
+    paged KV cache (``models.causal_lm.paged_decode``): it always
+    computes via XLA einsums over the gathered block view — never the
+    Pallas flash kernel, whatever the query length — and records its own
+    ``dl4j_attn_dispatch_total{path=paged}`` label so the paged and slab
+    decode paths are distinguishable in telemetry. Decode shapes
+    (seq_len < 2) stay pinned to XLA on the non-paged path exactly as
+    before.
 
     BENCH_r05 measured the flash BERT variant at 93.7 samples/sec vs 1373
     for plain XLA attention at seq_len=128 — the Pallas kernel's blocking
@@ -50,7 +59,9 @@ def attention_dispatch(seq_len: int) -> str:
     from ..common.environment import environment
 
     env = environment()
-    if int(seq_len) < 2:
+    if paged:
+        path = "paged"
+    elif int(seq_len) < 2:
         path = "xla"
     else:
         path = "flash" if int(seq_len) >= env.flash_min_seq() else "xla"

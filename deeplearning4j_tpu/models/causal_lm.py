@@ -17,15 +17,27 @@ accumulation, tied word-embedding head) with a causal mask and a
   per-token step; ``kernels.attention_dispatch`` routes this seq-len-1
   shape to the XLA attention path unconditionally).
 
-KV cache layout (the vLLM-style preallocated design, ring-indexed by the
-slot allocator in ``DecodeEngine``)::
+KV cache layouts. The *paged* layout (PagedAttention, Kwon et al. 2023)
+is what ``DecodeEngine`` serves from::
 
-    {"k": [slots, layers, max_ctx, heads, head_dim],
-     "v": [slots, layers, max_ctx, heads, head_dim]}
+    {"k": [num_blocks, layers, block_size, heads, head_dim],
+     "v": [num_blocks, layers, block_size, heads, head_dim]}
+
+plus a per-slot **block table** ``[slots, max_blocks]`` of pool indices:
+a sequence at length L only holds ``ceil(L/block_size)`` blocks, so long
+and short requests share one memory budget instead of each reserving
+``max_ctx`` rows. Block 0 is a scratch block: table entries past a
+slot's allocated count point at it, so fixed-shape writes of padding
+rows land somewhere harmless (every read of scratch content is masked
+by the per-slot length). The legacy slab layout
+``{"k"/"v": [slots, layers, max_ctx, heads, head_dim]}`` is kept as the
+single-slot reference path — and is exactly the paged layout with
+``block_size == max_ctx`` and one block per slot.
 
 Rows at positions ``> lengths[slot]`` are masked out of every attention —
-stale rows left by a previous occupant of the slot can never leak into a
-new request (the poison-value test in tests/test_generation.py).
+stale rows left by a previous occupant of the slot (or a freshly
+re-allocated block) can never leak into a new request (the poison-value
+test in tests/test_generation.py).
 """
 from __future__ import annotations
 
@@ -267,6 +279,127 @@ def decode(params, cache, tokens, lengths, config: CausalLMConfig):
     return {"k": cache_k, "v": cache_v}, _lm_logits(params, h)
 
 
+# -- paged (block-granular) KV cache -------------------------------------
+
+def init_paged_kv_cache(config: CausalLMConfig, num_blocks: int,
+                        block_size: int) -> Dict:
+    """Block pool ``[num_blocks, layers, block_size, heads, head_dim]``
+    (see module docstring). Block 0 is the scratch block the engine's
+    allocator never hands out."""
+    c = config
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    if num_blocks < 2:
+        raise ValueError(
+            f"num_blocks must be >= 2 (block 0 is scratch), got "
+            f"{num_blocks}")
+    shape = (int(num_blocks), c.num_layers, int(block_size), c.num_heads,
+             c.head_dim)
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
+def _block_coords(tables, positions, block_size):
+    """(block ids, in-block offsets) for token ``positions`` under the
+    per-row block ``tables`` — both [R, T] for tables [R, MB]. Positions
+    whose block-table column exceeds MB clip to the last column; the
+    engine never lets a live position get there (max_ctx <= MB*Bs)."""
+    mb = tables.shape[1]
+    col = jnp.clip(positions // block_size, 0, mb - 1)
+    blk = jnp.take_along_axis(tables, col, axis=1)
+    return blk, positions % block_size
+
+
+def paged_prefill(params, cache, input_ids, tables, lengths,
+                  config: CausalLMConfig):
+    """Batched prefill into the paged cache: fill each row's blocks from
+    its padded prompt in ONE dispatch.
+
+    ``input_ids`` [B, T] are prompts zero-padded to the bucket, ``tables``
+    [B, MB] each row's block table (unallocated columns -> scratch 0),
+    ``lengths`` [B] the real prompt lengths. All B*T rows are written —
+    padding rows land in the rows' own blocks past ``lengths`` (masked
+    out of every later attention) or in the scratch block. Returns
+    ``(cache, logits[B, V])`` with each row's logits taken at position
+    ``lengths[b]-1``: the distribution of the row's first generated
+    token."""
+    c = config
+    B, T = input_ids.shape
+    Bs = cache["k"].shape[2]
+    h = _embed(params, input_ids, jnp.arange(T)[None, :], c)
+    tpos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    blk, off = _block_coords(tables, tpos, Bs)
+    cache_k, cache_v = cache["k"], cache["v"]
+    for i, layer in enumerate(params["layers"]):
+        h, (k, v) = _causal_block(layer, h, c)
+        cache_k = cache_k.at[blk, i, off].set(
+            k.astype(cache_k.dtype), mode="drop")
+        cache_v = cache_v.at[blk, i, off].set(
+            v.astype(cache_v.dtype), mode="drop")
+    last = jnp.take_along_axis(
+        h, jnp.clip(lengths - 1, 0, T - 1)[:, None, None], axis=1)[:, 0]
+    return {"k": cache_k, "v": cache_v}, _lm_logits(params, last)
+
+
+def paged_decode(params, cache, tables, tokens, lengths,
+                 config: CausalLMConfig):
+    """Cache-aware step over every slot against the paged pool: ``Q=1``
+    is the classic single-token decode, ``Q=k+1`` is the speculative
+    verify pass (score a drafted continuation in one dispatch).
+
+    ``tokens`` [S, Q] are each slot's next Q tokens (position
+    ``lengths[s]+q``), ``lengths`` [S] how many committed rows each
+    slot's blocks hold. Writes each token's K/V through the block table,
+    then attends over the gathered block view — the block-table gather
+    happens inside the jitted step, so the executable set stays fixed
+    (zero steady-state recompiles). Returns ``(cache, logits[S, Q, V])``.
+
+    ``kernels.attention_dispatch`` labels this path ``paged`` on the
+    dispatch counter; like the slab decode it always computes via XLA
+    einsums (a gathered-block query can never amortize the Pallas
+    kernel's blocking)."""
+    from ..kernels import attention_dispatch
+
+    c = config
+    S, Q = tokens.shape
+    MB = tables.shape[1]
+    Bs = cache["k"].shape[2]
+    C = MB * Bs
+    pos = lengths[:, None] + jnp.arange(Q)[None, :]            # [S, Q]
+    h = _embed(params, tokens,
+               jnp.clip(pos, 0, c.max_position_embeddings - 1), c)
+    assert attention_dispatch(Q, paged=True) == "paged"
+    blk, off = _block_coords(tables, pos, Bs)
+    key_mask = jnp.arange(C)[None, None, :] <= pos[:, :, None]  # [S, Q, C]
+    scale = c.head_dim ** -0.5
+    cache_k, cache_v = cache["k"], cache["v"]
+    for i, layer in enumerate(params["layers"]):
+        a = layer["attn"]
+        q = jnp.einsum("sqe,ehd->sqhd", h, dequantize(a["wq"], h.dtype)) \
+            + a["bq"]
+        k = jnp.einsum("sqe,ehd->sqhd", h, dequantize(a["wk"], h.dtype)) \
+            + a["bk"]
+        v = jnp.einsum("sqe,ehd->sqhd", h, dequantize(a["wv"], h.dtype)) \
+            + a["bv"]
+        cache_k = cache_k.at[blk, i, off].set(
+            k.astype(cache_k.dtype), mode="drop")
+        cache_v = cache_v.at[blk, i, off].set(
+            v.astype(cache_v.dtype), mode="drop")
+        # gather each slot's blocks into its contiguous [C] key view
+        ks = jnp.take(cache_k[:, i], tables, axis=0).reshape(
+            S, C, c.num_heads, c.head_dim)
+        vs = jnp.take(cache_v[:, i], tables, axis=0).reshape(
+            S, C, c.num_heads, c.head_dim)
+        att = jnp.einsum("sqhd,schd->shqc", q, ks,
+                         preferred_element_type=jnp.float32) * scale
+        att = jnp.where(key_mask[:, None], att, _BIG_NEG)
+        probs = jax.nn.softmax(att, axis=-1).astype(h.dtype)
+        ctx = jnp.einsum("shqc,schd->sqhd", probs, vs)
+        out = jnp.einsum("sqhd,hde->sqe", ctx,
+                         dequantize(a["wo"], h.dtype)) + a["bo"]
+        h = _mlp_ln(layer, h, out, c)
+    return {"k": cache_k, "v": cache_v}, _lm_logits(params, h)
+
+
 def count_params(params) -> int:
     return sum(int(p.size) for p in jax.tree_util.tree_leaves(params))
 
@@ -290,6 +423,18 @@ class CausalLM:
 
     def decode(self, params, cache, tokens, lengths):
         return decode(params, cache, tokens, lengths, self.config)
+
+    # paged protocol (what DecodeEngine actually serves from)
+    def init_paged_kv_cache(self, num_blocks: int, block_size: int) -> Dict:
+        return init_paged_kv_cache(self.config, num_blocks, block_size)
+
+    def paged_prefill(self, params, cache, input_ids, tables, lengths):
+        return paged_prefill(params, cache, input_ids, tables, lengths,
+                             self.config)
+
+    def paged_decode(self, params, cache, tables, tokens, lengths):
+        return paged_decode(params, cache, tables, tokens, lengths,
+                            self.config)
 
     def forward(self, input_ids):
         return forward(self.params, input_ids, self.config)

@@ -1,41 +1,69 @@
-"""Generative serving fast path: KV-cached decode with continuous batching.
+"""Generative serving fast path: paged KV cache, batched prefill,
+continuous batching, and speculative decoding.
 
 The serving stack through PR 6 pads whole requests through a bucket ladder
 and answers them one-shot — it cannot serve autoregressive traffic. This
 module is the Orca (OSDI '22) per-iteration scheduling playbook plus the
-vLLM/PagedAttention (SOSP '23) preallocated-KV-cache design, sized down to
-a slot-per-sequence ring cache:
+vLLM/PagedAttention (SOSP '23) block-granular KV cache, plus Leviathan et
+al. (2023) draft-model speculative decoding:
 
-- **prefill/decode split** — a request's prompt runs through ONE
-  fixed-shape jitted ``prefill`` (prompt padded up a bucket ladder, one
-  executable per bucket) that fills its slot of a preallocated KV cache
-  ``[slots, layers, max_ctx, heads, head_dim]`` and samples the first
-  token; every later token costs ONE jitted ``decode`` step shared by all
-  active slots (a single executable for the whole steady state).
+- **paged KV cache** — the cache is a block pool
+  ``[num_blocks, layers, block_size, heads, head_dim]`` plus a per-slot
+  block table, so a sequence only holds ``ceil(len/block_size)`` blocks
+  instead of reserving ``max_ctx`` rows up front, and long/short requests
+  share one memory budget. Admission is gated on free *blocks* (not just
+  free slots), blocks are appended on demand as a sequence grows, and the
+  block-table gather happens inside the jitted step so the executable set
+  stays fixed. Block 0 is a scratch block: padding and inactive-slot
+  writes land there and are masked out of every attention read. When the
+  pool runs dry mid-decode the engine preempts the most recently admitted
+  sequence (LIFO), returns its blocks, and requeues it at the head of the
+  queue with its generated prefix — recompute-style preemption that keeps
+  greedy output token-identical.
+- **prefill/decode split with batched prefill** — queued prompts that pad
+  to the same prompt bucket are coalesced into ONE fixed-shape jitted
+  ``prefill`` dispatch (prompt padded up the bucket ladder, group padded
+  up a batch ladder — the ``InferenceEngine`` micro-batcher pattern), so
+  a burst of prompts costs one dispatch instead of one per prompt; every
+  later token costs ONE jitted ``decode`` step shared by all active slots.
 - **continuous batching** — requests join and leave the running decode
   batch *per token*: the loop admits pending requests into free slots
   between decode steps, so a short generation admitted after a long one
   finishes first instead of waiting behind it (no head-of-line blocking),
-  and a finished slot is recycled immediately.
+  and a finished slot is recycled immediately (its blocks return to the
+  pool).
+- **speculative decoding** — with a small draft model configured
+  (``draft_model`` + ``spec_k``/``DL4J_TPU_SPEC_DRAFT_K``), each
+  all-greedy decode iteration runs ONE jitted ``spec`` step: the draft
+  proposes k tokens autoregressively, the target scores all k+1 positions
+  in one cache-aware verify pass, and the accepted prefix (longest match
+  against the target's own greedy choices, plus one free target token) is
+  committed. Output is token-identical to non-speculative greedy by
+  construction; sampling riders and near-context-full sequences fall back
+  to the plain decode step.
 - **sampling** — greedy (temperature 0), temperature, and top-k, all
   per-slot arrays inside the jitted step so mixed sampling configs share
   one executable; per-request ``max_tokens`` and EOS stop host-side.
 
-Both steps route through ``counted_jit`` with the cache donated, so the
-compile counter observes exactly (len(prompt buckets) + 1) executables
-after warmup and steady-state decode performs **zero recompiles** — the
-acceptance invariant of the ``generative_decode`` bench. Donated-cache
-entries are store-ineligible by design (``runtime.compile_cache``): they
-record ``cache=bypass`` on the compile-seconds histogram and rely on the
-XLA backstop cache on accelerator backends.
+All steps route through ``counted_jit`` with the cache(s) donated, so the
+compile counter observes exactly ``len(prompt buckets) *
+len(batch ladder) + 1 (+1 with speculation)`` executables after warmup
+and steady-state decode performs **zero recompiles** — the acceptance
+invariant of the ``generative_decode`` bench. Donated-cache entries are
+store-ineligible by design (``runtime.compile_cache``): they record
+``cache=bypass`` on the compile-seconds histogram and rely on the XLA
+backstop cache on accelerator backends.
 
 Observability: ``dl4j_decode_requests_total``, ``dl4j_decode_tokens_total``,
 ``dl4j_decode_steps_total``, ``dl4j_decode_active_slots``,
-``dl4j_decode_queue_depth``, ``dl4j_decode_ttft_seconds`` (exemplared with
-trace ids). Each request's trace gains a ``generation/prefill`` span
-(queue wait + prompt dispatch, TTFT) and a ``generation/decode`` span
-(first token → finish), so ``/debug/requests`` reconstructs a
-generation's timeline end to end.
+``dl4j_decode_queue_depth``, ``dl4j_kv_blocks_free{model}``,
+``dl4j_decode_preempted_total``, ``dl4j_spec_proposed_tokens_total`` /
+``dl4j_spec_accepted_tokens_total``, ``dl4j_decode_ttft_seconds``
+(exemplared with trace ids). Each request's trace gains a
+``generation/prefill`` span (queue wait + prompt dispatch, TTFT) and a
+``generation/decode`` span (first token → finish), so ``/debug/requests``
+reconstructs a generation's timeline end to end; ``/debug/decode`` dumps
+the live slot map and block tables.
 """
 from __future__ import annotations
 
@@ -63,10 +91,19 @@ log = logging.getLogger(__name__)
 
 def is_generative_model(model) -> bool:
     """Duck-typed generative-model protocol (``models.causal_lm.CausalLM``):
-    ``init_kv_cache`` / ``prefill`` / ``decode`` plus a params pytree."""
+    the paged-cache trio ``init_paged_kv_cache`` / ``paged_prefill`` /
+    ``paged_decode`` (what ``DecodeEngine`` actually serves from), the
+    legacy slab trio ``init_kv_cache`` / ``prefill`` / ``decode``, plus a
+    params pytree."""
     return all(callable(getattr(model, m, None))
-               for m in ("init_kv_cache", "prefill", "decode")) \
+               for m in ("init_kv_cache", "prefill", "decode",
+                         "init_paged_kv_cache", "paged_prefill",
+                         "paged_decode")) \
         and hasattr(model, "params")
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-int(a) // int(b))
 
 
 # ---------------------------------------------------------------------------
@@ -100,7 +137,7 @@ def sample_tokens(logits, temperature, top_k, key):
 class _GenRequest:
     __slots__ = ("prompt", "max_tokens", "temperature", "top_k", "eos",
                  "on_token", "future", "ctx", "deadline", "t_submit",
-                 "t_first", "tokens", "slot")
+                 "t_first", "tokens", "slot", "prefix", "admit_seq")
 
     def __init__(self, prompt, max_tokens, temperature, top_k, eos,
                  on_token, deadline, ctx):
@@ -117,38 +154,103 @@ class _GenRequest:
         self.t_first: Optional[float] = None
         self.tokens: List[int] = []
         self.slot: Optional[int] = None
+        # the rows a prefill must (re)compute: the prompt, extended with
+        # every generated token when the request is preempted/requeued
+        self.prefix = prompt              # np.int32 [>=T]
+        self.admit_seq = -1               # LIFO preemption order
 
     def expired(self) -> bool:
         return self.deadline is not None and time.monotonic() >= self.deadline
 
 
+class _BlockAllocator:
+    """Free-list allocator over KV-pool block ids ``1..total`` (block 0 is
+    the scratch block and is never handed out). Callers hold the engine's
+    scheduler lock around every operation."""
+
+    def __init__(self, total: int):
+        self.total = int(total)
+        self._free = list(range(self.total, 0, -1))  # pop() yields 1 first
+        self._used: set = set()
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return len(self._used)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        self._used.update(out)
+        return out
+
+    def free(self, ids) -> int:
+        """Return blocks to the pool; double-frees and id 0 are ignored
+        (the reconcile pass repairs, it must never corrupt)."""
+        n = 0
+        for b in ids:
+            b = int(b)
+            if b in self._used:
+                self._used.discard(b)
+                self._free.append(b)
+                n += 1
+        return n
+
+    def reset_to(self, used_ids) -> None:
+        """Rebuild the free list so exactly ``used_ids`` are outstanding
+        (block-accounting repair)."""
+        self._used = {int(b) for b in used_ids if 0 < int(b) <= self.total}
+        self._free = [b for b in range(self.total, 0, -1)
+                      if b not in self._used]
+
+
 class DecodeEngine:
-    """Continuous-batching autoregressive decode engine over one model.
+    """Continuous-batching autoregressive decode engine over one model,
+    serving from a paged (block-granular) KV cache.
 
     - ``generate(prompt, ...) -> Future`` resolving to a result dict
       (``tokens``, ``finish_reason``, ``ttft_s``, token counts); an
       optional ``on_token`` callback streams tokens as they are sampled.
-    - ``warmup()`` pre-compiles one prefill executable per prompt bucket
-      plus the single decode-step executable.
+    - ``warmup()`` pre-compiles one prefill executable per (prompt bucket,
+      batch rung) pair plus the decode-step executable (plus the
+      speculative step when a draft model is configured).
     - ``drain()/close()/start()`` mirror ``InferenceEngine`` lifecycle so
       the serving registry hot-swaps/parks generative versions the same
       way it does predict engines.
 
     ``slots`` bounds concurrent sequences (``DL4J_TPU_DECODE_SLOTS``);
     ``max_ctx`` bounds prompt+generation length per sequence
-    (``DL4J_TPU_DECODE_MAX_CTX``, capped by the model's position table).
+    (``DL4J_TPU_DECODE_MAX_CTX``, capped by the model's position table);
+    ``kv_block_size`` (``DL4J_TPU_KV_BLOCK_SIZE``) sets the block
+    granularity — clamped to ``max_ctx``, so setting it >= max_ctx
+    reproduces the legacy slab layout; ``kv_blocks`` sizes the pool
+    (default: slab-equivalent, ``slots * ceil(max_ctx/block_size)``);
+    ``prefill_batch`` caps how many same-bucket prompts share one prefill
+    dispatch; ``draft_model`` + ``spec_k`` (``DL4J_TPU_SPEC_DRAFT_K``)
+    enable greedy speculative decoding.
     """
 
     def __init__(self, model, *, slots: Optional[int] = None,
                  max_ctx: Optional[int] = None,
                  prompt_buckets: Optional[Sequence[int]] = None,
-                 eos_token: Optional[int] = None, seed: int = 0):
+                 eos_token: Optional[int] = None, seed: int = 0,
+                 kv_block_size: Optional[int] = None,
+                 kv_blocks: Optional[int] = None,
+                 prefill_batch: Optional[int] = None,
+                 draft_model=None, spec_k: Optional[int] = None,
+                 model_name: str = "default"):
         if not is_generative_model(model):
             raise TypeError(
                 f"cannot decode a {type(model).__name__}: expected the "
-                "generative-model protocol (init_kv_cache/prefill/decode)")
+                "generative-model protocol (init_paged_kv_cache/"
+                "paged_prefill/paged_decode)")
         env = environment()
         self.model = model
+        self.model_name = str(model_name)
         self.slots = int(slots if slots is not None else env.decode_slots())
         max_ctx = int(max_ctx if max_ctx is not None
                       else env.decode_max_ctx())
@@ -157,12 +259,47 @@ class DecodeEngine:
         if pos_cap:
             max_ctx = min(max_ctx, int(pos_cap))
         self.max_ctx = max_ctx
-        # prompt-length bucket ladder: one prefill executable per rung
+        # prompt-length bucket ladder: one prefill executable per rung.
+        # The top rung always covers max_ctx: a preempted rider re-enters
+        # the queue with prompt+generated as its prefix, which can exceed
+        # the largest explicit bucket (but never max_ctx), and must still
+        # be admittable.
         self.ladder = bucket_ladder(self.max_ctx, prompt_buckets)
+        if self.ladder[-1] < self.max_ctx:
+            self.ladder = self.ladder + (self.max_ctx,)
+        # paged-cache geometry: block size clamps to the context window
+        # (block_size == max_ctx -> one block per sequence == slab layout)
+        bs = int(kv_block_size if kv_block_size is not None
+                 else env.kv_block_size())
+        self.block_size = max(1, min(bs, self.max_ctx))
+        self.max_blocks = _cdiv(self.max_ctx, self.block_size)  # per slot
+        pool = int(kv_blocks if kv_blocks is not None
+                   else self.slots * self.max_blocks)
+        self.kv_blocks = max(1, pool)
+        # batched prefill: group same-bucket prompts up a batch ladder
+        pb = int(prefill_batch if prefill_batch is not None
+                 else min(4, self.slots))
+        self.prefill_batch = max(1, min(pb, self.slots))
+        self.batch_ladder = bucket_ladder(self.prefill_batch)
+        # speculative decoding: draft proposes spec_k tokens per step
+        k = int(spec_k if spec_k is not None else env.spec_draft_k())
+        self.spec_k = max(0, k)
+        self.draft = draft_model
+        if self.draft is not None and not is_generative_model(self.draft):
+            raise TypeError(
+                f"draft_model {type(self.draft).__name__} does not "
+                "implement the generative-model protocol")
+        self._spec_enabled = self.draft is not None and self.spec_k >= 1
         self.eos_token = eos_token
         self._seed = int(seed)
         self._params = model.params
-        self._cache = model.init_kv_cache(self.slots, self.max_ctx)
+        # +1: block 0 is the scratch block for padding/inactive writes
+        self._cache = model.init_paged_kv_cache(self.kv_blocks + 1,
+                                                self.block_size)
+        self._dparams = self.draft.params if self._spec_enabled else None
+        self._dcache = (self.draft.init_paged_kv_cache(
+            self.kv_blocks + 1, self.block_size)
+            if self._spec_enabled else None)
         self._step = 0
         # per-slot host state (the loop thread owns it)
         S = self.slots
@@ -170,8 +307,12 @@ class DecodeEngine:
         self._lengths = np.zeros(S, np.int32)
         self._temps = np.zeros(S, np.float32)
         self._topks = np.zeros(S, np.int32)
+        self._tables = np.zeros((S, self.max_blocks), np.int32)
+        self._nblocks = np.zeros(S, np.int32)
+        self._alloc = _BlockAllocator(self.kv_blocks)
         self._slot_req: List[Optional[_GenRequest]] = [None] * S
         self._active_n = 0
+        self._admit_counter = 0
         # dispatch serialization: warmup and the loop both step the cache
         self._dispatch_lock = ordered_rlock("decode.dispatch")
         self._warmed: set = set()
@@ -191,7 +332,9 @@ class DecodeEngine:
         self.manifest_path = None
         self._stats_lock = ordered_lock("decode.stats")
         self._stats = {"requests": 0, "tokens": 0, "decode_steps": 0,
-                       "prefills": 0, "expired": 0}
+                       "prefills": 0, "prefill_dispatches": 0,
+                       "expired": 0, "preempted": 0, "spec_steps": 0,
+                       "spec_proposed": 0, "spec_accepted": 0}
         self._build_steps()
         reg = registry()
         self._reg = reg
@@ -203,13 +346,18 @@ class DecodeEngine:
             "Tokens sampled across prefill + decode steps")
         self._m_steps = reg.counter(
             "dl4j_decode_steps_total",
-            "Batched single-token decode dispatches")
+            "Batched decode dispatches (plain single-token + speculative)")
         self._m_active = reg.gauge(
             "dl4j_decode_active_slots",
             "Sequences currently occupying a decode slot")
         self._m_queue = reg.gauge(
             "dl4j_decode_queue_depth",
             "Generation requests waiting for a free slot")
+        self._m_blocks_free = reg.gauge(
+            "dl4j_kv_blocks_free",
+            "Free KV-cache blocks in the paged decode pool",
+            labels=("model",)).labels(model=self.model_name)
+        self._m_blocks_free.set(self._alloc.free_count)
         self._m_ttft = reg.histogram(
             "dl4j_decode_ttft_seconds",
             "Time from generate() to the first sampled token",
@@ -225,64 +373,150 @@ class DecodeEngine:
             "dl4j_decode_slot_leaks_total",
             "KV-cache slots found leaked (occupied without a live rider) "
             "and reclaimed by the per-iteration accounting check")
+        self._m_block_leaks = reg.counter(
+            "dl4j_kv_block_leaks_total",
+            "KV-pool blocks whose allocator accounting drifted from the "
+            "slot block tables and were repaired by the reconcile pass")
         self._m_cancelled = reg.counter(
             "dl4j_decode_cancelled_total",
             "Riders whose future was cancelled mid-decode; their slot is "
             "freed immediately")
+        self._m_preempted = reg.counter(
+            "dl4j_decode_preempted_total",
+            "Sequences preempted (blocks reclaimed, requeued for "
+            "recompute) because the KV block pool ran dry mid-decode")
+        self._m_spec_proposed = reg.counter(
+            "dl4j_spec_proposed_tokens_total",
+            "Draft tokens proposed by speculative decode steps")
+        self._m_spec_accepted = reg.counter(
+            "dl4j_spec_accepted_tokens_total",
+            "Draft tokens accepted (verified equal to the target model's "
+            "greedy choice) by speculative decode steps")
 
     # -- jitted steps ------------------------------------------------------
     def _build_steps(self):
         model = self.model
+        draft = self.draft if self._spec_enabled else None
+        k = self.spec_k
 
-        def prefill_fn(params, cache, ids, slot, length, temp, top_k,
-                       seed, step):
-            cache, logits = model.prefill(params, cache, ids, slot, length)
+        def prefill_fn(params, cache, ids, tables, lengths, temps,
+                       top_ks, seed, step):
+            cache, logits = model.paged_prefill(params, cache, ids,
+                                                tables, lengths)
             key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
-            tok = sample_tokens(logits[None], temp[None], top_k[None],
-                                key)[0]
-            return cache, tok
+            toks = sample_tokens(logits, temps, top_ks, key)
+            return cache, toks
 
-        def decode_fn(params, cache, tokens, lengths, active, temps,
-                      top_ks, seed, step):
-            cache, logits = model.decode(params, cache, tokens, lengths)
+        def prefill_draft_fn(params, dparams, cache, dcache, ids, tables,
+                             lengths, temps, top_ks, seed, step):
+            # the draft cache must hold the same committed rows as the
+            # target's, so the draft prefills inside the same dispatch
+            cache, logits = model.paged_prefill(params, cache, ids,
+                                                tables, lengths)
+            dcache, _ = draft.paged_prefill(dparams, dcache, ids, tables,
+                                            lengths)
             key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
-            nxt = sample_tokens(logits, temps, top_ks, key)
+            toks = sample_tokens(logits, temps, top_ks, key)
+            return cache, dcache, toks
+
+        def decode_fn(params, cache, tables, tokens, lengths, active,
+                      temps, top_ks, seed, step):
+            cache, logits = model.paged_decode(params, cache, tables,
+                                               tokens[:, None], lengths)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+            nxt = sample_tokens(logits[:, 0], temps, top_ks, key)
             return cache, jnp.where(active, nxt, tokens)
 
-        # the KV cache is donated: each step consumes the previous buffers
-        # in place (on backends that honor donation) — these entries are
-        # deliberately ineligible for the raw executable store and show up
-        # as cache=bypass on dl4j_compile_seconds (see compile_cache docs)
+        def spec_fn(params, dparams, cache, dcache, tables, tokens,
+                    lengths, active):
+            # greedy-only speculative step (Leviathan et al., 2023):
+            # draft proposes k tokens one at a time (k+1 steps — the last
+            # is write-only so the draft cache covers every row the
+            # target may commit), the target verifies all k+1 positions
+            # in ONE cache-aware pass, and the longest drafted prefix
+            # matching the target's own greedy choices is committed plus
+            # one free target token. Rejected rows are overwritten by the
+            # next dispatch's writes before any mask admits them.
+            S = tokens.shape[0]
+            prev = tokens
+            drafted = []
+            for j in range(k + 1):
+                dcache, dlogits = draft.paged_decode(
+                    dparams, dcache, tables, prev[:, None], lengths + j)
+                if j < k:
+                    prev = jnp.argmax(dlogits[:, 0, :],
+                                      axis=-1).astype(jnp.int32)
+                    drafted.append(prev)
+            d = jnp.stack(drafted, axis=1)                      # [S, k]
+            verify_in = jnp.concatenate([tokens[:, None], d], axis=1)
+            cache, vlogits = model.paged_decode(params, cache, tables,
+                                                verify_in, lengths)
+            g = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [S, k+1]
+            match = (d == g[:, :k]).astype(jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [S]
+            idx = jnp.arange(k + 1)[None, :]
+            g_at = jnp.take_along_axis(g, n_acc[:, None], axis=1)
+            pad_d = jnp.concatenate(
+                [d, jnp.zeros((S, 1), jnp.int32)], axis=1)
+            commit = jnp.where(idx < n_acc[:, None], pad_d,
+                               jnp.where(idx == n_acc[:, None], g_at, 0))
+            n_commit = jnp.where(active, n_acc + 1, 0).astype(jnp.int32)
+            return cache, dcache, commit, n_commit
+
+        # the KV cache(s) are donated: each step consumes the previous
+        # buffers in place (on backends that honor donation) — these
+        # entries are deliberately ineligible for the raw executable store
+        # and show up as cache=bypass on dl4j_compile_seconds (see
+        # compile_cache docs)
         # a quantized twin (quant/transforms.quantize_model) carries
         # _precision — suffix the tag so its executables never collide with
         # the full-precision model's in the persistent store (the first tag
-        # segment stays "prefill"/"decode": it is the kind metric label)
+        # segment stays "prefill"/"decode"/"spec": it is the kind metric
+        # label)
         prec = getattr(model, "_precision", None)
         suffix = f":{prec}" if prec else ""
-        self._prefill = counted_jit(prefill_fn, "prefill" + suffix,
-                                    donate_argnums=(1,))
+        if self._spec_enabled:
+            self._prefill = counted_jit(prefill_draft_fn,
+                                        "prefill" + suffix,
+                                        donate_argnums=(2, 3))
+            self._spec = counted_jit(spec_fn, "spec" + suffix,
+                                     donate_argnums=(2, 3))
+        else:
+            self._prefill = counted_jit(prefill_fn, "prefill" + suffix,
+                                        donate_argnums=(1,))
+            self._spec = None
         self._decode = counted_jit(decode_fn, "decode" + suffix,
                                    donate_argnums=(1,))
 
-    def _run_prefill(self, ids, slot, length, temperature, top_k):
+    def _run_prefill(self, ids, tables, lengths, temps, top_ks):
+        """One batched prefill dispatch: ``ids`` [B, Tb] padded prompts,
+        ``tables`` [B, MB] the target slots' block tables, ``lengths``
+        [B] real prompt lengths. Returns the B first sampled tokens."""
         if faults.active():
-            faults.check("decode.prefill", slot=slot, length=length)
+            faults.check("decode.prefill", batch=ids.shape[0],
+                         bucket=ids.shape[1])
         with self._dispatch_lock:
             self._dispatch_started_at = time.monotonic()
             try:
-                cache, tok = self._prefill(
-                    self._params, self._cache, jnp.asarray(ids),
-                    jnp.asarray(slot, jnp.int32),
-                    jnp.asarray(length, jnp.int32),
-                    jnp.asarray(temperature, jnp.float32),
-                    jnp.asarray(top_k, jnp.int32),
-                    jnp.asarray(self._seed, jnp.int32),
-                    jnp.asarray(self._step, jnp.int32))
+                args = (jnp.asarray(ids), jnp.asarray(tables),
+                        jnp.asarray(lengths),
+                        jnp.asarray(temps, jnp.float32),
+                        jnp.asarray(top_ks, jnp.int32),
+                        jnp.asarray(self._seed, jnp.int32),
+                        jnp.asarray(self._step, jnp.int32))
+                if self._spec_enabled:
+                    cache, dcache, toks = self._prefill(
+                        self._params, self._dparams, self._cache,
+                        self._dcache, *args)
+                    self._dcache = dcache
+                else:
+                    cache, toks = self._prefill(self._params, self._cache,
+                                                *args)
                 self._cache = cache
                 self._step += 1
             finally:
                 self._dispatch_started_at = None
-        return int(tok)
+        return np.asarray(toks)
 
     def _run_decode(self, active):
         if faults.active():
@@ -291,9 +525,10 @@ class DecodeEngine:
             self._dispatch_started_at = time.monotonic()
             try:
                 cache, nxt = self._decode(
-                    self._params, self._cache, jnp.asarray(self._tokens),
-                    jnp.asarray(self._lengths), jnp.asarray(active),
-                    jnp.asarray(self._temps), jnp.asarray(self._topks),
+                    self._params, self._cache, jnp.asarray(self._tables),
+                    jnp.asarray(self._tokens), jnp.asarray(self._lengths),
+                    jnp.asarray(active), jnp.asarray(self._temps),
+                    jnp.asarray(self._topks),
                     jnp.asarray(self._seed, jnp.int32),
                     jnp.asarray(self._step, jnp.int32))
                 self._cache = cache
@@ -302,15 +537,36 @@ class DecodeEngine:
                 self._dispatch_started_at = None
         return np.asarray(nxt)
 
+    def _run_spec(self, active):
+        if faults.active():
+            faults.check("decode.step", active=int(np.sum(active)),
+                         spec=True)
+        with self._dispatch_lock:
+            self._dispatch_started_at = time.monotonic()
+            try:
+                cache, dcache, commit, n_commit = self._spec(
+                    self._params, self._dparams, self._cache,
+                    self._dcache, jnp.asarray(self._tables),
+                    jnp.asarray(self._tokens), jnp.asarray(self._lengths),
+                    jnp.asarray(active))
+                self._cache = cache
+                self._dcache = dcache
+                self._step += 1
+            finally:
+                self._dispatch_started_at = None
+        return np.asarray(commit), np.asarray(n_commit)
+
     # -- warmup ------------------------------------------------------------
     def warmup(self, example=None,
                batch_sizes: Optional[Sequence[int]] = None,
                **_ignored) -> List[int]:
         """Compile the ladder before traffic: one prefill executable per
-        prompt bucket + the single decode-step executable. Idempotent.
-        (``example``/``batch_sizes`` are accepted for registry-warmup
-        signature compatibility and ignored: the shapes are fixed by the
-        engine's own slots/max_ctx/ladder configuration.)"""
+        (prompt bucket, batch rung) pair + the decode-step executable
+        (+ the speculative step when enabled). Idempotent. Warmup rows
+        use the scratch block table (all zeros) so no live block is
+        touched. (``example``/``batch_sizes`` are accepted for
+        registry-warmup signature compatibility and ignored: the shapes
+        are fixed by the engine's own configuration.)"""
         with self._cv:
             if self._active_n > 0:
                 raise RuntimeError(
@@ -318,16 +574,23 @@ class DecodeEngine:
                     "live KV rows; warm before taking traffic")
         warmed = []
         for b in self.ladder:
-            key = ("prefill", b)
-            if key not in self._warmed:
-                ids = np.zeros((1, b), np.int32)
-                self._run_prefill(ids, slot=0, length=1, temperature=0.0,
-                                  top_k=0)
-                self._warmed.add(key)
+            for bb in self.batch_ladder:
+                key = ("prefill", bb, b)
+                if key not in self._warmed:
+                    self._run_prefill(np.zeros((bb, b), np.int32),
+                                      np.zeros((bb, self.max_blocks),
+                                               np.int32),
+                                      np.ones(bb, np.int32),
+                                      np.zeros(bb, np.float32),
+                                      np.zeros(bb, np.int32))
+                    self._warmed.add(key)
             warmed.append(b)
         if "decode" not in self._warmed:
             self._run_decode(np.zeros(self.slots, bool))
             self._warmed.add("decode")
+        if self._spec_enabled and "spec" not in self._warmed:
+            self._run_spec(np.zeros(self.slots, bool))
+            self._warmed.add("spec")
         return warmed
 
     # -- request intake ----------------------------------------------------
@@ -356,6 +619,14 @@ class DecodeEngine:
         if max_tokens is None:
             max_tokens = min(environment().decode_max_tokens(), cap)
         max_tokens = max(1, min(int(max_tokens), cap))
+        worst = self._blocks_for(int(ids.size) + max_tokens)
+        if worst > self._alloc.total:
+            raise ValueError(
+                f"request may need {worst} KV blocks "
+                f"(prompt {ids.size} + max_tokens {max_tokens}, "
+                f"block_size {self.block_size}) but the pool holds only "
+                f"{self._alloc.total}; raise kv_blocks or lower "
+                "max_tokens")
         eos = self.eos_token if eos_token == "default" else eos_token
         deadline = (time.monotonic() + timeout_s
                     if timeout_s is not None else None)
@@ -480,11 +751,14 @@ class DecodeEngine:
                 self._release_slot(slot)
 
     def _reconcile_slots(self):
-        """Slot-lifecycle assertion: every occupied slot must hold a
-        rider whose future is still undelivered or just-finished — a
-        cancelled/leaked rider is reclaimed here and counted, so a KV
-        slot can never stay occupied forever (the regression the
-        ``dl4j_decode_slot_leaks_total`` counter exists to catch)."""
+        """Slot- and block-lifecycle assertion: every occupied slot must
+        hold a rider whose future is still undelivered or just-finished,
+        and the allocator's outstanding-block set must equal the union of
+        the occupied slots' block tables — a cancelled/leaked rider or a
+        drifted block is reclaimed here and counted, so a KV slot (or
+        pool block) can never stay occupied forever (the regressions the
+        ``dl4j_decode_slot_leaks_total`` / ``dl4j_kv_block_leaks_total``
+        counters exist to catch)."""
         leaked = []
         with self._cv:
             occupied = sum(1 for r in self._slot_req if r is not None)
@@ -499,26 +773,194 @@ class DecodeEngine:
             self._m_slot_leaks.inc(abs(leaked[0][1]))
             log.warning("decode slot accounting drifted by %d; repaired",
                         leaked[0][1])
+        block_drift = 0
+        with self._cv:
+            # a free slot must hold zero blocks; return any strays
+            for slot, req in enumerate(self._slot_req):
+                nb = int(self._nblocks[slot])
+                if req is None and nb > 0:
+                    block_drift += nb
+                    self._alloc.free(self._tables[slot, :nb])
+                    self._tables[slot, :] = 0
+                    self._nblocks[slot] = 0
+            expected = {int(b)
+                        for slot, req in enumerate(self._slot_req)
+                        if req is not None
+                        for b in self._tables[slot,
+                                              :int(self._nblocks[slot])]}
+            if expected != self._alloc._used:
+                block_drift += len(expected ^ self._alloc._used)
+                self._alloc.reset_to(expected)
+            free = self._alloc.free_count
+        self._m_blocks_free.set(free)
+        if block_drift:
+            self._m_block_leaks.inc(block_drift)
+            log.warning("KV block accounting drifted by %d blocks; "
+                        "repaired", block_drift)
 
+    # -- block accounting --------------------------------------------------
+    def _blocks_for(self, rows: int) -> int:
+        """Blocks a sequence needs to hold ``rows`` KV rows (capped at the
+        per-slot maximum — a row index can never reach max_ctx)."""
+        return _cdiv(min(int(rows), self.max_ctx), self.block_size)
+
+    def _grow_slot(self, slot: int, rows: int) -> bool:
+        """Extend ``slot``'s block table to cover ``rows`` rows; returns
+        False when the pool cannot satisfy it. Caller holds ``_cv``."""
+        need = self._blocks_for(rows)
+        have = int(self._nblocks[slot])
+        if need <= have:
+            return True
+        got = self._alloc.alloc(need - have)
+        if got is None:
+            return False
+        self._tables[slot, have:need] = got
+        self._nblocks[slot] = need
+        return True
+
+    def _blocks_deficit(self, horizon: int) -> int:
+        """Additional pool blocks the active set needs so every rider can
+        write ``horizon`` more rows. Caller holds ``_cv``."""
+        deficit = 0
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            need = self._blocks_for(int(self._lengths[slot]) + horizon)
+            deficit += max(0, need - int(self._nblocks[slot]))
+        return deficit
+
+    def _ensure_blocks(self, horizon: int):
+        """Guarantee every active rider owns blocks for its next
+        ``horizon`` rows, preempting the most recently admitted rider
+        (LIFO recompute: blocks returned, request requeued at the queue
+        head with its generated prefix) when the pool runs dry."""
+        while True:
+            victim = failed = None
+            with self._cv:
+                if self._blocks_deficit(horizon) <= self._alloc.free_count:
+                    for slot, req in enumerate(self._slot_req):
+                        if req is not None:
+                            ok = self._grow_slot(
+                                slot, int(self._lengths[slot]) + horizon)
+                            assert ok, "deficit accounting went stale"
+                    self._m_blocks_free.set(self._alloc.free_count)
+                    return
+                riders = [(req.admit_seq, slot, req)
+                          for slot, req in enumerate(self._slot_req)
+                          if req is not None]
+                if len(riders) <= 1:
+                    # nothing left to preempt: the pool genuinely cannot
+                    # host this sequence (generate() validation makes
+                    # this unreachable; keep the guard for drifted state)
+                    failed = (riders[0][1], riders[0][2])
+                else:
+                    _, vslot, vreq = max(riders)
+                    victim = (vslot, vreq)
+            if failed is not None:
+                slot, req = failed
+                if not req.future.done():
+                    req.future.set_exception(RuntimeError(
+                        "KV block pool exhausted with no rider left "
+                        "to preempt; raise kv_blocks"))
+                self._release_slot(slot)
+                return
+            self._preempt(*victim)
+
+    def _preempt(self, slot: int, req: _GenRequest):
+        """Recompute-preemption: drop ``req`` from its slot, return its
+        blocks, and requeue it at the queue head with prompt + generated
+        tokens as the new prefill prefix (greedy output stays
+        token-identical: a prefill over the full prefix yields the same
+        next-token argmax the decode path would have)."""
+        with self._cv:
+            if self._slot_req[slot] is not req:
+                return
+            req.prefix = np.concatenate(
+                [req.prompt,
+                 np.asarray(req.tokens, np.int32)]).astype(np.int32)
+            self._pending.insert(0, req)
+            depth = len(self._pending)
+        self._release_slot(slot)
+        req.slot = None
+        with self._stats_lock:
+            self._stats["preempted"] += 1
+        self._m_preempted.inc()
+        self._m_queue.set(depth)
+        log.info("preempted slot %d (seq len %d) for KV blocks; requeued",
+                 slot, len(req.prefix))
+
+    # -- admission ---------------------------------------------------------
     def _admit_pending(self):
         """Fill free slots from the queue (the per-iteration join half of
-        continuous batching: this runs between every decode step)."""
+        continuous batching: this runs between every decode step).
+        Requests that pad to the same prompt bucket are coalesced into
+        one batched prefill dispatch, capped by free slots, free blocks,
+        and ``prefill_batch``; the queue head is always first in its
+        group, so admission order cannot starve."""
         while True:
+            expired: List[_GenRequest] = []
+            group: List[_GenRequest] = []
+            slots_for: List[int] = []
+            bucket = None
             with self._cv:
-                free = next((i for i, r in enumerate(self._slot_req)
-                             if r is None), None)
-                if free is None or not self._pending:
-                    self._m_queue.set(len(self._pending))
-                    return
-                req = self._pending.pop(0)
-            if req.expired():
+                while self._pending and self._pending[0].expired():
+                    expired.append(self._pending.pop(0))
+                free_slots = [i for i, r in enumerate(self._slot_req)
+                              if r is None]
+                self._m_queue.set(len(self._pending))
+                if self._pending and free_slots:
+                    head = self._pending[0]
+                    bucket = bucket_for(len(head.prefix), self.ladder)
+                    budget = self._alloc.free_count
+                    need = self._blocks_for(len(head.prefix) + 1)
+                    if bucket is not None and need <= budget:
+                        group.append(head)
+                        budget -= need
+                        cap = min(len(free_slots), self.prefill_batch)
+                        for req in self._pending[1:]:
+                            if len(group) >= cap:
+                                break
+                            if req.expired():
+                                expired.append(req)
+                                continue
+                            if bucket_for(len(req.prefix),
+                                          self.ladder) != bucket:
+                                continue
+                            need = self._blocks_for(len(req.prefix) + 1)
+                            if need > budget:
+                                continue
+                            group.append(req)
+                            budget -= need
+                        for req in group + expired:
+                            if req in self._pending:
+                                self._pending.remove(req)
+                        slots_for = free_slots[:len(group)]
+                        for req, slot in zip(group, slots_for):
+                            ok = self._grow_slot(slot,
+                                                 len(req.prefix) + 1)
+                            assert ok, "admission budget went stale"
+                        self._m_blocks_free.set(self._alloc.free_count)
+                        self._m_queue.set(len(self._pending))
+            for req in expired:
                 self._expire(req)
-                continue
+            if not group:
+                return
             try:
-                self._start_request(req, free)
+                self._start_group(group, slots_for, bucket)
             except Exception as e:
-                if not req.future.done():
-                    req.future.set_exception(e)
+                for req, slot in zip(group, slots_for):
+                    if not req.future.done():
+                        req.future.set_exception(e)
+                    with self._cv:
+                        blks = self._tables[slot,
+                                            :int(self._nblocks[slot])]
+                        self._alloc.free(blks)
+                        self._tables[slot, :] = 0
+                        self._nblocks[slot] = 0
+                        self._m_blocks_free.set(self._alloc.free_count)
+                    if self._slot_req[slot] is req:
+                        self._release_slot(slot)
+                return
 
     def _expire(self, req: _GenRequest):
         if not req.future.done():
@@ -533,55 +975,129 @@ class DecodeEngine:
                             prompt_tokens=int(req.prompt.size),
                             error="TimeoutError")
 
-    def _start_request(self, req: _GenRequest, slot: int):
-        """Prefill the request's prompt into ``slot`` and sample its first
-        token (this is the TTFT-defining dispatch)."""
-        T = int(req.prompt.size)
-        bucket = bucket_for(T, self.ladder)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :T] = req.prompt
+    def _start_group(self, group: List[_GenRequest], slots: List[int],
+                     bucket: int):
+        """Prefill a same-bucket group of prompts in ONE dispatch (padded
+        up the batch ladder; padding rows write the scratch block) and
+        sample each request's first token (the TTFT-defining dispatch)."""
+        B = len(group)
+        bb = bucket_for(B, self.batch_ladder)
+        ids = np.zeros((bb, bucket), np.int32)
+        tables = np.zeros((bb, self.max_blocks), np.int32)
+        lengths = np.ones(bb, np.int32)
+        temps = np.zeros(bb, np.float32)
+        topks = np.zeros(bb, np.int32)
+        for r, (req, slot) in enumerate(zip(group, slots)):
+            p = req.prefix
+            ids[r, :p.size] = p
+            tables[r] = self._tables[slot]
+            lengths[r] = p.size
+            temps[r] = req.temperature
+            topks[r] = req.top_k
         t0 = time.perf_counter()
-        tok = self._run_prefill(ids, slot=slot, length=T,
-                                temperature=req.temperature,
-                                top_k=req.top_k)
-        req.t_first = time.perf_counter()
+        toks = self._run_prefill(ids, tables, lengths, temps, topks)
+        t_done = time.perf_counter()
         with self._stats_lock:
-            self._stats["prefills"] += 1
-        if self._reg.enabled:
-            self._m_ttft.observe(
-                req.t_first - req.t_submit,
-                exemplar=req.ctx.trace_id if req.ctx else None)
-            if req.ctx is not None:
-                tracer().record(
-                    "generation/prefill", t0, req.t_first, context=req.ctx,
-                    slot=slot, prompt_tokens=T, bucket=bucket,
-                    queue_s=round(t0 - req.t_submit, 6))
-        req.slot = slot
+            self._stats["prefills"] += B
+            self._stats["prefill_dispatches"] += 1
+        for r, (req, slot) in enumerate(zip(group, slots)):
+            tok = int(toks[r])
+            first = req.t_first is None
+            if first:
+                req.t_first = t_done
+            if self._reg.enabled:
+                if first:
+                    self._m_ttft.observe(
+                        req.t_first - req.t_submit,
+                        exemplar=req.ctx.trace_id if req.ctx else None)
+                if req.ctx is not None:
+                    tracer().record(
+                        "generation/prefill", t0, t_done, context=req.ctx,
+                        slot=slot, prompt_tokens=int(req.prefix.size),
+                        bucket=bucket, batch=B,
+                        queue_s=round(t0 - req.t_submit, 6))
+            req.slot = slot
+            with self._cv:
+                self._admit_counter += 1
+                req.admit_seq = self._admit_counter
+                self._slot_req[slot] = req
+                self._active_n += 1
+            self._m_active.set(self._active_n)
+            self._tokens[slot] = tok
+            self._lengths[slot] = int(req.prefix.size)
+            self._temps[slot] = req.temperature
+            self._topks[slot] = req.top_k
+            self._emit_token(req, tok)
+            self._check_stop(req, slot, tok)
+
+    # -- decode ------------------------------------------------------------
+    def _spec_ready(self) -> bool:
+        """True when this iteration can take the speculative step: every
+        active rider is greedy and has k+1 rows of context headroom, and
+        the pool can cover the k+1-row write horizon without preempting
+        anyone (speculation is a throughput luxury — it must never evict
+        a rider that plain decode could serve)."""
+        if not self._spec_enabled:
+            return False
+        k = self.spec_k
         with self._cv:
-            self._slot_req[slot] = req
-            self._active_n += 1
-        self._m_active.set(self._active_n)
-        self._tokens[slot] = tok
-        self._lengths[slot] = T
-        self._temps[slot] = req.temperature
-        self._topks[slot] = req.top_k
-        self._emit_token(req, tok)
-        self._check_stop(req, slot, tok)
+            riders = [slot for slot, r in enumerate(self._slot_req)
+                      if r is not None]
+            if not riders:
+                return False
+            for slot in riders:
+                if self._temps[slot] > 0:
+                    return False
+                if int(self._lengths[slot]) + k + 1 > self.max_ctx:
+                    return False
+            return self._blocks_deficit(k + 1) <= self._alloc.free_count
 
     def _decode_once(self):
+        spec = self._spec_ready()
+        self._ensure_blocks(self.spec_k + 1 if spec else 1)
         active = np.array([r is not None for r in self._slot_req])
-        nxt = self._run_decode(active)
+        if not active.any():
+            return
+        if spec:
+            self._spec_once(active)
+        else:
+            nxt = self._run_decode(active)
+            with self._stats_lock:
+                self._stats["decode_steps"] += 1
+            self._m_steps.inc()
+            for slot, req in enumerate(list(self._slot_req)):
+                if req is None:
+                    continue
+                self._lengths[slot] += 1
+                tok = int(nxt[slot])
+                self._tokens[slot] = tok
+                self._emit_token(req, tok)
+                self._check_stop(req, slot, tok)
+
+    def _spec_once(self, active):
+        commit, n_commit = self._run_spec(active)
+        k = self.spec_k
+        n_active = int(np.sum(active))
+        accepted = int(np.sum(np.maximum(n_commit[active] - 1, 0)))
         with self._stats_lock:
             self._stats["decode_steps"] += 1
+            self._stats["spec_steps"] += 1
+            self._stats["spec_proposed"] += k * n_active
+            self._stats["spec_accepted"] += accepted
         self._m_steps.inc()
+        self._m_spec_proposed.inc(k * n_active)
+        self._m_spec_accepted.inc(accepted)
         for slot, req in enumerate(list(self._slot_req)):
             if req is None:
                 continue
-            self._lengths[slot] += 1
-            tok = int(nxt[slot])
-            self._tokens[slot] = tok
-            self._emit_token(req, tok)
-            self._check_stop(req, slot, tok)
+            for j in range(int(n_commit[slot])):
+                tok = int(commit[slot, j])
+                self._lengths[slot] += 1
+                self._tokens[slot] = tok
+                self._emit_token(req, tok)
+                self._check_stop(req, slot, tok)
+                if self._slot_req[slot] is not req:
+                    break  # finished mid-prefix: drop the rest
 
     def _emit_token(self, req: _GenRequest, tok: int):
         req.tokens.append(tok)
@@ -632,12 +1148,20 @@ class DecodeEngine:
             if self._slot_req[slot] is not None:
                 self._slot_req[slot] = None
                 self._active_n -= 1
-            # stale KV rows stay in the cache but lengths=0 masks them out
-            # of every future attention (poison-value test)
+            # the slot's blocks return to the pool; stale KV rows stay in
+            # them but lengths=0 + a zeroed table masks them out of every
+            # future attention (poison-value test)
+            nb = int(self._nblocks[slot])
+            if nb > 0:
+                self._alloc.free(self._tables[slot, :nb])
+                self._tables[slot, :] = 0
+                self._nblocks[slot] = 0
             self._lengths[slot] = 0
             self._tokens[slot] = 0
+            free = self._alloc.free_count
             self._cv.notify_all()
         self._m_active.set(self._active_n)
+        self._m_blocks_free.set(free)
 
     # -- lifecycle (registry-compatible) -----------------------------------
     @property
@@ -702,9 +1226,56 @@ class DecodeEngine:
     # -- introspection -----------------------------------------------------
     def observed_entries(self) -> List[dict]:
         """Manifest handoff compatibility: generative warmup is fully
-        determined by (slots, max_ctx, ladder), so there is nothing to
-        replay from observed traffic."""
+        determined by (slots, max_ctx, ladder, batch ladder), so there is
+        nothing to replay from observed traffic."""
         return []
+
+    def debug_snapshot(self) -> Dict[str, Any]:
+        """Live slot map + block tables for ``GET /debug/decode`` and the
+        flight recorder: which sequence owns which slot, how many rows it
+        committed, and which pool blocks back it."""
+        with self._cv:
+            slots = []
+            for slot, req in enumerate(self._slot_req):
+                nb = int(self._nblocks[slot])
+                entry = {"slot": slot, "active": req is not None,
+                         "length": int(self._lengths[slot]),
+                         "blocks": [int(b)
+                                    for b in self._tables[slot, :nb]]}
+                if req is not None:
+                    entry.update({
+                        "prompt_tokens": int(req.prompt.size),
+                        "generated": len(req.tokens),
+                        "temperature": req.temperature,
+                        "trace_id": req.ctx.trace_id if req.ctx else None,
+                    })
+                slots.append(entry)
+            snap = {
+                "model": self.model_name,
+                "slots": slots,
+                "queue_depth": len(self._pending),
+                "pool": {"block_size": self.block_size,
+                         "total_blocks": self._alloc.total,
+                         "free_blocks": self._alloc.free_count,
+                         "max_blocks_per_slot": self.max_blocks,
+                         "scratch_block": 0},
+                "prefill": {"batch": self.prefill_batch,
+                            "buckets": list(self.ladder),
+                            "batch_ladder": list(self.batch_ladder)},
+                "speculative": {"enabled": self._spec_enabled,
+                                "k": self.spec_k},
+                "worker_dead": self._worker_dead,
+                "draining": self._draining,
+                "closed": self._closed,
+            }
+        with self._stats_lock:
+            snap["speculative"]["proposed"] = self._stats["spec_proposed"]
+            snap["speculative"]["accepted"] = self._stats["spec_accepted"]
+            prop = self._stats["spec_proposed"]
+            snap["speculative"]["acceptance_rate"] = (
+                round(self._stats["spec_accepted"] / prop, 4)
+                if prop else None)
+        return snap
 
     def stats(self) -> Dict[str, Any]:
         with self._stats_lock:
@@ -712,7 +1283,15 @@ class DecodeEngine:
         with self._cv:
             s["active_slots"] = self._active_n
             s["queued"] = len(self._pending)
+            s["kv_blocks_free"] = self._alloc.free_count
         s["slots"] = self.slots
         s["max_ctx"] = self.max_ctx
         s["prompt_buckets"] = list(self.ladder)
+        s["kv_block_size"] = self.block_size
+        s["kv_blocks"] = self.kv_blocks
+        s["prefill_batch"] = self.prefill_batch
+        s["spec_k"] = self.spec_k if self._spec_enabled else 0
+        if s["spec_proposed"]:
+            s["spec_acceptance"] = round(
+                s["spec_accepted"] / s["spec_proposed"], 4)
         return s

@@ -144,6 +144,10 @@ class GracefulLifecycle:
                 "breakers": self.registry.breaker_snapshot(),
                 "engine_health": resilience.health().snapshot(),
                 "faults": faults.stats(),
+                # generative decode state: slot map, block tables, pool
+                # occupancy, speculative acceptance (same join as
+                # /debug/decode)
+                "decode": self.registry.decode_snapshots(),
                 "trace_events": tracer().events(),
                 "metrics": metrics_registry().snapshot(),
             }

@@ -177,6 +177,11 @@ class ModelRegistry:
                decode_max_ctx: Optional[int] = None,
                decode_prompt_buckets: Optional[Sequence[int]] = None,
                decode_eos_token: Optional[int] = None,
+               decode_kv_block_size: Optional[int] = None,
+               decode_kv_blocks: Optional[int] = None,
+               decode_prefill_batch: Optional[int] = None,
+               decode_draft_model=None,
+               decode_spec_k: Optional[int] = None,
                quantize=None,
                calibration_batch=None,
                quant_max_divergence: Optional[float] = None,
@@ -194,13 +199,19 @@ class ModelRegistry:
         parked warm for rollback.
 
         A *generative* model (the ``models.causal_lm.CausalLM`` protocol:
-        ``init_kv_cache``/``prefill``/``decode``) deploys behind a
-        ``DecodeEngine`` instead of an ``InferenceEngine`` — served via
-        ``generate()`` / ``POST /v1/models/<name>/generate``; the
-        ``decode_*`` knobs size its slot count, context window, prompt
-        bucket ladder, and default EOS (env defaults otherwise). Warmup
-        compiles one prefill executable per prompt bucket plus the single
-        decode-step executable.
+        ``init_paged_kv_cache``/``paged_prefill``/``paged_decode``)
+        deploys behind a ``DecodeEngine`` instead of an
+        ``InferenceEngine`` — served via ``generate()`` /
+        ``POST /v1/models/<name>/generate``; the ``decode_*`` knobs size
+        its slot count, context window, prompt bucket ladder, and default
+        EOS (env defaults otherwise). ``decode_kv_block_size`` /
+        ``decode_kv_blocks`` size the paged KV pool,
+        ``decode_prefill_batch`` caps how many same-bucket prompts share
+        one prefill dispatch, and ``decode_draft_model`` +
+        ``decode_spec_k`` enable greedy speculative decoding. Warmup
+        compiles one prefill executable per (prompt bucket, batch rung)
+        pair plus the decode-step executable (plus the speculative step
+        when a draft is configured).
 
         ``quantize`` opts this deploy into post-training quantization
         (quant/): ``True``/``"int8"``/``"fp8"`` pick the storage mode, a
@@ -251,7 +262,13 @@ class ModelRegistry:
             engine = DecodeEngine(model, slots=decode_slots,
                                   max_ctx=decode_max_ctx,
                                   prompt_buckets=decode_prompt_buckets,
-                                  eos_token=decode_eos_token)
+                                  eos_token=decode_eos_token,
+                                  kv_block_size=decode_kv_block_size,
+                                  kv_blocks=decode_kv_blocks,
+                                  prefill_batch=decode_prefill_batch,
+                                  draft_model=decode_draft_model,
+                                  spec_k=decode_spec_k,
+                                  model_name=name)
         else:
             engine = InferenceEngine(model, max_batch=max_batch,
                                      buckets=buckets,
@@ -356,6 +373,23 @@ class ModelRegistry:
                 if name in self._current else None,
                 "versions": [mv.describe() for mv in versions],
             } for name, versions in sorted(self._versions.items())}
+
+    def decode_snapshots(self) -> List[Dict[str, Any]]:
+        """Live decode-engine state for ``GET /debug/decode`` and the
+        flight recorder: one entry per generative model, the current
+        version's slot map, block tables, pool occupancy, queue depth,
+        and speculative acceptance (``DecodeEngine.debug_snapshot()``)."""
+        with self._lock:
+            currents = sorted(self._current.items())
+        out = []
+        for name, mv in currents:
+            snap_fn = getattr(mv.engine, "debug_snapshot", None)
+            if callable(snap_fn):
+                snap = snap_fn()
+                snap["model"] = name
+                snap["version"] = mv.version
+                out.append(snap)
+        return out
 
     def ready(self) -> bool:
         """Readiness: not draining, and every deployed model's current

@@ -385,6 +385,10 @@ class ModelServer:
                             "watchdog":
                                 resilience.watchdog().watched(),
                             "faults": faults.stats()})
+                    elif path == "/debug/decode":
+                        self.send_json({
+                            "decode":
+                                server.registry.decode_snapshots()})
                     elif not handle_debug_get(self, path):
                         self.send_json({"error": "not found"}, 404)
                 else:

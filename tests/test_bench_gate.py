@@ -421,7 +421,9 @@ class TestCheckServingResilience:
         assert "gate_ok" in rec and "gate_reason" in rec
 
 
-def _gd_record(kv_speedup=4.0, cb_speedup=2.0, match=True, compiles=0):
+def _gd_record(kv_speedup=4.0, cb_speedup=2.0, match=True, compiles=0,
+               bytes_ratio=0.35, prefill_speedup=1.7, spec_match=True,
+               acceptance=0.8):
     return {
         "kv_cached": {"tokens_per_sec": 400.0},
         "recompute": {"tokens_per_sec": 400.0 / kv_speedup},
@@ -432,6 +434,20 @@ def _gd_record(kv_speedup=4.0, cb_speedup=2.0, match=True, compiles=0):
                        "p50_ttft_ms": 5.0, "p99_ttft_ms": 25.0},
         "serial": {"tokens_per_sec": 1000.0 / cb_speedup},
         "cb_speedup": cb_speedup,
+        "paged_kv": {"block_size": 16,
+                     "paged_bytes_per_token": 10000.0 * bytes_ratio
+                     if bytes_ratio is not None else None,
+                     "slab_bytes_per_token": 10000.0,
+                     "bytes_ratio": bytes_ratio},
+        "batched_prefill": {"prompts": 16, "batched_dispatches": 4,
+                            "serial_dispatches": 16,
+                            "speedup": prefill_speedup,
+                            "p99_ttft_ms": 20.0},
+        "speculative": {"k": 3, "decode_match": spec_match,
+                        "tokens_per_sec": 600.0,
+                        "plain_tokens_per_sec": 400.0,
+                        "speedup": 1.5, "acceptance_rate": acceptance,
+                        "proposed": 90, "accepted": 72},
     }
 
 
@@ -439,7 +455,12 @@ class TestCheckGenerativeDecode:
     """Gate logic for the generative_decode metric: the KV cache must buy
     >= 3x tokens/sec over prefix recompute, continuous batching >= 1.5x
     over per-request serving, greedy outputs must be token-identical, and
-    the steady state must compile nothing after warmup."""
+    the steady state must compile nothing after warmup. The paging PR
+    added three more: paged KV must hold <= 0.6x the slab layout's bytes
+    per active token, batched prefill must ingest prompts >= 1.3x faster
+    than per-prompt dispatch, and the speculative run must be
+    token-identical to the engine's own plain run with a measured
+    acceptance rate."""
 
     def test_accepts_good_record(self):
         ok, reason = bench.check_generative_decode(_gd_record())
@@ -476,18 +497,78 @@ class TestCheckGenerativeDecode:
         assert not ok
         assert "retracing" in reason
 
+    def test_rejects_high_kv_bytes_ratio(self):
+        # paged footprint near the slab's means blocks aren't tracking
+        # actual sequence length — the whole point of paging
+        ok, reason = bench.check_generative_decode(
+            _gd_record(bytes_ratio=0.7))
+        assert not ok
+        assert "bytes per active token" in reason
+        ok, _ = bench.check_generative_decode(_gd_record(bytes_ratio=0.59))
+        assert ok
+        ok, _ = bench.check_generative_decode(_gd_record(bytes_ratio=0.61))
+        assert not ok
+
+    def test_rejects_missing_paged_section(self):
+        rec = _gd_record()
+        del rec["paged_kv"]
+        ok, reason = bench.check_generative_decode(rec)
+        assert not ok
+        assert "paged_kv" in reason
+        rec = _gd_record(bytes_ratio=None)
+        ok, reason = bench.check_generative_decode(rec)
+        assert not ok
+        assert "paged_kv" in reason
+
+    def test_rejects_insufficient_prefill_speedup(self):
+        ok, reason = bench.check_generative_decode(
+            _gd_record(prefill_speedup=1.1))
+        assert not ok
+        assert "sharing a dispatch" in reason
+        ok, _ = bench.check_generative_decode(
+            _gd_record(prefill_speedup=1.31))
+        assert ok
+
+    def test_rejects_missing_prefill_section(self):
+        rec = _gd_record()
+        del rec["batched_prefill"]
+        ok, reason = bench.check_generative_decode(rec)
+        assert not ok
+        assert "batched_prefill" in reason
+
+    def test_rejects_speculative_token_mismatch(self):
+        # a draft that changes the greedy output is a correctness bug,
+        # whatever its speed
+        ok, reason = bench.check_generative_decode(
+            _gd_record(spec_match=False))
+        assert not ok
+        assert "non-speculative" in reason
+
+    def test_rejects_missing_acceptance_rate(self):
+        # no acceptance rate means the draft never proposed — the spec
+        # path wasn't actually exercised
+        ok, reason = bench.check_generative_decode(
+            _gd_record(acceptance=None))
+        assert not ok
+        assert "acceptance" in reason
+
     def test_custom_thresholds(self):
-        rec = _gd_record(kv_speedup=2.5, cb_speedup=1.2)
+        rec = _gd_record(kv_speedup=2.5, cb_speedup=1.2,
+                         bytes_ratio=0.7, prefill_speedup=1.1)
         ok, _ = bench.check_generative_decode(rec, min_kv_speedup=2.0,
-                                              min_cb_speedup=1.1)
+                                              min_cb_speedup=1.1,
+                                              max_kv_bytes_ratio=0.8,
+                                              min_prefill_speedup=1.0)
         assert ok
 
     def test_tiny_live_measurement_passes_gate(self):
         """The full metric end-to-end on CPU. Unlike the wall-clock-only
-        gates, this one IS asserted in CI: token-identity and the
-        zero-recompile invariant are deterministic, and the 3x/1.5x
-        speedups have wide margins at the tiny sizing (measured ~4.4x /
-        ~2.8x; the bench retries once on a timing hiccup)."""
+        gates, this one IS asserted in CI: token-identity, the
+        zero-recompile invariant, and the paged-vs-slab bytes ratio are
+        deterministic, and the timed gates have wide margins at the tiny
+        sizing (measured ~4.4x KV / ~2.8x cb / ~1.7x prefill against
+        3x / 1.5x / 1.3x; the bench retries once on a timing hiccup and
+        the prefill burst is a median of three)."""
         import jax
         import jax.numpy as jnp
 
@@ -495,6 +576,11 @@ class TestCheckGenerativeDecode:
         assert rec["decode_match"]
         assert rec["steady_state_compiles"] == 0
         assert rec["continuous"]["p99_ttft_ms"] > 0
+        assert rec["paged_kv"]["bytes_ratio"] < 0.6
+        assert rec["batched_prefill"]["batched_dispatches"] < \
+            rec["batched_prefill"]["serial_dispatches"]
+        assert rec["speculative"]["decode_match"]
+        assert rec["speculative"]["acceptance_rate"] is not None
         assert rec["gate_ok"], rec["gate_reason"]
 
 

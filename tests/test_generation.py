@@ -12,7 +12,9 @@ record cache=bypass instead of silently missing from compile telemetry;
 and POST /v1/models/<name>/generate works end-to-end through admission +
 trace context with reconstructable prefill/decode spans.
 """
+import dataclasses
 import json
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -327,23 +329,29 @@ class TestDecodeEngine:
         assert s["tokens"] == before["tokens"] + 3
         assert s["prefills"] == before["prefills"] + 1
         assert s["slots"] == 3
-        assert s["prompt_buckets"] == [32]
+        # explicit buckets, plus the always-present max_ctx top rung
+        # (preempted riders' prefixes must stay admittable)
+        assert s["prompt_buckets"] == [32, 64]
 
 
 class TestCompileCounting:
     def test_one_executable_per_bucket_plus_one_decode(self, model):
-        """Warmup compiles exactly len(ladder) prefill executables + 1
-        decode executable; steady-state traffic then compiles NOTHING —
-        the zero-recompile acceptance invariant."""
+        """Warmup compiles exactly len(ladder) * len(batch ladder)
+        prefill executables + 1 decode executable; steady-state traffic
+        then compiles NOTHING — the zero-recompile acceptance
+        invariant."""
         env = environment()
         eng = DecodeEngine(model, slots=2, max_ctx=64,
-                           prompt_buckets=[8, 32])
+                           prompt_buckets=[8, 32], prefill_batch=2)
+        expected = len(eng.ladder) * len(eng.batch_ladder) + 1
         try:
             env.reset_compile_count()
             eng.warmup()
-            assert env.compile_count() == 3  # prefill x2 + decode x1
+            # ladder (8, 32, + max_ctx rung) x batch ladder (1, 2)
+            # prefill executables, + 1 decode
+            assert env.compile_count() == expected
             eng.warmup()  # idempotent
-            assert env.compile_count() == 3
+            assert env.compile_count() == expected
             env.reset_compile_count()
             futs = [eng.generate(_prompt(n, seed=50 + n), max_tokens=4)
                     for n in (3, 8, 20, 5)]
@@ -393,6 +401,28 @@ class TestDecodeAttentionDispatch:
             env.set_flash_min_seq(prev)
         assert fam.labels(path="xla").value() == before + 1
 
+    def test_paged_path_ticks_paged_label(self):
+        """The block-table gather attention of paged_decode records its
+        own path=paged label — paged and slab decode executables stay
+        distinguishable in telemetry — and never takes the flash kernel,
+        whatever the query length or DL4J_TPU_FLASH_MIN_SEQ."""
+        from deeplearning4j_tpu.kernels import attention_dispatch
+
+        fam = registry().counter(
+            "dl4j_attn_dispatch_total",
+            "Attention path decisions for flash=True configs",
+            labels=("path",))
+        before = fam.labels(path="paged").value()
+        env = environment()
+        prev = env.flash_min_seq()
+        try:
+            env.set_flash_min_seq(1)
+            assert attention_dispatch(1, paged=True) == "paged"
+            assert attention_dispatch(512, paged=True) == "paged"
+        finally:
+            env.set_flash_min_seq(prev)
+        assert fam.labels(path="paged").value() == before + 2
+
 
 # ---------------------------------------------------------------------------
 # satellite: donated-cache steps are store-ineligible, never silent
@@ -416,12 +446,14 @@ class TestDonatedDecodeCompileCache:
         pre_prefill = bypass_count("prefill")
         pre_decode = bypass_count("decode")
         eng = DecodeEngine(model, slots=2, max_ctx=64,
-                           prompt_buckets=[16])
+                           prompt_buckets=[16], prefill_batch=1)
         try:
             eng.warmup()
         finally:
             eng.close(10)
-        assert bypass_count("prefill") == pre_prefill + 1
+        # one prefill executable per ladder rung ([16] + max_ctx top
+        # rung), one decode executable — every one a store bypass
+        assert bypass_count("prefill") == pre_prefill + len(eng.ladder)
         assert bypass_count("decode") == pre_decode + 1
         inv = compile_cache.inventory()
         assert inv["enabled"]  # conftest pins a live per-run cache dir
@@ -615,6 +647,25 @@ class TestGenerateEndpoint:
             w["total"] >= 1
             for w in srv.slo_for("lm").snapshot()["windows"]))
 
+    def test_debug_decode_endpoint(self, served_lm):
+        """GET /debug/decode joins every current generative engine's
+        slot map + block pool + speculative state into the debug
+        surface (and, via decode_snapshots(), the flight recorder)."""
+        reg, srv, base = served_lm
+        _post(base + "/v1/models/lm/generate",
+              {"prompt": [5, 6, 7], "max_tokens": 2})
+        status, _, body = _get(base + "/debug/decode")
+        assert status == 200
+        snaps = json.loads(body)["decode"]
+        snap = next(s for s in snaps if s["model"] == "lm")
+        assert snap["version"] == "v1"
+        assert snap["pool"]["scratch_block"] == 0
+        assert snap["pool"]["free_blocks"] <= snap["pool"]["total_blocks"]
+        assert len(snap["slots"]) == 2
+        assert snap["prefill"]["batch"] >= 1
+        assert snap["speculative"]["enabled"] is False
+        assert snap["queue_depth"] >= 0
+
     def test_hot_swap_generative_version(self, served_lm, model):
         """Warm-before-cutover + rollback work for DecodeEngine versions
         exactly as for predict engines."""
@@ -667,3 +718,306 @@ class TestDecodeEnvKnobs:
                 SystemProperties
             env.clear_property(SystemProperties.DECODE_SLOTS)
             env.clear_property(SystemProperties.DECODE_MAX_CTX)
+
+
+# ---------------------------------------------------------------------------
+# tentpole: paged KV block pool
+# ---------------------------------------------------------------------------
+
+class TestPagedKVBlocks:
+    def test_blocks_track_sequence_length(self, model):
+        """The reservation the paging PR exists for: a sequence holds
+        ceil((rows written + 1) / block_size) blocks at every step —
+        never the slab layout's full max_ctx worth."""
+        eng = _engine(model, slots=2, prompt_buckets=[16], kv_block_size=8)
+        samples = []
+
+        def cb(_tok):
+            samples.append((int(eng._nblocks.sum()),
+                            int(eng._lengths.sum())))
+
+        try:
+            total = eng.stats()["kv_blocks_free"]
+            assert total == eng.kv_blocks == 2 * eng.max_blocks
+            res = eng.generate(_prompt(12, seed=80), max_tokens=20,
+                               on_token=cb).result(timeout=60)
+            assert len(res["tokens"]) == 20
+            for nblocks, length in samples:
+                # within one block of committed rows (+1 for the write
+                # horizon the scheduler pre-allocates)
+                assert 0 <= nblocks * eng.block_size - length \
+                    <= eng.block_size
+            peak = max(nb for nb, _ in samples)
+            # final length 32 rows -> 4 blocks; slab would pin all 8
+            assert peak < eng.max_blocks
+            # every block returned on completion
+            assert eng.stats()["kv_blocks_free"] == total
+        finally:
+            eng.close(10)
+
+    def test_blocks_free_gauge_tracks_pool(self, model):
+        fam = registry().gauge(
+            "dl4j_kv_blocks_free",
+            "Free KV-cache blocks in the paged decode pool",
+            labels=("model",))
+        eng = _engine(model, kv_block_size=8, model_name="kvgauge")
+        child = fam.labels(model="kvgauge")
+        dips = []
+        try:
+            assert child.value() == eng.kv_blocks
+            eng.generate(_prompt(10, seed=81), max_tokens=8,
+                         on_token=lambda t: dips.append(child.value())
+                         ).result(timeout=60)
+            assert min(dips) < eng.kv_blocks  # held while decoding
+            assert child.value() == eng.kv_blocks  # returned on finish
+        finally:
+            eng.close(10)
+
+    def test_over_pool_request_rejected_at_submit(self, model):
+        """A request whose worst case cannot fit the pool must fail at
+        generate(), not deadlock the scheduler mid-decode."""
+        eng = _engine(model, kv_block_size=8, kv_blocks=4)  # 32 rows
+        try:
+            with pytest.raises(ValueError, match="KV blocks"):
+                # prompt 8 + capped max_tokens 56 -> 8 blocks > 4
+                eng.generate(_prompt(8, seed=82), max_tokens=56)
+            res = eng.generate(_prompt(8, seed=82),
+                               max_tokens=8).result(timeout=60)
+            assert len(res["tokens"]) == 8  # 16 rows = 2 blocks: fits
+        finally:
+            eng.close(10)
+
+    def test_slab_layout_is_block_size_max_ctx(self, model):
+        """kv_block_size >= max_ctx reproduces the legacy slab: one
+        block per slot, admission == slot availability."""
+        eng = _engine(model, kv_block_size=4096)
+        try:
+            assert eng.block_size == eng.max_ctx
+            assert eng.max_blocks == 1
+            assert eng.kv_blocks == eng.slots
+        finally:
+            eng.close(10)
+
+    def test_debug_snapshot_surface(self, model):
+        eng = _engine(model, kv_block_size=8, model_name="snap")
+        gate, release = threading.Event(), threading.Event()
+
+        def cb(_tok):
+            gate.set()
+            release.wait(30)
+
+        try:
+            fut = eng.generate(_prompt(6, seed=83), max_tokens=4,
+                               on_token=cb)
+            assert gate.wait(30)
+            snap = eng.debug_snapshot()
+            assert snap["model"] == "snap"
+            assert snap["pool"]["scratch_block"] == 0
+            assert snap["pool"]["block_size"] == 8
+            assert snap["pool"]["free_blocks"] < snap["pool"]["total_blocks"]
+            occupied = [s for s in snap["slots"] if s["active"]]
+            assert len(occupied) == 1
+            assert occupied[0]["prompt_tokens"] == 6
+            assert occupied[0]["blocks"]  # non-scratch ids
+            assert all(b > 0 for b in occupied[0]["blocks"])
+            assert snap["speculative"]["enabled"] is False
+            release.set()
+            fut.result(timeout=60)
+        finally:
+            release.set()
+            eng.close(10)
+
+
+class TestPreemption:
+    def test_pool_exhaustion_preempts_lifo_and_recomputes(self, model):
+        """Two riders whose combined growth exceeds the pool: the later-
+        admitted one is preempted (blocks reclaimed, requeued at the
+        queue head), then recomputed from prompt + committed tokens —
+        greedy output stays token-identical for BOTH."""
+        fam = registry().counter(
+            "dl4j_decode_preempted_total",
+            "Sequences preempted (blocks reclaimed, requeued for "
+            "recompute) because the KV block pool ran dry mid-decode")
+        before = fam.value()
+        # pool of 5 blocks = 40 rows; each request's worst case is 4
+        # blocks (32 rows), so both fit alone but not together
+        eng = _engine(model, slots=2, prompt_buckets=[16],
+                      kv_block_size=8, kv_blocks=5)
+        pa, pb = _prompt(8, seed=84), _prompt(8, seed=85)
+        ra, rb = _ref_greedy(model, pa, 24), _ref_greedy(model, pb, 24)
+        try:
+            fa = eng.generate(pa, max_tokens=24)
+            fb = eng.generate(pb, max_tokens=24)
+            assert fa.result(timeout=120)["tokens"] == ra
+            assert fb.result(timeout=120)["tokens"] == rb
+            s = eng.stats()
+            assert s["preempted"] >= 1
+            assert fam.value() >= before + 1
+            assert s["kv_blocks_free"] == 5  # nothing leaked
+        finally:
+            eng.close(10)
+
+
+# ---------------------------------------------------------------------------
+# tentpole: batched prefill
+# ---------------------------------------------------------------------------
+
+class TestBatchedPrefill:
+    def _gated_long(self, eng, seed):
+        """Start a request whose first on_token blocks the decode loop:
+        everything submitted while it is blocked is queued together, so
+        the next admission's grouping is deterministic."""
+        entered, release = threading.Event(), threading.Event()
+
+        def gate(_tok):
+            entered.set()
+            release.wait(30)
+
+        fut = eng.generate(_prompt(5, seed=seed), max_tokens=8,
+                           on_token=gate)
+        assert entered.wait(30)
+        return fut, release
+
+    def test_same_bucket_prompts_share_one_dispatch(self, model):
+        eng = _engine(model, slots=4, prompt_buckets=[16],
+                      prefill_batch=4)
+        prompts = [_prompt(6, seed=90 + i) for i in range(3)]
+        refs = [_ref_greedy(model, p, 4) for p in prompts]
+        long_ref = _ref_greedy(model, _prompt(5, seed=89), 8)
+        try:
+            before = eng.stats()
+            long_fut, release = self._gated_long(eng, 89)
+            futs = [eng.generate(p, max_tokens=4) for p in prompts]
+            release.set()
+            for f, ref in zip(futs, refs):
+                assert f.result(timeout=60)["tokens"] == ref
+            assert long_fut.result(timeout=60)["tokens"] == long_ref
+            s = eng.stats()
+            assert s["prefills"] - before["prefills"] == 4
+            # one dispatch for the long prompt + ONE for the group of 3
+            assert (s["prefill_dispatches"]
+                    - before["prefill_dispatches"]) == 2
+        finally:
+            eng.close(10)
+
+    def test_mixed_buckets_do_not_share_a_dispatch(self, model):
+        """Coalescing is per bucket: padding a 20-token prompt into a
+        16-bucket dispatch would corrupt it, so it gets its own."""
+        eng = _engine(model, slots=4, prompt_buckets=[16, 32],
+                      prefill_batch=4)
+        p16a, p32, p16b = (_prompt(6, seed=94), _prompt(20, seed=95),
+                           _prompt(7, seed=96))
+        refs = [_ref_greedy(model, p, 3) for p in (p16a, p32, p16b)]
+        try:
+            before = eng.stats()
+            long_fut, release = self._gated_long(eng, 93)
+            futs = [eng.generate(p, max_tokens=3)
+                    for p in (p16a, p32, p16b)]
+            release.set()
+            for f, ref in zip(futs, refs):
+                assert f.result(timeout=60)["tokens"] == ref
+            long_fut.result(timeout=60)
+            # long alone + {p16a, p16b} grouped + p32 alone
+            assert (eng.stats()["prefill_dispatches"]
+                    - before["prefill_dispatches"]) == 3
+        finally:
+            eng.close(10)
+
+
+# ---------------------------------------------------------------------------
+# tentpole: greedy speculative decoding
+# ---------------------------------------------------------------------------
+
+class TestSpeculativeDecode:
+    def test_same_model_draft_token_identical(self, model):
+        eng = _engine(model, draft_model=model, spec_k=3)
+        prompts = [_prompt(n, seed=100 + n) for n in (5, 9)]
+        refs = [_ref_greedy(model, p, 10) for p in prompts]
+        try:
+            futs = [eng.generate(p, max_tokens=10) for p in prompts]
+            for f, ref in zip(futs, refs):
+                assert f.result(timeout=60)["tokens"] == ref
+            s = eng.stats()
+            assert s["spec_steps"] > 0
+            assert s["spec_proposed"] > 0
+            # an identical draft should verify nearly everything
+            assert s.get("spec_acceptance", 0) >= 0.9
+            snap = eng.debug_snapshot()
+            assert snap["speculative"]["enabled"]
+            assert snap["speculative"]["k"] == 3
+            assert snap["speculative"]["acceptance_rate"] is not None
+        finally:
+            eng.close(10)
+
+    def test_truncated_draft_token_identical(self, model):
+        """The production shape: a cheaper draft sharing the target's
+        first layer + embeddings. Whatever it proposes, verification
+        must keep the greedy output byte-for-byte the target's own."""
+        dcfg = dataclasses.replace(CFG, num_layers=1)
+        draft = causal_lm.CausalLM(dcfg, params={
+            "embeddings": model.params["embeddings"],
+            "layers": model.params["layers"][:1]})
+        eng = _engine(model, draft_model=draft, spec_k=2)
+        prompt = _prompt(6, seed=110)
+        ref = _ref_greedy(model, prompt, 12)
+        try:
+            res = eng.generate(prompt, max_tokens=12).result(timeout=60)
+            assert res["tokens"] == ref
+            s = eng.stats()
+            assert s["spec_steps"] > 0
+            assert s.get("spec_acceptance") is not None
+        finally:
+            eng.close(10)
+
+    def test_sampled_rider_falls_back_to_plain_decode(self, model):
+        """Speculation is greedy-only: any sampled rider in the batch
+        sends the whole step down the plain path."""
+        eng = _engine(model, draft_model=model, spec_k=3)
+        try:
+            res = eng.generate(_prompt(5, seed=111), max_tokens=8,
+                               temperature=0.8, top_k=10
+                               ).result(timeout=60)
+            assert len(res["tokens"]) == 8
+            assert all(0 <= t < CFG.vocab_size for t in res["tokens"])
+            assert eng.stats()["spec_steps"] == 0
+        finally:
+            eng.close(10)
+
+    def test_non_generative_draft_rejected(self, model):
+        with pytest.raises(TypeError, match="draft_model"):
+            _engine(model, draft_model=object(), spec_k=2)
+
+
+class TestPagedEnvKnobs:
+    def test_defaults_and_overrides(self):
+        from deeplearning4j_tpu.common.environment import SystemProperties
+        env = environment()
+        assert env.kv_block_size() == 16
+        assert env.spec_draft_k() == 0
+        try:
+            env.set_kv_block_size(4)
+            env.set_spec_draft_k(2)
+            assert env.kv_block_size() == 4
+            assert env.spec_draft_k() == 2
+        finally:
+            env.clear_property(SystemProperties.KV_BLOCK_SIZE)
+            env.clear_property(SystemProperties.SPEC_DRAFT_K)
+
+    def test_engine_reads_env_knobs(self, model):
+        from deeplearning4j_tpu.common.environment import SystemProperties
+        env = environment()
+        try:
+            env.set_kv_block_size(4)
+            env.set_spec_draft_k(2)
+            eng = _engine(model, draft_model=model)
+            assert eng.block_size == 4
+            assert eng.max_blocks == 16
+            assert eng.spec_k == 2 and eng._spec_enabled
+            eng.close(5)
+            # spec_k=0 disables even with a draft wired
+            eng = _engine(model, draft_model=model, spec_k=0)
+            assert not eng._spec_enabled
+            eng.close(5)
+        finally:
+            env.clear_property(SystemProperties.KV_BLOCK_SIZE)
+            env.clear_property(SystemProperties.SPEC_DRAFT_K)

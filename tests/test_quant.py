@@ -268,6 +268,64 @@ class TestQuantizedCausalLM:
         finally:
             eng.close(5.0)
 
+    @staticmethod
+    def _twin_and_ref(mode, prompt, n):
+        """A quantized twin plus its OWN full-recompute greedy reference
+        (the paged/speculative paths must reproduce the twin's function,
+        not the f32 original's)."""
+        from deeplearning4j_tpu.models.causal_lm import (CausalLM,
+                                                         CausalLMConfig)
+
+        cfg = CausalLMConfig.tiny()
+        qm = quantize_model(CausalLM(cfg, seed=0), QuantSpec(mode=mode))
+        toks = [int(t) for t in prompt]
+        ref = []
+        for _ in range(n):
+            logits = qm.forward(
+                jnp.asarray(np.array(toks, np.int32)[None]))
+            tok = int(jnp.argmax(logits[0, len(toks) - 1]))
+            ref.append(tok)
+            toks.append(tok)
+        return qm, ref
+
+    @pytest.mark.parametrize("mode", ["int8", "fp8"])
+    def test_twin_paged_decode_token_identical(self, mode):
+        """Both storage modes decode through the paged KV cache (small
+        blocks, block-table gather) token-identically to the twin's own
+        full-recompute greedy."""
+        from deeplearning4j_tpu.runtime.generation import DecodeEngine
+
+        prompt = [3, 1, 4, 1, 5, 9]
+        qm, ref = self._twin_and_ref(mode, prompt, 8)
+        eng = DecodeEngine(qm, slots=2, max_ctx=32, prompt_buckets=[8],
+                           kv_block_size=4)
+        try:
+            res = eng.generate(prompt, max_tokens=8,
+                               eos_token=None).result(timeout=60)
+            assert res["tokens"] == ref
+            assert eng.stats()["kv_blocks_free"] == eng.kv_blocks
+        finally:
+            eng.close(5.0)
+
+    @pytest.mark.parametrize("mode", ["int8", "fp8"])
+    def test_twin_speculative_decode_token_identical(self, mode):
+        """The quantized twin drives the speculative path as both target
+        and draft: verification keeps the greedy output identical to the
+        twin's non-speculative function in either storage mode."""
+        from deeplearning4j_tpu.runtime.generation import DecodeEngine
+
+        prompt = [2, 7, 1, 8]
+        qm, ref = self._twin_and_ref(mode, prompt, 8)
+        eng = DecodeEngine(qm, slots=2, max_ctx=32, prompt_buckets=[8],
+                           kv_block_size=4, draft_model=qm, spec_k=2)
+        try:
+            res = eng.generate(prompt, max_tokens=8,
+                               eos_token=None).result(timeout=60)
+            assert res["tokens"] == ref
+            assert eng.stats()["spec_steps"] > 0
+        finally:
+            eng.close(5.0)
+
 
 # ---------------------------------------------------------------------------
 # the divergence gate + env knobs

@@ -559,7 +559,10 @@ class TestDecodeResilience:
         queued requests survive to be served next iteration."""
         eng = decode_engine
         leaks = metrics_registry().counter("dl4j_decode_slot_leaks_total")
+        block_leaks = metrics_registry().counter(
+            "dl4j_kv_block_leaks_total")
         leaks_before = leaks.value()
+        block_leaks_before = block_leaks.value()
         with faults.injected("decode.step", times=1):
             fut = eng.generate([1, 2, 3], max_tokens=8, eos_token=None)
             with pytest.raises(faults.InjectedFault):
@@ -569,6 +572,10 @@ class TestDecodeResilience:
             time.sleep(0.02)
         assert eng.stats()["active_slots"] == 0  # slot freed
         assert leaks.value() == leaks_before     # freed properly, no repair
+        # the failed rider's KV blocks went back to the pool the same
+        # way — released, not repaired by the reconcile pass
+        assert eng.stats()["kv_blocks_free"] == eng.kv_blocks
+        assert block_leaks.value() == block_leaks_before
         r = eng.generate([4, 5], max_tokens=3, eos_token=None).result(30)
         assert len(r["tokens"]) == 3             # engine still serves
         assert not eng.worker_dead
@@ -581,6 +588,8 @@ class TestDecodeResilience:
                 bad.result(timeout=30)
         ok = eng.generate([3, 4], max_tokens=2, eos_token=None).result(30)
         assert len(ok["tokens"]) == 2
+        # blocks pre-allocated for the failed prefill group were freed
+        assert eng.stats()["kv_blocks_free"] == eng.kv_blocks
 
     def test_cancelled_rider_releases_slot(self, decode_engine):
         eng = decode_engine
@@ -598,6 +607,35 @@ class TestDecodeResilience:
         cancelled = metrics_registry().counter(
             "dl4j_decode_cancelled_total")
         assert cancelled.value() >= 1
+        # a cancelled rider's blocks return with its slot
+        deadline = time.monotonic() + 10
+        while eng.stats()["kv_blocks_free"] != eng.kv_blocks \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert eng.stats()["kv_blocks_free"] == eng.kv_blocks
+
+    def test_reconcile_repairs_block_accounting_drift(self,
+                                                      decode_engine):
+        """Deliberately drift the allocator (a block marked in-use that
+        no slot's table references): the per-iteration reconcile pass
+        must return it to the pool and count the repair on
+        dl4j_kv_block_leaks_total."""
+        eng = decode_engine
+        block_leaks = metrics_registry().counter(
+            "dl4j_kv_block_leaks_total")
+        before = block_leaks.value()
+        with eng._cv:
+            stolen = eng._alloc.alloc(1)
+        assert stolen
+        assert eng.stats()["kv_blocks_free"] == eng.kv_blocks - 1
+        # any scheduler iteration runs the reconcile pass
+        eng.generate([6, 7], max_tokens=1, eos_token=None).result(30)
+        deadline = time.monotonic() + 10
+        while block_leaks.value() < before + 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert block_leaks.value() >= before + 1
+        assert eng.stats()["kv_blocks_free"] == eng.kv_blocks
 
     def test_loop_crash_supervised_restart(self, decode_engine):
         eng = decode_engine
