@@ -36,6 +36,13 @@ chip + MFU (BASELINE config 3; north-star acceptance 35% MFU → vs_baseline
                            tier-1 gate must fit CI, < 30 s — plus the
                            DL105 lock-order tracker's serving-throughput
                            overhead, on vs off; gated < 3%)
+  - sharded_serving       (sharded serving fleet: mesh-sharded deploy
+                           parity vs single-device + FleetRouter
+                           scale-out over 3 replicas; gated: identical
+                           argmax, 3-replica throughput >= 2x one
+                           replica, and a mid-storm replica kill keeps
+                           non-shed success at 100% via one failover
+                           retry)
 Config 5 (multi-chip scaling) needs >1 chip; the driver's multichip dryrun
 covers correctness, scaling numbers await real multi-chip hardware.
 
@@ -1845,6 +1852,245 @@ def check_static_analysis(rec, max_seconds=30.0, max_overhead=0.03):
     return True, "ok"
 
 
+def bench_sharded_serving(jax, jnp, tiny):
+    """Sharded serving fleet (serving/fleet): scale-up parity plus
+    scale-out routing. Three legs over the same toy MLP:
+
+    1. **mesh parity** — the model deployed sharded over the full
+       ``serving_mesh()`` (params partitioned over the ``model`` axis)
+       must answer ``predict`` with logits matching the single-device
+       deploy to float tolerance and with identical argmax.
+       Cross-device contractions reorder the reduction, so bitwise
+       identity holds only on a 1x1 mesh (pinned in
+       tests/test_fleet.py); the serving contract gated here is
+       decision-identity.
+    2. **scale-out** — a 6-thread client storm through a FleetRouter
+       over 3 in-process ModelServer replicas (each admission-limited
+       to ``max_concurrent=1``) vs the same storm over one replica.
+       Per-request service time is dominated by the micro-batcher's
+       coalescing window — a wait that burns no host CPU, standing in
+       for per-replica device time on a single-core CI box — so the
+       ratio measures the ROUTER's least-loaded spreading, not host
+       parallelism. Gate: >= 2x.
+    3. **replica-kill drill** — the same storm with one replica's HTTP
+       server stopped a quarter of the way in. The router must take
+       the dead replica out of rotation (one failover retry on a
+       different replica) with every non-shed request still
+       succeeding. Gate: 100% non-shed success and at least one
+       recorded failover.
+    """
+    import threading
+
+    from deeplearning4j_tpu.common.mesh import mesh_shape, serving_mesh
+    from deeplearning4j_tpu.common.metrics import registry as mreg
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+    from deeplearning4j_tpu.serving.fleet import FleetRouter, NoReplicaError
+
+    n_in, hidden, n_out, B = 32, 64, 8, 4
+    n_threads = 6
+    per_thread = 15 if tiny else 40
+    delay_ms = 20.0  # the no-CPU service-time floor per solo dispatch
+
+    def _mlp(seed=0):
+        b = NeuralNetConfiguration.builder().seed(seed).list()
+        b.layer(DenseLayer(n_in=n_in, n_out=hidden, activation="tanh"))
+        conf = b.layer(OutputLayer(n_in=hidden, n_out=n_out)).build()
+        return MultiLayerNetwork(conf).init()
+
+    x = np.random.RandomState(0).randn(B, n_in).astype(np.float32)
+    rec = {"n_devices": jax.device_count(), "threads": n_threads,
+           "requests_per_storm": n_threads * per_thread,
+           "batch_delay_ms": delay_ms}
+
+    # -- leg 1: mesh-sharded deploy parity vs single-device ---------------
+    mesh = serving_mesh()
+    regp = ModelRegistry(manifest_dir=None)
+    try:
+        regp.deploy("plain", "v1", _mlp(), example=x, warm=True)
+        ref = np.asarray(regp.predict("plain", x).jax())
+        mv = regp.deploy("sharded", "v1", _mlp(), example=x, warm=True,
+                         mesh=mesh)
+        out = np.asarray(regp.predict("sharded", x).jax())
+        rec["parity"] = {
+            "mesh_shape": mesh_shape(mesh),
+            "param_spec": mv.describe().get("param_spec"),
+            "allclose": bool(np.allclose(ref, out, rtol=1e-5, atol=1e-6)),
+            "argmax_match_rate": float(
+                (ref.argmax(-1) == out.argmax(-1)).mean()),
+            "max_abs_err": float(np.abs(ref - out).max()),
+        }
+    finally:
+        regp.drain_all(save_manifests=False)
+
+    # -- legs 2+3: the replica fleet --------------------------------------
+    body = json.dumps({"inputs": x.tolist()}).encode()
+
+    def storm(router, kill_at=None, kill_fn=None):
+        ok, shed, failed = [0], [0], [0]
+        lat, hit = [], set()
+        lock = threading.Lock()
+        done = [0]
+
+        def client():
+            for _ in range(per_thread):
+                t0 = time.perf_counter()
+                try:
+                    status, _, _, url = router.route(
+                        "POST", "/v1/models/bench/predict", body,
+                        headers=[("Content-Type", "application/json")],
+                        model="bench", timeout_s=30)
+                except NoReplicaError:
+                    with lock:
+                        failed[0] += 1
+                        done[0] += 1
+                    continue
+                dt = time.perf_counter() - t0
+                with lock:
+                    done[0] += 1
+                    if status == 200:
+                        ok[0] += 1
+                        lat.append(dt)
+                        hit.add(url)
+                    elif status == 429:
+                        shed[0] += 1
+                    else:
+                        failed[0] += 1
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        if kill_fn is not None:
+            while True:
+                with lock:
+                    if done[0] >= kill_at:
+                        break
+                time.sleep(0.005)
+            kill_fn()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return {"offered": n_threads * per_thread, "ok": ok[0],
+                "shed": shed[0], "failed": failed[0],
+                "throughput_rps": round(ok[0] / wall, 2),
+                "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2)
+                if lat else None,
+                "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2)
+                if lat else None,
+                "replicas_hit": len(hit)}
+
+    def failovers():
+        fam = mreg().get("dl4j_router_dispatch_total")
+        if fam is None:
+            return 0.0
+        i = fam.label_names.index("outcome")
+        return sum(c.value() for key, c in fam.children()
+                   if key[i] == "failover")
+
+    members, urls = [], []
+    try:
+        for i in range(3):
+            reg = ModelRegistry(manifest_dir=None)
+            reg.deploy("bench", "v1", _mlp(), example=x, max_batch=8,
+                       max_delay_ms=delay_ms)
+            srv = ModelServer(reg, max_concurrent=1, queue_depth=64,
+                              high_water=64)
+            port = srv.start()
+            members.append((reg, srv))
+            urls.append(f"http://127.0.0.1:{port}")
+
+        single = FleetRouter(urls[:1], poll_s=3600, retries=1,
+                             timeout_s=30)
+        single.poll_once()
+        rec["single_replica"] = storm(single)
+
+        fleet = FleetRouter(urls, poll_s=3600, retries=1, timeout_s=30)
+        fleet.poll_once()
+        rec["fleet3"] = storm(fleet)
+        rec["scaleout"] = round(
+            rec["fleet3"]["throughput_rps"]
+            / max(rec["single_replica"]["throughput_rps"], 1e-9), 3)
+
+        # leg 3: stop the replica the router would pick next, a quarter
+        # of the way through the storm
+        pre = failovers()
+        victim = fleet._candidates("bench")[0]
+        idx = next(i for i, (_, s) in enumerate(members)
+                   if f":{s.port}" in victim.url)
+        kill = storm(fleet, kill_at=(n_threads * per_thread) // 4,
+                     kill_fn=lambda: members[idx][1].stop())
+        kill["failovers"] = int(failovers() - pre)
+        kill["nonshed_success_rate"] = round(
+            kill["ok"] / max(kill["offered"] - kill["shed"], 1), 5)
+        rec["kill_drill"] = kill
+    finally:
+        for reg, srv in members:
+            try:
+                srv.stop()
+            except Exception:
+                pass
+            try:
+                reg.drain_all(save_manifests=False)
+            except Exception:
+                pass
+    ok, reason = check_sharded_serving(rec)
+    rec["gate_ok"], rec["gate_reason"] = ok, reason
+    return rec
+
+
+def check_sharded_serving(rec, min_scaleout=2.0):
+    """(ok, reason): gates a sharded_serving record must pass.
+
+    - the mesh-sharded deploy must serve the same model: logits within
+      float tolerance of the single-device deploy and every argmax
+      identical (cross-device reduction order forbids bitwise identity
+      on a >1-device mesh; decisions may never change);
+    - the 3-replica storm must actually have spread (>= 2 replicas hit)
+      — a ratio measured against a router that never fanned out proves
+      nothing;
+    - 3-replica throughput must be >= ``min_scaleout`` (2x) the single
+      replica's;
+    - the replica-kill drill must have recorded at least one failover
+      (the dead replica was really in rotation) and lost nothing: 100%
+      of non-shed requests succeed via the retry."""
+    p = rec["parity"]
+    if not p["allclose"] or p["argmax_match_rate"] < 1.0:
+        return False, (
+            f"sharded predict diverges from single-device: "
+            f"allclose={p['allclose']}, argmax match "
+            f"{p['argmax_match_rate']:.4f}, max |err| "
+            f"{p['max_abs_err']:.2e} — the mesh deploy is not serving "
+            "the same model")
+    if rec["fleet3"]["replicas_hit"] < 2:
+        return False, (
+            f"the 3-replica storm landed on "
+            f"{rec['fleet3']['replicas_hit']} replica(s): the router "
+            "never spread the load, so the scale-out ratio is untested")
+    if rec["scaleout"] < min_scaleout:
+        return False, (
+            f"3-replica throughput "
+            f"{rec['fleet3']['throughput_rps']:.2f} rps is only "
+            f"{rec['scaleout']:.2f}x the single replica's "
+            f"{rec['single_replica']['throughput_rps']:.2f} (gate: >= "
+            f"{min_scaleout}x): adding replicas is not scaling the "
+            "fleet out")
+    k = rec["kill_drill"]
+    if k["failovers"] < 1:
+        return False, (
+            "the kill drill recorded no failovers: the dead replica was "
+            "never routed to, so the recovery claim is untested")
+    if k["nonshed_success_rate"] < 1.0:
+        return False, (
+            f"only {k['nonshed_success_rate']:.4f} of non-shed requests "
+            "succeeded through the replica kill (gate: 100%): failover "
+            "is losing requests")
+    return True, "ok"
+
+
 def bench_flash_attention(jax, jnp, tiny):
     """Pallas flash attention vs XLA attention at long sequence length.
 
@@ -2066,6 +2312,11 @@ def main():
             out["static_analysis"] = bench_static_analysis(jax, jnp, tiny)
         except Exception as e:
             out["static_analysis"] = f"error: {type(e).__name__}"
+        _release()
+        try:
+            out["sharded_serving"] = bench_sharded_serving(jax, jnp, tiny)
+        except Exception as e:
+            out["sharded_serving"] = f"error: {type(e).__name__}"
         _release()
         try:
             fwd, train = bench_flash_attention(jax, jnp, tiny)

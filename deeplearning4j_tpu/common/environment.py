@@ -85,6 +85,9 @@ class EnvironmentVars:
     DL4J_TPU_WATCHDOG_FACTOR = "DL4J_TPU_WATCHDOG_FACTOR"
     DL4J_TPU_PROFILE_DIR = "DL4J_TPU_PROFILE_DIR"
     DL4J_TPU_FLIGHT_RECORDER_DIR = "DL4J_TPU_FLIGHT_RECORDER_DIR"
+    DL4J_TPU_FLEET_POLL_S = "DL4J_TPU_FLEET_POLL_S"
+    DL4J_TPU_FLEET_RETRIES = "DL4J_TPU_FLEET_RETRIES"
+    DL4J_TPU_FLEET_TIMEOUT_S = "DL4J_TPU_FLEET_TIMEOUT_S"
     XLA_FLAGS = "XLA_FLAGS"
 
 
@@ -145,6 +148,9 @@ class SystemProperties:
     WATCHDOG_FACTOR = "watchdog_factor"
     PROFILE_DIR = "profile_dir"
     FLIGHT_RECORDER_DIR = "flight_recorder_dir"
+    FLEET_POLL_S = "fleet_poll_s"
+    FLEET_RETRIES = "fleet_retries"
+    FLEET_TIMEOUT_S = "fleet_timeout_s"
 
 
 _ENV_FOR_PROP = {
@@ -225,6 +231,10 @@ _ENV_FOR_PROP = {
     SystemProperties.PROFILE_DIR: EnvironmentVars.DL4J_TPU_PROFILE_DIR,
     SystemProperties.FLIGHT_RECORDER_DIR:
         EnvironmentVars.DL4J_TPU_FLIGHT_RECORDER_DIR,
+    SystemProperties.FLEET_POLL_S: EnvironmentVars.DL4J_TPU_FLEET_POLL_S,
+    SystemProperties.FLEET_RETRIES: EnvironmentVars.DL4J_TPU_FLEET_RETRIES,
+    SystemProperties.FLEET_TIMEOUT_S:
+        EnvironmentVars.DL4J_TPU_FLEET_TIMEOUT_S,
 }
 
 _DEFAULTS = {
@@ -279,6 +289,9 @@ _DEFAULTS = {
     SystemProperties.WATCHDOG_FACTOR: "3",
     SystemProperties.PROFILE_DIR: "",          # "" = <cache_dir>/profiles
     SystemProperties.FLIGHT_RECORDER_DIR: "",  # "" = <cache_dir>/flight
+    SystemProperties.FLEET_POLL_S: "2.0",
+    SystemProperties.FLEET_RETRIES: "1",
+    SystemProperties.FLEET_TIMEOUT_S: "30.0",
 }
 
 
@@ -840,6 +853,37 @@ class Environment:
             return float(v)
         except (TypeError, ValueError):
             return 3.0
+
+    # -- fleet routing (serving/fleet) -------------------------------------
+    def fleet_poll_s(self) -> float:
+        """FleetRouter replica-poll interval in seconds
+        (``DL4J_TPU_FLEET_POLL_S``): how often each replica's
+        ``/readyz`` + ``/metrics.json`` are refreshed for the
+        least-loaded score."""
+        v = self.property(SystemProperties.FLEET_POLL_S)
+        try:
+            return max(float(v), 0.05)
+        except (TypeError, ValueError):
+            return 2.0
+
+    def fleet_retries(self) -> int:
+        """Failover retries the router makes on a *different* replica
+        after a replica-level failure — 503 / connection refused / timeout
+        (``DL4J_TPU_FLEET_RETRIES``)."""
+        v = self.property(SystemProperties.FLEET_RETRIES)
+        try:
+            return max(int(v), 0)
+        except (TypeError, ValueError):
+            return 1
+
+    def fleet_timeout_s(self) -> float:
+        """Per-attempt HTTP timeout for routed requests
+        (``DL4J_TPU_FLEET_TIMEOUT_S``)."""
+        v = self.property(SystemProperties.FLEET_TIMEOUT_S)
+        try:
+            return max(float(v), 0.1)
+        except (TypeError, ValueError):
+            return 30.0
 
     # -- telemetry (common/metrics.py, common/tracing.py) ------------------
     def metrics(self):

@@ -1,157 +1,40 @@
-"""Device mesh abstraction.
+"""Device mesh abstraction — moved to :mod:`..common.mesh`.
 
-Reference context (SURVEY.md §2.4/§2.5): the reference's distribution stack —
-ParallelWrapper replica threads, Spark parameter averaging, Aeron
-gradient-sharing mesh (`MeshOrganizer.java`) — is replaced wholesale by ONE
-concept: a `jax.sharding.Mesh` with named axes, over which whole training
-steps are jit-compiled and XLA inserts ICI collectives.
-
-Axes (the full 5D parallelism vocabulary, all first-class):
-  data   — batch sharding (subsumes all four reference DP flavors)
-  fsdp   — parameter sharding along data (ZeRO-3 style, optional)
-  tensor — tensor/model parallelism (absent in reference; required for BERT MFU)
-  seq    — sequence/context parallelism (ring attention)
-  pipe   — pipeline stages
-The reference's node-failure remapping (`MeshOrganizer.remapNode`) maps to
-JAX distributed-runtime coordination; in-process we expose elastic re-mesh
-by rebuilding the Mesh from the live device list.
+The mesh builders and spec helpers are shared between training
+(ParallelWrapper) and serving (InferenceEngine / DecodeEngine / fleet),
+so they live in ``common/mesh.py``; this module re-exports the training
+vocabulary so existing ``parallel.mesh`` imports keep working.
 """
 from __future__ import annotations
 
-import dataclasses
-import math
-from typing import Optional, Sequence, Tuple
+from ..common.mesh import (  # noqa: F401
+    DATA,
+    FSDP,
+    MODEL,
+    PIPE,
+    SEQ,
+    TENSOR,
+    MeshConfig,
+    axis_size,
+    batch_spec,
+    data_parallel_mesh,
+    dp_size,
+    local_mesh_info,
+    make_mesh,
+    num_devices,
+    replicate,
+    replicated_spec,
+    shard_batch,
+    shard_map,
+    zero1_place,
+    zero1_shardings,
+    zero1_spec,
+)
 
-import jax
-import numpy as np
-from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-DATA, FSDP, TENSOR, SEQ, PIPE = "data", "fsdp", "tensor", "seq", "pipe"
-
-try:
-    from jax import shard_map as _shard_map  # jax >= 0.5
-
-    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False, **kw):
-        return _shard_map(f, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs, check_vma=check_vma, **kw)
-except ImportError:  # jax 0.4.x: experimental module, check_rep spelling
-    from jax.experimental.shard_map import shard_map as _shard_map
-
-    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False, **kw):
-        # check_rep must stay False: 0.4.x has no replication rule for
-        # pallas_call, so check_rep=True rejects the flash-ring bodies
-        return _shard_map(f, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs, check_rep=check_vma, **kw)
-
-
-def axis_size(axis):
-    """lax.axis_size (jax >= 0.5), or the static psum-of-1 idiom on 0.4.x."""
-    if hasattr(lax, "axis_size"):
-        return lax.axis_size(axis)
-    return lax.psum(1, axis)
-
-
-@dataclasses.dataclass
-class MeshConfig:
-    """Declarative mesh shape; -1 on `data` means "all remaining devices"."""
-    data: int = -1
-    fsdp: int = 1
-    tensor: int = 1
-    seq: int = 1
-    pipe: int = 1
-
-    def resolve(self, n_devices: int) -> Tuple[int, int, int, int, int]:
-        fixed = self.fsdp * self.tensor * self.seq * self.pipe
-        data = self.data
-        if data == -1:
-            if n_devices % fixed != 0:
-                raise ValueError(f"{n_devices} devices not divisible by "
-                                 f"fsdp*tensor*seq*pipe={fixed}")
-            data = n_devices // fixed
-        if data * fixed != n_devices:
-            raise ValueError(f"mesh {data}x{fixed} != {n_devices} devices")
-        return (data, self.fsdp, self.tensor, self.seq, self.pipe)
-
-
-def make_mesh(config: MeshConfig = None, devices: Sequence = None) -> Mesh:
-    """Build a named Mesh.
-
-    Axis order puts `data` outermost (DCN-friendly) and `tensor`/`seq`
-    innermost (highest-bandwidth ICI neighbors) — the standard TPU layout
-    recipe: collectives that run every layer (TP allreduce, ring attention
-    ppermute) ride the fastest links.
-    """
-    config = config or MeshConfig()
-    devices = list(devices) if devices is not None else jax.devices()
-    shape = config.resolve(len(devices))
-    dev_array = np.asarray(devices).reshape(shape)
-    return Mesh(dev_array, (DATA, FSDP, TENSOR, SEQ, PIPE))
-
-
-def data_parallel_mesh(devices=None) -> Mesh:
-    return make_mesh(MeshConfig(), devices)
-
-
-def batch_spec() -> P:
-    """Batch sharded over data(+fsdp); everything else replicated."""
-    return P((DATA, FSDP))
-
-
-def replicated_spec() -> P:
-    return P()
-
-
-def shard_batch(mesh: Mesh, batch_tree):
-    """Place host arrays sharded over the batch axis."""
-    sharding = NamedSharding(mesh, batch_spec())
-    return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, sharding), batch_tree)
-
-
-def replicate(mesh: Mesh, tree):
-    sharding = NamedSharding(mesh, P())
-    return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, sharding), tree)
-
-
-def dp_size(mesh: Mesh) -> int:
-    """Size of the data-parallel group (data * fsdp axes)."""
-    return int(mesh.shape[DATA] * mesh.shape[FSDP])
-
-
-def zero1_spec(mesh: Mesh, arr) -> P:
-    """ZeRO-1 PartitionSpec for one optimizer-state leaf: leading dim
-    sharded over the data-parallel group when divisible, else replicated
-    (sharding is an optimization, never a correctness constraint)."""
-    n = dp_size(mesh)
-    if n > 1 and getattr(arr, "ndim", 0) >= 1 and arr.shape[0] % n == 0:
-        return P((DATA, FSDP))
-    return P()
-
-
-def zero1_shardings(mesh: Mesh, tree):
-    """NamedSharding tree for an updater-state pytree under ZeRO-1: each
-    chip holds 1/dp of every (divisible) state tensor. The updater math
-    runs on the shards; GSPMD all-gathers the resulting update where the
-    replicated params consume it — the ZeRO-1 recipe, expressed purely as
-    sharding annotations on the jitted train step."""
-    return jax.tree_util.tree_map(
-        lambda a: NamedSharding(mesh, zero1_spec(mesh, a)), tree)
-
-
-def zero1_place(mesh: Mesh, tree):
-    """device_put an updater-state pytree into the ZeRO-1 layout."""
-    return jax.tree_util.tree_map(
-        lambda a: jax.device_put(a, NamedSharding(mesh, zero1_spec(mesh, a))),
-        tree)
-
-
-def num_devices(mesh: Optional[Mesh] = None) -> int:
-    return int(np.prod(mesh.devices.shape)) if mesh is not None \
-        else jax.device_count()
-
-
-def local_mesh_info(mesh: Mesh) -> str:
-    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-    return f"Mesh({shape}, {mesh.devices.size} devices)"
+__all__ = [
+    "DATA", "FSDP", "MODEL", "PIPE", "SEQ", "TENSOR",
+    "MeshConfig", "axis_size", "batch_spec", "data_parallel_mesh",
+    "dp_size", "local_mesh_info", "make_mesh", "num_devices",
+    "replicate", "replicated_spec", "shard_batch", "shard_map",
+    "zero1_place", "zero1_shardings", "zero1_spec",
+]

@@ -30,8 +30,8 @@ from ..common.environment import environment
 from ..common.tracing import span
 from ..datasets.dataset import DataSet
 from ..ndarray.ndarray import NDArray
-from .mesh import (DATA, FSDP, MeshConfig, make_mesh, zero1_place,
-                   zero1_shardings)
+from ..common.mesh import (DATA, FSDP, MeshConfig, make_mesh, zero1_place,
+                           zero1_shardings)
 
 
 @dataclasses.dataclass

@@ -12,16 +12,21 @@ Three layers, safest-first:
 
 1. **On-disk executable store** (``DL4J_TPU_CACHE_DIR``, on by default at
    ``~/.cache/deeplearning4j_tpu``): for *serving-shaped* entries (no
-   donation, no explicit shardings, plain array args) the first call per
-   input signature runs ``jit(...).lower(...)`` and consults the store.
-   A hit deserializes the XLA executable (``PjRtClient.deserialize_
-   executable``) and skips XLA compilation entirely; a miss compiles via
-   ``lowered.compile()`` and serializes the result back. The cache key is
-   a sha256 over everything that feeds a trace: the lowered StableHLO
-   module (which captures shapes, dtypes, batch bucket, donation/sharding
-   attributes, and every conf knob that changes the traced program), the
-   jit kwargs, jax/jaxlib versions, backend platform + device kind +
-   device count, and the trace-relevant ``DL4J_TPU_*`` flags.
+   donation, no explicit sharding kwargs, plain array args — including
+   mesh-sharded arrays committed via ``NamedSharding``) the first call
+   per input signature runs ``jit(...).lower(...)`` and consults the
+   store. A hit deserializes the XLA executable (``PjRtClient.
+   deserialize_executable``) and skips XLA compilation entirely; a miss
+   compiles via ``lowered.compile()`` and serializes the result back,
+   for multi-device programs together with the mesh + in/out
+   PartitionSpecs needed to place inputs and reassemble sharded outputs
+   into global arrays on reload. The cache key is a sha256 over
+   everything that feeds a trace: the lowered StableHLO module (which
+   captures shapes, dtypes, batch bucket, donation/sharding attributes,
+   and every conf knob that changes the traced program), the jit kwargs,
+   the device assignment + input shardings of the concrete call,
+   jax/jaxlib versions, backend platform + device kind + device count,
+   and the trace-relevant ``DL4J_TPU_*`` flags.
 2. **jax persistent-compilation-cache backstop**: when the store is
    enabled on an accelerator backend, ``jax_compilation_cache_dir`` is
    pointed at ``<dir>/xla`` so every compile this process runs —
@@ -36,21 +41,23 @@ Three layers, safest-first:
    while lowering, loading, serializing, or calling an AOT entry falls
    back to the live ``jax.jit`` dispatch that predates this module.
 
-Observability: ``dl4j_compiles_total`` and the ``dl4j_compile_seconds``
-histogram are labeled ``cache=hit|miss|bypass`` (hit = loaded from the
-store; miss = compiled and stored; bypass = caching disabled or entry not
-eligible for serialization). Disable everything with
-``DL4J_TPU_CACHE_DIR=""``.
+Observability: ``dl4j_compiles_total`` is labeled
+``cache=hit|miss|bypass``; the ``dl4j_compile_seconds`` histogram carries
+the reasoned form — ``hit``, ``miss``, or ``bypass:<reason>`` (e.g.
+``bypass:donation`` for the donated-KV decode steps that remain
+store-ineligible by design, ``bypass:disabled`` when the store is off).
+Disable everything with ``DL4J_TPU_CACHE_DIR=""``.
 
 **Donated-KV-cache decode steps are store-ineligible by design.** The
 generative fast path (``runtime.generation.DecodeEngine``) donates its
 preallocated KV cache into every prefill/decode step so the cache updates
 in place; a raw stored executable bypasses jax's donation bookkeeping, so
-``_eligible`` refuses these entries and they dispatch through the live
-jit. They are NOT silently missing from telemetry: ``counted_jit`` still
-records one compile event per signature with ``cache=bypass`` on both
-``dl4j_compiles_total`` and the ``dl4j_compile_seconds`` histogram
-(asserted in tests/test_generation.py). On accelerator backends the
+``_ineligible_reason`` refuses these entries and they dispatch through
+the live jit. They are NOT silently missing from telemetry:
+``counted_jit`` still records one compile event per signature with
+``cache=bypass`` on ``dl4j_compiles_total`` and ``cache=bypass:donation``
+on the ``dl4j_compile_seconds`` histogram (asserted in
+tests/test_generation.py). On accelerator backends the
 ``jax_compilation_cache_dir`` backstop at ``<dir>/xla`` still shortens
 their restart compiles; on CPU the backstop stays off (see
 ``_backstop_wanted``) and decode steps recompile on restart — bounded at
@@ -73,7 +80,7 @@ from ..common.locks import ordered_lock
 log = logging.getLogger(__name__)
 
 #: bump to invalidate every existing on-disk entry (layout change)
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
 
 _PAYLOAD_EXT = ".bin"
 _META_EXT = ".json"
@@ -117,15 +124,51 @@ def _jit_kwargs_repr(jit_kwargs: Dict[str, Any]) -> str:
     return repr(sorted((k, repr(v)) for k, v in jit_kwargs.items()))
 
 
-def cache_key(lowered, jit_kwargs: Optional[Dict[str, Any]] = None) -> str:
+def _placement_fingerprint(args) -> str:
+    """Device assignment + input shardings of the call's args. The
+    StableHLO text carries the *logical* sharding attributes, but not the
+    physical device assignment — two processes with the same program on
+    different device orderings (or one sharded vs one replicated over a
+    different mesh) must not share a raw executable."""
+    if args is None:
+        return ""
+    import jax
+    from jax.sharding import NamedSharding
+
+    parts = []
+    try:
+        for leaf in jax.tree_util.tree_leaves(args):
+            sh = getattr(leaf, "sharding", None)
+            if sh is None:
+                parts.append("host")
+            elif isinstance(sh, NamedSharding):
+                mesh = sh.mesh
+                parts.append("named:%s:%s:%s:%s" % (
+                    ",".join(mesh.axis_names),
+                    "x".join(str(s) for s in mesh.devices.shape),
+                    ",".join(str(d.id) for d in mesh.devices.flat),
+                    sh.spec))
+            else:
+                ids = sorted(d.id for d in getattr(sh, "device_set", ()))
+                parts.append("%s:%s" % (type(sh).__name__, ids))
+    except Exception:
+        parts.append("unknown")
+    return ";".join(parts)
+
+
+def cache_key(lowered, jit_kwargs: Optional[Dict[str, Any]] = None,
+              args=None) -> str:
     """sha256 hex key for a ``jax.stages.Lowered``: the StableHLO text
     captures shapes/dtypes/buckets/mesh attributes and every conf knob
     that alters the traced program; the fingerprint adds versions,
-    topology, and env flags."""
+    topology, and env flags; the placement fingerprint adds the device
+    assignment + input shardings of the concrete call."""
     h = hashlib.sha256()
     h.update(env_fingerprint().encode())
     h.update(b"\x00")
     h.update(_jit_kwargs_repr(jit_kwargs or {}).encode())
+    h.update(b"\x00")
+    h.update(_placement_fingerprint(args).encode())
     h.update(b"\x00")
     h.update(lowered.as_text().encode())
     return h.hexdigest()
@@ -446,36 +489,47 @@ def serving_manifest_dir(create: bool = True) -> Optional[str]:
 # AOT entry construction (the counted_jit integration point)
 # ---------------------------------------------------------------------------
 
-def _eligible(args, jit_kwargs: Dict[str, Any]) -> bool:
-    """Serving-shaped calls only: raw executables bypass jax's arg
-    handling, so refuse anything with donation (buffer invalidation),
-    explicit shardings / static args (layout and closure semantics), or
+def _ineligible_reason(args, jit_kwargs: Dict[str, Any]) -> Optional[str]:
+    """Why a call may NOT be wrapped as a raw executable (None = may).
+
+    Raw executables bypass jax's arg handling, so refuse anything with
+    donation (buffer invalidation — the DecodeEngine's donated-KV steps),
+    explicit sharding kwargs / static args (closure semantics), or
     non-array leaves beyond plain python scalars (extended dtypes such as
-    PRNG keys lower to internal layouts)."""
+    PRNG keys lower to internal layouts). Multi-device args ARE eligible:
+    the key folds in the device assignment + shardings
+    (``_placement_fingerprint``) and ``_load_executor`` reassembles
+    sharded outputs into global arrays."""
     import jax
 
-    for k in ("donate_argnums", "donate_argnames", "static_argnums",
-              "static_argnames", "in_shardings", "out_shardings"):
+    for k in ("donate_argnums", "donate_argnames"):
         if jit_kwargs.get(k):
-            return False
+            return "donation"
+    for k in ("static_argnums", "static_argnames"):
+        if jit_kwargs.get(k):
+            return "static-args"
+    for k in ("in_shardings", "out_shardings"):
+        if jit_kwargs.get(k):
+            # explicit sharding kwargs ride the live jit (they only appear
+            # on training paths, usually next to donation anyway); the
+            # serving path shards via committed args, which we do wrap
+            return "shardings-kwarg"
     try:
         for leaf in jax.tree_util.tree_leaves(args):
             if isinstance(leaf, (bool, int, float)):
                 continue
             dt = getattr(leaf, "dtype", None)
             if dt is None or not hasattr(leaf, "shape"):
-                return False
+                return "non-array"
             if jax.dtypes.issubdtype(dt, jax.dtypes.extended):
-                return False
-            sh = getattr(leaf, "sharding", None)
-            if sh is not None and len(getattr(sh, "device_set", ())) > 1:
-                # sharded/replicated input: a raw executor would hand back
-                # one shard of the output — multi-device programs stay on
-                # the live jit + backstop
-                return False
+                return "extended-dtype"
     except Exception:
-        return False
-    return True
+        return "args-error"
+    return None
+
+
+def _eligible(args, jit_kwargs: Dict[str, Any]) -> bool:
+    return _ineligible_reason(args, jit_kwargs) is None
 
 
 def cost_analysis(compiled) -> Optional[dict]:
@@ -509,10 +563,64 @@ def cost_analysis(compiled) -> Optional[dict]:
     return out or None
 
 
+def _spec_encode(spec) -> list:
+    """PartitionSpec -> JSON list (None | axis name | [axis names])."""
+    out = []
+    for e in spec:
+        if e is None:
+            out.append(None)
+        elif isinstance(e, (tuple, list)):
+            out.append([str(n) for n in e])
+        else:
+            out.append(str(e))
+    return out
+
+
+def _spec_decode(enc):
+    from jax.sharding import PartitionSpec as P
+    return P(*[tuple(e) if isinstance(e, list) else e for e in enc])
+
+
+def _sharding_meta(compiled) -> Optional[dict]:
+    """mesh + flat in/out PartitionSpecs for a multi-device program (the
+    reload recipe ``_load_executor`` uses to place inputs and reassemble
+    outputs into global arrays). None for single-device programs. Raises
+    on sharding flavors we cannot round-trip (e.g. GSPMDSharding without
+    a named mesh) — the caller then treats the entry as bypass."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    in_leaves = jax.tree_util.tree_leaves(compiled.input_shardings[0])
+    out_leaves = jax.tree_util.tree_leaves(compiled.output_shardings)
+    if all(len(getattr(s, "device_set", ())) <= 1
+           for s in in_leaves + out_leaves):
+        return None
+    mesh = None
+
+    def desc(s):
+        nonlocal mesh
+        if not isinstance(s, NamedSharding):
+            raise ValueError(
+                f"cannot round-trip {type(s).__name__} shardings")
+        if mesh is None:
+            mesh = s.mesh
+        elif s.mesh != mesh:
+            raise ValueError("multiple meshes in one program")
+        return _spec_encode(s.spec)
+
+    return {"in_specs": [desc(s) for s in in_leaves],
+            "out_specs": [desc(s) for s in out_leaves],
+            "mesh": {"axes": list(mesh.axis_names),
+                     "shape": [int(x) for x in mesh.devices.shape],
+                     "device_ids": [int(d.id)
+                                    for d in mesh.devices.flat]}}
+
+
 def _serialize(compiled) -> Tuple[bytes, dict]:
     """(payload, meta) for a ``jax.stages.Compiled``. Raises when the
-    backend does not support executable serialization (caller treats the
-    entry as bypass; the jax backstop still covers it)."""
+    backend does not support executable serialization, or when a
+    multi-device program's shardings cannot be round-tripped (caller
+    treats the entry as bypass; the jax backstop still covers it)."""
     import jax
 
     exe = compiled.runtime_executable()
@@ -523,6 +631,9 @@ def _serialize(compiled) -> Tuple[bytes, dict]:
         raise ValueError("executable exposes no kept_var_idx")
     meta = {"kept_var_idx": sorted(int(i) for i in kept),
             "created": time.time()}
+    sharded = _sharding_meta(compiled)
+    if sharded:
+        meta.update(sharded)
     cost = cost_analysis(compiled)
     if cost:
         meta["cost"] = cost
@@ -533,10 +644,20 @@ def _load_executor(payload: bytes, meta: dict, lowered) -> Optional[Callable]:
     """Rebuild a callable from a stored executable: deserialize, then per
     call flatten args in jit order, keep only the argument positions the
     compiled program kept, execute, and unflatten with the lowering's
-    output treedef. Single-device, non-donating programs only (enforced
-    by ``_eligible`` before anything is stored)."""
+    output treedef.
+
+    Single-device programs take shard [0] of each result (there is only
+    one). Multi-device programs carry their mesh + in/out PartitionSpecs
+    in ``meta`` (``_sharding_meta``): inputs are committed to the stored
+    input shardings and every result's shards are reassembled into a
+    global array via ``jax.make_array_from_single_device_arrays`` (shards
+    map by device, so executable device order is irrelevant). Non-donating
+    programs only (enforced by ``_ineligible_reason`` before anything is
+    stored)."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
 
     try:
         if faults.active():
@@ -547,6 +668,21 @@ def _load_executor(payload: bytes, meta: dict, lowered) -> Optional[Callable]:
         exe = backend.deserialize_executable(payload)
         kept = meta["kept_var_idx"]
         out_tree = lowered.out_tree
+        in_sh = out_sh = out_avals = None
+        mesh_meta = meta.get("mesh")
+        if mesh_meta:
+            by_id = {d.id: d for d in jax.devices()}
+            devs = np.asarray(
+                [by_id[i] for i in mesh_meta["device_ids"]],
+                dtype=object).reshape(mesh_meta["shape"])
+            mesh = Mesh(devs, tuple(mesh_meta["axes"]))
+            in_sh = [NamedSharding(mesh, _spec_decode(s))
+                     for s in meta["in_specs"]]
+            out_sh = [NamedSharding(mesh, _spec_decode(s))
+                      for s in meta["out_specs"]]
+            out_avals = jax.tree_util.tree_leaves(lowered.out_info)
+            if len(out_avals) != len(out_sh):
+                raise ValueError("out_specs/out_info arity mismatch")
     except Exception as e:
         log.warning("compile cache deserialize failed (%s: %s); "
                     "recompiling", type(e).__name__, e)
@@ -554,12 +690,20 @@ def _load_executor(payload: bytes, meta: dict, lowered) -> Optional[Callable]:
 
     def call(*args):
         flat = jax.tree_util.tree_leaves(args)
-        bufs = [flat[i] if isinstance(flat[i], jax.Array)
-                else jnp.asarray(flat[i]) for i in kept]
+        if in_sh is None:
+            bufs = [flat[i] if isinstance(flat[i], jax.Array)
+                    else jnp.asarray(flat[i]) for i in kept]
+        else:
+            bufs = [jax.device_put(flat[i], in_sh[i]) for i in kept]
         results = exe.execute_sharded(
             bufs).disassemble_into_single_device_arrays()
-        return jax.tree_util.tree_unflatten(out_tree,
-                                            [r[0] for r in results])
+        if out_sh is None:
+            return jax.tree_util.tree_unflatten(out_tree,
+                                                [r[0] for r in results])
+        outs = [jax.make_array_from_single_device_arrays(
+                    tuple(av.shape), s, r)
+                for av, s, r in zip(out_avals, out_sh, results)]
+        return jax.tree_util.tree_unflatten(out_tree, outs)
 
     return call
 
@@ -572,20 +716,25 @@ def aot_entry(jfn, tag: str, args, jit_kwargs: Dict[str, Any]
 
     - ``"hit"``    — executable loaded from the store, XLA never ran;
     - ``"miss"``   — lowered + compiled AOT, serialized into the store;
-    - ``"bypass"`` — caching disabled, entry ineligible for raw
-      serialization, or any step failed: the live ``jax.jit`` dispatch is
-      returned unchanged (the jax persistent-cache backstop still
-      shortens its compile when enabled).
+    - ``"bypass:<reason>"`` — caching disabled, entry ineligible for raw
+      serialization (e.g. ``bypass:donation`` for the DecodeEngine's
+      donated-KV steps), or a step failed: the live ``jax.jit`` dispatch
+      is returned unchanged (the jax persistent-cache backstop still
+      shortens its compile when enabled). ``dl4j_compiles_total`` records
+      the base label; the reasoned form lands on ``dl4j_compile_seconds``.
     """
     cc = cache()
-    if cc is None or not _eligible(args, jit_kwargs):
-        return jfn, "bypass"
+    if cc is None:
+        return jfn, "bypass:disabled"
+    why = _ineligible_reason(args, jit_kwargs)
+    if why is not None:
+        return jfn, "bypass:" + why
     try:
         lowered = jfn.lower(*args)
-        key = cache_key(lowered, jit_kwargs)
+        key = cache_key(lowered, jit_kwargs, args)
     except Exception as e:
         log.debug("AOT lowering failed for %s (%s); live jit", tag, e)
-        return jfn, "bypass"
+        return jfn, "bypass:lower-error"
     entry = cc.get(key)
     if entry is not None:
         call = _load_executor(entry[0], entry[1], lowered)
@@ -596,7 +745,7 @@ def aot_entry(jfn, tag: str, args, jit_kwargs: Dict[str, Any]
         compiled = lowered.compile()
     except Exception as e:
         log.debug("AOT compile failed for %s (%s); live jit", tag, e)
-        return jfn, "bypass"
+        return jfn, "bypass:compile-error"
     try:
         payload, meta = _serialize(compiled)
         meta["tag_kind"] = tag.split(":")[0]
@@ -604,8 +753,8 @@ def aot_entry(jfn, tag: str, args, jit_kwargs: Dict[str, Any]
     except Exception as e:
         log.debug("executable serialization unavailable for %s (%s); "
                   "backstop only", tag, e)
-        return compiled, "bypass"
-    return compiled, ("miss" if stored else "bypass")
+        return compiled, "bypass:serialize"
+    return compiled, ("miss" if stored else "bypass:store-error")
 
 
 def warm(jfn, args, jit_kwargs: Optional[Dict[str, Any]] = None,
@@ -623,7 +772,7 @@ def warm(jfn, args, jit_kwargs: Optional[Dict[str, Any]] = None,
     jit_kwargs = jit_kwargs or {}
     if _eligible(args, jit_kwargs):
         _, label = aot_entry(jfn, tag, args, jit_kwargs)
-        return label
+        return label.partition(":")[0]
     try:
         jfn.lower(*args).compile()
     except Exception as e:

@@ -51,8 +51,8 @@ len(batch ladder) + 1 (+1 with speculation)`` executables after warmup
 and steady-state decode performs **zero recompiles** — the acceptance
 invariant of the ``generative_decode`` bench. Donated-cache entries are
 store-ineligible by design (``runtime.compile_cache``): they record
-``cache=bypass`` on the compile-seconds histogram and rely on the XLA
-backstop cache on accelerator backends.
+``cache=bypass:donation`` on the compile-seconds histogram and rely on
+the XLA backstop cache on accelerator backends.
 
 Observability: ``dl4j_decode_requests_total``, ``dl4j_decode_tokens_total``,
 ``dl4j_decode_steps_total``, ``dl4j_decode_active_slots``,
@@ -208,6 +208,29 @@ class _BlockAllocator:
                       if b not in self._used]
 
 
+def _shard_kv_pool(mesh, cache_tree):
+    """Commit a paged KV pool over the mesh: the heads dim (axis 3 of the
+    ``[blocks, layers, block_size, heads, head_dim]`` pool) shards over
+    the ``model`` axis when divisible, everything else replicates —
+    attention is head-parallel, so each device owns its heads' KV bytes
+    end to end."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..common.mesh import MODEL
+
+    size = int(mesh.shape[MODEL]) if MODEL in mesh.axis_names else 1
+
+    def place(leaf):
+        if (size > 1 and getattr(leaf, "ndim", 0) == 5
+                and leaf.shape[3] % size == 0):
+            spec = P(None, None, None, MODEL, None)
+        else:
+            spec = P()
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, cache_tree)
+
+
 class DecodeEngine:
     """Continuous-batching autoregressive decode engine over one model,
     serving from a paged (block-granular) KV cache.
@@ -242,7 +265,8 @@ class DecodeEngine:
                  kv_blocks: Optional[int] = None,
                  prefill_batch: Optional[int] = None,
                  draft_model=None, spec_k: Optional[int] = None,
-                 model_name: str = "default"):
+                 model_name: str = "default",
+                 mesh=None, param_spec=None):
         if not is_generative_model(model):
             raise TypeError(
                 f"cannot decode a {type(model).__name__}: expected the "
@@ -300,6 +324,20 @@ class DecodeEngine:
         self._dcache = (self.draft.init_paged_kv_cache(
             self.kv_blocks + 1, self.block_size)
             if self._spec_enabled else None)
+        # tensor-parallel decode: params shard over the model axis and the
+        # paged KV pool shards over its heads dim (replicated fallback when
+        # heads do not divide); jit propagates the committed shardings into
+        # the donated prefill/decode steps. mesh=None: single-device path.
+        self.mesh = mesh
+        self.param_spec = param_spec
+        if mesh is not None:
+            from ..common.mesh import shard_params, validate_mesh
+            validate_mesh(mesh)
+            self._params = shard_params(mesh, self._params, param_spec)
+            self._cache = _shard_kv_pool(mesh, self._cache)
+            if self._spec_enabled:
+                self._dparams = shard_params(mesh, self._dparams, param_spec)
+                self._dcache = _shard_kv_pool(mesh, self._dcache)
         self._step = 0
         # per-slot host state (the loop thread owns it)
         S = self.slots
@@ -466,8 +504,8 @@ class DecodeEngine:
         # the KV cache(s) are donated: each step consumes the previous
         # buffers in place (on backends that honor donation) — these
         # entries are deliberately ineligible for the raw executable store
-        # and show up as cache=bypass on dl4j_compile_seconds (see
-        # compile_cache docs)
+        # and show up as cache=bypass:donation on dl4j_compile_seconds
+        # (see compile_cache docs)
         # a quantized twin (quant/transforms.quantize_model) carries
         # _precision — suffix the tag so its executables never collide with
         # the full-precision model's in the persistent store (the first tag
@@ -1268,6 +1306,10 @@ class DecodeEngine:
                 "draining": self._draining,
                 "closed": self._closed,
             }
+            if self.mesh is not None:
+                from ..common.mesh import mesh_shape, spec_desc
+                snap["mesh_shape"] = mesh_shape(self.mesh)
+                snap["param_spec"] = spec_desc(self.param_spec)
         with self._stats_lock:
             snap["speculative"]["proposed"] = self._stats["spec_proposed"]
             snap["speculative"]["accepted"] = self._stats["spec_accepted"]

@@ -1,0 +1,49 @@
+"""Sharded serving fleet: scale-up over a mesh, scale-out over replicas.
+
+The two halves of serving beyond one device:
+
+**Scale-up** (tensor parallelism) lives in the engines, not here:
+``registry.deploy(..., mesh=serving_mesh(), param_spec=...)`` shards a
+version's params over the mesh's ``model`` axis (batches over ``data``,
+a generative model's paged KV pool over its heads) and the raw
+executable store round-trips the sharded executables, so a sharded
+replica warm-restarts without recompiling. See
+:mod:`deeplearning4j_tpu.common.mesh` (``serving_mesh``,
+``param_shardings``) and the ``mesh``/``param_spec`` kwargs on
+``InferenceEngine`` / ``DecodeEngine`` / ``ModelRegistry.deploy``.
+
+**Scale-out** (replica routing) is this package:
+:class:`~.router.FleetRouter` fronts N ``ModelServer`` replicas by URL
+with least-loaded dispatch (admission EWMA x backlog, polled from each
+replica's ``/metrics.json``), readyz-aware membership, and failover —
+one retry on a different replica for connection-level failures and 503s.
+:class:`~.router.FleetServer` is the HTTP front door;
+``python -m deeplearning4j_tpu.serving.fleet --replicas ...`` runs it
+standalone. A joining replica pre-bakes the fleet's bucket ladder from
+the shared warmup manifests before its ``/readyz`` flips, so elastic
+scale-out never routes traffic onto a cold compile.
+
+Minimal flow::
+
+    from deeplearning4j_tpu.common.mesh import serving_mesh
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+    from deeplearning4j_tpu.serving.fleet import FleetRouter, FleetServer
+
+    # each replica process: sharded deploy + HTTP server
+    registry = ModelRegistry()
+    registry.deploy("mnist", "v1", net, example=x, mesh=serving_mesh())
+    port = ModelServer(registry).start()
+
+    # the front door (its own process, no JAX needed)
+    router = FleetRouter([f"http://127.0.0.1:{port}", ...])
+    front = FleetServer(router)
+    front.start()                      # clients talk to this one URL
+
+Env knobs: ``DL4J_TPU_FLEET_POLL_S`` (replica poll cadence),
+``DL4J_TPU_FLEET_RETRIES`` (failover budget),
+``DL4J_TPU_FLEET_TIMEOUT_S`` (per-attempt timeout). Telemetry:
+``dl4j_fleet_replicas{model}``,
+``dl4j_router_dispatch_total{replica,outcome}``.
+"""
+from .router import (FleetRouter, FleetServer, NoReplicaError,  # noqa: F401
+                     Replica)

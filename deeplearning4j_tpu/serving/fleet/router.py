@@ -1,0 +1,494 @@
+"""Front-of-fleet replica router: scale-out over N ``ModelServer``\\ s.
+
+One :class:`FleetRouter` fronts N independent serving replicas (each a
+``serving.ModelServer`` — typically one process per TPU slice / host),
+addressed by base URL. The router is deliberately *stateless* about
+models: replicas own deployment, warmup, admission, and SLOs; the router
+only decides **which** replica answers a request and retries replica-
+level failures somewhere else.
+
+Routing policy — least loaded, admission-aware:
+
+- A background poller refreshes every replica's ``/readyz`` (is it
+  allowed to take traffic at all?) and ``/metrics.json`` (the admission
+  controller's live gauges: ``dl4j_serving_ewma_service_seconds``,
+  ``dl4j_serving_queue_depth``, ``dl4j_serving_active``,
+  ``dl4j_serving_waiters``) every ``DL4J_TPU_FLEET_POLL_S`` seconds.
+- A request for model M goes to the READY replica with the lowest
+  expected drain time: ``(waiters + router-side in-flight) x EWMA
+  service seconds``. Router-side in-flight counts dispatches the poller
+  has not seen yet, so a burst does not pile onto one replica between
+  polls.
+- Replica-level failures — connection refused/reset, timeout, HTTP 503
+  — fail over: up to ``DL4J_TPU_FLEET_RETRIES`` (default 1) retries on a
+  *different* replica, the failed one marked not-ready until a poll
+  succeeds again. Request-level outcomes (2xx/4xx/429) are the
+  replica's answer and are returned as-is — a shed (429) on the least
+  loaded replica means the fleet is saturated, and retrying it
+  elsewhere would only amplify the overload.
+
+Scale-out elasticity rides the warmup manifests of the serving layer: a
+joining replica pointed at the shared manifest directory
+(``DL4J_TPU_SERVING_MANIFEST_DIR`` / the executable-cache volume)
+pre-bakes the fleet's observed bucket ladder during ``deploy()`` —
+its ``/readyz`` stays false until the ladder is compiled, so
+``add_replica()`` can be called *before* warmup finishes and the router
+will not route to it until it is actually ready.
+
+Telemetry: ``dl4j_fleet_replicas{model}`` (ready replicas currently
+serving each model) and ``dl4j_router_dispatch_total{replica,outcome}``
+with outcome ``ok`` (replica answered), ``failover`` (replica-level
+failure, retried elsewhere), ``failed`` (failure with no retry budget
+left), ``no_replica`` (nothing ready).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ...common.environment import environment
+from ...common.locks import ordered_lock
+from ...common.metrics import registry as metrics_registry
+
+log = logging.getLogger(__name__)
+
+#: admission gauges polled off every replica's /metrics.json; missing
+#: series (a replica that has not served yet) default to 0.0
+_POLLED_GAUGES = ("dl4j_serving_ewma_service_seconds",
+                  "dl4j_serving_queue_depth",
+                  "dl4j_serving_active",
+                  "dl4j_serving_waiters")
+
+
+class NoReplicaError(RuntimeError):
+    """No ready replica could take the request (none ready, or every
+    attempt hit a replica-level failure with the retry budget spent)."""
+
+
+class Replica:
+    """One fleet member: its URL and the last polled view of it."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.ready = False
+        self.models: List[str] = []          # models the replica serves
+        #: per-model admission view: model -> {ewma_s, queue_depth,
+        #: active, waiters}
+        self.load: Dict[str, Dict[str, float]] = {}
+        self.inflight = 0                    # router-side, un-polled yet
+        self.dispatched = 0                  # lifetime routed attempts
+        self.last_poll_s: Optional[float] = None
+        self.consecutive_failures = 0
+
+    def score(self, model: str) -> float:
+        """Expected drain time of one more request on this replica:
+        (admission backlog + router-side in-flight) x EWMA service
+        seconds. Lower is better. A replica with no admission history
+        yet (a fresh joiner) takes only the 1e-4 floor — routing to it
+        is how the fleet learns its real EWMA."""
+        view = self.load.get(model, {})
+        ewma = float(view.get("ewma_s") or 0.0)
+        backlog = float(view.get("waiters") or 0.0) + self.inflight
+        return (backlog + 1.0) * max(ewma, 1e-4)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"url": self.url, "ready": self.ready,
+                "models": list(self.models),
+                "load": {m: dict(v) for m, v in sorted(self.load.items())},
+                "inflight": self.inflight,
+                "dispatched": self.dispatched,
+                "last_poll_s": self.last_poll_s,
+                "consecutive_failures": self.consecutive_failures}
+
+
+def _parse_metrics_json(doc: dict) -> Dict[str, Dict[str, float]]:
+    """``/metrics.json`` -> model -> admission view. Tolerates missing
+    families (a replica that has not admitted a request yet)."""
+    out: Dict[str, Dict[str, float]] = {}
+    short = {"dl4j_serving_ewma_service_seconds": "ewma_s",
+             "dl4j_serving_queue_depth": "queue_depth",
+             "dl4j_serving_active": "active",
+             "dl4j_serving_waiters": "waiters"}
+    for fam in _POLLED_GAUGES:
+        for series in (doc.get(fam) or {}).get("series", ()):
+            model = (series.get("labels") or {}).get("model")
+            if model is None:
+                continue
+            try:
+                value = float(series.get("value") or 0.0)
+            except (TypeError, ValueError):
+                value = 0.0
+            out.setdefault(model, {})[short[fam]] = value
+    return out
+
+
+class FleetRouter:
+    """Least-loaded, readyz-aware request router over serving replicas.
+
+    ``replicas`` are base URLs (``http://host:port``). Poll cadence,
+    failover retry budget, and per-attempt timeout default to the
+    ``DL4J_TPU_FLEET_*`` env knobs. ``start_polling()`` runs the
+    background refresh; tests can drive ``poll_once()`` directly."""
+
+    def __init__(self, replicas: Sequence[str] = (), *,
+                 poll_s: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 timeout_s: Optional[float] = None):
+        env = environment()
+        self.poll_s = env.fleet_poll_s() if poll_s is None else float(poll_s)
+        self.retries = env.fleet_retries() if retries is None \
+            else max(int(retries), 0)
+        self.timeout_s = env.fleet_timeout_s() if timeout_s is None \
+            else float(timeout_s)
+        self._lock = ordered_lock("fleet.router")
+        self._replicas: Dict[str, Replica] = {}
+        self._poll_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        reg = metrics_registry()
+        self._m_replicas = reg.gauge(
+            "dl4j_fleet_replicas",
+            "Ready replicas currently serving each model",
+            labels=("model",))
+        self._m_dispatch = reg.counter(
+            "dl4j_router_dispatch_total",
+            "Routed dispatch attempts by replica and outcome "
+            "(ok|failover|failed|no_replica)",
+            labels=("replica", "outcome"))
+        for url in replicas:
+            self.add_replica(url, poll=False)
+
+    # -- membership -------------------------------------------------------
+    def add_replica(self, url: str, *, poll: bool = True) -> Replica:
+        """Register one replica. It takes traffic only once a poll sees
+        its ``/readyz`` true — safe to call while the replica is still
+        warming its bucket ladder from the shared manifest."""
+        rep = Replica(url)
+        with self._lock:
+            existing = self._replicas.get(rep.url)
+            if existing is not None:
+                return existing
+            self._replicas[rep.url] = rep
+        if poll:
+            self._poll_replica(rep)
+            self._update_fleet_gauge()
+        return rep
+
+    def remove_replica(self, url: str) -> bool:
+        with self._lock:
+            gone = self._replicas.pop(url.rstrip("/"), None) is not None
+        if gone:
+            self._update_fleet_gauge()
+        return gone
+
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def snapshot(self) -> Dict[str, Any]:
+        """``/fleet`` debug view: every replica's polled state."""
+        return {"poll_s": self.poll_s, "retries": self.retries,
+                "replicas": [r.snapshot() for r in self.replicas()]}
+
+    # -- polling ----------------------------------------------------------
+    def _fetch_json(self, url: str, timeout: float):
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read() or b"{}")
+
+    def _poll_replica(self, rep: Replica):
+        timeout = min(self.timeout_s, max(self.poll_s * 2, 1.0))
+        try:
+            try:
+                status, ready_doc = self._fetch_json(
+                    rep.url + "/readyz", timeout)
+            except urllib.error.HTTPError as e:
+                # /readyz answers 503 with the same JSON body when unready
+                status, ready_doc = e.code, json.loads(e.read() or b"{}")
+            _, metrics_doc = self._fetch_json(
+                rep.url + "/metrics.json", timeout)
+        except (OSError, ValueError) as e:
+            with self._lock:
+                rep.ready = False
+                rep.consecutive_failures += 1
+                rep.last_poll_s = time.time()
+            log.debug("poll of %s failed: %r", rep.url, e)
+            return
+        with self._lock:
+            rep.ready = status == 200 and bool(ready_doc.get("ready"))
+            rep.models = sorted((ready_doc.get("models") or {}).keys())
+            rep.load = _parse_metrics_json(metrics_doc)
+            rep.consecutive_failures = 0
+            rep.last_poll_s = time.time()
+
+    def poll_once(self):
+        """One synchronous refresh of every replica (tests; the poll
+        thread calls this on its cadence)."""
+        for rep in self.replicas():
+            self._poll_replica(rep)
+        self._update_fleet_gauge()
+
+    def _update_fleet_gauge(self):
+        counts: Dict[str, int] = {}
+        with self._lock:
+            reps = list(self._replicas.values())
+            for rep in reps:
+                if not rep.ready:
+                    continue
+                for model in rep.models:
+                    counts[model] = counts.get(model, 0) + 1
+            known = set()
+            for rep in reps:
+                known.update(rep.models)
+        for model in known:
+            self._m_replicas.labels(model=model).set(counts.get(model, 0))
+
+    def start_polling(self) -> "FleetRouter":
+        if self._poll_thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception:
+                    log.exception("fleet poll cycle failed")
+                self._stop.wait(self.poll_s)
+
+        self._poll_thread = threading.Thread(
+            target=loop, name="dl4j-tpu-fleet-poll", daemon=True)
+        self._poll_thread.start()
+        return self
+
+    def stop_polling(self):
+        self._stop.set()
+        t = self._poll_thread
+        if t is not None:
+            t.join(timeout=max(self.poll_s * 2, 2.0))
+            self._poll_thread = None
+
+    # -- routing ----------------------------------------------------------
+    def _candidates(self, model: Optional[str]) -> List[Replica]:
+        """READY replicas (serving ``model``, when known), best score
+        first."""
+        with self._lock:
+            reps = [r for r in self._replicas.values() if r.ready]
+        if model is not None:
+            serving = [r for r in reps if model in r.models]
+            # a replica whose model list is unknown yet (no successful
+            # poll since deploy) still counts — the attempt will 404
+            # and surface the truth
+            reps = serving or reps
+        if model is not None:
+            # dispatched count breaks score ties: equally loaded
+            # replicas round-robin instead of piling onto the first
+            reps.sort(key=lambda r: (r.score(model), r.dispatched, r.url))
+        return reps
+
+    def route(self, method: str, path: str, body: Optional[bytes] = None,
+              headers: Sequence[Tuple[str, str]] = (),
+              model: Optional[str] = None,
+              timeout_s: Optional[float] = None
+              ) -> Tuple[int, Dict[str, str], bytes, str]:
+        """Route one HTTP request to the best replica, failing over on
+        replica-level errors. Returns ``(status, headers, body,
+        replica_url)``. Raises :class:`NoReplicaError` when no replica
+        could take it."""
+        timeout = self.timeout_s if timeout_s is None else float(timeout_s)
+        tried: List[str] = []
+        attempts = self.retries + 1
+        last_err: Optional[BaseException] = None
+        for _ in range(attempts):
+            rep = next((r for r in self._candidates(model)
+                        if r.url not in tried), None)
+            if rep is None:
+                break
+            tried.append(rep.url)
+            with self._lock:
+                rep.inflight += 1
+                rep.dispatched += 1
+            try:
+                req = urllib.request.Request(
+                    rep.url + path, data=body, method=method,
+                    headers=dict(headers))
+                try:
+                    with urllib.request.urlopen(req, timeout=timeout) as r:
+                        status, hdrs, payload = (r.status, dict(r.headers),
+                                                 r.read())
+                except urllib.error.HTTPError as e:
+                    status, hdrs, payload = e.code, dict(e.headers), e.read()
+            except (OSError, urllib.error.URLError) as e:
+                # connection refused/reset, DNS, timeout: replica-level
+                last_err = e
+                self._mark_failed(rep, "connect")
+                continue
+            finally:
+                with self._lock:
+                    rep.inflight = max(rep.inflight - 1, 0)
+            if status == 503:
+                # replica-level: draining / breaker / not ready — take it
+                # out of rotation and try the next one
+                last_err = None
+                self._mark_failed(rep, "503")
+                continue
+            self._m_dispatch.labels(replica=rep.url, outcome="ok").inc()
+            return status, hdrs, payload, rep.url
+        if tried:
+            self._m_dispatch.labels(replica=tried[-1],
+                                    outcome="failed").inc()
+            raise NoReplicaError(
+                f"all routed attempts failed (tried {tried})"
+                + (f": {last_err!r}" if last_err else ""))
+        self._m_dispatch.labels(replica="", outcome="no_replica").inc()
+        raise NoReplicaError(
+            "no ready replica" + (f" for model '{model}'" if model else ""))
+
+    def _mark_failed(self, rep: Replica, why: str):
+        with self._lock:
+            rep.ready = False
+            rep.consecutive_failures += 1
+        self._m_dispatch.labels(replica=rep.url, outcome="failover").inc()
+        log.warning("replica %s failed (%s); failing over", rep.url, why)
+        self._update_fleet_gauge()
+
+    # -- convenience client API -------------------------------------------
+    def predict(self, model: str, inputs, *,
+                timeout_s: Optional[float] = None) -> dict:
+        """JSON predict against the least-loaded replica; returns the
+        parsed response body. Non-2xx answers raise RuntimeError with
+        the replica's error payload."""
+        body = json.dumps({"inputs": inputs if isinstance(inputs, (dict,
+                           list)) else inputs.tolist()}).encode()
+        status, _, payload, url = self.route(
+            "POST", f"/v1/models/{model}/predict", body,
+            headers=[("Content-Type", "application/json")],
+            model=model, timeout_s=timeout_s)
+        doc = json.loads(payload or b"{}")
+        if status != 200:
+            raise RuntimeError(
+                f"predict on {url} answered {status}: {doc.get('error')}")
+        return doc
+
+    def generate(self, model: str, prompt: Sequence[int], *,
+                 timeout_s: Optional[float] = None, **opts) -> dict:
+        body = json.dumps({"prompt": list(prompt), **opts}).encode()
+        status, _, payload, url = self.route(
+            "POST", f"/v1/models/{model}/generate", body,
+            headers=[("Content-Type", "application/json")],
+            model=model, timeout_s=timeout_s)
+        doc = json.loads(payload or b"{}")
+        if status != 200:
+            raise RuntimeError(
+                f"generate on {url} answered {status}: {doc.get('error')}")
+        return doc
+
+
+_MODEL_PATH_RE = re.compile(r"^/v1/models/([^/:]+)(?::[^/]+)?/")
+
+#: request headers the front door forwards to the replica (trace context
+#: and deadlines must survive the hop; hop-by-hop headers must not)
+_FORWARDED_HEADERS = ("content-type", "traceparent", "x-request-timeout-s")
+
+
+class FleetServer:
+    """HTTP front door over a :class:`FleetRouter`: the one URL clients
+    talk to. ``POST /v1/models/...`` proxies to the least-loaded ready
+    replica (with failover); ``GET /v1/models`` answers from the best
+    replica; ``/readyz`` is the *fleet's* readiness (any replica ready);
+    ``/fleet`` is the router's polled membership view; ``/metrics`` is
+    the router process's own registry (dispatch counters + fleet
+    gauges)."""
+
+    def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.router = router
+        self.host = host
+        self.port = port
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        from ...common.httpserver import QuietThreadingHTTPServer
+        self._httpd = QuietThreadingHTTPServer((self.host, self.port),
+                                               self._handler())
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="dl4j-tpu-fleet-router",
+                                        daemon=True)
+        self._thread.start()
+        self.router.start_polling()
+        log.info("fleet router on %s:%d fronting %d replicas",
+                 self.host, self.port, len(self.router.replicas()))
+        return self.port
+
+    def stop(self):
+        self.router.stop_polling()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        return self
+
+    def _handler(self):
+        from ...common.httpserver import JsonRequestHandler, metrics_payload
+        router = self.router
+
+        class Handler(JsonRequestHandler):
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    self.send_payload(b"ok", "text/plain")
+                elif path == "/readyz":
+                    reps = router.replicas()
+                    ready = any(r.ready for r in reps)
+                    self.send_json(
+                        {"ready": ready,
+                         "replicas": [{"url": r.url, "ready": r.ready}
+                                      for r in reps]},
+                        200 if ready else 503)
+                elif path == "/fleet":
+                    self.send_json(router.snapshot())
+                elif path == "/metrics":
+                    self.send_payload(*metrics_payload())
+                elif path == "/metrics.json":
+                    self.send_payload(*metrics_payload("json"))
+                elif path == "/v1/models":
+                    self._proxy("GET", None)
+                else:
+                    self.send_json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0]
+                m = _MODEL_PATH_RE.match(path)
+                if m is None:
+                    self.send_json({"error": "not found"}, 404)
+                    return
+                self._proxy("POST", m.group(1))
+
+            def _proxy(self, method: str, model: Optional[str]):
+                body = self.read_body() if method == "POST" else None
+                fwd = [(k, v) for k, v in self.headers.items()
+                       if k.lower() in _FORWARDED_HEADERS]
+                try:
+                    status, hdrs, payload, url = router.route(
+                        method, self.path, body, headers=fwd, model=model)
+                except NoReplicaError as e:
+                    self.send_json({"error": str(e)}, 503,
+                                   headers=[("Retry-After", "1")])
+                    return
+                passthrough = [(k, v) for k, v in hdrs.items()
+                               if k.lower() in ("x-trace-id",
+                                                "x-model-version",
+                                                "retry-after")]
+                passthrough.append(("X-Fleet-Replica", url))
+                self.send_payload(
+                    payload,
+                    hdrs.get("Content-Type", "application/json"),
+                    status, headers=passthrough)
+
+        return Handler

@@ -37,6 +37,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from ..common.environment import environment
 from ..common.locks import ordered_rlock
+from ..common.mesh import mesh_shape as _mesh_shape, spec_desc
 from ..common.metrics import registry as metrics_registry
 from ..common.tracing import span
 from ..quant.calibrate import QuantSpec, calibrate as quant_calibrate
@@ -65,7 +66,8 @@ class ModelVersion:
     """One deployed (name, version) pair and its serving engine."""
 
     __slots__ = ("name", "version", "engine", "state", "deployed_at",
-                 "precision", "param_bytes", "divergence")
+                 "precision", "param_bytes", "divergence", "mesh_shape",
+                 "param_spec")
 
     def __init__(self, name: str, version: str, engine: InferenceEngine):
         self.name = name
@@ -79,6 +81,10 @@ class ModelVersion:
         self.precision: Optional[str] = None
         self.param_bytes: Optional[int] = None
         self.divergence: Optional[Dict[str, float]] = None
+        #: sharded deploys only: {"data": d, "model": m} and the
+        #: PartitionSpec description ("auto(model)", "P(None, 'model')", …)
+        self.mesh_shape: Optional[Dict[str, int]] = None
+        self.param_spec: Optional[str] = None
 
     def describe(self) -> Dict[str, Any]:
         d = {"version": self.version, "state": self.state,
@@ -88,6 +94,9 @@ class ModelVersion:
              "generative": isinstance(self.engine, DecodeEngine),
              "precision": self.precision,
              "param_bytes": self.param_bytes}
+        if self.mesh_shape is not None:
+            d["mesh_shape"] = dict(self.mesh_shape)
+            d["param_spec"] = self.param_spec
         if self.divergence is not None:
             d["quant_divergence"] = self.divergence
         return d
@@ -185,7 +194,9 @@ class ModelRegistry:
                quantize=None,
                calibration_batch=None,
                quant_max_divergence: Optional[float] = None,
-               quant_min_top1: Optional[float] = None) -> ModelVersion:
+               quant_min_top1: Optional[float] = None,
+               mesh=None,
+               param_spec=None) -> ModelVersion:
         """Deploy ``model`` as ``name``:``version`` with warm-before-
         cutover; returns the new (current) ModelVersion.
 
@@ -223,7 +234,17 @@ class ModelRegistry:
         ``QuantizationRejectedError`` aborts the swap with the incoming
         engine closed and the full-precision current version still live.
         ``quant_max_divergence``/``quant_min_top1`` override the env
-        budgets for this deploy only."""
+        budgets for this deploy only.
+
+        ``mesh`` deploys the version *sharded* over a device mesh built
+        with :func:`~deeplearning4j_tpu.common.mesh.serving_mesh`:
+        params partition over the ``model`` axis per ``param_spec`` (a
+        single PartitionSpec, a pytree of specs matching the params, or
+        None for automatic last-divisible-dim sharding), batches shard
+        over the ``data`` axis, and a generative model's paged KV pool
+        splits its heads over ``model``. Warmed executables land in the
+        raw executable store with their shardings, so a sharded replica
+        warm-restarts without recompiling."""
         name, version = str(name), str(version)
         with self._lock:
             if self._draining:
@@ -268,14 +289,19 @@ class ModelRegistry:
                                   prefill_batch=decode_prefill_batch,
                                   draft_model=decode_draft_model,
                                   spec_k=decode_spec_k,
-                                  model_name=name)
+                                  model_name=name,
+                                  mesh=mesh, param_spec=param_spec)
         else:
             engine = InferenceEngine(model, max_batch=max_batch,
                                      buckets=buckets,
                                      max_delay_ms=max_delay_ms,
                                      outputs=outputs,
-                                     manifest_path=self.manifest_path(name))
+                                     manifest_path=self.manifest_path(name),
+                                     mesh=mesh, param_spec=param_spec)
         mv = ModelVersion(name, version, engine)
+        if mesh is not None:
+            mv.mesh_shape = _mesh_shape(mesh)
+            mv.param_spec = spec_desc(param_spec)
         mv.precision = precision_of_model(model)
         mv.param_bytes = param_bytes_of(model)
         if warm:

@@ -746,6 +746,111 @@ class TestCheckColdStart:
             rec["cold"]["ttfi_s"] / rec["warm"]["ttfi_s"], rel=1e-2)
 
 
+def _ss_record(allclose=True, argmax=1.0, max_err=3e-8, scaleout=2.8,
+               hit3=3, failovers=1, nonshed=1.0):
+    return {
+        "n_devices": 8, "threads": 6, "requests_per_storm": 90,
+        "batch_delay_ms": 20.0,
+        "parity": {"mesh_shape": {"data": 1, "model": 8},
+                   "param_spec": "auto(model)", "allclose": allclose,
+                   "argmax_match_rate": argmax, "max_abs_err": max_err},
+        "single_replica": {"offered": 90, "ok": 90, "shed": 0,
+                           "failed": 0, "throughput_rps": 46.0,
+                           "p50_ms": 129.0, "p99_ms": 133.0,
+                           "replicas_hit": 1},
+        "fleet3": {"offered": 90, "ok": 90, "shed": 0, "failed": 0,
+                   "throughput_rps": 46.0 * scaleout, "p50_ms": 45.0,
+                   "p99_ms": 53.0, "replicas_hit": hit3},
+        "scaleout": scaleout,
+        "kill_drill": {"offered": 90, "ok": int(round(88 * nonshed)),
+                       "shed": 2, "failed": 90 - 2 - int(round(
+                           88 * nonshed)),
+                       "throughput_rps": 98.0, "p50_ms": 65.0,
+                       "p99_ms": 78.0, "replicas_hit": 3,
+                       "failovers": failovers,
+                       "nonshed_success_rate": nonshed},
+    }
+
+
+class TestCheckShardedServing:
+    """Gate logic for the sharded_serving metric: the mesh-sharded deploy
+    must be decision-identical to single-device, the 3-replica router
+    must actually spread and buy >= 2x throughput over one replica, and
+    killing a replica mid-storm must lose nothing (100% non-shed success
+    via one failover retry)."""
+
+    def test_accepts_good_record(self):
+        ok, reason = bench.check_sharded_serving(_ss_record())
+        assert ok, reason
+
+    def test_rejects_diverging_sharded_logits(self):
+        ok, reason = bench.check_sharded_serving(
+            _ss_record(allclose=False, max_err=0.3))
+        assert not ok
+        assert "diverges" in reason
+
+    def test_rejects_changed_decisions(self):
+        # logits within tolerance but a flipped argmax is a served
+        # wrong answer, whatever the float error
+        ok, reason = bench.check_sharded_serving(_ss_record(argmax=0.75))
+        assert not ok
+        assert "diverges" in reason
+
+    def test_rejects_insufficient_scaleout(self):
+        ok, reason = bench.check_sharded_serving(_ss_record(scaleout=1.5))
+        assert not ok
+        assert "scaling the fleet out" in reason
+
+    def test_boundary_at_two_x(self):
+        ok, _ = bench.check_sharded_serving(_ss_record(scaleout=2.01))
+        assert ok
+        ok, _ = bench.check_sharded_serving(_ss_record(scaleout=1.99))
+        assert not ok
+
+    def test_rejects_unspread_storm(self):
+        # a ratio measured against a router that piled everything onto
+        # one replica proves nothing about scale-out
+        ok, reason = bench.check_sharded_serving(_ss_record(hit3=1))
+        assert not ok
+        assert "never spread" in reason
+
+    def test_rejects_unexercised_kill_drill(self):
+        ok, reason = bench.check_sharded_serving(_ss_record(failovers=0))
+        assert not ok
+        assert "untested" in reason
+
+    def test_rejects_lost_requests_on_failover(self):
+        ok, reason = bench.check_sharded_serving(
+            _ss_record(nonshed=0.977))
+        assert not ok
+        assert "losing requests" in reason
+
+    def test_custom_min_scaleout(self):
+        ok, _ = bench.check_sharded_serving(_ss_record(scaleout=1.6),
+                                            min_scaleout=1.5)
+        assert ok
+
+    def test_tiny_live_measurement_passes_gate(self):
+        """The full metric end-to-end on CPU. The deterministic legs ARE
+        asserted in CI: sharded-vs-single-device parity, the router
+        spreading over all 3 replicas, and the kill drill's zero lost
+        requests with a recorded failover. The 2x throughput gate has
+        wide margin at this sizing (measured ~2.8x: per-replica service
+        time is the micro-batcher's no-CPU coalescing window, so three
+        replicas overlap their windows even on one core)."""
+        import jax
+        import jax.numpy as jnp
+
+        rec = bench.bench_sharded_serving(jax, jnp, tiny=True)
+        assert rec["parity"]["allclose"]
+        assert rec["parity"]["argmax_match_rate"] == 1.0
+        assert rec["fleet3"]["replicas_hit"] == 3
+        assert rec["kill_drill"]["failovers"] >= 1
+        assert rec["kill_drill"]["nonshed_success_rate"] == 1.0
+        assert rec["kill_drill"]["failed"] == 0
+        assert rec["gate_ok"], rec["gate_reason"]
+
+
 class TestScannedStepEndToEnd:
     def test_tiny_scan_chain_produces_sane_record(self):
         """The full measurement path on CPU: scanned step, median-of-5,

@@ -202,6 +202,48 @@ class TestStoreRoundTrip:
                                    np.asarray(_x() @ jnp.ones((16, 32))),
                                    rtol=1e-6)
 
+    def test_sharded_predict_hits_store_on_warm_restart(self, fresh_cache):
+        # the fleet regression: a mesh-sharded predict executable must be
+        # a raw-store HIT after restart (reloaded with its device
+        # assignment and in/out shardings), not a silent bypass
+        from deeplearning4j_tpu.common.mesh import (MODEL, serving_mesh,
+                                                    shard_params)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if jax.device_count() < 2:
+            pytest.skip("needs >= 2 devices")
+        cc = fresh_cache
+        mesh = serving_mesh()
+        params = shard_params(mesh, _params())
+        x = jax.device_put(_x(), NamedSharding(mesh, P()))
+        f1 = counted_jit(_model, tag="tshard:1")
+        ref = np.asarray(f1(params, x))
+        assert cc.stats["misses"] == 1 and cc.stats["puts"] == 1
+
+        jax.clear_caches()  # "restart"
+        f2 = counted_jit(_model, tag="tshard:2")
+        out = f2(params, x)
+        assert cc.stats["hits"] == 1, \
+            "sharded executable must round-trip the raw store"
+        np.testing.assert_array_equal(ref, np.asarray(out))
+        # the reloaded output is still mesh-sharded, not silently gathered
+        assert isinstance(out.sharding, NamedSharding)
+        assert out.sharding.spec == P(None, MODEL)
+
+    def test_sharded_and_host_args_key_separately(self, fresh_cache):
+        from deeplearning4j_tpu.common.mesh import serving_mesh, shard_params
+
+        if jax.device_count() < 2:
+            pytest.skip("needs >= 2 devices")
+        cc = fresh_cache
+        mesh = serving_mesh()
+        f = counted_jit(_model, tag="tsk:1")
+        f(_params(), _x())
+        f2 = counted_jit(_model, tag="tsk:2")
+        f2(shard_params(mesh, _params()), _x())
+        # same shapes, different placement: two distinct entries
+        assert cc.stats["puts"] == 2 and cc.entry_count() == 2
+
     def test_disabled_via_empty_dir(self):
         env = environment()
         prev = env.property_override(SystemProperties.CACHE_DIR)
@@ -365,14 +407,33 @@ class TestEligibility:
     def test_prng_key_ineligible(self):
         assert not compile_cache._eligible((_x(), jax.random.key(0)), {})
 
-    def test_multi_device_array_ineligible(self):
+    def test_multi_device_array_eligible(self):
+        # mesh-sharded committed args joined the raw store (their device
+        # assignment + shardings fold into the cache key and the entry
+        # meta carries the shardings for reload)
         from jax.sharding import (Mesh, NamedSharding, PartitionSpec as P)
 
         if jax.device_count() < 2:
             pytest.skip("needs >= 2 devices")
         mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
         x = jax.device_put(_x(b=4), NamedSharding(mesh, P("data")))
-        assert not compile_cache._eligible((x,), {})
+        assert compile_cache._eligible((x,), {})
+
+    def test_placement_fingerprint_distinguishes_shardings(self):
+        # the same shapes on different layouts must key differently —
+        # a replicated and a sharded executable are not interchangeable
+        from jax.sharding import (Mesh, NamedSharding, PartitionSpec as P)
+
+        if jax.device_count() < 2:
+            pytest.skip("needs >= 2 devices")
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
+        host = (_x(b=4),)
+        sharded = (jax.device_put(_x(b=4),
+                                  NamedSharding(mesh, P("data"))),)
+        repl = (jax.device_put(_x(b=4), NamedSharding(mesh, P())),)
+        fps = {compile_cache._placement_fingerprint(a)
+               for a in (host, sharded, repl)}
+        assert len(fps) == 3
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,420 @@
+"""Sharded serving fleet (serving/fleet + mesh-sharded engines).
+
+Covers the acceptance contract of the fleet PR: a sharded deploy on a
+(1, N) CPU mesh serves predictions numerically matching single-device
+(bitwise on a 1x1 mesh), with mesh metadata surfaced on /v1/models and
+engine snapshots; the FleetRouter picks the least-loaded ready replica
+under skew, fails over exactly once on connection refusal and on 503,
+refuses nothing silently (NoReplicaError / front-door 503 otherwise);
+and a joining replica warmed from the shared manifest takes traffic only
+after its /readyz flips.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.common.mesh import (MODEL, mesh_shape, serving_mesh,
+                                            spec_fits, validate_mesh)
+from deeplearning4j_tpu.common.metrics import registry
+from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+from deeplearning4j_tpu.serving.fleet import (FleetRouter, FleetServer,
+                                              NoReplicaError, Replica)
+
+N_IN, N_OUT = 6, 3
+
+
+def _mlp(seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=N_IN, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=N_OUT))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _x(n=4, seed=0):
+    return np.random.RandomState(seed).randn(n, N_IN).astype(np.float32)
+
+
+def _get(url, timeout=10):
+    try:
+        r = urllib.request.urlopen(url, timeout=timeout)
+        return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _counter_value(fam_name, **labels):
+    fam = registry().get(fam_name)
+    if fam is None:
+        return 0.0
+    want = tuple(labels[k] for k in fam.label_names)
+    return sum(child.value() for key, child in fam.children()
+               if key == want)
+
+
+@pytest.fixture
+def unsharded_ref():
+    reg = ModelRegistry(manifest_dir=None)
+    reg.deploy("toy", "v1", _mlp(), example=_x(), warm=True)
+    ref = np.asarray(reg.predict("toy", _x()).jax())
+    yield ref
+    reg.drain_all(save_manifests=False)
+
+
+# ---------------------------------------------------------------------------
+# scale-up: mesh-sharded serving
+# ---------------------------------------------------------------------------
+
+class TestMeshHelpers:
+    def test_serving_mesh_defaults_all_devices_on_model_axis(self):
+        mesh = serving_mesh()
+        assert mesh_shape(mesh) == {"data": 1,
+                                    "model": jax.device_count()}
+
+    def test_validate_mesh_requires_axes(self):
+        mesh = serving_mesh()
+        validate_mesh(mesh)  # data axis present: fine
+        with pytest.raises(ValueError, match="nope"):
+            validate_mesh(mesh, required=("nope",))
+
+    def test_spec_fits(self):
+        from jax.sharding import PartitionSpec as P
+        mesh = serving_mesh()
+        n = jax.device_count()
+        w = np.zeros((4, 2 * n), np.float32)
+        assert spec_fits(w, P(None, MODEL), mesh)
+        assert not spec_fits(np.zeros((4, 3), np.float32),
+                             P(None, MODEL), mesh)
+
+
+class TestShardedServing:
+    def test_1x1_mesh_bitwise_identical(self, unsharded_ref):
+        mesh = serving_mesh(model_parallel=1, devices=jax.devices()[:1])
+        reg = ModelRegistry(manifest_dir=None)
+        try:
+            reg.deploy("toy", "v1", _mlp(), example=_x(), warm=True,
+                       mesh=mesh)
+            out = np.asarray(reg.predict("toy", _x()).jax())
+            np.testing.assert_array_equal(unsharded_ref, out)
+        finally:
+            reg.drain_all(save_manifests=False)
+
+    @pytest.mark.skipif(jax.device_count() < 2, reason="needs >= 2 devices")
+    def test_sharded_predict_matches_unsharded(self, unsharded_ref):
+        reg = ModelRegistry(manifest_dir=None)
+        try:
+            mv = reg.deploy("toy", "v1", _mlp(), example=_x(), warm=True,
+                            mesh=serving_mesh())
+            out = np.asarray(reg.predict("toy", _x()).jax())
+            # cross-device contractions reorder the reduction: logits
+            # match to float tolerance and the decisions exactly
+            np.testing.assert_allclose(unsharded_ref, out, rtol=1e-5,
+                                       atol=1e-6)
+            assert (unsharded_ref.argmax(-1) == out.argmax(-1)).all()
+            assert mv.engine.stats()["mesh_shape"] == mesh_shape(
+                serving_mesh())
+        finally:
+            reg.drain_all(save_manifests=False)
+
+    def test_v1_models_reports_mesh_metadata(self):
+        mesh = serving_mesh()
+        reg = ModelRegistry(manifest_dir=None)
+        srv = ModelServer(reg)
+        port = srv.start()
+        try:
+            reg.deploy("toy", "v1", _mlp(), example=_x(), warm=True,
+                       mesh=mesh)
+            status, doc = _get(f"http://127.0.0.1:{port}/v1/models")
+            assert status == 200
+            ver = doc["models"]["toy"]["versions"][0]
+            assert ver["mesh_shape"] == mesh_shape(mesh)
+            assert ver["param_spec"] == "auto(model)"
+        finally:
+            srv.stop()
+            reg.drain_all(save_manifests=False)
+
+    def test_unsharded_versions_omit_mesh_metadata(self):
+        reg = ModelRegistry(manifest_dir=None)
+        try:
+            mv = reg.deploy("toy", "v1", _mlp(), example=_x(), warm=True)
+            assert "mesh_shape" not in mv.describe()
+            assert "mesh_shape" not in mv.engine.stats()
+        finally:
+            reg.drain_all(save_manifests=False)
+
+    @pytest.mark.skipif(jax.device_count() < 2, reason="needs >= 2 devices")
+    def test_sharded_decode_tokens_identical(self):
+        from deeplearning4j_tpu.models import causal_lm
+        from deeplearning4j_tpu.runtime.generation import DecodeEngine
+
+        cfg = causal_lm.CausalLMConfig.tiny()
+        prompt = list(range(1, 9))
+        e0 = DecodeEngine(causal_lm.CausalLM(cfg, seed=3), slots=2,
+                          max_ctx=64, prompt_buckets=[32],
+                          model_name="fleetlm0")
+        e1 = DecodeEngine(causal_lm.CausalLM(cfg, seed=3), slots=2,
+                          max_ctx=64, prompt_buckets=[32],
+                          model_name="fleetlm1", mesh=serving_mesh())
+        try:
+            r0 = e0.generate_sync(prompt, max_tokens=8, temperature=0.0)
+            r1 = e1.generate_sync(prompt, max_tokens=8, temperature=0.0)
+            assert r0["tokens"] == r1["tokens"]
+            snap = e1.debug_snapshot()
+            assert snap["mesh_shape"] == mesh_shape(serving_mesh())
+            assert snap["param_spec"] == "auto(model)"
+        finally:
+            e0.close(10)
+            e1.close(10)
+
+
+# ---------------------------------------------------------------------------
+# scale-out: the replica router
+# ---------------------------------------------------------------------------
+
+def _stub_replica(router, url, model="toy", ewma=0.01, waiters=0,
+                  ready=True):
+    """Inject a polled view without HTTP (pure routing-policy tests)."""
+    rep = Replica(url)
+    rep.ready = ready
+    rep.models = [model]
+    rep.load = {model: {"ewma_s": ewma, "queue_depth": 0.0,
+                        "active": 0.0, "waiters": float(waiters)}}
+    router._replicas[rep.url] = rep
+    return rep
+
+
+class TestLeastLoaded:
+    def test_skewed_load_prefers_idle_replica(self):
+        router = FleetRouter(poll_s=3600, retries=1)
+        _stub_replica(router, "http://busy:1", ewma=0.5, waiters=20)
+        idle = _stub_replica(router, "http://idle:1", ewma=0.01, waiters=0)
+        cands = router._candidates("toy")
+        assert cands[0] is idle
+
+    def test_router_side_inflight_breaks_ties(self):
+        # between polls, dispatched-but-unpolled work must count: a burst
+        # spreads instead of piling onto the replica that looked idle
+        router = FleetRouter(poll_s=3600, retries=1)
+        a = _stub_replica(router, "http://a:1", ewma=0.1, waiters=0)
+        b = _stub_replica(router, "http://b:1", ewma=0.1, waiters=0)
+        a.inflight = 5
+        assert router._candidates("toy")[0] is b
+
+    def test_not_ready_replica_excluded(self):
+        router = FleetRouter(poll_s=3600)
+        _stub_replica(router, "http://down:1", ready=False)
+        up = _stub_replica(router, "http://up:1")
+        assert router._candidates("toy") == [up]
+
+    def test_no_replica_raises(self):
+        router = FleetRouter(poll_s=3600)
+        with pytest.raises(NoReplicaError, match="no ready replica"):
+            router.route("POST", "/v1/models/toy/predict", b"{}",
+                         model="toy")
+
+
+class _Fleet:
+    """N live single-model replicas + a router, torn down in reverse."""
+
+    def __init__(self, n, manifest_dir=None, **router_kw):
+        self.members = []
+        urls = []
+        for i in range(n):
+            reg = ModelRegistry(manifest_dir=manifest_dir)
+            reg.deploy("toy", "v1", _mlp(), example=_x(), warm=True)
+            srv = ModelServer(reg)
+            port = srv.start()
+            self.members.append((reg, srv))
+            urls.append(f"http://127.0.0.1:{port}")
+        router_kw.setdefault("poll_s", 0.2)
+        router_kw.setdefault("timeout_s", 30)
+        self.router = FleetRouter(urls, **router_kw)
+        self.router.poll_once()
+
+    def close(self):
+        self.router.stop_polling()
+        for reg, srv in self.members:
+            try:
+                srv.stop()
+            except Exception:
+                pass
+            try:
+                reg.drain_all(save_manifests=False)
+            except Exception:
+                pass
+
+
+class TestFailover:
+    def test_conn_refused_fails_over_once(self):
+        fleet = _Fleet(2, retries=1)
+        try:
+            victim = fleet.router._candidates("toy")[0]
+            # kill the replica the router would pick first
+            idx = next(i for i, (_, s) in enumerate(fleet.members)
+                       if f":{s.port}" in victim.url)
+            fleet.members[idx][1].stop()
+            pre = _counter_value("dl4j_router_dispatch_total",
+                                 replica=victim.url, outcome="failover")
+            doc = fleet.router.predict("toy", _x().tolist())
+            assert np.asarray(doc["outputs"]).shape == (4, N_OUT)
+            assert _counter_value("dl4j_router_dispatch_total",
+                                  replica=victim.url,
+                                  outcome="failover") == pre + 1
+            assert not victim.ready  # out of rotation until a poll
+        finally:
+            fleet.close()
+
+    def test_503_fails_over(self):
+        fleet = _Fleet(2, retries=1)
+        try:
+            victim = fleet.router._candidates("toy")[0]
+            idx = next(i for i, (_, s) in enumerate(fleet.members)
+                       if f":{s.port}" in victim.url)
+            # draining answers 503 on predict while the socket stays up
+            fleet.members[idx][1].begin_drain()
+            doc = fleet.router.predict("toy", _x().tolist())
+            assert np.asarray(doc["outputs"]).shape == (4, N_OUT)
+            assert not victim.ready
+        finally:
+            fleet.close()
+
+    def test_exhausted_budget_raises(self):
+        fleet = _Fleet(2, retries=1)
+        try:
+            for _, srv in fleet.members:
+                srv.stop()
+            with pytest.raises(NoReplicaError, match="all routed attempts"):
+                fleet.router.predict("toy", _x().tolist())
+        finally:
+            fleet.close()
+
+    def test_fleet_gauge_tracks_ready_replicas(self):
+        fleet = _Fleet(2)
+        try:
+            fam = registry().get("dl4j_fleet_replicas")
+            val = {key: child.value() for key, child in fam.children()}
+            assert val[("toy",)] == 2
+            fleet.members[0][1].stop()
+            fleet.router.poll_once()
+            val = {key: child.value() for key, child in fam.children()}
+            assert val[("toy",)] == 1
+        finally:
+            fleet.close()
+
+
+class TestFrontDoor:
+    def test_proxies_predict_with_replica_header(self):
+        fleet = _Fleet(2)
+        front = FleetServer(fleet.router)
+        port = front.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/toy/predict",
+                data=json.dumps({"inputs": _x().tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            r = urllib.request.urlopen(req, timeout=30)
+            assert r.status == 200
+            assert r.headers.get("X-Fleet-Replica") in \
+                [rep.url for rep in fleet.router.replicas()]
+            doc = json.loads(r.read())
+            assert np.asarray(doc["outputs"]).shape == (4, N_OUT)
+            status, doc = _get(f"http://127.0.0.1:{port}/readyz")
+            assert status == 200 and doc["ready"]
+            status, doc = _get(f"http://127.0.0.1:{port}/fleet")
+            assert status == 200 and len(doc["replicas"]) == 2
+        finally:
+            front.stop()
+            fleet.close()
+
+    def test_empty_fleet_answers_503(self):
+        router = FleetRouter(poll_s=3600)
+        front = FleetServer(router)
+        port = front.start()
+        try:
+            status, doc = _get(f"http://127.0.0.1:{port}/readyz")
+            assert status == 503
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/toy/predict",
+                data=b'{"inputs": []}',
+                headers={"Content-Type": "application/json"})
+            try:
+                r = urllib.request.urlopen(req, timeout=10)
+                status = r.status
+            except urllib.error.HTTPError as e:
+                status = e.code
+            assert status == 503
+        finally:
+            front.stop()
+
+
+class TestJoiningReplica:
+    def test_manifest_warmed_joiner_serves_after_readyz(self, tmp_path):
+        mdir = str(tmp_path)
+        # replica 1 serves traffic, then persists its observed shapes
+        reg1 = ModelRegistry(manifest_dir=mdir)
+        reg1.deploy("toy", "v1", _mlp(), example=_x(), warm=True)
+        srv1 = ModelServer(reg1)
+        port1 = srv1.start()
+        reg1.predict("toy", _x(2))
+        written = reg1.save_manifests()
+        assert written, "manifest must be written for the joiner"
+
+        router = FleetRouter([f"http://127.0.0.1:{port1}"], poll_s=0.2)
+        router.poll_once()
+
+        # the joiner deploys UNWARMED against the shared manifest dir:
+        # registered with the router immediately, but /readyz is false
+        # until the manifest-driven warmup compiles the ladder
+        reg2 = ModelRegistry(manifest_dir=mdir)
+        reg2.deploy("toy", "v1", _mlp(), warm=False)
+        srv2 = ModelServer(reg2)
+        port2 = srv2.start()
+        joiner_url = f"http://127.0.0.1:{port2}"
+        router.add_replica(joiner_url)
+        router.poll_once()
+        try:
+            joiner = next(r for r in router.replicas()
+                          if r.url == joiner_url)
+            assert not joiner.ready
+            # every routed request lands on replica 1 only
+            for _ in range(3):
+                _, _, _, url = router.route(
+                    "POST", "/v1/models/toy/predict",
+                    json.dumps({"inputs": _x().tolist()}).encode(),
+                    headers=[("Content-Type", "application/json")],
+                    model="toy")
+                assert url != joiner_url
+
+            # manifest-driven warmup (no example, no live traffic to
+            # replay) flips the joiner ready; the router then routes to it
+            buckets = reg2.warm("toy")
+            assert buckets, "joiner must warm from the shared manifest"
+            status, _ = _get(joiner_url + "/readyz")
+            assert status == 200
+            router.poll_once()
+            joiner = next(r for r in router.replicas()
+                          if r.url == joiner_url)
+            assert joiner.ready
+            hit = set()
+            for _ in range(8):
+                _, _, _, url = router.route(
+                    "POST", "/v1/models/toy/predict",
+                    json.dumps({"inputs": _x().tolist()}).encode(),
+                    headers=[("Content-Type", "application/json")],
+                    model="toy")
+                hit.add(url)
+            assert joiner_url in hit
+        finally:
+            router.stop_polling()
+            srv2.stop()
+            srv1.stop()
+            reg2.drain_all(save_manifests=False)
+            reg1.drain_all(save_manifests=False)

@@ -431,9 +431,9 @@ class TestDecodeAttentionDispatch:
 class TestDonatedDecodeCompileCache:
     def test_decode_steps_bypass_store_with_histogram_evidence(self, model):
         """Donated-KV-cache prefill/decode entries must (a) never land in
-        the raw executable store and (b) still record cache=bypass on the
-        dl4j_compile_seconds histogram — observable, not silently
-        missing."""
+        the raw executable store and (b) still record the *reasoned*
+        cache=bypass:donation on the dl4j_compile_seconds histogram —
+        observable, not silently missing, and attributable."""
         fam = registry().histogram(
             "dl4j_compile_seconds",
             "Wall time to materialize + first-run an executable, by cache "
@@ -441,7 +441,7 @@ class TestDonatedDecodeCompileCache:
 
         def bypass_count(kind):
             return sum(child.count() for key, child in fam.children()
-                       if key == (kind, "bypass"))
+                       if key == (kind, "bypass:donation"))
 
         pre_prefill = bypass_count("prefill")
         pre_decode = bypass_count("decode")
