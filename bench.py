@@ -2091,6 +2091,158 @@ def check_sharded_serving(rec, min_scaleout=2.0):
     return True, "ok"
 
 
+def bench_fleet_cold_start(jax, jnp, tiny):
+    """Fleet-scale cold start over the shared artifact store (the
+    ArtifactStore tentpole's headline): with DL4J_TPU_REMOTE_CACHE
+    pointed at a shared filesystem-rooted store, a second "replica"
+    booting with an EMPTY local cache must reach ready (full ladder
+    warmed + first inference served) with zero live compiles — every
+    bucket a store hit, pulled from the remote — and in <= 1.2x the
+    time-to-ready of a fully-warm local restart. Three phases, each a
+    fresh network/engine + jax.clear_caches() (a process restart in
+    miniature): seed (replica 1 compiles and write-populates local +
+    remote), warm_restart (replica 1 again, all local hits — the
+    baseline), cold_join (replica 2: empty local dir, everything pulled
+    from the shared store)."""
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu.common.environment import (SystemProperties,
+                                                       environment)
+    from deeplearning4j_tpu.common.metrics import registry
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.runtime import compile_cache
+    from deeplearning4j_tpu.runtime.inference import InferenceEngine
+
+    # same sizing as bench_cold_start: deep enough that XLA compile time
+    # (what the store removes) dominates the cold path
+    n_in, hidden, n_out, depth = (16, 64, 4, 8) if tiny \
+        else (256, 1024, 64, 12)
+    max_batch = 8 if tiny else 32
+
+    def build():
+        b = NeuralNetConfiguration.builder().seed(0).list()
+        b.layer(DenseLayer(n_in=n_in, n_out=hidden, activation="relu"))
+        for _ in range(depth - 2):
+            b.layer(DenseLayer(n_in=hidden, n_out=hidden,
+                               activation="relu"))
+        conf = b.layer(OutputLayer(n_in=hidden, n_out=n_out)).build()
+        return MultiLayerNetwork(conf).init()
+
+    def live_compiles():
+        # miss/bypass = XLA actually ran (or would have): what a warm
+        # joiner must record zero of. hit = loaded from the store.
+        fam = registry().get("dl4j_compiles_total")
+        out = {"live": 0, "hit": 0}
+        for key, child in (fam.children() if fam else []):
+            if len(key) == 2:
+                out["live" if key[1] in ("miss", "bypass")
+                    else "hit"] += int(child.value())
+        return out
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, n_in).astype(np.float32)
+
+    env = environment()
+    saved = {p: env.property_override(p)
+             for p in (SystemProperties.CACHE_DIR,
+                       SystemProperties.REMOTE_CACHE,
+                       SystemProperties.CACHE_TIER)}
+    root = tempfile.mkdtemp(prefix="dl4j-fleet-cold-")
+    dirs = {name: os.path.join(root, name)
+            for name in ("remote", "local1", "local2")}
+    rec = {"max_batch": max_batch, "model_depth": depth}
+    keep = []  # nets stay alive so id()-keyed compile tags never collide
+    try:
+        env.set_remote_cache(dirs["remote"])
+        env.set_cache_tier("auto")
+        for phase, local in (("seed", "local1"),
+                             ("warm_restart", "local1"),
+                             ("cold_join", "local2")):
+            env.set_cache_dir(dirs[local])
+            compile_cache.reset_cache()
+            jax.clear_caches()
+            cc = compile_cache.cache()
+            c0, h0 = live_compiles(), cc.stats["hits"]
+            net = build()
+            keep.append(net)
+            eng = InferenceEngine(net, max_batch=max_batch)
+            # time-to-ready: what /readyz gates on — the full ladder
+            # warmed plus the first real inference answered
+            t0 = time.perf_counter()
+            warmed = eng.warmup(jnp.asarray(x))
+            jax.block_until_ready(eng.infer(jnp.asarray(x)).jax())
+            ttr = time.perf_counter() - t0
+            c1 = live_compiles()
+            rec[phase] = {
+                "ttr_s": round(ttr, 4),
+                "buckets_warmed": len(warmed),
+                "live_compiles": c1["live"] - c0["live"],
+                "hit_compiles": c1["hit"] - c0["hit"],
+                "store_hits": cc.stats["hits"] - h0,
+            }
+            eng.close(timeout_s=10.0)
+        remote_stat = compile_cache.RemoteStore(dirs["remote"]).stat()
+        rec["remote_entries"] = remote_stat["entries"]
+        rec["remote_bytes"] = remote_stat["bytes"]
+    finally:
+        for prop, value in saved.items():
+            if value is None:
+                env.clear_property(prop)
+            else:
+                env.set_property(prop, value)
+        compile_cache.reset_cache()
+        shutil.rmtree(root, ignore_errors=True)
+    rec["ttr_ratio"] = round(
+        rec["cold_join"]["ttr_s"] / max(rec["warm_restart"]["ttr_s"],
+                                        1e-9), 3)
+    ok, reason = check_fleet_cold_start(rec)
+    rec["gate_ok"], rec["gate_reason"] = ok, reason
+    return rec
+
+
+def check_fleet_cold_start(rec, max_ratio=1.2):
+    """(ok, reason): gates a fleet_cold_start record must pass.
+
+    - the seed phase must have published executables to the shared store
+      (remote_entries > 0) — without that the "cold join" would just be
+      measuring local recompiles;
+    - the cold joiner must record ZERO live (miss/bypass) compiles: its
+      whole ladder must resolve as store hits, at least one per warmed
+      bucket — the download-don't-compile contract;
+    - the joiner's time-to-ready must be <= ``max_ratio`` (1.2x) of the
+      fully-warm local restart's: pulling from the shared store may cost
+      a transfer, never a compile-shaped wait."""
+    if rec.get("remote_entries", 0) <= 0:
+        return False, ("the seed phase published no executables to the "
+                       "shared store: nothing for a joiner to pull, the "
+                       "cold-join claim is untested")
+    cold = rec["cold_join"]
+    if cold.get("live_compiles", 0) > 0:
+        return False, (
+            f"the cold joiner ran {cold['live_compiles']} live "
+            "compile(s) (gate: 0): its empty local cache was not fully "
+            "served by the shared store")
+    if cold.get("store_hits", 0) < cold.get("buckets_warmed", 0):
+        return False, (
+            f"the cold joiner loaded {cold['store_hits']} executable(s) "
+            f"from the store for {cold['buckets_warmed']} warmed "
+            "buckets: part of the ladder came from somewhere other than "
+            "the shared store")
+    ratio = rec["cold_join"]["ttr_s"] / max(rec["warm_restart"]["ttr_s"],
+                                            1e-9)
+    if ratio > max_ratio:
+        return False, (
+            f"cold-join time-to-ready {rec['cold_join']['ttr_s']:.4f}s "
+            f"is {ratio:.2f}x the fully-warm restart's "
+            f"{rec['warm_restart']['ttr_s']:.4f}s (gate: <= "
+            f"{max_ratio}x): the store pull is not bounding the "
+            "joiner's cold start")
+    return True, "ok"
+
+
 def bench_flash_attention(jax, jnp, tiny):
     """Pallas flash attention vs XLA attention at long sequence length.
 
@@ -2317,6 +2469,12 @@ def main():
             out["sharded_serving"] = bench_sharded_serving(jax, jnp, tiny)
         except Exception as e:
             out["sharded_serving"] = f"error: {type(e).__name__}"
+        _release()
+        try:
+            out["fleet_cold_start"] = bench_fleet_cold_start(jax, jnp,
+                                                             tiny)
+        except Exception as e:
+            out["fleet_cold_start"] = f"error: {type(e).__name__}"
         _release()
         try:
             fwd, train = bench_flash_attention(jax, jnp, tiny)

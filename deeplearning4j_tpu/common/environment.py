@@ -45,6 +45,8 @@ class EnvironmentVars:
     DL4J_TPU_LOCK_CHECK = "DL4J_TPU_LOCK_CHECK"
     DL4J_TPU_CACHE_DIR = "DL4J_TPU_CACHE_DIR"
     DL4J_TPU_CACHE_MAX_BYTES = "DL4J_TPU_CACHE_MAX_BYTES"
+    DL4J_TPU_REMOTE_CACHE = "DL4J_TPU_REMOTE_CACHE"
+    DL4J_TPU_CACHE_TIER = "DL4J_TPU_CACHE_TIER"
     DL4J_TPU_XLA_CACHE = "DL4J_TPU_XLA_CACHE"
     DL4J_TPU_WARMUP_THREADS = "DL4J_TPU_WARMUP_THREADS"
     DL4J_TPU_FLASH_MIN_SEQ = "DL4J_TPU_FLASH_MIN_SEQ"
@@ -108,6 +110,8 @@ class SystemProperties:
     LOG_INITIALIZATION = "log_initialization"
     CACHE_DIR = "cache_dir"
     CACHE_MAX_BYTES = "cache_max_bytes"
+    REMOTE_CACHE = "remote_cache"
+    CACHE_TIER = "cache_tier"
     XLA_CACHE = "xla_cache"
     WARMUP_THREADS = "warmup_threads"
     FLASH_MIN_SEQ = "flash_min_seq"
@@ -172,6 +176,8 @@ _ENV_FOR_PROP = {
     SystemProperties.CACHE_DIR: EnvironmentVars.DL4J_TPU_CACHE_DIR,
     SystemProperties.CACHE_MAX_BYTES:
         EnvironmentVars.DL4J_TPU_CACHE_MAX_BYTES,
+    SystemProperties.REMOTE_CACHE: EnvironmentVars.DL4J_TPU_REMOTE_CACHE,
+    SystemProperties.CACHE_TIER: EnvironmentVars.DL4J_TPU_CACHE_TIER,
     SystemProperties.XLA_CACHE: EnvironmentVars.DL4J_TPU_XLA_CACHE,
     SystemProperties.WARMUP_THREADS: EnvironmentVars.DL4J_TPU_WARMUP_THREADS,
     SystemProperties.FLASH_MIN_SEQ: EnvironmentVars.DL4J_TPU_FLASH_MIN_SEQ,
@@ -251,6 +257,8 @@ _DEFAULTS = {
     SystemProperties.LOG_INITIALIZATION: "1",
     SystemProperties.CACHE_DIR: "~/.cache/deeplearning4j_tpu",
     SystemProperties.CACHE_MAX_BYTES: str(2 << 30),  # 2 GiB
+    SystemProperties.REMOTE_CACHE: "",  # no shared store by default
+    SystemProperties.CACHE_TIER: "auto",
     SystemProperties.XLA_CACHE: "auto",
     SystemProperties.WARMUP_THREADS: "0",  # 0 = auto
     SystemProperties.FLASH_MIN_SEQ: "1024",
@@ -428,6 +436,33 @@ class Environment:
             return int(v)
         except (TypeError, ValueError):
             return 2 << 30
+
+    def remote_cache(self) -> Optional[str]:
+        """Root of the fleet-shared artifact store, expanded
+        (``DL4J_TPU_REMOTE_CACHE`` — typically an NFS/FUSE-mounted
+        bucket); None when no shared store is configured (the
+        default)."""
+        d = self.property(SystemProperties.REMOTE_CACHE)
+        if not d:
+            return None
+        return os.path.expanduser(d)
+
+    def set_remote_cache(self, d: Optional[str]):
+        """Programmatic override; "" or None disables the shared store."""
+        return self.set_property(SystemProperties.REMOTE_CACHE, d or "")
+
+    def cache_tier(self) -> str:
+        """Store-tier policy (``DL4J_TPU_CACHE_TIER``): "auto" (default)
+        tiers local+remote when ``DL4J_TPU_REMOTE_CACHE`` is set and is
+        plain local otherwise; "local"/"remote"/"tiered" force a layout.
+        Anything unrecognized falls back to "auto"."""
+        v = str(self.property(SystemProperties.CACHE_TIER) or "auto").lower()
+        return v if v in ("auto", "local", "remote", "tiered") else "auto"
+
+    def set_cache_tier(self, tier: Optional[str]):
+        """Programmatic override; None restores "auto"."""
+        return self.set_property(SystemProperties.CACHE_TIER,
+                                 tier or "auto")
 
     def xla_cache(self) -> str:
         """Policy for the ``jax_compilation_cache_dir`` backstop

@@ -66,12 +66,14 @@ one prefill per prompt bucket plus one decode executable.
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
 import logging
 import os
+import shutil
 import threading
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..common import faults
 from ..common.environment import environment
@@ -175,120 +177,209 @@ def cache_key(lowered, jit_kwargs: Optional[Dict[str, Any]] = None,
 
 
 # ---------------------------------------------------------------------------
-# the on-disk store
+# pluggable raw artifact stores
 # ---------------------------------------------------------------------------
 
-class AOTCompileCache:
-    """Content-addressed executable store under ``<dir>/aot``.
+class CorruptEntryError(Exception):
+    """A stored entry failed validation (format/size/digest) and was
+    deleted by the store before raising. The cache layer turns this into
+    a one-time warning + a miss — never an exception to the caller."""
 
-    Entry = ``<key>.bin`` (serialized XLA executable) + ``<key>.json``
-    (integrity + reload metadata). LRU by file mtime, capped at
-    ``max_bytes`` (``DL4J_TPU_CACHE_MAX_BYTES``). Every read validates
-    format version, payload size, and payload sha256; anything off is
-    deleted and reported as a miss — a corrupt cache can cost a compile,
-    never an exception."""
+    def __init__(self, why: str):
+        super().__init__(why)
+        self.why = why
 
-    def __init__(self, base_dir: str, max_bytes: int):
-        self.base_dir = base_dir
-        self.aot_dir = os.path.join(base_dir, "aot")
-        self.max_bytes = int(max_bytes)
-        self._lock = ordered_lock("cache.store")
-        self._warned_keys: set = set()
-        self.stats = {"hits": 0, "misses": 0, "puts": 0, "corrupt": 0,
-                      "evictions": 0, "put_errors": 0}
-        os.makedirs(self.aot_dir, exist_ok=True)
 
-    # -- paths -------------------------------------------------------------
-    def _paths(self, key: str) -> Tuple[str, str]:
-        return (os.path.join(self.aot_dir, key + _PAYLOAD_EXT),
-                os.path.join(self.aot_dir, key + _META_EXT))
+_TMP_COUNTER = itertools.count()
 
-    def _drop(self, key: str):
+
+def _tmp_suffix() -> str:
+    """Unique-per-writer tmp suffix: two replicas (or two threads of one
+    replica) pushing the same key must never collide on the tmp file —
+    each writes its own and the last ``os.replace`` wins atomically."""
+    return ".tmp%d-%d-%d" % (os.getpid(), threading.get_ident(),
+                             next(_TMP_COUNTER))
+
+
+def _stamp_meta(payload: bytes, meta: dict) -> dict:
+    """Copy of ``meta`` stamped with the integrity fields every store
+    validates on read."""
+    meta = dict(meta)
+    meta["format"] = FORMAT_VERSION
+    meta["payload_bytes"] = len(payload)
+    meta["payload_sha"] = hashlib.sha256(payload).hexdigest()
+    return meta
+
+
+def _validate_entry(payload: bytes, meta: dict):
+    """Raise ValueError when (payload, meta) fail the integrity check."""
+    if meta.get("format") != FORMAT_VERSION:
+        raise ValueError(f"format {meta.get('format')} != {FORMAT_VERSION}")
+    if len(payload) != meta.get("payload_bytes"):
+        raise ValueError("payload truncated")
+    if hashlib.sha256(payload).hexdigest() != meta.get("payload_sha"):
+        raise ValueError("payload checksum mismatch")
+
+
+class _FilesystemStore:
+    """Shared machinery of the filesystem-rooted stores: an entry is
+    ``<key>.bin`` + ``<key>.json`` under ``_entry_dir(key)``, written via
+    a unique tmp file + ``os.replace`` (atomic on POSIX, so concurrent
+    writers of the same key cannot interleave partial content) and
+    digest-verified on every read (a failed check deletes the entry and
+    raises :class:`CorruptEntryError`)."""
+
+    tier = "local"
+
+    def _entry_dir(self, key: str, create: bool = False) -> str:
+        raise NotImplementedError
+
+    def _paths(self, key: str, create: bool = False) -> Tuple[str, str]:
+        d = self._entry_dir(key, create=create)
+        return (os.path.join(d, key + _PAYLOAD_EXT),
+                os.path.join(d, key + _META_EXT))
+
+    def contains(self, key: str) -> bool:
+        return os.path.exists(self._paths(key)[1])
+
+    def get(self, key: str) -> Optional[Tuple[bytes, dict]]:
+        payload_p, meta_p = self._paths(key)
+        if not os.path.exists(meta_p):
+            return None
+        try:
+            with open(meta_p, "r") as f:
+                meta = json.load(f)
+            with open(payload_p, "rb") as f:
+                payload = f.read()
+            _validate_entry(payload, meta)
+        except Exception as e:
+            self.delete(key)
+            raise CorruptEntryError(f"{type(e).__name__}: {e}") from e
+        self.touch(key)
+        return payload, meta
+
+    def put(self, key: str, payload: bytes, meta: dict) -> bool:
+        """``meta`` must already be stamped (``_stamp_meta``)."""
+        try:
+            payload_p, meta_p = self._paths(key, create=True)
+            for path, data, mode in ((payload_p, payload, "wb"),
+                                     (meta_p, json.dumps(meta), "w")):
+                tmp = path + _tmp_suffix()
+                with open(tmp, mode) as f:
+                    f.write(data)
+                os.replace(tmp, path)
+        except OSError as e:
+            log.warning("artifact store write failed (%s); continuing "
+                        "uncached", e)
+            return False
+        return True
+
+    def delete(self, key: str):
         for p in self._paths(key):
             try:
                 os.remove(p)
             except OSError:
                 pass
 
-    def _warn_once(self, key: str, why: str):
-        with self._lock:
-            self.stats["corrupt"] += 1
-            if key in self._warned_keys:
-                return
-            self._warned_keys.add(key)
-        log.warning("compile cache entry %s.. dropped (%s); recompiling",
-                    key[:12], why)
-
-    # -- read --------------------------------------------------------------
-    def get(self, key: str) -> Optional[Tuple[bytes, dict]]:
-        """(payload, meta) for a valid entry, else None. Corrupt entries
-        are deleted with a one-time warning."""
-        payload_p, meta_p = self._paths(key)
-        if not os.path.exists(meta_p):
-            with self._lock:
-                self.stats["misses"] += 1
-            return None
-        try:
-            if faults.active():
-                # injected read fault: exercises the corrupt-entry
-                # recovery path (drop + warn + recompile) on demand
-                faults.check("cache.load", key=key)
-            with open(meta_p, "r") as f:
-                meta = json.load(f)
-            if meta.get("format") != FORMAT_VERSION:
-                raise ValueError(f"format {meta.get('format')} != "
-                                 f"{FORMAT_VERSION}")
-            with open(payload_p, "rb") as f:
-                payload = f.read()
-            if len(payload) != meta.get("payload_bytes"):
-                raise ValueError("payload truncated")
-            if hashlib.sha256(payload).hexdigest() != meta.get("payload_sha"):
-                raise ValueError("payload checksum mismatch")
-        except Exception as e:
-            self._drop(key)
-            self._warn_once(key, f"{type(e).__name__}: {e}")
-            with self._lock:
-                self.stats["misses"] += 1
-            return None
+    def touch(self, key: str):
+        """LRU recency hint; overridden to a no-op where mtime churn is
+        unwanted (the shared remote)."""
         now = time.time()
         try:
-            os.utime(payload_p, (now, now))  # LRU touch
+            os.utime(self._paths(key)[0], (now, now))
         except OSError:
             pass
-        with self._lock:
-            self.stats["hits"] += 1
-        return payload, meta
 
-    # -- write -------------------------------------------------------------
-    def put(self, key: str, payload: bytes, meta: dict) -> bool:
-        """Atomic write (tmp + rename), then LRU cap enforcement."""
-        payload_p, meta_p = self._paths(key)
-        meta = dict(meta)
-        meta["format"] = FORMAT_VERSION
-        meta["payload_bytes"] = len(payload)
-        meta["payload_sha"] = hashlib.sha256(payload).hexdigest()
+    def entry_meta(self, key: str) -> Optional[dict]:
         try:
-            for path, data, mode in ((payload_p, payload, "wb"),
-                                     (meta_p, json.dumps(meta), "w")):
-                tmp = path + f".tmp{os.getpid()}"
-                with open(tmp, mode) as f:
-                    f.write(data)
-                os.replace(tmp, path)
-        except OSError as e:
-            log.warning("compile cache write failed (%s); continuing "
-                        "uncached", e)
-            with self._lock:
-                self.stats["put_errors"] += 1
-            return False
-        with self._lock:
-            self.stats["puts"] += 1
-        self._enforce_cap()
-        return True
+            with open(self._paths(key)[1], "r") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
 
-    def _enforce_cap(self):
-        """Evict least-recently-used entries beyond max_bytes."""
-        if self.max_bytes <= 0:
-            return
+    def last_used(self, key: str) -> Optional[float]:
+        try:
+            return os.stat(self._paths(key)[0]).st_mtime
+        except OSError:
+            return None
+
+    def _iter_dirs(self):
+        raise NotImplementedError
+
+    def keys(self) -> List[str]:
+        out = []
+        for d in self._iter_dirs():
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            out.extend(n[:-len(_META_EXT)] for n in names
+                       if n.endswith(_META_EXT))
+        return out
+
+    def stat(self) -> Dict[str, int]:
+        """{"entries", "bytes"} of the tier, by payload files."""
+        entries = 0
+        total = 0
+        for d in self._iter_dirs():
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for n in names:
+                if n.endswith(_META_EXT):
+                    entries += 1
+                elif n.endswith(_PAYLOAD_EXT):
+                    try:
+                        total += os.stat(os.path.join(d, n)).st_size
+                    except OSError:
+                        pass
+        return {"entries": entries, "bytes": total}
+
+    def clear(self):
+        for d in self._iter_dirs():
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for n in names:
+                try:
+                    os.remove(os.path.join(d, n))
+                except OSError:
+                    pass
+        return self
+
+    def tiers(self) -> List["_FilesystemStore"]:
+        return [self]
+
+    def enforce_cap(self, max_bytes: int) -> int:
+        """Evict LRU entries beyond ``max_bytes``; returns evicted count.
+        Only the local tier caps — see the overrides."""
+        return 0
+
+
+class LocalDirStore(_FilesystemStore):
+    """Today's per-machine layout: flat ``<base_dir>/aot/<key>.bin|.json``
+    with mtime-LRU eviction. The default store — behavior-identical to
+    the pre-ArtifactStore cache when no remote is configured."""
+
+    tier = "local"
+
+    def __init__(self, base_dir: str):
+        self.base_dir = base_dir
+        self.aot_dir = os.path.join(base_dir, "aot")
+        os.makedirs(self.aot_dir, exist_ok=True)
+
+    def _entry_dir(self, key: str, create: bool = False) -> str:
+        return self.aot_dir
+
+    def _iter_dirs(self):
+        yield self.aot_dir
+
+    def enforce_cap(self, max_bytes: int) -> int:
+        if max_bytes <= 0:
+            return 0
+        evicted = 0
         try:
             entries = []
             total = 0
@@ -303,35 +394,317 @@ class AOTCompileCache:
                 total += st.st_size
                 entries.append((st.st_mtime, st.st_size,
                                 name[:-len(_PAYLOAD_EXT)]))
-            if total <= self.max_bytes:
-                return
+            if total <= max_bytes:
+                return 0
             entries.sort()  # oldest first
             for _, size, key in entries:
-                if total <= self.max_bytes:
+                if total <= max_bytes:
                     break
-                self._drop(key)
+                self.delete(key)
                 total -= size
-                with self._lock:
-                    self.stats["evictions"] += 1
+                evicted += 1
         except OSError:
             pass  # capping is best-effort; never fail the compile path
+        return evicted
+
+    def describe(self) -> dict:
+        return {"tier": self.tier, "backend": "local-dir",
+                "path": self.aot_dir}
+
+
+class RemoteStore(_FilesystemStore):
+    """Content-addressed shared store the whole fleet reads and writes:
+    sha256-keyed objects under ``<root>/objects/<key[:2]>/`` (the cache
+    key is already a sha256; the two-hex fan-out keeps any one directory
+    small at fleet scale). Writes are unique-tmp + ``os.replace`` and
+    reads digest-verify, so N replicas pushing the same key concurrently
+    converge on one valid entry and a torn write can never be served.
+
+    This filesystem-rooted implementation is both the test double and a
+    real deployment path (``DL4J_TPU_REMOTE_CACHE`` pointed at an NFS /
+    FUSE-mounted bucket). An HTTP/object-store client is the documented
+    extension point: subclass and override ``get``/``put``/``delete``/
+    ``contains``/``keys``/``stat`` (and ``manifest_*``) with your
+    transport — everything above the store (keying, validation fallback,
+    tiering, pull metrics) is transport-agnostic. No LRU here: recency
+    touches and byte caps are per-machine policies (``LocalDirStore``);
+    a shared store is pruned by whoever owns the bucket."""
+
+    tier = "remote"
+
+    def __init__(self, root: str):
+        self.root = root
+        self.objects_dir = os.path.join(root, "objects")
+        os.makedirs(self.objects_dir, exist_ok=True)
+
+    def _entry_dir(self, key: str, create: bool = False) -> str:
+        d = os.path.join(self.objects_dir, key[:2] or "_")
+        if create:
+            os.makedirs(d, exist_ok=True)
+        return d
+
+    def _iter_dirs(self):
+        try:
+            shards = sorted(os.listdir(self.objects_dir))
+        except OSError:
+            shards = []
+        for s in shards:
+            yield os.path.join(self.objects_dir, s)
+
+    def touch(self, key: str):
+        pass  # shared mtimes stay put: every replica would churn them
+
+    def manifest_dir(self, create: bool = False) -> str:
+        """Where pushed warmup manifests live (``<root>/manifests``) —
+        the pull-on-boot counterpart of ``serving_manifest_dir``."""
+        d = os.path.join(self.root, "manifests")
+        if create:
+            os.makedirs(d, exist_ok=True)
+        return d
+
+    def describe(self) -> dict:
+        return {"tier": self.tier, "backend": "remote-fs",
+                "path": self.objects_dir}
+
+
+class TieredStore:
+    """Read local-then-remote, write-populate both.
+
+    A local miss falls through to the shared remote; a remote hit is
+    written back into the local dir so the next restart never leaves the
+    machine. A *corrupt* local entry is deleted and transparently
+    refetched from the remote (``on_corrupt`` is still told, so the
+    cache's corruption stats see it); a corrupt remote entry is deleted
+    for the whole fleet and reported as a miss. Remote fetch latency
+    lands on ``dl4j_cache_pull_seconds{outcome=hit|miss|error}``."""
+
+    tier = "tiered"
+
+    def __init__(self, local: LocalDirStore, remote: RemoteStore):
+        self.local = local
+        self.remote = remote
+        #: set by the owning cache to route corruption into its
+        #: warn-once + stats path
+        self.on_corrupt: Optional[Callable[[str, str], None]] = None
+
+    def _corrupt(self, key: str, why: str):
+        if self.on_corrupt is not None:
+            self.on_corrupt(key, why)
+        else:
+            log.warning("compile cache entry %s.. dropped (%s)",
+                        key[:12], why)
+
+    def contains(self, key: str) -> bool:
+        return self.local.contains(key) or self.remote.contains(key)
+
+    def get(self, key: str) -> Optional[Tuple[bytes, dict]]:
+        local_why = None
+        try:
+            entry = self.local.get(key)
+            if entry is not None:
+                return entry
+        except CorruptEntryError as e:
+            local_why = e.why  # deleted; try to refetch from the remote
+        t0 = time.perf_counter()
+        try:
+            entry = self.remote.get(key)
+        except CorruptEntryError as e:
+            observe_pull("error", time.perf_counter() - t0)
+            self._corrupt(key, f"remote entry: {e.why}")
+            return None
+        if entry is None:
+            observe_pull("miss", time.perf_counter() - t0)
+            if local_why is not None:
+                # nothing to refetch: surface the local corruption
+                raise CorruptEntryError(local_why)
+            return None
+        observe_pull("hit", time.perf_counter() - t0)
+        if local_why is not None:
+            self._corrupt(key, f"{local_why}; refetched from remote store")
+        self.local.put(key, entry[0], entry[1])
+        return entry
+
+    def put(self, key: str, payload: bytes, meta: dict) -> bool:
+        local_ok = self.local.put(key, payload, meta)
+        remote_ok = self.remote.put(key, payload, meta)
+        return local_ok or remote_ok
+
+    def delete(self, key: str):
+        self.local.delete(key)
+        self.remote.delete(key)
+
+    def keys(self) -> List[str]:
+        """Local-tier keys (what the inventory lists as resident)."""
+        return self.local.keys()
+
+    def entry_meta(self, key: str) -> Optional[dict]:
+        return self.local.entry_meta(key) or self.remote.entry_meta(key)
+
+    def last_used(self, key: str) -> Optional[float]:
+        return self.local.last_used(key)
+
+    def stat(self) -> Dict[str, int]:
+        return self.local.stat()
+
+    def clear(self):
+        """Clears the *local* tier only: the shared remote outlives any
+        one replica (use ``RemoteStore.clear()`` deliberately)."""
+        self.local.clear()
+        return self
+
+    def tiers(self) -> List[Any]:
+        return [self.local, self.remote]
+
+    def enforce_cap(self, max_bytes: int) -> int:
+        return self.local.enforce_cap(max_bytes)
+
+    def describe(self) -> dict:
+        return {"tier": self.tier, "backend": "tiered"}
+
+
+def observe_pull(outcome: str, seconds: float):
+    """Record one remote-store fetch on
+    ``dl4j_cache_pull_seconds{outcome}`` (hit = object downloaded, miss =
+    not in the remote, error = corrupt/unreadable remote entry) — the
+    boot-time pull latency the fleet cold-start gate bounds."""
+    try:
+        from ..common.metrics import COMPILE_SECONDS_BUCKETS, registry
+        registry().histogram(
+            "dl4j_cache_pull_seconds",
+            "Remote artifact-store fetch latency by outcome "
+            "(hit|miss|error)", labels=("outcome",),
+            buckets=COMPILE_SECONDS_BUCKETS).labels(
+                outcome=outcome).observe(seconds)
+    except Exception:
+        pass  # observability must never break the load path
+
+
+# ---------------------------------------------------------------------------
+# the executable cache (policy layer over an ArtifactStore)
+# ---------------------------------------------------------------------------
+
+class AOTCompileCache:
+    """Executable cache: validation stats, corruption warnings, and LRU
+    policy over a pluggable raw store.
+
+    Default store is :class:`LocalDirStore` — entry = ``<key>.bin``
+    (serialized XLA executable) + ``<key>.json`` (integrity + reload
+    metadata) under ``<dir>/aot``, LRU by file mtime, capped at
+    ``max_bytes`` (``DL4J_TPU_CACHE_MAX_BYTES``). With
+    ``DL4J_TPU_REMOTE_CACHE`` set the store is a :class:`TieredStore`
+    (local + content-addressed shared remote). Every read validates
+    format version, payload size, and payload sha256; anything off is
+    deleted and reported as a miss — a corrupt cache can cost a compile,
+    never an exception."""
+
+    def __init__(self, base_dir: str, max_bytes: int, store=None):
+        self.base_dir = base_dir
+        self.store = store if store is not None else LocalDirStore(base_dir)
+        local = next((t for t in self.store.tiers() if t.tier == "local"),
+                     None)
+        #: the local tier's flat entry dir (tests poke files here); None
+        #: for a remote-only store
+        self.aot_dir = local.aot_dir if local is not None else None
+        self.max_bytes = int(max_bytes)
+        self._lock = ordered_lock("cache.store")
+        self._warned_keys: set = set()
+        self.stats = {"hits": 0, "misses": 0, "puts": 0, "corrupt": 0,
+                      "evictions": 0, "put_errors": 0}
+        if isinstance(self.store, TieredStore):
+            self.store.on_corrupt = self._warn_once
+        self._refresh_store_gauges()
+
+    def _drop(self, key: str):
+        self.store.delete(key)
+
+    def _warn_once(self, key: str, why: str):
+        with self._lock:
+            self.stats["corrupt"] += 1
+            if key in self._warned_keys:
+                return
+            self._warned_keys.add(key)
+        log.warning("compile cache entry %s.. dropped (%s); recompiling",
+                    key[:12], why)
+
+    def _refresh_store_gauges(self):
+        """Per-tier size gauges, refreshed on every store mutation."""
+        try:
+            from ..common.metrics import registry
+            reg = registry()
+            g_bytes = reg.gauge(
+                "dl4j_cache_store_bytes",
+                "Payload bytes resident per artifact-store tier",
+                labels=("tier",))
+            g_entries = reg.gauge(
+                "dl4j_cache_store_entries",
+                "Executable entries resident per artifact-store tier",
+                labels=("tier",))
+            for t in self.store.tiers():
+                st = t.stat()
+                g_bytes.labels(tier=t.tier).set(st["bytes"])
+                g_entries.labels(tier=t.tier).set(st["entries"])
+        except Exception:
+            pass  # observability must never break the compile path
+
+    # -- read --------------------------------------------------------------
+    def get(self, key: str) -> Optional[Tuple[bytes, dict]]:
+        """(payload, meta) for a valid entry, else None. Corrupt entries
+        are deleted with a one-time warning (a tiered store transparently
+        refetches a locally corrupt entry from the remote first)."""
+        entry = None
+        mutated = False
+        try:
+            entry = self.store.get(key)
+            if entry is not None and faults.active():
+                # injected read fault: exercises the corrupt-entry
+                # recovery path (drop + warn + recompile) on demand
+                faults.check("cache.load", key=key)
+        except CorruptEntryError as e:
+            self._warn_once(key, e.why)
+            entry = None
+            mutated = True
+        except Exception as e:
+            self.store.delete(key)
+            self._warn_once(key, f"{type(e).__name__}: {e}")
+            entry = None
+            mutated = True
+        if mutated:
+            self._refresh_store_gauges()
+        if entry is None:
+            with self._lock:
+                self.stats["misses"] += 1
+            return None
+        with self._lock:
+            self.stats["hits"] += 1
+        return entry
+
+    # -- write -------------------------------------------------------------
+    def put(self, key: str, payload: bytes, meta: dict) -> bool:
+        """Atomic write (unique tmp + rename), then LRU cap
+        enforcement on the local tier."""
+        meta = _stamp_meta(payload, meta)
+        if not self.store.put(key, payload, meta):
+            with self._lock:
+                self.stats["put_errors"] += 1
+            return False
+        with self._lock:
+            self.stats["puts"] += 1
+        evicted = self.store.enforce_cap(self.max_bytes)
+        if evicted:
+            with self._lock:
+                self.stats["evictions"] += evicted
+        self._refresh_store_gauges()
+        return True
 
     # -- maintenance -------------------------------------------------------
     def clear(self):
-        try:
-            for name in os.listdir(self.aot_dir):
-                try:
-                    os.remove(os.path.join(self.aot_dir, name))
-                except OSError:
-                    pass
-        except OSError:
-            pass
+        self.store.clear()
+        self._refresh_store_gauges()
         return self
 
     def entry_count(self) -> int:
         try:
-            return sum(1 for n in os.listdir(self.aot_dir)
-                       if n.endswith(_META_EXT))
+            return len(self.store.keys())
         except OSError:
             return 0
 
@@ -341,32 +714,54 @@ class AOTCompileCache:
 # ---------------------------------------------------------------------------
 
 _CACHE: Optional[AOTCompileCache] = None
-_CACHE_DIR_USED: Optional[str] = None
+_CACHE_CONF_USED: Optional[Tuple] = None
 _CACHE_LOCK = ordered_lock("cache.global")
 _BACKSTOP_DIR: Optional[str] = None
 
 
+def _store_conf() -> Tuple[Optional[str], Optional[str], str]:
+    """(cache_dir, remote_cache, cache_tier) — the env triple the
+    singleton is keyed on."""
+    env = environment()
+    return (env.cache_dir(), env.remote_cache(), env.cache_tier())
+
+
+def _build_store(cache_dir: str, remote: Optional[str], tier: str):
+    """Store for the resolved conf: no remote (or tier=local) keeps
+    today's LocalDirStore; tier=remote serves straight off the shared
+    store; auto/tiered with a remote configured reads local-then-remote
+    and write-populates both."""
+    if tier == "local" or not remote:
+        return LocalDirStore(cache_dir)
+    if tier == "remote":
+        return RemoteStore(remote)
+    return TieredStore(LocalDirStore(cache_dir), RemoteStore(remote))
+
+
 def cache() -> Optional[AOTCompileCache]:
     """The process-wide store, or None when caching is disabled
-    (``DL4J_TPU_CACHE_DIR=""``). Re-resolves if the configured dir
-    changed since the last call (tests, ``Environment.set_cache_dir``)."""
-    global _CACHE, _CACHE_DIR_USED
-    d = environment().cache_dir()
-    if d == _CACHE_DIR_USED:
+    (``DL4J_TPU_CACHE_DIR=""``). Re-resolves if the configured dir,
+    remote root, or tier changed since the last call (tests,
+    ``Environment.set_cache_dir``/``set_remote_cache``)."""
+    global _CACHE, _CACHE_CONF_USED
+    conf = _store_conf()
+    if conf == _CACHE_CONF_USED:
         return _CACHE
     with _CACHE_LOCK:
-        if d != _CACHE_DIR_USED:
+        if conf != _CACHE_CONF_USED:
+            d, remote, tier = conf
             if d:
                 try:
                     _CACHE = AOTCompileCache(
-                        d, environment().cache_max_bytes())
+                        d, environment().cache_max_bytes(),
+                        store=_build_store(d, remote, tier))
                 except OSError as e:
                     log.warning("compile cache dir %s unusable (%s); "
                                 "caching disabled", d, e)
                     _CACHE = None
             else:
                 _CACHE = None
-            _CACHE_DIR_USED = d
+            _CACHE_CONF_USED = conf
         if _CACHE is not None and _backstop_wanted():
             _configure_backstop(_CACHE.base_dir)
         else:
@@ -375,13 +770,14 @@ def cache() -> Optional[AOTCompileCache]:
 
 
 def reset_cache():
-    """Drop the singleton and immediately re-resolve DL4J_TPU_CACHE_DIR,
-    re-pointing (or disabling) the jax backstop so no compile keeps
-    writing into a stale — possibly deleted — directory."""
-    global _CACHE, _CACHE_DIR_USED
+    """Drop the singleton and immediately re-resolve the store conf
+    (DL4J_TPU_CACHE_DIR / _REMOTE_CACHE / _CACHE_TIER), re-pointing (or
+    disabling) the jax backstop so no compile keeps writing into a stale
+    — possibly deleted — directory."""
+    global _CACHE, _CACHE_CONF_USED
     with _CACHE_LOCK:
         _CACHE = None
-        _CACHE_DIR_USED = None
+        _CACHE_CONF_USED = None
     cache()
 
 
@@ -483,6 +879,110 @@ def serving_manifest_dir(create: bool = True) -> Optional[str]:
                         "stay in-memory", d, e)
             return None
     return d
+
+
+# ---------------------------------------------------------------------------
+# fleet handoff: push-on-drain / pull-on-boot over the shared store
+# ---------------------------------------------------------------------------
+
+def _tiered() -> Optional[TieredStore]:
+    cc = cache()
+    if cc is not None and isinstance(cc.store, TieredStore):
+        return cc.store
+    return None
+
+
+def _copy_manifests(src: Optional[str], dst: Optional[str]) -> int:
+    """Atomic-copy every ``*.warmup.json`` from src into dst; returns the
+    count copied."""
+    if not src or not dst or not os.path.isdir(src):
+        return 0
+    try:
+        os.makedirs(dst, exist_ok=True)
+        names = [n for n in os.listdir(src) if n.endswith(".warmup.json")]
+    except OSError:
+        return 0
+    copied = 0
+    for name in names:
+        try:
+            tmp = os.path.join(dst, name + _tmp_suffix())
+            shutil.copyfile(os.path.join(src, name), tmp)
+            os.replace(tmp, os.path.join(dst, name))
+            copied += 1
+        except OSError as e:
+            log.warning("manifest copy %s failed (%s)", name, e)
+    return copied
+
+
+def push_to_remote() -> Dict[str, int]:
+    """Publish this replica's warm state to the shared store: every local
+    executable the remote doesn't have yet, plus the serving warmup
+    manifests. Called by ``GracefulLifecycle.drain`` so a draining
+    replica's compiles outlive it; safe under concurrent pushers (unique
+    tmp + atomic rename per object). No-op without a tiered store."""
+    store = _tiered()
+    if store is None:
+        return {"executables": 0, "manifests": 0}
+    pushed = 0
+    for key in store.local.keys():
+        if store.remote.contains(key):
+            continue
+        try:
+            entry = store.local.get(key)
+        except CorruptEntryError:
+            continue  # deleted by the read; nothing to publish
+        if entry is not None and store.remote.put(key, entry[0], entry[1]):
+            pushed += 1
+    manifests = _copy_manifests(serving_manifest_dir(create=False),
+                                store.remote.manifest_dir(create=True))
+    cc = cache()
+    if cc is not None:
+        cc._refresh_store_gauges()
+    if pushed or manifests:
+        log.info("pushed %d executables, %d manifests to remote store",
+                 pushed, manifests)
+    return {"executables": pushed, "manifests": manifests}
+
+
+def pull_manifests() -> int:
+    """Copy the shared store's warmup manifests into the local serving
+    manifest dir (overwriting), so ``registry.deploy`` replays the fleet's
+    observed shapes instead of starting blind. No-op without a tiered
+    store."""
+    store = _tiered()
+    if store is None:
+        return 0
+    return _copy_manifests(store.remote.manifest_dir(create=False),
+                           serving_manifest_dir(create=True))
+
+
+def pull_from_remote(keys: Optional[List[str]] = None) -> Dict[str, int]:
+    """Boot-time warm restore: download manifests plus every remote
+    executable not already local (or just ``keys``) into the local tier.
+    Run this *before* ``/readyz`` flips — a replica advertised ready with
+    a cold store would compile under live traffic, the exact spike this
+    store exists to prevent. Each fetch lands on
+    ``dl4j_cache_pull_seconds``. No-op without a tiered store."""
+    store = _tiered()
+    if store is None:
+        return {"executables": 0, "manifests": 0}
+    manifests = pull_manifests()
+    pulled = 0
+    for key in (keys if keys is not None else store.remote.keys()):
+        if store.local.contains(key):
+            continue
+        try:
+            if store.get(key) is not None:  # tiered get write-populates
+                pulled += 1
+        except CorruptEntryError:
+            pass  # deleted from the fleet store; next compile republishes
+    cc = cache()
+    if cc is not None:
+        cc._refresh_store_gauges()
+    if pulled or manifests:
+        log.info("pulled %d executables, %d manifests from remote store",
+                 pulled, manifests)
+    return {"executables": pulled, "manifests": manifests}
 
 
 # ---------------------------------------------------------------------------
@@ -786,47 +1286,41 @@ def warm(jfn, args, jit_kwargs: Optional[Dict[str, Any]] = None,
 # ---------------------------------------------------------------------------
 
 def inventory() -> dict:
-    """The on-disk executable store as a JSON-able listing: per entry the
-    cache key, tag kind, payload size, creation/last-use times, and the
-    XLA cost analysis captured at compile time (flops, bytes accessed,
-    buffer sizes). Entries sort most-recently-used first."""
+    """The executable store as a JSON-able listing: per entry the cache
+    key, tag kind, payload size, creation/last-use times, and the XLA
+    cost analysis captured at compile time (flops, bytes accessed,
+    buffer sizes); plus per-tier backend/entry-count/byte totals under
+    ``"tiers"``. Entries (from the primary tier) sort most-recently-used
+    first."""
     cc = cache()
     if cc is None:
-        return {"enabled": False, "entries": [], "stats": {}}
+        return {"enabled": False, "entries": [], "stats": {},
+                "tiers": []}
     entries = []
-    try:
-        names = os.listdir(cc.aot_dir)
-    except OSError:
-        names = []
-    for name in names:
-        if not name.endswith(_META_EXT):
+    for key in cc.store.keys():
+        meta = cc.store.entry_meta(key)
+        if meta is None:
             continue
-        key = name[:-len(_META_EXT)]
-        meta_p, payload_p = (os.path.join(cc.aot_dir, name),
-                             os.path.join(cc.aot_dir, key + _PAYLOAD_EXT))
-        try:
-            with open(meta_p, "r") as f:
-                meta = json.load(f)
-        except (OSError, ValueError):
-            continue
-        try:
-            last_used = os.stat(payload_p).st_mtime
-        except OSError:
-            last_used = None
         entry = {"key": key, "tag_kind": meta.get("tag_kind"),
                  "payload_bytes": meta.get("payload_bytes"),
-                 "created": meta.get("created"), "last_used": last_used}
+                 "created": meta.get("created"),
+                 "last_used": cc.store.last_used(key)}
         if meta.get("cost"):
             entry["cost"] = meta["cost"]
         entries.append(entry)
     entries.sort(key=lambda e: e.get("last_used") or 0, reverse=True)
+    tiers = []
+    for t in cc.store.tiers():
+        st = t.stat()
+        tiers.append({**t.describe(), "entry_count": st["entries"],
+                      "payload_bytes": st["bytes"]})
     with cc._lock:
         stats = dict(cc.stats)
     return {"enabled": True, "dir": cc.base_dir,
             "max_bytes": cc.max_bytes, "entry_count": len(entries),
             "total_payload_bytes": sum(e.get("payload_bytes") or 0
                                        for e in entries),
-            "stats": stats, "entries": entries}
+            "stats": stats, "tiers": tiers, "entries": entries}
 
 
 # ---------------------------------------------------------------------------

@@ -604,7 +604,15 @@ class DecodeEngine:
         use the scratch block table (all zeros) so no live block is
         touched. (``example``/``batch_sizes`` are accepted for
         registry-warmup signature compatibility and ignored: the shapes
-        are fixed by the engine's own configuration.)"""
+        are fixed by the engine's own configuration.)
+
+        ``runtime.warm_image --generative`` runs exactly this warmup to
+        pre-bake the ladder into a shared artifact dir; a fleet joiner
+        with ``DL4J_TPU_REMOTE_CACHE`` set then pulls the prefill
+        executables instead of compiling them. The donated-KV decode
+        step is raw-store-ineligible (see ``compile_cache``): it loads
+        from the baked ``xla/`` backstop on accelerators and recompiles
+        on CPU — bounded at one executable."""
         with self._cv:
             if self._active_n > 0:
                 raise RuntimeError(

@@ -684,6 +684,12 @@ class InferenceEngine:
         Idempotent and re-entrant: a (bucket, shape) pair already warmed —
         or being warmed by a concurrent call — is never compiled twice;
         late callers wait for the in-flight compile instead.
+
+        With a shared artifact store configured (``DL4J_TPU_REMOTE_CACHE``,
+        or a ``runtime.warm_image`` pre-baked artifact dir), each warmup
+        compile resolves through the tiered store first — on a fleet
+        joiner or freshly booted CI image the whole ladder typically
+        loads as store hits and never reaches XLA.
         """
         jobs: List[Tuple[int, Tuple]] = []  # (bucket, input-sig)
         if example is not None:

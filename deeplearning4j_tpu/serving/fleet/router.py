@@ -33,7 +33,16 @@ joining replica pointed at the shared manifest directory
 pre-bakes the fleet's observed bucket ladder during ``deploy()`` —
 its ``/readyz`` stays false until the ladder is compiled, so
 ``add_replica()`` can be called *before* warmup finishes and the router
-will not route to it until it is actually ready.
+will not route to it until it is actually ready. With a fleet-shared
+artifact store (``DL4J_TPU_REMOTE_CACHE``) the joiner *downloads* that
+ladder instead of compiling it: ``lifecycle.restore_on_boot()`` pulls
+the fleet's manifests + executables before deploy, so every warmup
+bucket is a store hit and cold-join time-to-ready is bounded by
+artifact download, not XLA.
+
+Poll scheduling is jittered: each replica is polled on its own
+deterministic phase within ``DL4J_TPU_FLEET_POLL_S`` (see
+``poll_offset``) so N replicas don't all get probed on the same tick.
 
 Telemetry: ``dl4j_fleet_replicas{model}`` (ready replicas currently
 serving each model) and ``dl4j_router_dispatch_total{replica,outcome}``
@@ -50,6 +59,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ...common.environment import environment
@@ -227,10 +237,21 @@ class FleetRouter:
 
     def poll_once(self):
         """One synchronous refresh of every replica (tests; the poll
-        thread calls this on its cadence)."""
+        thread spreads the same work across the period instead)."""
         for rep in self.replicas():
             self._poll_replica(rep)
         self._update_fleet_gauge()
+
+    def poll_offset(self, url: str) -> float:
+        """Deterministic per-replica phase within the poll period,
+        ``[0, poll_s)``: each replica's first scheduled poll is delayed
+        by this much so N replicas spread over the window instead of
+        being probed in one thundering-herd tick (and, fleet-wide, N
+        routers hash the same replica to the same phase rather than all
+        re-synchronizing on their own start times). Hash, not index, so
+        an offset never changes as membership churns."""
+        return (zlib.crc32(url.rstrip("/").encode("utf-8")) % 9973) \
+            / 9973.0 * self.poll_s
 
     def _update_fleet_gauge(self):
         counts: Dict[str, int] = {}
@@ -253,12 +274,36 @@ class FleetRouter:
         self._stop.clear()
 
         def loop():
+            # each replica keeps its own next-poll deadline, first seen
+            # at now + poll_offset(url): distinct phases per replica,
+            # full poll_s cadence each thereafter
+            due: Dict[str, float] = {}
             while not self._stop.is_set():
-                try:
-                    self.poll_once()
-                except Exception:
-                    log.exception("fleet poll cycle failed")
-                self._stop.wait(self.poll_s)
+                now = time.monotonic()
+                polled = False
+                for rep in self.replicas():
+                    when = due.get(rep.url)
+                    if when is None:
+                        when = now + self.poll_offset(rep.url)
+                        due[rep.url] = when
+                    if when > now:
+                        continue
+                    try:
+                        self._poll_replica(rep)
+                    except Exception:
+                        log.exception("fleet poll of %s failed", rep.url)
+                    due[rep.url] = now + self.poll_s
+                    polled = True
+                if polled:
+                    self._update_fleet_gauge()
+                with self._lock:
+                    live = set(self._replicas)
+                for url in list(due):
+                    if url not in live:
+                        del due[url]
+                now = time.monotonic()
+                next_due = min(due.values(), default=now + self.poll_s)
+                self._stop.wait(max(min(next_due - now, self.poll_s), 0.01))
 
         self._poll_thread = threading.Thread(
             target=loop, name="dl4j-tpu-fleet-poll", daemon=True)

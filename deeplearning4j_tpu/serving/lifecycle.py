@@ -28,6 +28,14 @@ are written atomically.
 ``GracefulLifecycle.install()`` wires this to SIGTERM (handler chains to
 any previously installed one); ``drain()`` can also be called directly —
 e.g. from a preStop hook or a test.
+
+With a fleet-shared artifact store configured (``DL4J_TPU_REMOTE_CACHE``)
+the contract extends across replicas: ``drain()`` additionally pushes the
+local executables + manifests to the shared store
+(``compile_cache.push_to_remote``), and :func:`restore_on_boot` pulls
+them down on the way up — call it before deploying models, i.e. before
+``/readyz`` can flip, so the load balancer never routes traffic to a
+replica that would compile instead of serve.
 """
 from __future__ import annotations
 
@@ -44,11 +52,27 @@ from ..common.environment import environment
 from ..common.locks import ordered_lock
 from ..common.metrics import registry as metrics_registry
 from ..common.tracing import tracer
+from ..runtime import compile_cache
 from . import resilience
 from .registry import ModelRegistry
 from .server import ModelServer
 
 log = logging.getLogger(__name__)
+
+
+def restore_on_boot() -> dict:
+    """Pull the fleet's executables + warmup manifests from the shared
+    artifact store into the local cache (no-op without
+    ``DL4J_TPU_REMOTE_CACHE``). Call before ``registry.deploy`` /
+    ``ModelServer`` start so every bucket warms from a store hit and
+    ``/readyz`` only ever flips on a replica that won't compile under
+    live traffic. Returns ``{"executables": n, "manifests": m}``."""
+    try:
+        return compile_cache.pull_from_remote()
+    except Exception:
+        log.exception("artifact-store pull on boot failed; continuing "
+                      "with a cold cache")
+        return {"executables": 0, "manifests": 0}
 
 
 class GracefulLifecycle:
@@ -188,6 +212,13 @@ class GracefulLifecycle:
             self.dump_flight_recorder()
             ok = self.registry.drain_all(timeout_s=self.drain_timeout_s,
                                          save_manifests=True)
+            # publish this replica's compiles + manifests to the shared
+            # artifact store (no-op without DL4J_TPU_REMOTE_CACHE) so its
+            # replacement boots warm instead of recompiling under load
+            try:
+                compile_cache.push_to_remote()
+            except Exception:
+                log.exception("artifact-store push on drain failed")
             if self.server is not None:
                 self.server.stop()  # socket closes after the work is done
             if self.on_drained is not None:

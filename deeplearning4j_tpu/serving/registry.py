@@ -113,8 +113,18 @@ class ModelRegistry:
                        if retain is None else int(retain))
         # "auto" = ride the executable cache volume; None disables disk
         # manifests entirely (hot-swap handoff still works in-process)
-        self._manifest_dir = (compile_cache.serving_manifest_dir()
-                              if manifest_dir == "auto" else manifest_dir)
+        if manifest_dir == "auto":
+            # with a fleet store configured, sync down the fleet's
+            # observed-traffic manifests first so deploy() warms the
+            # shapes other replicas served, not just this machine's past
+            try:
+                compile_cache.pull_manifests()
+            except Exception:
+                log.exception("fleet manifest pull failed; using local "
+                              "manifests only")
+            self._manifest_dir = compile_cache.serving_manifest_dir()
+        else:
+            self._manifest_dir = manifest_dir
         self._lock = ordered_rlock("registry")
         self._versions: Dict[str, List[ModelVersion]] = {}
         self._current: Dict[str, ModelVersion] = {}

@@ -965,3 +965,90 @@ class TestCheckStaticAnalysis:
         assert "gate_ok" in rec and "gate_reason" in rec
         # the bench restored the tracker to the suite's state
         assert locks.lock_check_enabled() == before
+
+
+def _fcs_record(remote_entries=4, live=0, hits=4, buckets=4,
+                cold_ttr=0.12, warm_ttr=0.1):
+    return {
+        "remote_entries": remote_entries, "remote_bytes": 4096,
+        "seed": {"ttr_s": 0.9, "buckets_warmed": buckets,
+                 "live_compiles": buckets, "hit_compiles": 0,
+                 "store_hits": 0},
+        "warm_restart": {"ttr_s": warm_ttr, "buckets_warmed": buckets,
+                         "live_compiles": 0, "hit_compiles": buckets,
+                         "store_hits": buckets},
+        "cold_join": {"ttr_s": cold_ttr, "buckets_warmed": buckets,
+                      "live_compiles": live, "hit_compiles": hits,
+                      "store_hits": hits},
+        "ttr_ratio": round(cold_ttr / warm_ttr, 3),
+    }
+
+
+class TestCheckFleetColdStart:
+    """Gate logic for the fleet_cold_start metric: a second replica with
+    an empty local cache must warm entirely from the shared artifact
+    store — zero live compiles — in <= 1.2x a fully-warm local
+    restart's time-to-ready."""
+
+    def test_accepts_good_record(self):
+        ok, reason = bench.check_fleet_cold_start(_fcs_record())
+        assert ok, reason
+
+    def test_rejects_empty_shared_store(self):
+        # nothing published by the seed phase -> the cold join would be
+        # measuring local recompiles, not the store
+        ok, reason = bench.check_fleet_cold_start(
+            _fcs_record(remote_entries=0))
+        assert not ok
+        assert "shared store" in reason
+
+    def test_rejects_live_compiles_on_cold_join(self):
+        ok, reason = bench.check_fleet_cold_start(
+            _fcs_record(live=1, hits=3))
+        assert not ok
+        assert "live" in reason
+
+    def test_rejects_partial_store_coverage(self):
+        # a full ladder warmed but fewer store hits than buckets means
+        # part of it came from somewhere other than the shared store
+        ok, reason = bench.check_fleet_cold_start(
+            _fcs_record(hits=2, buckets=4))
+        assert not ok
+        assert "somewhere other than" in reason
+
+    def test_rejects_slow_join_and_boundary(self):
+        ok, reason = bench.check_fleet_cold_start(
+            _fcs_record(cold_ttr=0.15, warm_ttr=0.1))
+        assert not ok
+        assert "1.2" in reason
+        ok, _ = bench.check_fleet_cold_start(
+            _fcs_record(cold_ttr=0.119, warm_ttr=0.1))
+        assert ok
+
+    def test_custom_max_ratio(self):
+        rec = _fcs_record(cold_ttr=0.15, warm_ttr=0.1)
+        ok, _ = bench.check_fleet_cold_start(rec, max_ratio=2.0)
+        assert ok
+
+    def test_tiny_live_measurement_passes_gate(self):
+        """The full metric end-to-end on CPU against a real shared
+        filesystem store. The deterministic legs are hard asserts (seed
+        publishes, joiner records zero live compiles with every bucket a
+        store hit); the 1.2x wall-clock ratio has wide margin on CPU
+        since local and remote tiers are the same filesystem."""
+        import jax
+        import jax.numpy as jnp
+
+        rec = bench.bench_fleet_cold_start(jax, jnp, tiny=True)
+        for phase in ("seed", "warm_restart", "cold_join"):
+            assert rec[phase]["ttr_s"] > 0
+            assert rec[phase]["buckets_warmed"] >= 1
+        assert rec["remote_entries"] > 0
+        assert rec["seed"]["live_compiles"] > 0
+        assert rec["cold_join"]["live_compiles"] == 0
+        assert rec["cold_join"]["store_hits"] >= \
+            rec["cold_join"]["buckets_warmed"]
+        assert rec["ttr_ratio"] == pytest.approx(
+            rec["cold_join"]["ttr_s"] / rec["warm_restart"]["ttr_s"],
+            rel=1e-2)
+        assert "gate_ok" in rec and "gate_reason" in rec

@@ -660,3 +660,346 @@ class TestAttentionDispatch:
         out_xla = bert.encode(params, ids, config=config, use_flash=False)
         np.testing.assert_array_equal(np.asarray(out_flash),
                                       np.asarray(out_xla))
+
+
+# ---------------------------------------------------------------------------
+# pluggable artifact stores: tiers, concurrent writers, fleet handoff
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def tiered_cache(tmp_path):
+    """Local + remote tiered cache over private dirs (the shared-store
+    deployment in miniature), env triple restored afterwards."""
+    env = environment()
+    saved = {p: env.property_override(p)
+             for p in (SystemProperties.CACHE_DIR,
+                       SystemProperties.REMOTE_CACHE,
+                       SystemProperties.CACHE_TIER)}
+    env.set_cache_dir(str(tmp_path / "local"))
+    env.set_remote_cache(str(tmp_path / "remote"))
+    env.set_cache_tier("auto")
+    compile_cache.reset_cache()
+    yield compile_cache.cache()
+    for prop, value in saved.items():
+        if value is None:
+            env.clear_property(prop)
+        else:
+            env.set_property(prop, value)
+    compile_cache.reset_cache()
+
+
+def _remote_paths(store, key):
+    return store._paths(key)
+
+
+class TestArtifactStores:
+    def test_default_store_is_local_dir(self, fresh_cache):
+        """No remote configured -> behavior-identical LocalDirStore with
+        today's flat <base>/aot layout."""
+        assert isinstance(fresh_cache.store,
+                          compile_cache.LocalDirStore)
+        assert fresh_cache.aot_dir.endswith(os.path.join("", "aot"))
+        fresh_cache.put("k1", b"payload", {"kept_var_idx": [0]})
+        assert os.path.exists(os.path.join(fresh_cache.aot_dir, "k1.bin"))
+        assert os.path.exists(os.path.join(fresh_cache.aot_dir, "k1.json"))
+        tiers = fresh_cache.store.tiers()
+        assert [t.tier for t in tiers] == ["local"]
+        assert tiers[0].describe()["backend"] == "local-dir"
+
+    def test_tiered_put_populates_both_tiers(self, tiered_cache):
+        assert isinstance(tiered_cache.store, compile_cache.TieredStore)
+        tiered_cache.put("ab" * 32, b"payload", {"kept_var_idx": [0]})
+        store = tiered_cache.store
+        assert store.local.contains("ab" * 32)
+        assert store.remote.contains("ab" * 32)
+        # content-addressed remote layout: objects/<key[:2]>/<key>.bin
+        payload_p, _ = _remote_paths(store.remote, "ab" * 32)
+        assert os.sep + os.path.join("objects", "ab") + os.sep in payload_p
+
+    def test_local_miss_falls_through_and_backfills(self, tiered_cache):
+        tiered_cache.put("cd" * 32, b"payload", {"kept_var_idx": [0]})
+        tiered_cache.store.local.clear()
+        assert not tiered_cache.store.local.contains("cd" * 32)
+        got = tiered_cache.get("cd" * 32)
+        assert got is not None and got[0] == b"payload"
+        assert tiered_cache.stats["hits"] == 1
+        # the remote hit was written back into the local tier
+        assert tiered_cache.store.local.contains("cd" * 32)
+
+    def test_corrupt_local_refetches_from_remote(self, tiered_cache,
+                                                 caplog):
+        """Digest mismatch on the local copy -> delete + transparent
+        refetch from the shared store, surfaced on the existing
+        corruption warning path."""
+        tiered_cache.put("ef" * 32, b"payload", {"kept_var_idx": [0]})
+        with open(os.path.join(tiered_cache.aot_dir,
+                               "ef" * 32 + ".bin"), "wb") as fh:
+            fh.write(b"garbage")
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu.runtime"
+                                    ".compile_cache"):
+            got = tiered_cache.get("ef" * 32)
+        assert got is not None and got[0] == b"payload"
+        assert tiered_cache.stats["corrupt"] == 1
+        assert any("refetched from remote" in r.getMessage()
+                   for r in caplog.records)
+        # the backfill healed the local copy
+        healed = tiered_cache.store.local.get("ef" * 32)
+        assert healed is not None and healed[0] == b"payload"
+
+    def test_corrupt_remote_deleted_with_warning(self, tiered_cache,
+                                                 caplog):
+        """A bad shared-store entry is deleted for the whole fleet and
+        reported as a miss via the existing recompiling warning."""
+        store = tiered_cache.store
+        store.remote.put("12" * 32, b"payload",
+                         compile_cache._stamp_meta(b"payload", {}))
+        payload_p, _ = _remote_paths(store.remote, "12" * 32)
+        with open(payload_p, "wb") as fh:
+            fh.write(b"garbage")
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu.runtime"
+                                    ".compile_cache"):
+            assert tiered_cache.get("12" * 32) is None
+        assert tiered_cache.stats["corrupt"] == 1
+        assert tiered_cache.stats["misses"] == 1
+        assert not store.remote.contains("12" * 32)
+        assert any("recompiling" in r.getMessage()
+                   for r in caplog.records)
+
+    def test_half_written_entry_detected_and_dropped(self, tmp_path):
+        """Satellite regression: an interleaved half-written entry (a
+        writer that died mid-payload AFTER the meta landed) must fail the
+        digest check and be deleted, never served."""
+        store = compile_cache.RemoteStore(str(tmp_path))
+        meta = compile_cache._stamp_meta(b"full-payload-bytes", {})
+        store.put("ab" * 32, b"full-payload-bytes", meta)
+        payload_p, _ = _remote_paths(store, "ab" * 32)
+        with open(payload_p, "wb") as fh:
+            fh.write(b"full-pay")  # torn write: correct prefix, truncated
+        with pytest.raises(compile_cache.CorruptEntryError):
+            store.get("ab" * 32)
+        assert not store.contains("ab" * 32)
+        # a crashed writer's leftover tmp file is not an entry either
+        with open(payload_p + compile_cache._tmp_suffix(), "wb") as fh:
+            fh.write(b"partial")
+        assert store.keys() == []
+        assert store.stat()["entries"] == 0
+
+    def test_concurrent_same_key_writers_converge(self, tmp_path):
+        """N threads racing a put of the same key: unique tmp files +
+        atomic rename mean the survivor is always a valid entry."""
+        store = compile_cache.RemoteStore(str(tmp_path))
+        payload = b"x" * 4096
+        meta = compile_cache._stamp_meta(payload, {"kept_var_idx": [0]})
+        errs = []
+
+        def writer():
+            try:
+                for _ in range(20):
+                    assert store.put("fe" * 32, payload, meta)
+            except Exception as e:  # pragma: no cover - failure path
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        got = store.get("fe" * 32)
+        assert got is not None and got[0] == payload
+        # no tmp litter survived the races
+        shard = os.path.dirname(_remote_paths(store, "fe" * 32)[0])
+        assert [n for n in os.listdir(shard) if ".tmp" in n] == []
+
+    def test_tmp_suffixes_are_unique(self):
+        out = set()
+
+        def grab():
+            for _ in range(50):
+                out.add(compile_cache._tmp_suffix())
+
+        threads = [threading.Thread(target=grab) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(out) == 200
+
+    def test_remote_only_tier(self, tmp_path):
+        env = environment()
+        saved = {p: env.property_override(p)
+                 for p in (SystemProperties.CACHE_DIR,
+                           SystemProperties.REMOTE_CACHE,
+                           SystemProperties.CACHE_TIER)}
+        try:
+            env.set_cache_dir(str(tmp_path / "base"))
+            env.set_remote_cache(str(tmp_path / "remote"))
+            env.set_cache_tier("remote")
+            compile_cache.reset_cache()
+            cc = compile_cache.cache()
+            assert isinstance(cc.store, compile_cache.RemoteStore)
+            assert cc.aot_dir is None
+            cc.put("ba" * 32, b"payload", {"kept_var_idx": [0]})
+            assert cc.get("ba" * 32)[0] == b"payload"
+            assert cc.entry_count() == 1
+        finally:
+            for prop, value in saved.items():
+                if value is None:
+                    env.clear_property(prop)
+                else:
+                    env.set_property(prop, value)
+            compile_cache.reset_cache()
+
+    def test_shared_remote_not_lru_capped(self, tmp_path):
+        """One replica's byte cap must never evict the fleet's shared
+        entries: enforce_cap only prunes the local tier."""
+        local = compile_cache.LocalDirStore(str(tmp_path / "l"))
+        remote = compile_cache.RemoteStore(str(tmp_path / "r"))
+        store = compile_cache.TieredStore(local, remote)
+        for i in range(4):
+            key = f"{i:02d}" * 32
+            store.put(key, b"x" * 80,
+                      compile_cache._stamp_meta(b"x" * 80, {}))
+        assert store.enforce_cap(100) > 0
+        assert local.stat()["bytes"] <= 100
+        assert remote.stat()["entries"] == 4
+
+
+class TestTieredInventory:
+    def test_inventory_reports_tiers(self, tiered_cache):
+        tiered_cache.put("aa" * 32, b"x" * 100, {"kept_var_idx": [0]})
+        tiered_cache.put("bb" * 32, b"y" * 50, {"kept_var_idx": [0]})
+        tiered_cache.store.local.delete("bb" * 32)  # remote-only entry
+        inv = compile_cache.inventory()
+        assert inv["enabled"] and inv["entry_count"] == 1
+        by_tier = {t["tier"]: t for t in inv["tiers"]}
+        assert set(by_tier) == {"local", "remote"}
+        assert by_tier["local"]["backend"] == "local-dir"
+        assert by_tier["remote"]["backend"] == "remote-fs"
+        assert by_tier["local"]["entry_count"] == 1
+        assert by_tier["remote"]["entry_count"] == 2
+        assert by_tier["local"]["payload_bytes"] >= 100
+        assert by_tier["remote"]["payload_bytes"] >= 150
+
+    def test_debug_endpoint_serves_tier_listing(self, tiered_cache):
+        """/debug/compile_cache with a tiered store: per-tier backend,
+        entry counts, and bytes ride the existing inventory document."""
+        import urllib.request
+
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        tiered_cache.put("cc" * 32, b"z" * 64, {"kept_var_idx": [0]})
+        ui = UIServer(port=0)
+        port = ui.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/compile_cache",
+                    timeout=5) as r:
+                doc = json.loads(r.read())
+        finally:
+            ui.stop()
+        assert doc["enabled"] and doc["entry_count"] == 1
+        tiers = {t["tier"]: t for t in doc["tiers"]}
+        assert tiers["local"]["entry_count"] == 1
+        assert tiers["remote"]["entry_count"] == 1
+        assert tiers["remote"]["payload_bytes"] >= 64
+
+    def test_store_gauges_track_mutations(self, tiered_cache):
+        reg = registry()
+        tiered_cache.put("dd" * 32, b"p" * 128, {"kept_var_idx": [0]})
+        g_entries = reg.get("dl4j_cache_store_entries")
+        g_bytes = reg.get("dl4j_cache_store_bytes")
+        assert g_entries.labels(tier="local").value() == 1
+        assert g_entries.labels(tier="remote").value() == 1
+        assert g_bytes.labels(tier="remote").value() >= 128
+        tiered_cache.clear()  # local-only clear: remote keeps the entry
+        assert g_entries.labels(tier="local").value() == 0
+        assert g_entries.labels(tier="remote").value() == 1
+
+
+class TestFleetHandoff:
+    def test_push_to_remote_publishes_missing_entries(self, tmp_path):
+        env = environment()
+        saved = {p: env.property_override(p)
+                 for p in (SystemProperties.CACHE_DIR,
+                           SystemProperties.REMOTE_CACHE,
+                           SystemProperties.CACHE_TIER)}
+        try:
+            # seed executables with NO remote configured (yesterday's
+            # replica), then attach the shared store and push on drain
+            env.set_cache_dir(str(tmp_path / "local"))
+            env.set_remote_cache(None)
+            compile_cache.reset_cache()
+            cc = compile_cache.cache()
+            cc.put("ab" * 32, b"one", {"kept_var_idx": [0]})
+            cc.put("cd" * 32, b"two", {"kept_var_idx": [0]})
+            mdir = compile_cache.serving_manifest_dir()
+            with open(os.path.join(mdir, "toy.warmup.json"), "w") as fh:
+                json.dump([{"inputs": [], "buckets": [1]}], fh)
+            env.set_remote_cache(str(tmp_path / "remote"))
+            compile_cache.reset_cache()
+            pushed = compile_cache.push_to_remote()
+            assert pushed == {"executables": 2, "manifests": 1}
+            remote = compile_cache.RemoteStore(str(tmp_path / "remote"))
+            assert remote.stat()["entries"] == 2
+            assert os.path.exists(os.path.join(
+                remote.manifest_dir(), "toy.warmup.json"))
+            # idempotent: nothing new to publish the second time
+            assert compile_cache.push_to_remote()["executables"] == 0
+        finally:
+            for prop, value in saved.items():
+                if value is None:
+                    env.clear_property(prop)
+                else:
+                    env.set_property(prop, value)
+            compile_cache.reset_cache()
+
+    def test_pull_from_remote_warms_empty_local(self, tmp_path):
+        env = environment()
+        saved = {p: env.property_override(p)
+                 for p in (SystemProperties.CACHE_DIR,
+                           SystemProperties.REMOTE_CACHE,
+                           SystemProperties.CACHE_TIER)}
+        try:
+            remote = compile_cache.RemoteStore(str(tmp_path / "remote"))
+            for key, payload in (("ab" * 32, b"one"), ("cd" * 32, b"two")):
+                remote.put(key, payload,
+                           compile_cache._stamp_meta(payload, {}))
+            os.makedirs(remote.manifest_dir(), exist_ok=True)
+            with open(os.path.join(remote.manifest_dir(),
+                                   "toy.warmup.json"), "w") as fh:
+                json.dump([{"inputs": [], "buckets": [1]}], fh)
+            env.set_cache_dir(str(tmp_path / "local2"))  # empty joiner
+            env.set_remote_cache(str(tmp_path / "remote"))
+            compile_cache.reset_cache()
+            pulled = compile_cache.pull_from_remote()
+            assert pulled == {"executables": 2, "manifests": 1}
+            cc = compile_cache.cache()
+            assert cc.store.local.contains("ab" * 32)
+            assert cc.store.local.contains("cd" * 32)
+            assert os.path.exists(os.path.join(
+                compile_cache.serving_manifest_dir(),
+                "toy.warmup.json"))
+            # the boot pull landed on the pull-latency histogram
+            fam = registry().get("dl4j_cache_pull_seconds")
+            hits = sum(child.count()
+                       for key, child in fam.children()
+                       if key == ("hit",))
+            assert hits >= 2
+        finally:
+            for prop, value in saved.items():
+                if value is None:
+                    env.clear_property(prop)
+                else:
+                    env.set_property(prop, value)
+            compile_cache.reset_cache()
+
+    def test_handoff_noop_without_remote_store(self, fresh_cache):
+        fresh_cache.put("ab" * 32, b"one", {"kept_var_idx": [0]})
+        assert compile_cache.push_to_remote() == {"executables": 0,
+                                                  "manifests": 0}
+        assert compile_cache.pull_from_remote() == {"executables": 0,
+                                                    "manifests": 0}
+        assert compile_cache.pull_manifests() == 0

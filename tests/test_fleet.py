@@ -418,3 +418,149 @@ class TestJoiningReplica:
             srv1.stop()
             reg2.drain_all(save_manifests=False)
             reg1.drain_all(save_manifests=False)
+
+
+# ---------------------------------------------------------------------------
+# poll jitter: N replicas must not thundering-herd the same tick
+# ---------------------------------------------------------------------------
+
+class TestPollJitter:
+    def test_offsets_distinct_deterministic_in_range(self):
+        router = FleetRouter(poll_s=5.0)
+        urls = [f"http://10.0.0.{i}:8080" for i in range(1, 9)]
+        offsets = [router.poll_offset(u) for u in urls]
+        assert all(0.0 <= o < 5.0 for o in offsets)
+        # distinct scheduled offsets: the herd is actually spread
+        assert len(set(offsets)) == len(offsets)
+        # deterministic: same url -> same phase, every call
+        assert offsets == [router.poll_offset(u) for u in urls]
+        # and normalization-stable (trailing slash is the same replica)
+        assert router.poll_offset(urls[0] + "/") == offsets[0]
+
+    def test_offsets_scale_with_poll_period(self):
+        u = "http://10.0.0.1:8080"
+        assert FleetRouter(poll_s=8.0).poll_offset(u) == pytest.approx(
+            4 * FleetRouter(poll_s=2.0).poll_offset(u))
+
+    def test_poll_thread_staggers_first_polls(self):
+        import threading
+        import time as _time
+
+        polled = []
+        lock = threading.Lock()
+
+        class _Recorder(FleetRouter):
+            def _poll_replica(self, rep):
+                with lock:
+                    polled.append((rep.url, _time.monotonic()))
+
+        router = _Recorder(poll_s=0.6)
+        # pick two urls whose hashed phases are far apart, so the
+        # assertion below is about scheduling, not luck
+        base, other = "http://10.0.0.1:8080", None
+        for i in range(2, 200):
+            candidate = f"http://10.0.0.{i}:8080"
+            if abs(router.poll_offset(candidate)
+                   - router.poll_offset(base)) > 0.25:
+                other = candidate
+                break
+        assert other is not None
+        router.add_replica(base, poll=False)
+        router.add_replica(other, poll=False)
+        router.start_polling()
+        try:
+            deadline = _time.monotonic() + 3.0
+            while _time.monotonic() < deadline:
+                with lock:
+                    if len(polled) >= 2:
+                        break
+                _time.sleep(0.02)
+            with lock:
+                first = {}
+                for url, t in polled:
+                    first.setdefault(url, t)
+            assert set(first) == {base, other}
+            # distinct phases -> the first polls did not share a tick
+            assert abs(first[base] - first[other]) > 0.1
+        finally:
+            router.stop_polling()
+
+
+# ---------------------------------------------------------------------------
+# shared-store cold join: download, don't compile
+# ---------------------------------------------------------------------------
+
+def _compile_events(cache_labels):
+    fam = registry().get("dl4j_compiles_total")
+    return sum(int(child.value()) for key, child in
+               (fam.children() if fam else [])
+               if len(key) == 2 and key[1] in cache_labels)
+
+
+class TestSharedStoreJoiner:
+    def test_cold_joiner_warms_from_shared_store(self, tmp_path):
+        """The fleet cold-start contract end-to-end: replica 1 serves,
+        drains (push-on-drain), then a joiner with an EMPTY local cache
+        restores on boot and reaches a fully warmed deploy with zero
+        live compiles — every bucket a store hit."""
+        import os
+
+        from deeplearning4j_tpu.common.environment import (
+            SystemProperties, environment)
+        from deeplearning4j_tpu.runtime import compile_cache
+        from deeplearning4j_tpu.serving import lifecycle
+
+        env = environment()
+        saved = {p: env.property_override(p)
+                 for p in (SystemProperties.CACHE_DIR,
+                           SystemProperties.REMOTE_CACHE,
+                           SystemProperties.CACHE_TIER)}
+        keep = []  # nets stay alive: compile tags are id()-keyed
+        reg1 = reg2 = None
+        try:
+            env.set_cache_dir(str(tmp_path / "replica1"))
+            env.set_remote_cache(str(tmp_path / "shared"))
+            env.set_cache_tier("auto")
+            compile_cache.reset_cache()
+            net1 = _mlp()
+            keep.append(net1)
+            reg1 = ModelRegistry()
+            reg1.deploy("toy", "v1", net1, example=_x(), warm=True)
+            ref = np.asarray(reg1.predict("toy", _x()).jax())
+            assert lifecycle.GracefulLifecycle(reg1).drain()
+            reg1 = None
+            shared = compile_cache.RemoteStore(str(tmp_path / "shared"))
+            assert shared.stat()["entries"] > 0
+            assert os.path.exists(os.path.join(
+                shared.manifest_dir(), "toy.warmup.json"))
+
+            # ---- the joiner: fresh local dir, nothing compiled yet ----
+            env.set_cache_dir(str(tmp_path / "replica2"))
+            compile_cache.reset_cache()
+            jax.clear_caches()
+            pulled = lifecycle.restore_on_boot()
+            assert pulled["executables"] > 0
+            assert pulled["manifests"] >= 1
+            live0 = _compile_events(("miss", "bypass"))
+            hit0 = _compile_events(("hit",))
+            net2 = _mlp()
+            keep.append(net2)
+            reg2 = ModelRegistry()  # "auto" syncs fleet manifests
+            reg2.deploy("toy", "v1", net2, warm=False)
+            buckets = reg2.warm("toy")
+            assert buckets, "joiner must warm from the pulled manifest"
+            assert _compile_events(("miss", "bypass")) - live0 == 0, \
+                "cold join must download executables, not compile them"
+            assert _compile_events(("hit",)) - hit0 >= len(buckets)
+            out = np.asarray(reg2.predict("toy", _x()).jax())
+            np.testing.assert_allclose(ref, out, rtol=1e-6, atol=1e-7)
+        finally:
+            for reg in (reg1, reg2):
+                if reg is not None:
+                    reg.drain_all(save_manifests=False)
+            for prop, value in saved.items():
+                if value is None:
+                    env.clear_property(prop)
+                else:
+                    env.set_property(prop, value)
+            compile_cache.reset_cache()

@@ -1,0 +1,159 @@
+"""warm_image CLI: pre-baked artifact directories for CI images.
+
+The tier-1 smoke of the bake contract: bake a tiny MLP's bucket ladder
+into a tmpdir (remote-store layout), then boot a fresh-cache engine
+against the artifact and reach a fully warmed ladder with zero live
+compiles — every bucket a store hit on the compile counter.
+"""
+import json
+import os
+import sys
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common.environment import (SystemProperties,
+                                                   environment)
+from deeplearning4j_tpu.common.metrics import registry
+from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.runtime import compile_cache, warm_image
+from deeplearning4j_tpu.runtime.inference import InferenceEngine
+
+N_IN, N_OUT = 6, 3
+
+
+def _mlp():
+    conf = (NeuralNetConfiguration.builder().seed(0).list()
+            .layer(DenseLayer(n_in=N_IN, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=N_OUT))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _factory():
+    return _mlp(), jnp.zeros((1, N_IN), "float32")
+
+
+@pytest.fixture
+def factory_module():
+    """The CLI imports --model as pkg.module:factory; register a module
+    carrying the tiny-MLP factory for it to find."""
+    mod = types.ModuleType("_warm_image_fixture")
+    mod.build = _factory
+    sys.modules["_warm_image_fixture"] = mod
+    yield "_warm_image_fixture:build"
+    sys.modules.pop("_warm_image_fixture", None)
+
+
+def _restore(env, saved):
+    for prop, value in saved.items():
+        if value is None:
+            env.clear_property(prop)
+        else:
+            env.set_property(prop, value)
+    compile_cache.reset_cache()
+
+
+def _compile_events(cache_labels):
+    fam = registry().get("dl4j_compiles_total")
+    return sum(int(child.value()) for key, child in
+               (fam.children() if fam else [])
+               if len(key) == 2 and key[1] in cache_labels)
+
+
+class TestWarmImageCLI:
+    def test_bake_writes_relocatable_artifact(self, factory_module,
+                                              tmp_path, capsys):
+        out_dir = str(tmp_path / "artifact")
+        rc = warm_image.main(["--model", factory_module,
+                              "--output", out_dir,
+                              "--name", "tinymlp",
+                              "--max-batch", "4"])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["entries"] == len(summary["buckets"]) > 0
+        # remote-store layout: content-addressed objects + the manifest
+        objects = [n for _, _, names in os.walk(
+            os.path.join(out_dir, "objects")) for n in names]
+        assert len(objects) == 2 * summary["entries"]  # .bin + .json
+        assert os.path.exists(os.path.join(
+            out_dir, "manifests", "tinymlp.warmup.json"))
+
+    def test_bad_model_spec_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="pkg.module:factory"):
+            warm_image.main(["--model", "no_colon_here",
+                             "--output", str(tmp_path)])
+
+    def test_predict_bake_requires_example(self, tmp_path):
+        mod = types.ModuleType("_warm_image_noex")
+        mod.build = _mlp  # model only, no example, no --example-shape
+        sys.modules["_warm_image_noex"] = mod
+        try:
+            with pytest.raises(ValueError, match="example"):
+                warm_image.main(["--model", "_warm_image_noex:build",
+                                 "--output", str(tmp_path / "a")])
+        finally:
+            sys.modules.pop("_warm_image_noex", None)
+
+    def test_bake_restores_cache_conf(self, factory_module, tmp_path,
+                                      capsys):
+        env = environment()
+        before = {p: env.property_override(p)
+                  for p in (SystemProperties.CACHE_DIR,
+                            SystemProperties.REMOTE_CACHE,
+                            SystemProperties.CACHE_TIER)}
+        warm_image.main(["--model", factory_module,
+                         "--output", str(tmp_path / "b"),
+                         "--max-batch", "2"])
+        capsys.readouterr()
+        after = {p: env.property_override(p) for p in before}
+        assert after == before
+
+    def test_baked_engine_boots_with_zero_live_compiles(
+            self, factory_module, tmp_path, capsys):
+        """The aha moment: a fresh-cache engine pointed at the baked
+        artifact warms its whole ladder without ever running XLA."""
+        out_dir = str(tmp_path / "artifact")
+        assert warm_image.main(["--model", factory_module,
+                                "--output", out_dir,
+                                "--name", "tinymlp",
+                                "--max-batch", "4"]) == 0
+        summary = json.loads(capsys.readouterr().out)
+
+        env = environment()
+        saved = {p: env.property_override(p)
+                 for p in (SystemProperties.CACHE_DIR,
+                           SystemProperties.REMOTE_CACHE,
+                           SystemProperties.CACHE_TIER)}
+        try:
+            # a CI replica: empty local cache, artifact as the remote
+            env.set_cache_dir(str(tmp_path / "fresh-local"))
+            env.set_remote_cache(out_dir)
+            env.set_cache_tier("auto")
+            compile_cache.reset_cache()
+            jax.clear_caches()
+            cc = compile_cache.cache()
+            live0 = _compile_events(("miss", "bypass"))
+            hit0 = _compile_events(("hit",))
+            net = _mlp()
+            eng = InferenceEngine(net, max_batch=4, manifest_path=os.path.join(
+                out_dir, "manifests", "tinymlp.warmup.json"))
+            try:
+                buckets = eng.warmup()  # replay the baked manifest
+                assert sorted(buckets) == sorted(summary["buckets"])
+                x = np.zeros((2, N_IN), np.float32)
+                jax.block_until_ready(eng.infer(jnp.asarray(x)).jax())
+            finally:
+                eng.close(timeout_s=10.0)
+            assert _compile_events(("miss", "bypass")) - live0 == 0, \
+                "a baked ladder must never compile live"
+            assert _compile_events(("hit",)) - hit0 >= len(buckets)
+            assert cc.stats["misses"] == 0
+            assert cc.stats["hits"] >= len(buckets)
+        finally:
+            _restore(env, saved)
