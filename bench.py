@@ -2091,6 +2091,289 @@ def check_sharded_serving(rec, min_scaleout=2.0):
     return True, "ok"
 
 
+def bench_fleet_resilience(jax, jnp, tiny):
+    """Tail-tolerant fleet under storm (serving/fleet): hedged requests,
+    retry budget, outlier ejection, probe re-admission. Three phases
+    over a 3-replica fleet of admission-limited ModelServers, all
+    through one FleetRouter with background polling on:
+
+    1. **baseline** — a fault-free 6-thread client storm. Sets the p99
+       yardstick and warms the router's per-model latency samples so
+       hedging is armed for phase 2.
+    2. **faulted storm** — the same storm with ``fleet.dispatch``
+       faults injected router-side: a 20% connection-error rate on the
+       two healthy replicas, plus a fixed 10x-service-time connect
+       delay on ONE replica (the outlier — its OWN ``/readyz`` and
+       ``/metrics.json`` stay perfectly healthy, so only dispatch-
+       outcome ejection can catch it). The router must hedge around
+       the outlier, eject it on latency z-score, fail over around the
+       connection errors within the retry budget, and lose zero
+       non-shed requests while holding p99 <= 3x the baseline.
+    3. **re-admission** — faults cleared; single requests driven until
+       the ejected outlier's backoff expires and one probe request
+       re-admits it.
+
+    Gates (check_fleet_resilience): faults actually fired; zero lost
+    requests in both storms; p99 ratio <= 3x; total dispatch attempts
+    bounded by offered + budget allowance (hedges and retries both
+    draw tokens); at least one hedge launched; the outlier ejected at
+    least once and probe-re-admitted."""
+    import threading
+
+    from deeplearning4j_tpu.common import faults
+    from deeplearning4j_tpu.common.metrics import registry as mreg
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+    from deeplearning4j_tpu.serving.fleet import FleetRouter, NoReplicaError
+
+    n_in, hidden, n_out, B = 32, 64, 8, 4
+    n_threads = 6
+    per_thread = 15 if tiny else 40
+    delay_ms = 20.0              # no-CPU service-time floor per dispatch
+    fault_rate = 0.2             # connect-error rate on healthy replicas
+    outlier_delay_s = 10.0 * delay_ms / 1e3  # the 10x-latency outlier
+    budget_ratio, budget_burst = 0.5, 10.0
+
+    def _mlp(seed=0):
+        b = NeuralNetConfiguration.builder().seed(seed).list()
+        b.layer(DenseLayer(n_in=n_in, n_out=hidden, activation="tanh"))
+        conf = b.layer(OutputLayer(n_in=hidden, n_out=n_out)).build()
+        return MultiLayerNetwork(conf).init()
+
+    x = np.random.RandomState(0).randn(B, n_in).astype(np.float32)
+    body = json.dumps({"inputs": x.tolist()}).encode()
+    rec = {"threads": n_threads, "requests_per_storm": n_threads * per_thread,
+           "batch_delay_ms": delay_ms, "fault_rate": fault_rate,
+           "outlier_delay_ms": round(outlier_delay_s * 1e3, 1),
+           "budget": {"ratio": budget_ratio, "burst": budget_burst}}
+
+    def counter(name, **want):
+        fam = mreg().get(name)
+        if fam is None:
+            return 0.0
+        idx = {k: fam.label_names.index(k) for k in want}
+        return sum(c.value() for key, c in fam.children()
+                   if all(key[i] == v for v, i
+                          in zip(want.values(), idx.values())))
+
+    def attempts_total():
+        # every dispatch outcome except no_replica is one real HTTP
+        # attempt (ok|failover|failed|passthrough|abandoned), so this
+        # delta is the hedge+retry overhead denominator
+        fam = mreg().get("dl4j_router_dispatch_total")
+        if fam is None:
+            return 0.0
+        i = fam.label_names.index("outcome")
+        return sum(c.value() for key, c in fam.children()
+                   if key[i] != "no_replica")
+
+    def storm(router):
+        ok, shed, failed = [0], [0], [0]
+        lat, hit = [], set()
+        lock = threading.Lock()
+
+        def client():
+            for _ in range(per_thread):
+                t0 = time.perf_counter()
+                try:
+                    status, _, _, url = router.route(
+                        "POST", "/v1/models/bench/predict", body,
+                        headers=[("Content-Type", "application/json")],
+                        model="bench", timeout_s=30)
+                except NoReplicaError:
+                    with lock:
+                        failed[0] += 1
+                    continue
+                dt = time.perf_counter() - t0
+                with lock:
+                    if status == 200:
+                        ok[0] += 1
+                        lat.append(dt)
+                        hit.add(url)
+                    elif status == 429:
+                        shed[0] += 1
+                    else:
+                        failed[0] += 1
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return {"offered": n_threads * per_thread, "ok": ok[0],
+                "shed": shed[0], "failed": failed[0],
+                "throughput_rps": round(ok[0] / wall, 2),
+                "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2)
+                if lat else None,
+                "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2)
+                if lat else None,
+                "replicas_hit": len(hit)}
+
+    members, urls = [], []
+    router = None
+    try:
+        for i in range(3):
+            reg = ModelRegistry(manifest_dir=None)
+            reg.deploy("bench", "v1", _mlp(), example=x, max_batch=8,
+                       max_delay_ms=delay_ms)
+            srv = ModelServer(reg, max_concurrent=1, queue_depth=64,
+                              high_water=64)
+            port = srv.start()
+            members.append((reg, srv))
+            urls.append(f"http://127.0.0.1:{port}")
+
+        # enough failover headroom that a 20% connect-fault rate can't
+        # exhaust distinct+second-chance attempts; fast poll so faulted
+        # replicas come back into rotation between errors; short
+        # ejection backoff so phase 3 probes inside the bench budget
+        router = FleetRouter(urls, poll_s=0.25, retries=4, timeout_s=30,
+                             retry_budget=budget_ratio,
+                             retry_burst=budget_burst,
+                             hedge_pctl=95, hedge_min_samples=8,
+                             eject_window=12, eject_min_samples=6,
+                             eject_backoff_s=0.5, eject_max_backoff_s=2.0)
+        router.poll_once()
+        router.start_polling()
+
+        # -- phase 1: fault-free baseline (also warms hedge samples) ------
+        rec["baseline"] = storm(router)
+
+        # -- phase 2: faulted storm ---------------------------------------
+        outlier = urls[-1]
+        pre_attempts = attempts_total()
+        pre_inject = counter("dl4j_faults_injected_total")
+        pre_hedge = {o: counter("dl4j_fleet_hedges_total", outcome=o)
+                     for o in ("launched", "won", "suppressed")}
+        pre_denied = counter("dl4j_fleet_budget_denials_total")
+        faults.inject("fleet.dispatch", kind="delay", rate=1.0, seed=11,
+                      delay_s=outlier_delay_s,
+                      predicate=lambda ctx: ctx.get("url") == outlier
+                      and ctx.get("phase") == "connect")
+        faults.inject("fleet.dispatch", kind="error", rate=fault_rate,
+                      seed=7,
+                      predicate=lambda ctx: ctx.get("url") != outlier
+                      and ctx.get("phase") == "connect")
+        try:
+            faulted = storm(router)
+        finally:
+            faults.clear("fleet.dispatch")
+        faulted["injected"] = int(counter("dl4j_faults_injected_total")
+                                  - pre_inject)
+        faulted["attempts"] = int(attempts_total() - pre_attempts)
+        faulted["extra_dispatches"] = (faulted["attempts"]
+                                       - faulted["offered"])
+        faulted["hedges"] = {
+            o: int(counter("dl4j_fleet_hedges_total", outcome=o)
+                   - pre_hedge[o])
+            for o in ("launched", "won", "suppressed")}
+        faulted["budget_denials"] = int(
+            counter("dl4j_fleet_budget_denials_total") - pre_denied)
+        rec["faulted"] = faulted
+        rec["p99_ratio"] = (
+            round(faulted["p99_ms"] / max(rec["baseline"]["p99_ms"], 1e-9),
+                  3)
+            if faulted["p99_ms"] is not None
+            and rec["baseline"]["p99_ms"] is not None else None)
+
+        # -- phase 3: probe re-admission after the faults clear -----------
+        def readmissions():
+            return counter("dl4j_fleet_readmissions_total",
+                           replica=outlier)
+
+        deadline = time.perf_counter() + (10 if tiny else 20)
+        while readmissions() < 1 and time.perf_counter() < deadline:
+            try:
+                router.route("POST", "/v1/models/bench/predict", body,
+                             headers=[("Content-Type",
+                                       "application/json")],
+                             model="bench", timeout_s=30)
+            except NoReplicaError:
+                pass
+            time.sleep(0.05)
+        rec["outlier"] = {
+            "url": outlier,
+            "ejections": int(counter("dl4j_fleet_ejections_total",
+                                     replica=outlier)),
+            "readmissions": int(readmissions())}
+    finally:
+        if router is not None:
+            router.stop_polling()
+        for reg, srv in members:
+            try:
+                srv.stop()
+            except Exception:
+                pass
+            try:
+                reg.drain_all(save_manifests=False)
+            except Exception:
+                pass
+    ok, reason = check_fleet_resilience(rec)
+    rec["gate_ok"], rec["gate_reason"] = ok, reason
+    return rec
+
+
+def check_fleet_resilience(rec, max_p99_ratio=3.0):
+    """(ok, reason): gates a fleet_resilience record must pass.
+
+    - the faulted storm must actually have injected faults AND launched
+      at least one hedge — a drill where nothing fired proves nothing;
+    - zero lost requests in both storms: every non-shed request answers
+      200 through the fault storm (failover + hedging absorb the 20%
+      connect-error rate and the outlier's 10x latency);
+    - faulted p99 <= ``max_p99_ratio`` x the fault-free p99 — the tail
+      stays bounded while a third of the fleet is a zombie;
+    - hedge+retry overhead stays inside the configured budget: extra
+      dispatch attempts <= ratio x offered + burst (hedges and
+      failovers draw from the same token bucket);
+    - the outlier was ejected on observed dispatch outcomes and then
+      probe-re-admitted once the faults cleared."""
+    b, f = rec["baseline"], rec["faulted"]
+    if f["injected"] < 1:
+        return False, (
+            "the faulted storm fired no injected faults: the resilience "
+            "claim is untested")
+    if b["failed"] > 0:
+        return False, (
+            f"{b['failed']} request(s) failed in the FAULT-FREE baseline "
+            "storm: the p99 yardstick is meaningless")
+    if f["failed"] > 0:
+        return False, (
+            f"{f['failed']} non-shed request(s) lost in the fault storm "
+            "(gate: 0): hedging + budgeted failover is dropping traffic")
+    if rec["p99_ratio"] is None or rec["p99_ratio"] > max_p99_ratio:
+        return False, (
+            f"faulted p99 {f['p99_ms']}ms is {rec['p99_ratio']}x the "
+            f"fault-free {b['p99_ms']}ms (gate: <= {max_p99_ratio}x): "
+            "the tail is not being hedged around the outlier")
+    allowance = (rec["budget"]["ratio"] * f["offered"]
+                 + rec["budget"]["burst"])
+    if f["extra_dispatches"] > allowance:
+        return False, (
+            f"{f['extra_dispatches']} extra dispatch attempts over "
+            f"{f['offered']} offered exceeds the retry budget allowance "
+            f"{allowance:.1f} (ratio {rec['budget']['ratio']} x offered "
+            f"+ burst {rec['budget']['burst']}): hedging is unbounded")
+    if f["hedges"]["launched"] < 1:
+        return False, (
+            "no hedge was launched during the fault storm: the hedging "
+            "path is untested (latency samples never warmed?)")
+    o = rec["outlier"]
+    if o["ejections"] < 1:
+        return False, (
+            f"the 10x-latency outlier {o['url']} was never ejected: "
+            "dispatch-outcome outlier detection is not firing")
+    if o["readmissions"] < 1:
+        return False, (
+            f"the ejected outlier {o['url']} was never probe-re-admitted "
+            "after the faults cleared: ejection is permanent")
+    return True, "ok"
+
+
 def bench_fleet_cold_start(jax, jnp, tiny):
     """Fleet-scale cold start over the shared artifact store (the
     ArtifactStore tentpole's headline): with DL4J_TPU_REMOTE_CACHE
@@ -2469,6 +2752,12 @@ def main():
             out["sharded_serving"] = bench_sharded_serving(jax, jnp, tiny)
         except Exception as e:
             out["sharded_serving"] = f"error: {type(e).__name__}"
+        _release()
+        try:
+            out["fleet_resilience"] = bench_fleet_resilience(jax, jnp,
+                                                             tiny)
+        except Exception as e:
+            out["fleet_resilience"] = f"error: {type(e).__name__}"
         _release()
         try:
             out["fleet_cold_start"] = bench_fleet_cold_start(jax, jnp,

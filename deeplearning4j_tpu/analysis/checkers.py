@@ -16,18 +16,19 @@ from . import Finding, Module, PACKAGE_ROOT
 
 #: label keys metric families may use — the bounded-cardinality contract
 #: (DL104). Every key here is either a closed enum (kind/cache/outcome/
-#: reason/state/good/window/path/site/engine/mode/tier — mode is the
-#: quantization storage format, int8|fp8; tier is the artifact-store
-#: layer, local|remote), a deploy-bounded identity
+#: reason/state/good/window/path/site/engine/mode/tier/priority — mode is
+#: the quantization storage format, int8|fp8; tier is the artifact-store
+#: layer, local|remote; priority is the X-Priority request class, the
+#: ten values "0".."9"), a deploy-bounded identity
 #: (model/version/bucket/worker/name/replica — replica is a fleet
 #: member's URL, bounded by the router's configured replica set), or
 #: process identity (the build-info trio). A request-scoped value (trace id, user id, prompt)
 #: must ride on exemplars or spans, never on labels.
 REGISTERED_LABELS: Set[str] = {
     "bucket", "cache", "engine", "good", "kind", "mode", "model", "name",
-    "outcome", "path", "reason", "replica", "site", "state", "tier",
-    "version", "window", "worker", "jax_version", "jaxlib_version",
-    "platform",
+    "outcome", "path", "priority", "reason", "replica", "site", "state",
+    "tier", "version", "window", "worker", "jax_version",
+    "jaxlib_version", "platform",
 }
 
 #: callables that stage a Python function for tracing (DL103): a function

@@ -90,6 +90,10 @@ class EnvironmentVars:
     DL4J_TPU_FLEET_POLL_S = "DL4J_TPU_FLEET_POLL_S"
     DL4J_TPU_FLEET_RETRIES = "DL4J_TPU_FLEET_RETRIES"
     DL4J_TPU_FLEET_TIMEOUT_S = "DL4J_TPU_FLEET_TIMEOUT_S"
+    DL4J_TPU_FLEET_RETRY_BUDGET = "DL4J_TPU_FLEET_RETRY_BUDGET"
+    DL4J_TPU_FLEET_HEDGE_PCTL = "DL4J_TPU_FLEET_HEDGE_PCTL"
+    DL4J_TPU_FLEET_BROWNOUT_FRAC = "DL4J_TPU_FLEET_BROWNOUT_FRAC"
+    DL4J_TPU_FLEET_DEFAULT_PRIORITY = "DL4J_TPU_FLEET_DEFAULT_PRIORITY"
     XLA_FLAGS = "XLA_FLAGS"
 
 
@@ -155,6 +159,10 @@ class SystemProperties:
     FLEET_POLL_S = "fleet_poll_s"
     FLEET_RETRIES = "fleet_retries"
     FLEET_TIMEOUT_S = "fleet_timeout_s"
+    FLEET_RETRY_BUDGET = "fleet_retry_budget"
+    FLEET_HEDGE_PCTL = "fleet_hedge_pctl"
+    FLEET_BROWNOUT_FRAC = "fleet_brownout_frac"
+    FLEET_DEFAULT_PRIORITY = "fleet_default_priority"
 
 
 _ENV_FOR_PROP = {
@@ -241,6 +249,14 @@ _ENV_FOR_PROP = {
     SystemProperties.FLEET_RETRIES: EnvironmentVars.DL4J_TPU_FLEET_RETRIES,
     SystemProperties.FLEET_TIMEOUT_S:
         EnvironmentVars.DL4J_TPU_FLEET_TIMEOUT_S,
+    SystemProperties.FLEET_RETRY_BUDGET:
+        EnvironmentVars.DL4J_TPU_FLEET_RETRY_BUDGET,
+    SystemProperties.FLEET_HEDGE_PCTL:
+        EnvironmentVars.DL4J_TPU_FLEET_HEDGE_PCTL,
+    SystemProperties.FLEET_BROWNOUT_FRAC:
+        EnvironmentVars.DL4J_TPU_FLEET_BROWNOUT_FRAC,
+    SystemProperties.FLEET_DEFAULT_PRIORITY:
+        EnvironmentVars.DL4J_TPU_FLEET_DEFAULT_PRIORITY,
 }
 
 _DEFAULTS = {
@@ -300,6 +316,10 @@ _DEFAULTS = {
     SystemProperties.FLEET_POLL_S: "2.0",
     SystemProperties.FLEET_RETRIES: "1",
     SystemProperties.FLEET_TIMEOUT_S: "30.0",
+    SystemProperties.FLEET_RETRY_BUDGET: "0.2",
+    SystemProperties.FLEET_HEDGE_PCTL: "95",
+    SystemProperties.FLEET_BROWNOUT_FRAC: "0.5",
+    SystemProperties.FLEET_DEFAULT_PRIORITY: "5",
 }
 
 
@@ -919,6 +939,50 @@ class Environment:
             return max(float(v), 0.1)
         except (TypeError, ValueError):
             return 30.0
+
+    def fleet_retry_budget(self) -> float:
+        """Fleet retry-budget ratio (``DL4J_TPU_FLEET_RETRY_BUDGET``):
+        failovers + hedges may add at most this fraction of recent
+        primary dispatches on top of the offered load. 0 disables every
+        extra dispatch — one request, one attempt."""
+        v = self.property(SystemProperties.FLEET_RETRY_BUDGET)
+        try:
+            return min(max(float(v), 0.0), 1.0)
+        except (TypeError, ValueError):
+            return 0.2
+
+    def fleet_hedge_pctl(self) -> float:
+        """Latency percentile of the router's observed per-model
+        dispatch latencies used as the hedge delay
+        (``DL4J_TPU_FLEET_HEDGE_PCTL``): an idempotent request still
+        unanswered past that percentile gets a second, budgeted attempt
+        on a different replica. <= 0 disables hedging."""
+        v = self.property(SystemProperties.FLEET_HEDGE_PCTL)
+        try:
+            return min(float(v), 100.0)
+        except (TypeError, ValueError):
+            return 95.0
+
+    def fleet_brownout_frac(self) -> float:
+        """Ready-capacity fraction below which the fleet front door
+        browns out (``DL4J_TPU_FLEET_BROWNOUT_FRAC``): lowest-priority
+        traffic is shed first and forwarded deadlines tighten. <= 0
+        disables brownout."""
+        v = self.property(SystemProperties.FLEET_BROWNOUT_FRAC)
+        try:
+            return min(max(float(v), 0.0), 1.0)
+        except (TypeError, ValueError):
+            return 0.5
+
+    def fleet_default_priority(self) -> int:
+        """Priority assumed for requests without an ``X-Priority``
+        header (``DL4J_TPU_FLEET_DEFAULT_PRIORITY``), clamped to
+        [0, 9]; 9 is most important and shed last during brownout."""
+        v = self.property(SystemProperties.FLEET_DEFAULT_PRIORITY)
+        try:
+            return min(max(int(v), 0), 9)
+        except (TypeError, ValueError):
+            return 5
 
     # -- telemetry (common/metrics.py, common/tracing.py) ------------------
     def metrics(self):

@@ -19,6 +19,14 @@ injected here, never against luck.
     ``cache.load``        compile-cache entry read
     ``cache.deserialize`` compile-cache executable deserialization
     ``http.handler``      serving HTTP request handler
+    ``fleet.dispatch``    FleetRouter routed attempt; ctx carries
+                          ``url``/``model``/``phase`` — ``connect``
+                          (before the HTTP call: an ``error`` rule is a
+                          connection failure, a ``delay`` rule a slow
+                          replica) and ``body`` (after response headers,
+                          before the body read: an ``error`` rule is a
+                          truncated response / mid-stream reset)
+    ``fleet.poll``        FleetRouter replica health poll (ctx: ``url``)
 
 **Configuration** is env-first and deterministic:
 

@@ -15,8 +15,11 @@ replica warm-restarts without recompiling. See
 **Scale-out** (replica routing) is this package:
 :class:`~.router.FleetRouter` fronts N ``ModelServer`` replicas by URL
 with least-loaded dispatch (admission EWMA x backlog, polled from each
-replica's ``/metrics.json``), readyz-aware membership, and failover —
-one retry on a different replica for connection-level failures and 503s.
+replica's ``/metrics.json``), readyz-aware membership, and the
+tail-tolerance layer: budgeted failover + hedged requests drawing from
+one fleet-wide :class:`~.router.RetryBudget`, outlier ejection over
+actual dispatch outcomes with probe re-admission, and brownout
+shedding by ``X-Priority`` when ready capacity drops.
 :class:`~.router.FleetServer` is the HTTP front door;
 ``python -m deeplearning4j_tpu.serving.fleet --replicas ...`` runs it
 standalone. A joining replica pre-bakes the fleet's bucket ladder from
@@ -40,10 +43,19 @@ Minimal flow::
     front.start()                      # clients talk to this one URL
 
 Env knobs: ``DL4J_TPU_FLEET_POLL_S`` (replica poll cadence),
-``DL4J_TPU_FLEET_RETRIES`` (failover budget),
-``DL4J_TPU_FLEET_TIMEOUT_S`` (per-attempt timeout). Telemetry:
+``DL4J_TPU_FLEET_RETRIES`` (failover attempts),
+``DL4J_TPU_FLEET_TIMEOUT_S`` (per-attempt timeout),
+``DL4J_TPU_FLEET_RETRY_BUDGET`` (failover+hedge token ratio),
+``DL4J_TPU_FLEET_HEDGE_PCTL`` (hedge-delay latency percentile),
+``DL4J_TPU_FLEET_BROWNOUT_FRAC`` (ready fraction below which the front
+door sheds), ``DL4J_TPU_FLEET_DEFAULT_PRIORITY`` (priority assumed
+without an ``X-Priority`` header). Telemetry:
 ``dl4j_fleet_replicas{model}``,
-``dl4j_router_dispatch_total{replica,outcome}``.
+``dl4j_router_dispatch_total{replica,outcome}``,
+``dl4j_fleet_hedges_total{model,outcome}``,
+``dl4j_fleet_ejections_total{replica,reason}``,
+``dl4j_fleet_shed_total{model,priority}`` and friends (see
+:mod:`.router`).
 """
-from .router import (FleetRouter, FleetServer, NoReplicaError,  # noqa: F401
-                     Replica)
+from .router import (FleetRouter, FleetServer, MidStreamError,  # noqa: F401
+                     NoReplicaError, Replica, RetryBudget)

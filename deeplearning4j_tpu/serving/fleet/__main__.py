@@ -40,6 +40,16 @@ def main(argv=None) -> int:
                     help="failover retries (DL4J_TPU_FLEET_RETRIES)")
     ap.add_argument("--timeout-s", type=float, default=None,
                     help="per-attempt timeout (DL4J_TPU_FLEET_TIMEOUT_S)")
+    ap.add_argument("--retry-budget", type=float, default=None,
+                    help="failover+hedge token ratio "
+                         "(DL4J_TPU_FLEET_RETRY_BUDGET)")
+    ap.add_argument("--hedge-pctl", type=float, default=None,
+                    help="hedge-delay latency percentile, <=0 disables "
+                         "(DL4J_TPU_FLEET_HEDGE_PCTL)")
+    ap.add_argument("--brownout-frac", type=float, default=None,
+                    help="ready fraction below which the front door "
+                         "sheds low-priority traffic "
+                         "(DL4J_TPU_FLEET_BROWNOUT_FRAC)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -50,7 +60,10 @@ def main(argv=None) -> int:
     if not urls:
         ap.error("--replicas needs at least one URL")
     router = FleetRouter(urls, poll_s=args.poll_s, retries=args.retries,
-                         timeout_s=args.timeout_s)
+                         timeout_s=args.timeout_s,
+                         retry_budget=args.retry_budget,
+                         hedge_pctl=args.hedge_pctl,
+                         brownout_frac=args.brownout_frac)
     server = FleetServer(router, host=args.host, port=args.port)
     port = server.start()
     print(f"fleet router on http://{args.host}:{port} "

@@ -1,11 +1,12 @@
-"""Front-of-fleet replica router: scale-out over N ``ModelServer``\\ s.
+"""Front-of-fleet replica router: tail-tolerant scale-out over N
+``ModelServer``\\ s.
 
 One :class:`FleetRouter` fronts N independent serving replicas (each a
 ``serving.ModelServer`` — typically one process per TPU slice / host),
 addressed by base URL. The router is deliberately *stateless* about
 models: replicas own deployment, warmup, admission, and SLOs; the router
-only decides **which** replica answers a request and retries replica-
-level failures somewhere else.
+only decides **which** replica answers a request and what happens when a
+replica-level failure or a slow tail threatens it.
 
 Routing policy — least loaded, admission-aware:
 
@@ -14,18 +15,60 @@ Routing policy — least loaded, admission-aware:
   controller's live gauges: ``dl4j_serving_ewma_service_seconds``,
   ``dl4j_serving_queue_depth``, ``dl4j_serving_active``,
   ``dl4j_serving_waiters``) every ``DL4J_TPU_FLEET_POLL_S`` seconds.
+  Malformed poll payloads degrade that replica to a *neutral* score and
+  count a ``poll_error`` — junk JSON must never wedge scoring.
 - A request for model M goes to the READY replica with the lowest
   expected drain time: ``(waiters + router-side in-flight) x EWMA
   service seconds``. Router-side in-flight counts dispatches the poller
   has not seen yet, so a burst does not pile onto one replica between
   polls.
-- Replica-level failures — connection refused/reset, timeout, HTTP 503
-  — fail over: up to ``DL4J_TPU_FLEET_RETRIES`` (default 1) retries on a
-  *different* replica, the failed one marked not-ready until a poll
-  succeeds again. Request-level outcomes (2xx/4xx/429) are the
-  replica's answer and are returned as-is — a shed (429) on the least
-  loaded replica means the fleet is saturated, and retrying it
-  elsewhere would only amplify the overload.
+
+Tail tolerance (Dean & Barroso, *The Tail at Scale*; the same shapes
+Envoy ships as retry budgets + outlier detection):
+
+- **Retry budget** (:class:`RetryBudget`): one fleet-wide token bucket
+  (``DL4J_TPU_FLEET_RETRY_BUDGET``, default 0.2) that every failover
+  AND every hedge draws from. Tokens accrue per primary dispatch, so
+  extra attempts are bounded to ~20% of recent offered load (plus a
+  small burst) — a sick fleet degrades to pass-through instead of
+  amplifying its own overload with a retry storm. Budget exhausted ⇒
+  dispatch count == request count.
+- **Hedged requests**: an *idempotent* request (predict; never
+  generate) still unanswered past the per-model hedge delay — the
+  ``DL4J_TPU_FLEET_HEDGE_PCTL`` percentile of the router's own observed
+  dispatch latencies — gets a second, budgeted attempt on a different
+  replica. First non-503 answer wins; the loser is abandoned and
+  counted (``outcome="abandoned"``).
+- **Outlier ejection**: per-replica error-rate + latency-z-score over
+  *actual dispatch outcomes* (``serving.resilience.DispatchStats``),
+  not just ``/readyz`` polls — a zombie that polls healthy but fails
+  traffic is caught here. An ejected replica leaves rotation with
+  exponential backoff and re-admits via a single probe request; a
+  max-ejection fraction stops the router from ejecting itself to zero,
+  and when nothing scores as routable the router *panics* open (routes
+  to any known non-ejected replica) rather than failing the request.
+- **Failover** still retries replica-level failures — connection
+  refused/reset, timeout, HTTP 503 — on a *different* replica, up to
+  ``DL4J_TPU_FLEET_RETRIES`` (budget permitting); the failed replica is
+  marked not-ready until a poll succeeds. Request-level outcomes
+  (2xx/4xx/429) are the replica's answer and are returned as-is. A 503
+  that cannot be retried is *passed through* with its ``Retry-After``
+  intact instead of being flattened into :class:`NoReplicaError`.
+- **Non-retryable mid-stream failures**: once a non-idempotent request
+  (generate) has started consuming its response body, a connection
+  reset surfaces as :class:`MidStreamError` carrying the trace id —
+  never a silent duplicate generation.
+
+Brownout (:class:`FleetServer`): when the fleet's ready fraction falls
+below ``DL4J_TPU_FLEET_BROWNOUT_FRAC``, the front door sheds
+lowest-priority traffic first (``X-Priority`` header 0–9, default
+``DL4J_TPU_FLEET_DEFAULT_PRIORITY``) with 503 + ``Retry-After``, and
+tightens forwarded deadlines in proportion to the capacity deficit.
+
+Fault sites for drills (``common.faults``): ``fleet.dispatch`` (ctx
+``url``/``model``/``phase``: ``connect`` = connection failure or slow
+replica, ``body`` = truncated response / mid-stream reset) and
+``fleet.poll`` (ctx ``url``).
 
 Scale-out elasticity rides the warmup manifests of the serving layer: a
 joining replica pointed at the shared manifest directory
@@ -35,25 +78,29 @@ its ``/readyz`` stays false until the ladder is compiled, so
 ``add_replica()`` can be called *before* warmup finishes and the router
 will not route to it until it is actually ready. With a fleet-shared
 artifact store (``DL4J_TPU_REMOTE_CACHE``) the joiner *downloads* that
-ladder instead of compiling it: ``lifecycle.restore_on_boot()`` pulls
-the fleet's manifests + executables before deploy, so every warmup
-bucket is a store hit and cold-join time-to-ready is bounded by
-artifact download, not XLA.
+ladder instead of compiling it (``lifecycle.restore_on_boot()``).
 
 Poll scheduling is jittered: each replica is polled on its own
 deterministic phase within ``DL4J_TPU_FLEET_POLL_S`` (see
 ``poll_offset``) so N replicas don't all get probed on the same tick.
 
-Telemetry: ``dl4j_fleet_replicas{model}`` (ready replicas currently
-serving each model) and ``dl4j_router_dispatch_total{replica,outcome}``
-with outcome ``ok`` (replica answered), ``failover`` (replica-level
-failure, retried elsewhere), ``failed`` (failure with no retry budget
-left), ``no_replica`` (nothing ready).
+Telemetry: ``dl4j_fleet_replicas{model}``,
+``dl4j_router_dispatch_total{replica,outcome}`` with outcome
+``ok|failover|failed|passthrough|abandoned|no_replica``,
+``dl4j_fleet_hedges_total{model,outcome}`` (``launched|won|suppressed``),
+``dl4j_fleet_retry_tokens``, ``dl4j_fleet_budget_denials_total{reason}``,
+``dl4j_fleet_ejections_total{replica,reason}``,
+``dl4j_fleet_readmissions_total{replica}``, ``dl4j_fleet_ejected``,
+``dl4j_fleet_poll_errors_total{replica,reason}``,
+``dl4j_fleet_shed_total{model,priority}``, ``dl4j_fleet_brownout``,
+``dl4j_fleet_ready_fraction``.
 """
 from __future__ import annotations
 
 import json
 import logging
+import math
+import queue
 import re
 import threading
 import time
@@ -62,9 +109,11 @@ import urllib.request
 import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ...common import faults
 from ...common.environment import environment
 from ...common.locks import ordered_lock
 from ...common.metrics import registry as metrics_registry
+from ..resilience import DispatchStats, latency_zscore
 
 log = logging.getLogger(__name__)
 
@@ -81,10 +130,59 @@ class NoReplicaError(RuntimeError):
     attempt hit a replica-level failure with the retry budget spent)."""
 
 
-class Replica:
-    """One fleet member: its URL and the last polled view of it."""
+class MidStreamError(RuntimeError):
+    """A non-idempotent request (generate) lost its connection AFTER the
+    response body started streaming. Retrying would silently run the
+    generation twice, so the failure surfaces instead, carrying the
+    replica's trace id for correlation."""
 
-    def __init__(self, url: str):
+    def __init__(self, replica_url: str, trace_id: Optional[str] = None,
+                 cause: Optional[BaseException] = None):
+        super().__init__(
+            f"mid-stream failure from {replica_url}"
+            + (f" (trace {trace_id})" if trace_id else "")
+            + (f": {cause!r}" if cause else "")
+            + "; not retried — the generation may have run")
+        self.replica_url = replica_url
+        self.trace_id = trace_id
+        self.cause = cause
+
+
+class RetryBudget:
+    """Fleet-wide token bucket that every extra dispatch — failover
+    retry or hedge — must draw from. Tokens accrue at ``ratio`` per
+    *primary* dispatch up to a small ``burst`` cap, so extra attempts
+    are bounded to ``ratio`` of recent offered load: under a fleet-wide
+    failure the router degrades to pass-through instead of amplifying
+    the overload. ``ratio`` 0 disables every extra dispatch. Not
+    self-locking — the owning router serializes access."""
+
+    def __init__(self, ratio: float, burst: Optional[float] = None):
+        self.ratio = min(max(float(ratio), 0.0), 1.0)
+        if burst is None:
+            burst = max(1.0, self.ratio * 50.0)
+        self.burst = float(burst) if self.ratio > 0 else 0.0
+        self.tokens = self.burst
+
+    def record_dispatch(self):
+        self.tokens = min(self.tokens + self.ratio, self.burst)
+
+    def try_spend(self) -> bool:
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"ratio": self.ratio, "burst": self.burst,
+                "tokens": round(self.tokens, 3)}
+
+
+class Replica:
+    """One fleet member: its URL, the last polled view of it, and its
+    rolling dispatch-outcome window (the ejection evidence)."""
+
+    def __init__(self, url: str, stats_window: int = 20):
         self.url = url.rstrip("/")
         self.ready = False
         self.models: List[str] = []          # models the replica serves
@@ -95,6 +193,13 @@ class Replica:
         self.dispatched = 0                  # lifetime routed attempts
         self.last_poll_s: Optional[float] = None
         self.consecutive_failures = 0
+        # outlier-ejection state
+        self.stats = DispatchStats(stats_window)
+        self.ejected = False
+        self.ejected_until = 0.0             # monotonic; probation opens
+        self.eject_backoff_s = 0.0           # current backoff (0 = base)
+        self.ejections = 0                   # lifetime ejection count
+        self.probe_inflight = False          # the single re-admit probe
 
     def score(self, model: str) -> float:
         """Expected drain time of one more request on this replica:
@@ -114,50 +219,119 @@ class Replica:
                 "inflight": self.inflight,
                 "dispatched": self.dispatched,
                 "last_poll_s": self.last_poll_s,
-                "consecutive_failures": self.consecutive_failures}
+                "consecutive_failures": self.consecutive_failures,
+                "ejected": self.ejected,
+                "ejections": self.ejections,
+                "outcomes": self.stats.snapshot()}
 
 
-def _parse_metrics_json(doc: dict) -> Dict[str, Dict[str, float]]:
-    """``/metrics.json`` -> model -> admission view. Tolerates missing
-    families (a replica that has not admitted a request yet)."""
+def _parse_metrics_json(doc) -> Tuple[Dict[str, Dict[str, float]], int]:
+    """``/metrics.json`` -> (model -> admission view, malformed-entry
+    count). Tolerates missing families (a replica that has not admitted
+    a request yet) and degrades junk entries — non-dict series,
+    non-dict labels, unparseable or non-finite values — to neutral 0.0
+    while counting them, so a garbage payload can never wedge scoring.
+    A payload that is not a JSON object at all raises ValueError."""
+    if not isinstance(doc, dict):
+        raise ValueError(
+            f"/metrics.json answered non-object JSON "
+            f"({type(doc).__name__})")
     out: Dict[str, Dict[str, float]] = {}
+    malformed = 0
     short = {"dl4j_serving_ewma_service_seconds": "ewma_s",
              "dl4j_serving_queue_depth": "queue_depth",
              "dl4j_serving_active": "active",
              "dl4j_serving_waiters": "waiters"}
     for fam in _POLLED_GAUGES:
-        for series in (doc.get(fam) or {}).get("series", ()):
-            model = (series.get("labels") or {}).get("model")
+        entry = doc.get(fam)
+        if entry is None:
+            continue
+        if not isinstance(entry, dict):
+            malformed += 1
+            continue
+        series_list = entry.get("series", ())
+        if not isinstance(series_list, (list, tuple)):
+            malformed += 1
+            continue
+        for series in series_list:
+            if not isinstance(series, dict):
+                malformed += 1
+                continue
+            labels = series.get("labels")
+            if not isinstance(labels, dict):
+                malformed += 1
+                continue
+            model = labels.get("model")
             if model is None:
                 continue
             try:
                 value = float(series.get("value") or 0.0)
             except (TypeError, ValueError):
+                malformed += 1
                 value = 0.0
-            out.setdefault(model, {})[short[fam]] = value
-    return out
+            if not math.isfinite(value):
+                malformed += 1
+                value = 0.0
+            out.setdefault(str(model), {})[short[fam]] = value
+    return out, malformed
 
 
 class FleetRouter:
-    """Least-loaded, readyz-aware request router over serving replicas.
+    """Least-loaded, readyz-aware, tail-tolerant request router over
+    serving replicas.
 
     ``replicas`` are base URLs (``http://host:port``). Poll cadence,
-    failover retry budget, and per-attempt timeout default to the
-    ``DL4J_TPU_FLEET_*`` env knobs. ``start_polling()`` runs the
-    background refresh; tests can drive ``poll_once()`` directly."""
+    failover retries, per-attempt timeout, retry-budget ratio, hedge
+    percentile, and brownout fraction default to the
+    ``DL4J_TPU_FLEET_*`` env knobs; the ejection thresholds are
+    constructor-only (they are operator tuning, not deployment config).
+    ``start_polling()`` runs the background refresh; tests can drive
+    ``poll_once()`` directly."""
 
     def __init__(self, replicas: Sequence[str] = (), *,
                  poll_s: Optional[float] = None,
                  retries: Optional[int] = None,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None,
+                 retry_budget: Optional[float] = None,
+                 retry_burst: Optional[float] = None,
+                 hedge_pctl: Optional[float] = None,
+                 hedge_min_samples: int = 8,
+                 brownout_frac: Optional[float] = None,
+                 eject_window: int = 20,
+                 eject_min_samples: int = 8,
+                 eject_error_rate: float = 0.5,
+                 eject_latency_z: float = 3.0,
+                 eject_backoff_s: float = 5.0,
+                 eject_max_backoff_s: float = 60.0,
+                 eject_max_frac: float = 0.5):
         env = environment()
         self.poll_s = env.fleet_poll_s() if poll_s is None else float(poll_s)
         self.retries = env.fleet_retries() if retries is None \
             else max(int(retries), 0)
         self.timeout_s = env.fleet_timeout_s() if timeout_s is None \
             else float(timeout_s)
+        self.hedge_pctl = env.fleet_hedge_pctl() if hedge_pctl is None \
+            else min(float(hedge_pctl), 100.0)
+        self.hedge_min_samples = max(int(hedge_min_samples), 2)
+        self.brownout_frac = env.fleet_brownout_frac() \
+            if brownout_frac is None else min(max(float(brownout_frac),
+                                                  0.0), 1.0)
+        self.default_priority = env.fleet_default_priority()
+        self.eject_window = max(int(eject_window), 1)
+        self.eject_min_samples = max(int(eject_min_samples), 1)
+        self.eject_error_rate = float(eject_error_rate)
+        self.eject_latency_z = float(eject_latency_z)
+        self.eject_backoff_s = max(float(eject_backoff_s), 0.01)
+        self.eject_max_backoff_s = max(float(eject_max_backoff_s),
+                                       self.eject_backoff_s)
+        self.eject_max_frac = min(max(float(eject_max_frac), 0.0), 1.0)
+        self._budget = RetryBudget(
+            env.fleet_retry_budget() if retry_budget is None
+            else retry_budget, retry_burst)
         self._lock = ordered_lock("fleet.router")
         self._replicas: Dict[str, Replica] = {}
+        #: per-model recent winner latencies (the hedge-delay basis)
+        self._latencies: Dict[str, "list[float]"] = {}
         self._poll_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         reg = metrics_registry()
@@ -167,9 +341,51 @@ class FleetRouter:
             labels=("model",))
         self._m_dispatch = reg.counter(
             "dl4j_router_dispatch_total",
-            "Routed dispatch attempts by replica and outcome "
-            "(ok|failover|failed|no_replica)",
+            "Routed dispatch attempts by replica and outcome (ok|"
+            "failover|failed|passthrough|abandoned|no_replica)",
             labels=("replica", "outcome"))
+        self._m_hedges = reg.counter(
+            "dl4j_fleet_hedges_total",
+            "Hedged second attempts by outcome "
+            "(launched|won|suppressed)",
+            labels=("model", "outcome"))
+        self._m_tokens = reg.gauge(
+            "dl4j_fleet_retry_tokens",
+            "Retry-budget tokens currently available to failovers "
+            "and hedges")
+        self._m_denials = reg.counter(
+            "dl4j_fleet_budget_denials_total",
+            "Extra dispatches refused by the retry budget (reason "
+            "retry|hedge)",
+            labels=("reason",))
+        self._m_ejections = reg.counter(
+            "dl4j_fleet_ejections_total",
+            "Replica ejections by reason "
+            "(error_rate|latency|probe_failed)",
+            labels=("replica", "reason"))
+        self._m_readmissions = reg.counter(
+            "dl4j_fleet_readmissions_total",
+            "Replicas re-admitted after a successful probe request",
+            labels=("replica",))
+        self._m_ejected = reg.gauge(
+            "dl4j_fleet_ejected",
+            "Replicas currently ejected from rotation")
+        self._m_poll_errors = reg.counter(
+            "dl4j_fleet_poll_errors_total",
+            "Replica polls that failed or carried malformed payloads "
+            "(reason unreachable|malformed)",
+            labels=("replica", "reason"))
+        self._m_shed = reg.counter(
+            "dl4j_fleet_shed_total",
+            "Requests shed by the brownout front door, by priority",
+            labels=("model", "priority"))
+        self._m_brownout = reg.gauge(
+            "dl4j_fleet_brownout",
+            "1 while the fleet front door is in brownout")
+        self._m_ready_frac = reg.gauge(
+            "dl4j_fleet_ready_fraction",
+            "Fraction of known replicas ready and not ejected")
+        self._m_tokens.set(self._budget.tokens)
         for url in replicas:
             self.add_replica(url, poll=False)
 
@@ -178,7 +394,7 @@ class FleetRouter:
         """Register one replica. It takes traffic only once a poll sees
         its ``/readyz`` true — safe to call while the replica is still
         warming its bucket ladder from the shared manifest."""
-        rep = Replica(url)
+        rep = Replica(url, stats_window=self.eject_window)
         with self._lock:
             existing = self._replicas.get(rep.url)
             if existing is not None:
@@ -201,8 +417,13 @@ class FleetRouter:
             return list(self._replicas.values())
 
     def snapshot(self) -> Dict[str, Any]:
-        """``/fleet`` debug view: every replica's polled state."""
+        """``/fleet`` debug view: every replica's polled state plus the
+        budget and brownout posture."""
+        with self._lock:
+            budget = self._budget.snapshot()
         return {"poll_s": self.poll_s, "retries": self.retries,
+                "budget": budget,
+                "brownout": self.brownout_state(),
                 "replicas": [r.snapshot() for r in self.replicas()]}
 
     # -- polling ----------------------------------------------------------
@@ -213,6 +434,8 @@ class FleetRouter:
     def _poll_replica(self, rep: Replica):
         timeout = min(self.timeout_s, max(self.poll_s * 2, 1.0))
         try:
+            if faults.active():
+                faults.check("fleet.poll", url=rep.url)
             try:
                 status, ready_doc = self._fetch_json(
                     rep.url + "/readyz", timeout)
@@ -221,17 +444,36 @@ class FleetRouter:
                 status, ready_doc = e.code, json.loads(e.read() or b"{}")
             _, metrics_doc = self._fetch_json(
                 rep.url + "/metrics.json", timeout)
-        except (OSError, ValueError) as e:
+            if not isinstance(ready_doc, dict):
+                raise ValueError(
+                    f"/readyz answered non-object JSON "
+                    f"({type(ready_doc).__name__})")
+        except (OSError, ValueError, faults.InjectedFault) as e:
             with self._lock:
                 rep.ready = False
                 rep.consecutive_failures += 1
                 rep.last_poll_s = time.time()
+            self._m_poll_errors.labels(replica=rep.url,
+                                       reason="unreachable").inc()
             log.debug("poll of %s failed: %r", rep.url, e)
             return
+        # the replica is reachable and its readiness is known; a junk
+        # /metrics.json only costs it its load view (neutral score),
+        # never its place in rotation
+        try:
+            load, malformed = _parse_metrics_json(metrics_doc)
+        except ValueError as e:
+            load, malformed = {}, 1
+            log.debug("junk /metrics.json from %s: %r", rep.url, e)
+        if malformed:
+            self._m_poll_errors.labels(replica=rep.url,
+                                       reason="malformed").inc()
+        models = ready_doc.get("models")
         with self._lock:
             rep.ready = status == 200 and bool(ready_doc.get("ready"))
-            rep.models = sorted((ready_doc.get("models") or {}).keys())
-            rep.load = _parse_metrics_json(metrics_doc)
+            rep.models = sorted(models.keys()) \
+                if isinstance(models, dict) else []
+            rep.load = load
             rep.consecutive_failures = 0
             rep.last_poll_s = time.time()
 
@@ -257,8 +499,9 @@ class FleetRouter:
         counts: Dict[str, int] = {}
         with self._lock:
             reps = list(self._replicas.values())
+            ejected = sum(1 for r in reps if r.ejected)
             for rep in reps:
-                if not rep.ready:
+                if not rep.ready or rep.ejected:
                     continue
                 for model in rep.models:
                     counts[model] = counts.get(model, 0) + 1
@@ -267,6 +510,7 @@ class FleetRouter:
                 known.update(rep.models)
         for model in known:
             self._m_replicas.labels(model=model).set(counts.get(model, 0))
+        self._m_ejected.set(ejected)
 
     def start_polling(self) -> "FleetRouter":
         if self._poll_thread is not None:
@@ -317,12 +561,111 @@ class FleetRouter:
             t.join(timeout=max(self.poll_s * 2, 2.0))
             self._poll_thread = None
 
+    # -- hedge-delay basis ------------------------------------------------
+    def _note_latency(self, model: str, latency_s: float):
+        with self._lock:
+            samples = self._latencies.setdefault(model, [])
+            samples.append(latency_s)
+            if len(samples) > 64:
+                del samples[:len(samples) - 64]
+
+    def _hedge_delay(self, model: Optional[str]) -> Optional[float]:
+        """The per-model hedge delay: the ``hedge_pctl`` percentile of
+        observed winner latencies. None (no hedging) until enough
+        samples exist or when hedging is disabled."""
+        if model is None or self.hedge_pctl <= 0:
+            return None
+        with self._lock:
+            samples = sorted(self._latencies.get(model, ()))
+        if len(samples) < self.hedge_min_samples:
+            return None
+        idx = min(len(samples) - 1,
+                  max(0, math.ceil(self.hedge_pctl / 100.0
+                                   * len(samples)) - 1))
+        return max(samples[idx], 0.001)
+
+    # -- outlier ejection -------------------------------------------------
+    def _settle_attempt(self, rep: Replica, *, ok: bool,
+                        latency_s: Optional[float], probe: bool):
+        """Book one finished dispatch attempt against the replica's
+        rolling outcome window; resolve a probe; evaluate ejection.
+        Metric writes happen after the lock drops."""
+        events: List[Tuple[str, str]] = []
+        with self._lock:
+            rep.inflight = max(rep.inflight - 1, 0)
+            rep.stats.record(ok, latency_s)
+            if probe:
+                rep.probe_inflight = False
+                if ok:
+                    rep.ejected = False
+                    rep.eject_backoff_s = 0.0
+                    rep.stats.reset()
+                    events.append(("readmitted", ""))
+                else:
+                    self._eject_locked(rep, "probe_failed")
+                    events.append(("ejected", "probe_failed"))
+            elif not rep.ejected:
+                reason = self._eject_reason_locked(rep)
+                if reason is not None:
+                    self._eject_locked(rep, reason)
+                    events.append(("ejected", reason))
+        for what, reason in events:
+            if what == "readmitted":
+                self._m_readmissions.labels(replica=rep.url).inc()
+                log.info("replica %s re-admitted after probe", rep.url)
+            else:
+                self._m_ejections.labels(replica=rep.url,
+                                         reason=reason).inc()
+                log.warning("replica %s ejected (%s), backoff %.2fs",
+                            rep.url, reason, rep.eject_backoff_s)
+        if events:
+            self._update_fleet_gauge()
+
+    def _eject_reason_locked(self, rep: Replica) -> Optional[str]:
+        """Why ``rep`` should be ejected right now, or None. Caller
+        holds the lock. Honors the max-ejection fraction: the router
+        must never eject itself to zero."""
+        if len(rep.stats) < self.eject_min_samples:
+            return None
+        reason = None
+        if rep.stats.error_rate() >= self.eject_error_rate:
+            reason = "error_rate"
+        else:
+            mean = rep.stats.mean_latency_s()
+            if mean is not None:
+                peers = [r.stats.mean_latency_s()
+                         for r in self._replicas.values()
+                         if r is not rep and not r.ejected
+                         and len(r.stats) >= self.eject_min_samples]
+                if latency_zscore(mean, peers) >= self.eject_latency_z:
+                    reason = "latency"
+        if reason is None:
+            return None
+        total = len(self._replicas)
+        already = sum(1 for r in self._replicas.values() if r.ejected)
+        if total and (already + 1) / total > self.eject_max_frac:
+            log.warning("replica %s looks like an outlier (%s) but the "
+                        "max-ejection fraction %.2f is spent",
+                        rep.url, reason, self.eject_max_frac)
+            return None
+        return reason
+
+    def _eject_locked(self, rep: Replica, reason: str):
+        rep.ejected = True
+        rep.ejections += 1
+        rep.eject_backoff_s = min(
+            self.eject_backoff_s if rep.eject_backoff_s <= 0
+            else rep.eject_backoff_s * 2.0,
+            self.eject_max_backoff_s)
+        rep.ejected_until = time.monotonic() + rep.eject_backoff_s
+
     # -- routing ----------------------------------------------------------
     def _candidates(self, model: Optional[str]) -> List[Replica]:
-        """READY replicas (serving ``model``, when known), best score
-        first."""
+        """READY, non-ejected replicas (serving ``model``, when known),
+        best score first."""
         with self._lock:
-            reps = [r for r in self._replicas.values() if r.ready]
+            reps = [r for r in self._replicas.values()
+                    if r.ready and not r.ejected]
         if model is not None:
             serving = [r for r in reps if model in r.models]
             # a replica whose model list is unknown yet (no successful
@@ -335,71 +678,333 @@ class FleetRouter:
             reps.sort(key=lambda r: (r.score(model), r.dispatched, r.url))
         return reps
 
+    def _pick(self, model: Optional[str], exclude: Sequence[str],
+              strict: bool = False) -> Tuple[Optional[Replica], bool]:
+        """Next replica for an attempt, ``(replica, is_probe)``. An
+        ejected replica whose backoff expired gets exactly one probe
+        request — this one. When nothing scores as routable, panic
+        open: any known non-ejected replica beats failing the request
+        outright (the attempt will surface the truth), and — unless
+        ``strict`` — a failover may even re-try an already-tried
+        replica as a last resort (a transient connect fault draws
+        independently on the second attempt). Hedges are ``strict``:
+        a hedge on the same replica measures nothing."""
+        now = time.monotonic()
+        with self._lock:
+            probe = next(
+                (r for r in self._replicas.values()
+                 if r.ejected and not r.probe_inflight
+                 and now >= r.ejected_until and r.url not in exclude),
+                None)
+            if probe is not None:
+                probe.probe_inflight = True
+                return probe, True
+        rep = next((r for r in self._candidates(model)
+                    if r.url not in exclude), None)
+        if rep is not None:
+            return rep, False
+        with self._lock:
+            panic = [r for r in self._replicas.values()
+                     if not r.ejected and r.url not in exclude]
+            if not panic and not strict:
+                panic = [r for r in self._replicas.values()
+                         if not r.ejected]
+        panic.sort(key=lambda r: (r.consecutive_failures, r.dispatched,
+                                  r.url))
+        return (panic[0] if panic else None), False
+
+    def _do_http(self, rep: Replica, method: str, path: str,
+                 body: Optional[bytes], headers: Sequence[Tuple[str, str]],
+                 timeout: float, model: Optional[str]):
+        """One HTTP attempt. Returns ``(kind, payload)``:
+        ``("response", (status, hdrs, body))``, ``("conn_error", exc)``
+        (nothing consumed — retryable), or
+        ``("mid_stream", (hdrs, exc))`` (response body partially
+        consumed — retryable only for idempotent requests)."""
+        resp = None
+        try:
+            if faults.active():
+                faults.check("fleet.dispatch", url=rep.url, model=model,
+                             phase="connect")
+            req = urllib.request.Request(
+                rep.url + path, data=body, method=method,
+                headers=dict(headers))
+            try:
+                resp = urllib.request.urlopen(req, timeout=timeout)
+                status = resp.status
+            except urllib.error.HTTPError as e:
+                resp, status = e, e.code
+            hdrs = dict(resp.headers)
+        except (OSError, urllib.error.URLError, faults.InjectedFault) as e:
+            return "conn_error", e
+        try:
+            if faults.active():
+                faults.check("fleet.dispatch", url=rep.url, model=model,
+                             phase="body")
+            payload = resp.read()
+        except (OSError, faults.InjectedFault) as e:
+            return "mid_stream", (hdrs, e)
+        finally:
+            try:
+                resp.close()
+            except Exception:
+                pass
+        return "response", (status, hdrs, payload)
+
+    def _attempt(self, rep: Replica, method: str, path: str,
+                 body: Optional[bytes], headers: Sequence[Tuple[str, str]],
+                 timeout: float, model: Optional[str], meta: Dict[str, Any],
+                 resq: "queue.Queue", race: Dict[str, bool],
+                 race_lock: threading.Lock):
+        kind, res = self._do_http(rep, method, path, body, headers,
+                                  timeout, model)
+        with race_lock:
+            if not race["done"]:
+                resq.put((rep, kind, res, meta))
+                return
+        # the race already settled while this attempt was in flight:
+        # the loser accounts for itself
+        self._account_abandoned(rep, kind, res, meta)
+
+    def _account_abandoned(self, rep: Replica, kind: str, res,
+                           meta: Dict[str, Any]):
+        latency = time.monotonic() - meta["t0"]
+        ok = kind == "response" and res[0] != 503
+        self._settle_attempt(rep, ok=ok,
+                             latency_s=latency if ok else None,
+                             probe=meta["probe"])
+        if not ok:
+            why = "503" if kind == "response" else kind
+            self._note_replica_failure(rep, why)
+        self._m_dispatch.labels(replica=rep.url, outcome="abandoned").inc()
+
+    def _note_replica_failure(self, rep: Replica, why: str):
+        with self._lock:
+            rep.ready = False
+            rep.consecutive_failures += 1
+        log.warning("replica %s failed (%s)", rep.url, why)
+        self._update_fleet_gauge()
+
     def route(self, method: str, path: str, body: Optional[bytes] = None,
               headers: Sequence[Tuple[str, str]] = (),
               model: Optional[str] = None,
-              timeout_s: Optional[float] = None
+              timeout_s: Optional[float] = None,
+              idempotent: Optional[bool] = None
               ) -> Tuple[int, Dict[str, str], bytes, str]:
-        """Route one HTTP request to the best replica, failing over on
-        replica-level errors. Returns ``(status, headers, body,
-        replica_url)``. Raises :class:`NoReplicaError` when no replica
-        could take it."""
+        """Route one HTTP request to the best replica with budgeted
+        failover and (for idempotent requests) a budgeted hedge.
+        Returns ``(status, headers, body, replica_url)``. A 503 that
+        cannot be retried is returned as-is (``Retry-After``
+        preserved); :class:`NoReplicaError` is raised only when no
+        replica produced an HTTP answer at all; a mid-stream failure on
+        a non-idempotent request raises :class:`MidStreamError` instead
+        of retrying. ``idempotent`` defaults from the path: generate is
+        not, everything else is."""
         timeout = self.timeout_s if timeout_s is None else float(timeout_s)
+        if idempotent is None:
+            idempotent = not path.split("?", 1)[0].endswith("/generate")
+        resq: "queue.Queue" = queue.Queue()
+        race = {"done": False}
+        race_lock = threading.Lock()
         tried: List[str] = []
-        attempts = self.retries + 1
+        inflight = 0
+        failovers = 0
+        hedged = False
+        hedge_blocked = not idempotent
+        last_503: Optional[Tuple[int, Dict[str, str], bytes, str]] = None
         last_err: Optional[BaseException] = None
-        for _ in range(attempts):
-            rep = next((r for r in self._candidates(model)
-                        if r.url not in tried), None)
-            if rep is None:
-                break
+
+        def start(rep: Replica, probe: bool, hedge: bool):
+            nonlocal inflight
             tried.append(rep.url)
             with self._lock:
                 rep.inflight += 1
                 rep.dispatched += 1
-            try:
-                req = urllib.request.Request(
-                    rep.url + path, data=body, method=method,
-                    headers=dict(headers))
+            meta = {"probe": probe, "hedge": hedge, "t0": time.monotonic()}
+            threading.Thread(
+                target=self._attempt,
+                args=(rep, method, path, body, headers, timeout, model,
+                      meta, resq, race, race_lock),
+                name="dl4j-tpu-fleet-attempt", daemon=True).start()
+            inflight += 1
+
+        def finish():
+            with race_lock:
+                race["done"] = True
+            # drain results that were queued before the race settled
+            while True:
                 try:
-                    with urllib.request.urlopen(req, timeout=timeout) as r:
-                        status, hdrs, payload = (r.status, dict(r.headers),
-                                                 r.read())
-                except urllib.error.HTTPError as e:
-                    status, hdrs, payload = e.code, dict(e.headers), e.read()
-            except (OSError, urllib.error.URLError) as e:
-                # connection refused/reset, DNS, timeout: replica-level
-                last_err = e
-                self._mark_failed(rep, "connect")
-                continue
-            finally:
+                    orep, okind, ores, ometa = resq.get_nowait()
+                except queue.Empty:
+                    return
+                self._account_abandoned(orep, okind, ores, ometa)
+
+        rep, probe = self._pick(model, tried)
+        if rep is None:
+            self._m_dispatch.labels(replica="", outcome="no_replica").inc()
+            raise NoReplicaError(
+                "no ready replica"
+                + (f" for model '{model}'" if model else ""))
+        with self._lock:
+            self._budget.record_dispatch()
+            tokens = self._budget.tokens
+        self._m_tokens.set(tokens)
+        start(rep, probe, hedge=False)
+        hedge_delay = self._hedge_delay(model) if idempotent else None
+        hedge_at = None if hedge_delay is None \
+            else time.monotonic() + hedge_delay
+
+        while inflight:
+            wait = None
+            if hedge_at is not None and not hedged and not hedge_blocked \
+                    and inflight == 1:
+                wait = max(hedge_at - time.monotonic(), 0.0)
+            try:
+                rep, kind, res, meta = resq.get(timeout=wait)
+            except queue.Empty:
+                # hedge timer fired with the primary still unanswered
+                cand, cprobe = self._pick(model, tried, strict=True)
+                if cand is None:
+                    hedge_blocked = True
+                    continue
                 with self._lock:
-                    rep.inflight = max(rep.inflight - 1, 0)
-            if status == 503:
-                # replica-level: draining / breaker / not ready — take it
-                # out of rotation and try the next one
-                last_err = None
-                self._mark_failed(rep, "503")
+                    granted = self._budget.try_spend()
+                    tokens = self._budget.tokens
+                    if not granted and cprobe:
+                        cand.probe_inflight = False  # return the slot
+                self._m_tokens.set(tokens)
+                if not granted:
+                    hedge_blocked = True
+                    self._m_denials.labels(reason="hedge").inc()
+                    self._m_hedges.labels(model=model or "",
+                                          outcome="suppressed").inc()
+                    continue
+                hedged = True
+                self._m_hedges.labels(model=model or "",
+                                      outcome="launched").inc()
+                start(cand, cprobe, hedge=True)
                 continue
-            self._m_dispatch.labels(replica=rep.url, outcome="ok").inc()
-            return status, hdrs, payload, rep.url
-        if tried:
-            self._m_dispatch.labels(replica=tried[-1],
+            inflight -= 1
+            latency = time.monotonic() - meta["t0"]
+
+            if kind == "response":
+                status, hdrs, payload = res
+                if status != 503:
+                    # the replica's answer — the race winner
+                    self._settle_attempt(
+                        rep, ok=True,
+                        latency_s=latency if status < 300 else None,
+                        probe=meta["probe"])
+                    if status < 300 and model is not None:
+                        self._note_latency(model, latency)
+                    finish()
+                    self._m_dispatch.labels(replica=rep.url,
+                                            outcome="ok").inc()
+                    if meta["hedge"]:
+                        self._m_hedges.labels(model=model or "",
+                                              outcome="won").inc()
+                    return status, hdrs, payload, rep.url
+                # 503 is replica-level (draining / breaker / unready):
+                # keep its Retry-After in hand for pass-through
+                last_503 = (status, hdrs, payload, rep.url)
+                last_err = None
+                self._settle_attempt(rep, ok=False, latency_s=None,
+                                     probe=meta["probe"])
+                self._note_replica_failure(rep, "503")
+            elif kind == "mid_stream":
+                hdrs, err = res
+                self._settle_attempt(rep, ok=False, latency_s=None,
+                                     probe=meta["probe"])
+                self._note_replica_failure(rep, "mid_stream")
+                if not idempotent:
+                    # the response body started; a retry could run the
+                    # generation twice — surface instead
+                    finish()
+                    self._m_dispatch.labels(replica=rep.url,
+                                            outcome="failed").inc()
+                    raise MidStreamError(
+                        rep.url,
+                        trace_id=hdrs.get("X-Trace-Id")
+                        or hdrs.get("x-trace-id"),
+                        cause=err)
+                last_err = err
+            else:  # conn_error: nothing reached the replica's handler
+                last_err = res
+                self._settle_attempt(rep, ok=False, latency_s=None,
+                                     probe=meta["probe"])
+                self._note_replica_failure(rep, "connect")
+
+            # a sibling attempt may still win the race
+            if inflight:
+                self._m_dispatch.labels(replica=rep.url,
+                                        outcome="failover").inc()
+                continue
+            # failover, budget and candidates permitting
+            if failovers < self.retries:
+                cand, cprobe = self._pick(model, tried)
+                if cand is not None:
+                    with self._lock:
+                        granted = self._budget.try_spend()
+                        tokens = self._budget.tokens
+                        if not granted and cprobe:
+                            cand.probe_inflight = False  # return the slot
+                    self._m_tokens.set(tokens)
+                    if granted:
+                        failovers += 1
+                        self._m_dispatch.labels(replica=rep.url,
+                                                outcome="failover").inc()
+                        start(cand, cprobe, hedge=False)
+                        continue
+                    self._m_denials.labels(reason="retry").inc()
+            # terminal: no retry possible for this failed attempt
+            finish()
+            if last_503 is not None:
+                # degrade to pass-through: the replica's own 503 (with
+                # its Retry-After) beats a synthesized error
+                self._m_dispatch.labels(
+                    replica=rep.url,
+                    outcome="passthrough" if kind == "response"
+                    else "failed").inc()
+                return last_503
+            self._m_dispatch.labels(replica=rep.url,
                                     outcome="failed").inc()
             raise NoReplicaError(
                 f"all routed attempts failed (tried {tried})"
                 + (f": {last_err!r}" if last_err else ""))
-        self._m_dispatch.labels(replica="", outcome="no_replica").inc()
-        raise NoReplicaError(
-            "no ready replica" + (f" for model '{model}'" if model else ""))
+        raise NoReplicaError(  # pragma: no cover — loop always resolves
+            f"all routed attempts failed (tried {tried})")
 
-    def _mark_failed(self, rep: Replica, why: str):
+    # -- brownout ---------------------------------------------------------
+    def brownout_state(self) -> Dict[str, Any]:
+        """The front door's degradation posture. Brownout turns on when
+        the fraction of known replicas that are ready and not ejected
+        drops below ``brownout_frac``; the priority cutoff and the
+        forwarded-deadline scale both deepen with the capacity
+        deficit."""
         with self._lock:
-            rep.ready = False
-            rep.consecutive_failures += 1
-        self._m_dispatch.labels(replica=rep.url, outcome="failover").inc()
-        log.warning("replica %s failed (%s); failing over", rep.url, why)
-        self._update_fleet_gauge()
+            reps = list(self._replicas.values())
+        known = len(reps)
+        ready = sum(1 for r in reps if r.ready and not r.ejected)
+        frac = (ready / known) if known else 0.0
+        limit = self.brownout_frac
+        active = limit > 0 and frac < limit
+        if active:
+            ratio = frac / limit                      # [0, 1)
+            cutoff = min(math.ceil(10.0 * (1.0 - ratio)), 10)
+            timeout_scale = max(ratio, 0.25)
+        else:
+            cutoff = 0
+            timeout_scale = 1.0
+        self._m_brownout.set(1.0 if active else 0.0)
+        self._m_ready_frac.set(frac)
+        return {"active": active, "ready_fraction": round(frac, 4),
+                "cutoff": cutoff, "timeout_scale": round(timeout_scale, 4),
+                "retry_after_s": max(int(math.ceil(self.poll_s)), 1),
+                "default_priority": self.default_priority}
+
+    def count_shed(self, model: Optional[str], priority: int):
+        self._m_shed.labels(model=model or "",
+                            priority=str(priority)).inc()
 
     # -- convenience client API -------------------------------------------
     def predict(self, model: str, inputs, *,
@@ -412,7 +1017,7 @@ class FleetRouter:
         status, _, payload, url = self.route(
             "POST", f"/v1/models/{model}/predict", body,
             headers=[("Content-Type", "application/json")],
-            model=model, timeout_s=timeout_s)
+            model=model, timeout_s=timeout_s, idempotent=True)
         doc = json.loads(payload or b"{}")
         if status != 200:
             raise RuntimeError(
@@ -425,7 +1030,7 @@ class FleetRouter:
         status, _, payload, url = self.route(
             "POST", f"/v1/models/{model}/generate", body,
             headers=[("Content-Type", "application/json")],
-            model=model, timeout_s=timeout_s)
+            model=model, timeout_s=timeout_s, idempotent=False)
         doc = json.loads(payload or b"{}")
         if status != 200:
             raise RuntimeError(
@@ -435,19 +1040,38 @@ class FleetRouter:
 
 _MODEL_PATH_RE = re.compile(r"^/v1/models/([^/:]+)(?::[^/]+)?/")
 
-#: request headers the front door forwards to the replica (trace context
-#: and deadlines must survive the hop; hop-by-hop headers must not)
-_FORWARDED_HEADERS = ("content-type", "traceparent", "x-request-timeout-s")
+#: request headers the front door forwards to the replica (trace context,
+#: deadlines, and priority must survive the hop; hop-by-hop headers must
+#: not)
+_FORWARDED_HEADERS = ("content-type", "traceparent", "x-request-timeout-s",
+                      "x-priority")
+
+
+def _parse_priority(raw: Optional[str], default: int) -> int:
+    if raw is None:
+        return default
+    try:
+        return min(max(int(str(raw).strip()), 0), 9)
+    except ValueError:
+        return default
 
 
 class FleetServer:
     """HTTP front door over a :class:`FleetRouter`: the one URL clients
     talk to. ``POST /v1/models/...`` proxies to the least-loaded ready
-    replica (with failover); ``GET /v1/models`` answers from the best
-    replica; ``/readyz`` is the *fleet's* readiness (any replica ready);
-    ``/fleet`` is the router's polled membership view; ``/metrics`` is
+    replica (with budgeted failover + hedging); ``GET /v1/models``
+    answers from the best replica; ``/readyz`` is the *fleet's*
+    readiness (any replica ready) plus its brownout posture; ``/fleet``
+    is the router's polled membership + budget view; ``/metrics`` is
     the router process's own registry (dispatch counters + fleet
-    gauges)."""
+    gauges).
+
+    During brownout the front door sheds POSTs whose ``X-Priority``
+    (0–9, default ``DL4J_TPU_FLEET_DEFAULT_PRIORITY``) falls below the
+    capacity-scaled cutoff — 503 with ``Retry-After`` and
+    ``X-Fleet-Brownout: 1`` — and tightens the forwarded
+    ``X-Request-Timeout-S`` so queued work inside the degraded fleet
+    gives up sooner."""
 
     def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
                  port: int = 0):
@@ -493,7 +1117,9 @@ class FleetServer:
                     ready = any(r.ready for r in reps)
                     self.send_json(
                         {"ready": ready,
-                         "replicas": [{"url": r.url, "ready": r.ready}
+                         "brownout": router.brownout_state(),
+                         "replicas": [{"url": r.url, "ready": r.ready,
+                                       "ejected": r.ejected}
                                       for r in reps]},
                         200 if ready else 503)
                 elif path == "/fleet":
@@ -519,9 +1145,52 @@ class FleetServer:
                 body = self.read_body() if method == "POST" else None
                 fwd = [(k, v) for k, v in self.headers.items()
                        if k.lower() in _FORWARDED_HEADERS]
+                brown = router.brownout_state()
+                if method == "POST" and brown["active"]:
+                    prio = _parse_priority(self.headers.get("X-Priority"),
+                                           brown["default_priority"])
+                    if prio < brown["cutoff"]:
+                        router.count_shed(model, prio)
+                        self.send_json(
+                            {"error": "brownout: fleet capacity at "
+                             f"{brown['ready_fraction']:.0%}, shedding "
+                             f"priority < {brown['cutoff']}",
+                             "priority": prio},
+                            503,
+                            headers=[("Retry-After",
+                                      str(brown["retry_after_s"])),
+                                     ("X-Fleet-Brownout", "1")])
+                        return
+                    # tighten the forwarded deadline: a browned-out
+                    # fleet must not queue work it cannot finish
+                    base = None
+                    for k, v in fwd:
+                        if k.lower() == "x-request-timeout-s":
+                            try:
+                                base = float(v)
+                            except ValueError:
+                                base = None
+                    if base is None:
+                        base = environment().serving_default_timeout_s() \
+                            or router.timeout_s
+                    tightened = max(base * brown["timeout_scale"], 0.1)
+                    fwd = [(k, v) for k, v in fwd
+                           if k.lower() != "x-request-timeout-s"]
+                    fwd.append(("X-Request-Timeout-S",
+                                f"{tightened:.3f}"))
+                path = self.path.split("?", 1)[0]
+                idempotent = not path.endswith("/generate")
                 try:
                     status, hdrs, payload, url = router.route(
-                        method, self.path, body, headers=fwd, model=model)
+                        method, self.path, body, headers=fwd, model=model,
+                        idempotent=idempotent)
+                except MidStreamError as e:
+                    hh = [("X-Trace-Id", e.trace_id)] if e.trace_id else []
+                    self.send_json(
+                        {"error": str(e), "trace_id": e.trace_id,
+                         "replica": e.replica_url},
+                        502, headers=hh)
+                    return
                 except NoReplicaError as e:
                     self.send_json({"error": str(e)}, 503,
                                    headers=[("Retry-After", "1")])

@@ -235,7 +235,8 @@ class ModelServer:
                         kind: str = "predict",
                         latency_s: Optional[float] = None,
                         disposition: Optional[str] = None,
-                        precision: Optional[str] = None):
+                        precision: Optional[str] = None,
+                        priority: Optional[int] = None):
         """Ring + SLO bookkeeping for one completed request, whatever its
         outcome (the ring is the /debug/requests + flight-recorder
         source). ``latency_s`` overrides the SLO-fed latency — generate
@@ -256,6 +257,7 @@ class ModelServer:
             "outcome": _OUTCOMES.get(status, str(status)),
             "disposition": disposition,
             "precision": precision,
+            "priority": priority,
             "ts": time.time(), "duration_s": round(duration_s, 6),
             "timeout_s": timeout_s})
         if status in _SLO_STATUSES:
@@ -425,6 +427,17 @@ class ModelServer:
                 self._latency_s = None
                 self._disposition = None
                 self._precision = None
+                # the fleet front door's brownout class rides X-Priority;
+                # recording it in the ring lets a post-mortem tell what a
+                # shed would have cost (which priorities were in flight)
+                self._priority = None
+                raw_prio = self.headers.get("X-Priority")
+                if raw_prio is not None:
+                    try:
+                        self._priority = min(max(int(raw_prio.strip()),
+                                                 0), 9)
+                    except ValueError:
+                        pass
                 if server.draining:
                     self.send_json(
                         {"error": "server is draining"}, 503,
@@ -443,7 +456,8 @@ class ModelServer:
                         self._timeout_s, kind=kind,
                         latency_s=self._latency_s,
                         disposition=self._disposition,
-                        precision=self._precision)
+                        precision=self._precision,
+                        priority=self._priority)
 
             def _dispatch_request(self, kind: str, name: str,
                                   version: Optional[str]):

@@ -851,6 +851,128 @@ class TestCheckShardedServing:
         assert rec["gate_ok"], rec["gate_reason"]
 
 
+def _fr_record(baseline_p99=80.0, faulted_p99=160.0, failed=0,
+               baseline_failed=0, injected=30, extra=30, launched=10,
+               ejections=1, readmissions=1, ratio=0.5, burst=10.0):
+    offered = 90
+    return {
+        "threads": 6, "requests_per_storm": offered,
+        "batch_delay_ms": 20.0, "fault_rate": 0.2,
+        "outlier_delay_ms": 200.0,
+        "budget": {"ratio": ratio, "burst": burst},
+        "baseline": {"offered": offered, "ok": offered - baseline_failed,
+                     "shed": 0, "failed": baseline_failed,
+                     "throughput_rps": 80.0, "p50_ms": 60.0,
+                     "p99_ms": baseline_p99, "replicas_hit": 3},
+        "faulted": {"offered": offered, "ok": offered - failed,
+                    "shed": 0, "failed": failed, "throughput_rps": 60.0,
+                    "p50_ms": 70.0, "p99_ms": faulted_p99,
+                    "replicas_hit": 3, "injected": injected,
+                    "attempts": offered + extra,
+                    "extra_dispatches": extra,
+                    "hedges": {"launched": launched, "won": 5,
+                               "suppressed": 1},
+                    "budget_denials": 1},
+        "p99_ratio": round(faulted_p99 / baseline_p99, 3),
+        "outlier": {"url": "http://127.0.0.1:9999",
+                    "ejections": ejections,
+                    "readmissions": readmissions},
+    }
+
+
+class TestCheckFleetResilience:
+    """Gate logic for the fleet_resilience metric: under a 20% injected
+    dispatch-fault rate plus one 10x-latency outlier, the router must
+    lose zero non-shed requests, hold p99 <= 3x the fault-free storm,
+    keep hedge+retry overhead inside the token budget, and eject then
+    probe-re-admit the outlier."""
+
+    def test_accepts_good_record(self):
+        ok, reason = bench.check_fleet_resilience(_fr_record())
+        assert ok, reason
+
+    def test_rejects_zero_injected_faults(self):
+        ok, reason = bench.check_fleet_resilience(_fr_record(injected=0))
+        assert not ok
+        assert "untested" in reason
+
+    def test_rejects_dirty_baseline(self):
+        # a fault-free storm that drops requests invalidates the p99
+        # yardstick (and means the fleet is broken without faults)
+        ok, reason = bench.check_fleet_resilience(
+            _fr_record(baseline_failed=1))
+        assert not ok
+        assert "yardstick" in reason
+
+    def test_rejects_lost_requests(self):
+        ok, reason = bench.check_fleet_resilience(_fr_record(failed=1))
+        assert not ok
+        assert "dropping traffic" in reason
+
+    def test_rejects_unbounded_p99_and_boundary(self):
+        ok, reason = bench.check_fleet_resilience(
+            _fr_record(faulted_p99=241.0))
+        assert not ok
+        assert "tail" in reason
+        ok, _ = bench.check_fleet_resilience(_fr_record(faulted_p99=239.0))
+        assert ok
+
+    def test_rejects_overbudget_dispatch_and_boundary(self):
+        # allowance = 0.5 * 90 offered + 10 burst = 55
+        ok, reason = bench.check_fleet_resilience(_fr_record(extra=56))
+        assert not ok
+        assert "unbounded" in reason
+        ok, _ = bench.check_fleet_resilience(_fr_record(extra=55))
+        assert ok
+
+    def test_rejects_storm_that_never_hedged(self):
+        ok, reason = bench.check_fleet_resilience(_fr_record(launched=0))
+        assert not ok
+        assert "hedging path is untested" in reason
+
+    def test_rejects_unejected_outlier(self):
+        ok, reason = bench.check_fleet_resilience(_fr_record(ejections=0))
+        assert not ok
+        assert "never ejected" in reason
+
+    def test_rejects_permanent_ejection(self):
+        ok, reason = bench.check_fleet_resilience(
+            _fr_record(readmissions=0))
+        assert not ok
+        assert "permanent" in reason
+
+    def test_custom_max_ratio(self):
+        rec = _fr_record(faulted_p99=320.0)
+        ok, _ = bench.check_fleet_resilience(rec, max_p99_ratio=5.0)
+        assert ok
+
+    def test_tiny_live_measurement_passes_gate(self):
+        """The full metric end-to-end on CPU. The deterministic legs ARE
+        asserted in CI: faults fired, zero lost requests in both storms,
+        hedges launched, the outlier ejected and probe-re-admitted, and
+        dispatch overhead inside the configured budget. The 3x p99
+        ratio is evaluated and recorded with wide margin at the tiny
+        sizing (the hedge answers at ~p95 while the outlier sits on a
+        fixed 200 ms connect delay)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.common import faults as faults_mod
+
+        rec = bench.bench_fleet_resilience(jax, jnp, tiny=True)
+        assert not faults_mod.active()  # bench disarmed everything
+        assert rec["faulted"]["injected"] > 0
+        assert rec["baseline"]["failed"] == 0
+        assert rec["faulted"]["failed"] == 0
+        assert rec["faulted"]["hedges"]["launched"] >= 1
+        allowance = (rec["budget"]["ratio"] * rec["faulted"]["offered"]
+                     + rec["budget"]["burst"])
+        assert rec["faulted"]["extra_dispatches"] <= allowance
+        assert rec["outlier"]["ejections"] >= 1
+        assert rec["outlier"]["readmissions"] >= 1
+        assert rec["gate_ok"], rec["gate_reason"]
+
+
 class TestScannedStepEndToEnd:
     def test_tiny_scan_chain_produces_sane_record(self):
         """The full measurement path on CPU: scanned step, median-of-5,

@@ -1,6 +1,6 @@
 """Sharded serving fleet (serving/fleet + mesh-sharded engines).
 
-Covers the acceptance contract of the fleet PR: a sharded deploy on a
+Covers the acceptance contract of the fleet PRs: a sharded deploy on a
 (1, N) CPU mesh serves predictions numerically matching single-device
 (bitwise on a 1x1 mesh), with mesh metadata surfaced on /v1/models and
 engine snapshots; the FleetRouter picks the least-loaded ready replica
@@ -8,8 +8,18 @@ under skew, fails over exactly once on connection refusal and on 503,
 refuses nothing silently (NoReplicaError / front-door 503 otherwise);
 and a joining replica warmed from the shared manifest takes traffic only
 after its /readyz flips.
+
+The tail-tolerance layer is pinned here too: the RetryBudget token
+bucket (with the budget at zero, dispatch attempts == requests —
+hedging is provably bounded), hedged requests for idempotent predicts
+only, outlier ejection over actual dispatch outcomes with probe
+re-admission, replica 503 Retry-After pass-through, mid-stream
+non-retryability for generate, poll hardening against junk payloads,
+brownout priority shedding, and a SIGTERM chaos drill through the
+front door.
 """
 import json
+import time
 import urllib.error
 import urllib.request
 
@@ -18,6 +28,7 @@ import pytest
 
 import jax
 
+from deeplearning4j_tpu.common import faults
 from deeplearning4j_tpu.common.mesh import (MODEL, mesh_shape, serving_mesh,
                                             spec_fits, validate_mesh)
 from deeplearning4j_tpu.common.metrics import registry
@@ -26,7 +37,10 @@ from deeplearning4j_tpu.nn import (MultiLayerNetwork,
 from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
 from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
 from deeplearning4j_tpu.serving.fleet import (FleetRouter, FleetServer,
-                                              NoReplicaError, Replica)
+                                              MidStreamError,
+                                              NoReplicaError, Replica,
+                                              RetryBudget)
+from deeplearning4j_tpu.serving.fleet.router import _parse_metrics_json
 
 N_IN, N_OUT = 6, 3
 
@@ -58,6 +72,25 @@ def _counter_value(fam_name, **labels):
     want = tuple(labels[k] for k in fam.label_names)
     return sum(child.value() for key, child in fam.children()
                if key == want)
+
+
+def _attempts_total():
+    """Real HTTP dispatch attempts: every dl4j_router_dispatch_total
+    outcome except no_replica (which records a request that never
+    reached a replica)."""
+    fam = registry().get("dl4j_router_dispatch_total")
+    if fam is None:
+        return 0.0
+    i = fam.label_names.index("outcome")
+    return sum(child.value() for key, child in fam.children()
+               if key[i] != "no_replica")
+
+
+@pytest.fixture(autouse=True)
+def _no_armed_faults():
+    """Fault rules must never leak across tests."""
+    yield
+    faults.clear()
 
 
 @pytest.fixture
@@ -564,3 +597,632 @@ class TestSharedStoreJoiner:
                 else:
                     env.set_property(prop, value)
             compile_cache.reset_cache()
+
+
+# ---------------------------------------------------------------------------
+# tail tolerance: retry budget
+# ---------------------------------------------------------------------------
+
+class TestRetryBudget:
+    def test_tokens_accrue_per_dispatch_and_cap_at_burst(self):
+        b = RetryBudget(0.5, burst=2.0)
+        assert b.tokens == 2.0
+        assert b.try_spend() and b.try_spend()
+        assert not b.try_spend()
+        b.record_dispatch()  # +0.5 -> below one whole token
+        assert not b.try_spend()
+        b.record_dispatch()
+        assert b.try_spend()
+        for _ in range(100):
+            b.record_dispatch()
+        assert b.tokens == 2.0  # never exceeds burst
+
+    def test_zero_ratio_disables_every_extra_dispatch(self):
+        b = RetryBudget(0.0)
+        assert b.burst == 0.0
+        for _ in range(50):
+            b.record_dispatch()
+        assert not b.try_spend()
+
+    def test_ratio_clamped_to_unit_interval(self):
+        assert RetryBudget(3.0).ratio == 1.0
+        assert RetryBudget(-1.0).ratio == 0.0
+        assert RetryBudget(0.2).burst == 10.0  # default: ratio * 50
+
+
+# ---------------------------------------------------------------------------
+# tail tolerance: poll hardening (malformed replica payloads)
+# ---------------------------------------------------------------------------
+
+def _stub_http_server(metrics_body):
+    """A fake replica: healthy /readyz, arbitrary /metrics.json bytes."""
+    import http.server
+    import threading
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/readyz":
+                body = json.dumps({"ready": True,
+                                   "models": {"toy": {}}}).encode()
+            else:
+                body = metrics_body
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+class TestPollHardening:
+    def test_non_object_metrics_payload_raises(self):
+        with pytest.raises(ValueError, match="non-object"):
+            _parse_metrics_json([1, 2, 3])
+
+    def test_junk_entries_degrade_to_neutral_and_count(self):
+        doc = {
+            "dl4j_serving_ewma_service_seconds": {"series": [
+                {"labels": {"model": "toy"}, "value": "0.25"},
+                {"labels": {"model": "bad"}, "value": "wat"},
+                {"labels": {"model": "nan"}, "value": float("nan")},
+                {"labels": "junk"},
+                "junk",
+            ]},
+            "dl4j_serving_waiters": "junk",
+            "dl4j_serving_queue_depth": {"series": "junk"},
+        }
+        load, malformed = _parse_metrics_json(doc)
+        assert load["toy"]["ewma_s"] == 0.25
+        assert load["bad"]["ewma_s"] == 0.0  # unparseable -> neutral
+        assert load["nan"]["ewma_s"] == 0.0  # non-finite -> neutral
+        assert malformed == 6
+
+    def test_junk_metrics_keeps_replica_in_rotation(self):
+        # garbage /metrics.json costs the replica its load view, never
+        # its place in rotation (its readiness is known)
+        srv = _stub_http_server(b'"garbage"')
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        router = FleetRouter([url], poll_s=3600)
+        try:
+            pre = _counter_value("dl4j_fleet_poll_errors_total",
+                                 replica=url, reason="malformed")
+            router.poll_once()
+            rep = router.replicas()[0]
+            assert rep.ready and rep.models == ["toy"]
+            assert rep.load == {}
+            assert router._candidates("toy") == [rep]
+            assert _counter_value("dl4j_fleet_poll_errors_total",
+                                  replica=url,
+                                  reason="malformed") == pre + 1
+        finally:
+            srv.shutdown()
+
+    def test_poll_fault_counts_unreachable_and_unreadies(self):
+        fleet = _Fleet(1, poll_s=3600)
+        url = fleet.router.replicas()[0].url
+        try:
+            faults.inject("fleet.poll", kind="error", rate=1.0)
+            pre = _counter_value("dl4j_fleet_poll_errors_total",
+                                 replica=url, reason="unreachable")
+            fleet.router.poll_once()
+            assert not fleet.router.replicas()[0].ready
+            assert _counter_value("dl4j_fleet_poll_errors_total",
+                                  replica=url,
+                                  reason="unreachable") == pre + 1
+        finally:
+            faults.clear()
+            fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# tail tolerance: hedged requests
+# ---------------------------------------------------------------------------
+
+_CT = [("Content-Type", "application/json")]
+
+
+class TestHedging:
+    def test_hedge_beats_slow_replica_and_settles_both_attempts(self):
+        fleet = _Fleet(2, poll_s=3600, retries=1, hedge_pctl=50,
+                       hedge_min_samples=4, retry_budget=1.0,
+                       retry_burst=8)
+        try:
+            for _ in range(8):
+                fleet.router._note_latency("toy", 0.01)
+            slow = fleet.router._candidates("toy")[0]
+            pre_att = _attempts_total()
+            pre_won = _counter_value("dl4j_fleet_hedges_total",
+                                     model="toy", outcome="won")
+            faults.inject(
+                "fleet.dispatch", kind="delay", rate=1.0, delay_s=0.8,
+                predicate=lambda ctx: ctx.get("url") == slow.url
+                and ctx.get("phase") == "connect")
+            t0 = time.perf_counter()
+            doc = fleet.router.predict("toy", _x().tolist())
+            dt = time.perf_counter() - t0
+            assert np.asarray(doc["outputs"]).shape == (4, N_OUT)
+            assert dt < 0.8  # the hedge answered before the primary
+            assert _counter_value("dl4j_fleet_hedges_total", model="toy",
+                                  outcome="won") == pre_won + 1
+            # the abandoned loser still settles: exactly 2 attempts
+            deadline = time.monotonic() + 5
+            while (_attempts_total() < pre_att + 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert _attempts_total() == pre_att + 2
+        finally:
+            faults.clear()
+            fleet.close()
+
+    def test_non_idempotent_request_never_hedges(self):
+        fleet = _Fleet(2, poll_s=3600, retries=1, hedge_pctl=50,
+                       hedge_min_samples=4, retry_budget=1.0,
+                       retry_burst=8)
+        try:
+            for _ in range(8):
+                fleet.router._note_latency("toy", 0.01)
+            slow = fleet.router._candidates("toy")[0]
+            pre = _counter_value("dl4j_fleet_hedges_total", model="toy",
+                                 outcome="launched")
+            faults.inject(
+                "fleet.dispatch", kind="delay", rate=1.0, delay_s=0.3,
+                predicate=lambda ctx: ctx.get("url") == slow.url
+                and ctx.get("phase") == "connect")
+            t0 = time.perf_counter()
+            status, _, _, url = fleet.router.route(
+                "POST", "/v1/models/toy/predict",
+                json.dumps({"inputs": _x().tolist()}).encode(),
+                headers=_CT, model="toy", idempotent=False)
+            dt = time.perf_counter() - t0
+            assert status == 200 and url == slow.url
+            assert dt >= 0.3  # waited the slow replica out, no hedge
+            assert _counter_value("dl4j_fleet_hedges_total", model="toy",
+                                  outcome="launched") == pre
+        finally:
+            faults.clear()
+            fleet.close()
+
+    def test_exhausted_budget_bounds_dispatch_to_request_count(self):
+        """The acceptance criterion: with the retry budget at zero,
+        total dispatch attempts == request count even while faults make
+        hedges and retries desirable."""
+        fleet = _Fleet(2, poll_s=3600, retries=2, retry_budget=0.0,
+                       hedge_pctl=50, hedge_min_samples=1)
+        try:
+            fleet.router._note_latency("toy", 0.001)  # hedge wants to fire
+            faults.inject(
+                "fleet.dispatch", kind="error", rate=0.4, seed=3,
+                predicate=lambda ctx: ctx.get("phase") == "connect")
+            pre = _attempts_total()
+            pre_denied = (
+                _counter_value("dl4j_fleet_budget_denials_total",
+                               reason="retry")
+                + _counter_value("dl4j_fleet_budget_denials_total",
+                                 reason="hedge"))
+            n, served = 12, 0
+            for _ in range(n):
+                try:
+                    fleet.router.predict("toy", _x(1).tolist())
+                    served += 1
+                except (NoReplicaError, RuntimeError):
+                    pass
+            assert _attempts_total() - pre == n
+            assert served > 0  # the fleet degraded, not died
+            denied = (
+                _counter_value("dl4j_fleet_budget_denials_total",
+                               reason="retry")
+                + _counter_value("dl4j_fleet_budget_denials_total",
+                                 reason="hedge"))
+            assert denied > pre_denied  # extras were wanted and refused
+        finally:
+            faults.clear()
+            fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# tail tolerance: outlier ejection + probe re-admission
+# ---------------------------------------------------------------------------
+
+class TestOutlierEjection:
+    def _router(self, **kw):
+        kw.setdefault("poll_s", 3600)
+        kw.setdefault("eject_min_samples", 4)
+        kw.setdefault("eject_window", 8)
+        kw.setdefault("eject_backoff_s", 0.05)
+        return FleetRouter(**kw)
+
+    def test_error_rate_ejects_and_excludes_from_rotation(self):
+        router = self._router()
+        bad = _stub_replica(router, "http://bad:1")
+        good = _stub_replica(router, "http://good:1")
+        pre = _counter_value("dl4j_fleet_ejections_total",
+                             replica=bad.url, reason="error_rate")
+        for _ in range(4):
+            router._settle_attempt(bad, ok=False, latency_s=0.01,
+                                   probe=False)
+        assert bad.ejected and bad.ejections == 1
+        assert _counter_value("dl4j_fleet_ejections_total",
+                              replica=bad.url,
+                              reason="error_rate") == pre + 1
+        assert router._candidates("toy") == [good]
+
+    def test_latency_zscore_ejects_zombie(self):
+        # the zombie answers 200 every time — only its latency is wrong
+        router = self._router()
+        slow = _stub_replica(router, "http://slow:1")
+        p1 = _stub_replica(router, "http://p1:1")
+        p2 = _stub_replica(router, "http://p2:1")
+        for rep, lat in ((p1, 0.010), (p2, 0.012)):
+            for _ in range(4):
+                router._settle_attempt(rep, ok=True, latency_s=lat,
+                                       probe=False)
+        assert not p1.ejected and not p2.ejected
+        for _ in range(4):
+            router._settle_attempt(slow, ok=True, latency_s=0.5,
+                                   probe=False)
+        assert slow.ejected
+        assert _counter_value("dl4j_fleet_ejections_total",
+                              replica=slow.url, reason="latency") == 1
+
+    def test_tight_peer_agreement_does_not_hair_trigger(self):
+        # when peers agree to the microsecond the peer std collapses and
+        # a replica 0.2 ms slower would score z > 3 on significance
+        # alone — the 2x practical-significance floor must hold it in
+        from deeplearning4j_tpu.serving.resilience import latency_zscore
+        assert latency_zscore(0.00825, [0.00800, 0.00805]) == 0.0
+        assert latency_zscore(0.248, [0.00800, 0.00805]) >= 3.0
+        router = self._router()
+        slowish = _stub_replica(router, "http://slowish:1")
+        p1 = _stub_replica(router, "http://peer1:1")
+        p2 = _stub_replica(router, "http://peer2:1")
+        for rep, lat in ((p1, 0.00800), (p2, 0.00805)):
+            for _ in range(4):
+                router._settle_attempt(rep, ok=True, latency_s=lat,
+                                       probe=False)
+        for _ in range(4):
+            router._settle_attempt(slowish, ok=True, latency_s=0.00825,
+                                   probe=False)
+        assert not slowish.ejected and slowish.ejections == 0
+
+    def test_max_ejection_fraction_keeps_last_replica(self):
+        router = self._router()
+        a = _stub_replica(router, "http://a:1")
+        b = _stub_replica(router, "http://b:1")
+        for _ in range(4):
+            router._settle_attempt(a, ok=False, latency_s=0.01,
+                                   probe=False)
+        assert a.ejected
+        # b misbehaves too, but ejecting it would empty the fleet
+        for _ in range(6):
+            router._settle_attempt(b, ok=False, latency_s=0.01,
+                                   probe=False)
+        assert not b.ejected and b.ejections == 0
+
+    def test_probe_readmits_after_backoff(self):
+        router = self._router()
+        bad = _stub_replica(router, "http://bad:1")
+        good = _stub_replica(router, "http://good:1")
+        for _ in range(4):
+            router._settle_attempt(bad, ok=False, latency_s=0.01,
+                                   probe=False)
+        assert bad.ejected
+        rep, is_probe = router._pick("toy", ())
+        assert rep is good and not is_probe  # backoff still running
+        time.sleep(0.08)
+        rep, is_probe = router._pick("toy", ())
+        assert rep is bad and is_probe  # exactly one probe slot
+        rep2, is_probe2 = router._pick("toy", ())
+        assert rep2 is good and not is_probe2  # slot already taken
+        pre = _counter_value("dl4j_fleet_readmissions_total",
+                             replica=bad.url)
+        router._settle_attempt(bad, ok=True, latency_s=0.01, probe=True)
+        assert not bad.ejected
+        assert len(bad.stats) == 0  # history wiped on re-admission
+        assert _counter_value("dl4j_fleet_readmissions_total",
+                              replica=bad.url) == pre + 1
+
+    def test_failed_probe_reejects_with_doubled_backoff(self):
+        router = self._router()
+        bad = _stub_replica(router, "http://bad:1")
+        _stub_replica(router, "http://good:1")
+        for _ in range(4):
+            router._settle_attempt(bad, ok=False, latency_s=0.01,
+                                   probe=False)
+        assert bad.eject_backoff_s == pytest.approx(0.05)
+        time.sleep(0.08)
+        rep, is_probe = router._pick("toy", ())
+        assert rep is bad and is_probe
+        router._settle_attempt(bad, ok=False, latency_s=0.01, probe=True)
+        assert bad.ejected
+        assert bad.eject_backoff_s == pytest.approx(0.10)
+        assert _counter_value("dl4j_fleet_ejections_total",
+                              replica=bad.url, reason="probe_failed") == 1
+
+    def test_live_zombie_ejected_while_polling_healthy(self):
+        """A replica whose /readyz and /metrics.json look perfect but
+        whose dispatches crawl must still be ejected — health polls
+        cannot see it, dispatch outcomes can. Needs >= 2 healthy peers:
+        the z-score refuses to judge against a single peer."""
+        fleet = _Fleet(3, poll_s=3600, retries=1, hedge_pctl=0,
+                       eject_min_samples=3, eject_window=6,
+                       eject_backoff_s=30)
+        try:
+            zombie = fleet.router._candidates("toy")[0]
+            faults.inject(
+                "fleet.dispatch", kind="delay", rate=1.0, delay_s=0.25,
+                predicate=lambda ctx: ctx.get("url") == zombie.url
+                and ctx.get("phase") == "connect")
+            for _ in range(20):
+                fleet.router.predict("toy", _x(1).tolist())
+                if zombie.ejected:
+                    break
+            assert zombie.ejected
+            fleet.router.poll_once()
+            assert zombie.ready  # the poll still says healthy...
+            assert zombie not in fleet.router._candidates("toy")  # ...but
+        finally:
+            faults.clear()
+            fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# tail tolerance: Retry-After pass-through + mid-stream non-retryability
+# ---------------------------------------------------------------------------
+
+class TestRetryAfterPassthrough:
+    def test_route_returns_replica_503_with_retry_after(self):
+        fleet = _Fleet(2, poll_s=3600, retries=2)
+        try:
+            for _, srv in fleet.members:
+                srv.begin_drain()
+            status, hdrs, payload, url = fleet.router.route(
+                "POST", "/v1/models/toy/predict",
+                json.dumps({"inputs": _x().tolist()}).encode(),
+                headers=_CT, model="toy")
+            assert status == 503
+            retry_after = {k.lower(): v for k, v in hdrs.items()}.get(
+                "retry-after")
+            assert retry_after == "1"  # the replica's own hint, intact
+            assert b"draining" in payload
+        finally:
+            fleet.close()
+
+    def test_front_door_forwards_retry_after(self):
+        fleet = _Fleet(2, poll_s=3600, retries=2)
+        front = FleetServer(fleet.router)
+        port = front.start()
+        try:
+            for _, srv in fleet.members:
+                srv.begin_drain()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/toy/predict",
+                data=json.dumps({"inputs": _x().tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After") == "1"
+            ei.value.read()
+        finally:
+            front.stop()
+            fleet.close()
+
+
+class TestMidStream:
+    def test_non_idempotent_mid_stream_raises_and_never_retries(self):
+        fleet = _Fleet(2, poll_s=3600, retries=2)
+        try:
+            faults.inject(
+                "fleet.dispatch", kind="error", rate=1.0,
+                predicate=lambda ctx: ctx.get("phase") == "body")
+            pre = _attempts_total()
+            with pytest.raises(MidStreamError,
+                               match="not retried") as ei:
+                fleet.router.route(
+                    "POST", "/v1/models/toy/predict",
+                    json.dumps({"inputs": _x().tolist()}).encode(),
+                    headers=_CT, model="toy", idempotent=False)
+            assert _attempts_total() - pre == 1  # exactly one attempt
+            assert ei.value.replica_url.startswith("http://")
+            assert ei.value.trace_id  # replica's X-Trace-Id carried out
+        finally:
+            faults.clear()
+            fleet.close()
+
+    def test_idempotent_mid_stream_retries_to_success(self):
+        fleet = _Fleet(2, poll_s=3600, retries=2)
+        try:
+            victim = fleet.router._candidates("toy")[0]
+            faults.inject(
+                "fleet.dispatch", kind="error", rate=1.0,
+                predicate=lambda ctx: ctx.get("url") == victim.url
+                and ctx.get("phase") == "body")
+            doc = fleet.router.predict("toy", _x().tolist())
+            assert np.asarray(doc["outputs"]).shape == (4, N_OUT)
+        finally:
+            faults.clear()
+            fleet.close()
+
+    def test_front_door_maps_mid_stream_to_502_with_trace(self):
+        fleet = _Fleet(2, poll_s=3600, retries=2)
+        front = FleetServer(fleet.router)
+        port = front.start()
+        try:
+            faults.inject(
+                "fleet.dispatch", kind="error", rate=1.0,
+                predicate=lambda ctx: ctx.get("phase") == "body")
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/toy/generate",
+                data=json.dumps({"prompt": [1, 2, 3]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 502
+            doc = json.loads(ei.value.read() or b"{}")
+            assert "mid-stream" in doc["error"]
+            assert doc.get("trace_id")
+        finally:
+            faults.clear()
+            front.stop()
+            fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# tail tolerance: brownout degradation
+# ---------------------------------------------------------------------------
+
+class TestBrownout:
+    def test_brownout_state_tracks_capacity_deficit(self):
+        router = FleetRouter(poll_s=3600, brownout_frac=0.5)
+        _stub_replica(router, "http://up:1", ready=True)
+        for i in range(3):
+            _stub_replica(router, f"http://down:{i}", ready=False)
+        st = router.brownout_state()
+        assert st["active"] and st["ready_fraction"] == 0.25
+        assert st["cutoff"] == 5  # half the deficit -> half the ladder
+        assert st["timeout_scale"] == 0.5
+        assert st["retry_after_s"] >= 1
+
+    def test_brownout_off_at_or_above_the_limit(self):
+        router = FleetRouter(poll_s=3600, brownout_frac=0.5)
+        _stub_replica(router, "http://a:1")
+        _stub_replica(router, "http://b:1")
+        st = router.brownout_state()
+        assert not st["active"]
+        assert st["cutoff"] == 0 and st["timeout_scale"] == 1.0
+
+    def test_ejected_replicas_count_against_ready_capacity(self):
+        router = FleetRouter(poll_s=3600, brownout_frac=0.75)
+        a = _stub_replica(router, "http://a:1")
+        _stub_replica(router, "http://b:1")
+        assert not router.brownout_state()["active"]
+        a.ejected = True
+        st = router.brownout_state()
+        assert st["active"] and st["ready_fraction"] == 0.5
+
+    def test_front_door_sheds_low_priority_first(self):
+        fleet = _Fleet(1, poll_s=3600, brownout_frac=0.5)
+        for i in range(3):
+            _stub_replica(fleet.router, f"http://down:{i}", ready=False)
+        front = FleetServer(fleet.router)
+        port = front.start()
+        try:
+            pre = _counter_value("dl4j_fleet_shed_total", model="toy",
+                                 priority="1")
+            body = json.dumps({"inputs": _x().tolist()}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/toy/predict",
+                data=body, headers={"Content-Type": "application/json",
+                                    "X-Priority": "1"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 503
+            assert ei.value.headers.get("X-Fleet-Brownout") == "1"
+            assert ei.value.headers.get("Retry-After")
+            ei.value.read()
+            assert _counter_value("dl4j_fleet_shed_total", model="toy",
+                                  priority="1") == pre + 1
+            # important traffic still flows to the surviving replica
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/toy/predict",
+                data=body, headers={"Content-Type": "application/json",
+                                    "X-Priority": "9"})
+            r = urllib.request.urlopen(req, timeout=30)
+            assert r.status == 200
+            r.read()
+        finally:
+            front.stop()
+            fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: SIGTERM-drain one replica mid-storm through the front door
+# ---------------------------------------------------------------------------
+
+class TestFleetChaos:
+    @pytest.mark.slow
+    def test_sigterm_drain_mid_storm_loses_nothing(self, tmp_path,
+                                                   monkeypatch):
+        """One replica takes a SIGTERM graceful drain mid-storm while
+        dispatch faults are armed; every non-shed request through the
+        FleetServer front door must still answer 200, and the drained
+        replica's flight recorder must be written and parseable."""
+        import signal
+        import threading
+
+        from deeplearning4j_tpu.serving.lifecycle import GracefulLifecycle
+
+        monkeypatch.setenv("DL4J_TPU_FLIGHT_RECORDER_DIR", str(tmp_path))
+        # brownout off: this drill asserts the ROUTING contract (every
+        # request survives via failover); the shedding contract has its
+        # own tests above
+        fleet = _Fleet(3, poll_s=0.2, retries=4, retry_budget=0.5,
+                       retry_burst=10, hedge_pctl=95, brownout_frac=0.0)
+        fleet.router.start_polling()
+        vreg, vsrv = fleet.members[0]
+        lc = GracefulLifecycle(vreg, vsrv, drain_timeout_s=15)
+        lc.install()
+        front = FleetServer(fleet.router)
+        port = front.start()
+        statuses = []
+        lock = threading.Lock()
+        body = json.dumps({"inputs": _x().tolist()}).encode()
+
+        def client():
+            for _ in range(12):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/models/toy/predict",
+                    data=body,
+                    headers={"Content-Type": "application/json",
+                             "X-Priority": "9"})
+                try:
+                    r = urllib.request.urlopen(req, timeout=30)
+                    st = r.status
+                    r.read()
+                except urllib.error.HTTPError as e:
+                    st = e.code
+                    e.read()
+                except OSError as e:
+                    st = f"conn:{type(e).__name__}"
+                with lock:
+                    statuses.append(st)
+
+        faults.inject(
+            "fleet.dispatch", kind="error", rate=0.1, seed=9,
+            predicate=lambda ctx: ctx.get("phase") == "connect")
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            signal.raise_signal(signal.SIGTERM)
+            for t in threads:
+                t.join()
+            assert lc.wait_drained(30)
+        finally:
+            faults.clear()
+            lc.uninstall()
+            front.stop()
+            fleet.close()
+        assert len(statuses) == 48
+        assert all(st == 200 for st in statuses), statuses
+        flights = sorted(tmp_path.glob("flight-*.json"))
+        assert flights, "the drained replica must dump a flight record"
+        doc = json.loads(flights[0].read_text())
+        assert doc["draining"]
+        for key in ("requests", "breakers", "engine_health", "faults"):
+            assert key in doc
+        served = [r for r in doc["requests"]
+                  if r.get("kind") == "predict" and r.get("status") == 200]
+        assert served, "the victim served storm traffic before draining"
+        for r in served:
+            # clean flight: nothing quarantined / breaker-opened, and
+            # the X-Priority header survived front door -> replica ring
+            assert r["disposition"] is None
+            assert r["priority"] == 9
