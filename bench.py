@@ -1279,6 +1279,198 @@ def check_generative_decode(rec, min_kv_speedup=3.0, min_cb_speedup=1.5,
     return True, "ok"
 
 
+def bench_prefix_reuse(jax, jnp, tiny):
+    """Prefix-aware KV reuse (the radix-cache headline): the same tiny
+    causal LM serving two chat-shaped workloads with the prefix cache
+    on vs off.
+
+    1. **Shared-system-prompt storm** — N requests sharing one long
+       system prompt, each with a distinct short user tail. The first
+       request prefills the full prompt; every follower must attach the
+       cached common blocks and prefill only its tail, so the common
+       prefix is prefilled exactly once fleet-wide. The engine's
+       dispatch counters prove it: ``prefill_rows`` (rows actually
+       computed) drops by exactly ``prefix_reused_rows`` (rows attached
+       from cache) relative to the cache-off engine.
+    2. **Multi-turn session replay** — turn 1 generates a reply; turn 2
+       re-sends the whole history plus a new user message. Warm (cache
+       on, same engine) the prefill covers only the new tail and lands
+       in a small prompt bucket; cold (cache off) it recomputes the
+       whole history in the big bucket. Reported as the cold/warm TTFT
+       ratio (gate: >= 5x).
+
+    Greedy output must be token-identical between the cached and
+    uncached engines in every phase — reuse that changes tokens is a
+    correctness bug, whatever its speed. Gated by
+    ``check_prefix_reuse``.
+    """
+    from deeplearning4j_tpu.models import causal_lm
+    from deeplearning4j_tpu.runtime.generation import DecodeEngine
+
+    if tiny:
+        # 4 layers, not the usual tiny 2: the cold full-history prefill
+        # must dwarf the warm tail's fixed dispatch overhead for the 5x
+        # TTFT gate to measure compute skipped, not scheduler noise
+        cfg = causal_lm.CausalLMConfig(
+            vocab_size=128, hidden_size=128, num_layers=4, num_heads=4,
+            intermediate_size=256, max_position_embeddings=512,
+            dtype=jnp.float32)
+        max_ctx, bs = 512, 16
+        buckets = [16, 32, 512]
+        common_len, tail_len, storm_n, storm_gen = 224, 12, 6, 8
+        turn1_len, turn1_gen, turn2_extra, ttft_runs = 352, 16, 14, 5
+    else:
+        cfg = causal_lm.CausalLMConfig(
+            vocab_size=8192, hidden_size=512, num_layers=6, num_heads=8,
+            intermediate_size=2048, max_position_embeddings=2048,
+            dtype=jnp.bfloat16)
+        max_ctx, bs = 2048, 32
+        buckets = [32, 64, 2048]
+        common_len, tail_len, storm_n, storm_gen = 1024, 24, 8, 16
+        turn1_len, turn1_gen, turn2_extra, ttft_runs = 1500, 32, 28, 5
+    model = causal_lm.CausalLM(cfg, seed=0)
+    rng = np.random.RandomState(7)
+    blocks = 4 * (max_ctx // bs)   # roomy pool: no eviction noise
+
+    def engine(cache):
+        eng = DecodeEngine(model, slots=4, max_ctx=max_ctx,
+                           prompt_buckets=buckets, kv_block_size=bs,
+                           kv_blocks=blocks, prefill_batch=1,
+                           prefix_cache=cache)
+        eng.warmup()
+        return eng
+
+    rec = {"block_size": bs, "prompt_buckets": buckets}
+    for attempt in range(2):
+        # -- phase 1: shared-system-prompt storm --------------------------
+        common = rng.randint(0, cfg.vocab_size, common_len).astype(np.int32)
+        tails = [rng.randint(0, cfg.vocab_size, tail_len).astype(np.int32)
+                 for _ in range(storm_n)]
+        prompts = [np.concatenate([common, t]) for t in tails]
+
+        def storm(eng):
+            # leader first so followers find its blocks published, then
+            # the rest of the storm concurrently
+            first = eng.generate(prompts[0], max_tokens=storm_gen,
+                                 eos_token=None).result()
+            futs = [eng.generate(p, max_tokens=storm_gen, eos_token=None)
+                    for p in prompts[1:]]
+            return [first["tokens"]] + [f.result()["tokens"] for f in futs]
+
+        warm_eng = engine(True)
+        warm_toks = storm(warm_eng)
+        ws = warm_eng.stats()
+        warm_eng.close(10.0)
+        cold_eng = engine(False)
+        cold_toks = storm(cold_eng)
+        cs = cold_eng.stats()
+        cold_eng.close(10.0)
+        # every follower reuses exactly the block-aligned common run
+        expected_reused = (storm_n - 1) * (common_len // bs) * bs
+        rec["storm"] = {
+            "requests": storm_n,
+            "common_tokens": common_len,
+            "prefill_rows": ws["prefill_rows"],
+            "prefill_rows_cold": cs["prefill_rows"],
+            "reused_rows": ws["prefix_reused_rows"],
+            "expected_reused_rows": expected_reused,
+            "prefix_hits": ws["prefix_hits"],
+            "decode_match": warm_toks == cold_toks,
+        }
+
+        # -- phase 2: multi-turn session replay ---------------------------
+        base = rng.randint(0, cfg.vocab_size, turn1_len).astype(np.int32)
+        extra = rng.randint(0, cfg.vocab_size,
+                            turn2_extra).astype(np.int32)
+
+        def session(eng):
+            # turn 1 populates (or not) the cache; turn 2 re-sends the
+            # whole history + a new user message, several times for a
+            # stable TTFT median (cache-off never re-learns, cache-on
+            # re-attaches every repeat)
+            t1 = eng.generate(base, max_tokens=turn1_gen,
+                              eos_token=None).result()
+            turn2 = np.concatenate(
+                [base, np.asarray(t1["tokens"], np.int32), extra])
+            ttfts, toks = [], None
+            for _ in range(ttft_runs):
+                r = eng.generate(turn2, max_tokens=storm_gen,
+                                 eos_token=None).result()
+                ttfts.append(r["ttft_s"])
+                toks = r["tokens"]
+            return t1["tokens"], toks, float(np.median(ttfts))
+
+        warm_eng = engine(True)
+        w1, w2, warm_ttft = session(warm_eng)
+        ws2 = warm_eng.stats()
+        warm_eng.close(10.0)
+        cold_eng = engine(False)
+        c1, c2, cold_ttft = session(cold_eng)
+        cold_eng.close(10.0)
+        rec["session"] = {
+            "turn2_tokens": int(turn1_len + turn1_gen + turn2_extra),
+            "cold_ttft_ms": round(cold_ttft * 1e3, 3),
+            "warm_ttft_ms": round(warm_ttft * 1e3, 3),
+            "ttft_ratio": round(cold_ttft / max(warm_ttft, 1e-9), 3),
+            "warm_reused_rows": ws2["prefix_reused_rows"],
+            "decode_match": (w1, w2) == (c1, c2),
+        }
+
+        ok, reason = check_prefix_reuse(rec)
+        if ok or attempt == 1:
+            break
+    rec["gate_ok"], rec["gate_reason"] = ok, reason
+    return rec
+
+
+def check_prefix_reuse(rec, min_ratio=5.0):
+    """(ok, reason): gates a prefix_reuse record must pass.
+
+    - cached greedy output must be token-identical to the cache-off
+      engine in both phases (reuse must not change the function);
+    - the storm must reuse exactly the block-aligned common prefix for
+      every follower — ``reused_rows == (N-1) * aligned(common)`` —
+      and the computed-row counter must drop by the same amount vs the
+      cold engine, proving the common prefix was prefilled once;
+    - every storm follower must be a cache hit;
+    - the warm turn-2 TTFT must be >= ``min_ratio`` (5x) faster than
+      the cold engine's full-history prefill."""
+    storm = rec.get("storm") or {}
+    if not storm.get("decode_match"):
+        return False, ("storm greedy tokens differ between cached and "
+                       "uncached engines: prefix reuse changed the "
+                       "decoded function")
+    expected = storm.get("expected_reused_rows")
+    if storm.get("reused_rows") != expected:
+        return False, (
+            f"storm reused {storm.get('reused_rows')} rows, expected "
+            f"exactly {expected}: followers are not attaching the "
+            "block-aligned common prefix")
+    if storm.get("prefill_rows_cold", 0) - storm.get("prefill_rows", 0) \
+            != expected:
+        return False, (
+            f"storm computed {storm.get('prefill_rows')} rows vs "
+            f"{storm.get('prefill_rows_cold')} cold — the gap must be "
+            f"exactly the {expected} reused rows: the common prefix was "
+            "not prefilled exactly once")
+    if storm.get("prefix_hits") != storm.get("requests", 0) - 1:
+        return False, (
+            f"{storm.get('prefix_hits')} storm followers hit the cache, "
+            f"expected {storm.get('requests', 0) - 1}")
+    sess = rec.get("session") or {}
+    if not sess.get("decode_match"):
+        return False, ("session replay tokens differ between cached and "
+                       "uncached engines: re-attached turn history "
+                       "decodes differently")
+    ratio = sess.get("ttft_ratio", 0.0)
+    if ratio < min_ratio:
+        return False, (
+            f"warm turn-2 TTFT only {ratio:.2f}x the cold full-history "
+            f"prefill (gate: >= {min_ratio}x): the tail-only prefill is "
+            "not skipping the cached history")
+    return True, "ok"
+
+
 def bench_quantized_inference(jax, jnp, tiny):
     """Post-training quantization for serving (quant/): an MLP served
     three ways — f32 reference, bf16 (the pre-PR mixed-precision serving
@@ -2730,6 +2922,11 @@ def main():
                                                                tiny)
         except Exception as e:
             out["generative_decode"] = f"error: {type(e).__name__}"
+        _release()
+        try:
+            out["prefix_reuse"] = bench_prefix_reuse(jax, jnp, tiny)
+        except Exception as e:
+            out["prefix_reuse"] = f"error: {type(e).__name__}"
         _release()
         try:
             out["quantized_inference"] = bench_quantized_inference(jax, jnp,

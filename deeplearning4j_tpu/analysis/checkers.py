@@ -19,7 +19,9 @@ from . import Finding, Module, PACKAGE_ROOT
 #: reason/state/good/window/path/site/engine/mode/tier/priority — mode is
 #: the quantization storage format, int8|fp8; tier is the artifact-store
 #: layer, local|remote; priority is the X-Priority request class, the
-#: ten values "0".."9"), a deploy-bounded identity
+#: ten values "0".."9"; outcome enums are per-family, e.g. the router
+#: dispatch set and the session-affinity pair hit|fallback on
+#: ``dl4j_fleet_affinity_total``), a deploy-bounded identity
 #: (model/version/bucket/worker/name/replica — replica is a fleet
 #: member's URL, bounded by the router's configured replica set), or
 #: process identity (the build-info trio). A request-scoped value (trace id, user id, prompt)

@@ -57,6 +57,7 @@ class EnvironmentVars:
     DL4J_TPU_DECODE_MAX_TOKENS = "DL4J_TPU_DECODE_MAX_TOKENS"
     DL4J_TPU_KV_BLOCK_SIZE = "DL4J_TPU_KV_BLOCK_SIZE"
     DL4J_TPU_SPEC_DRAFT_K = "DL4J_TPU_SPEC_DRAFT_K"
+    DL4J_TPU_PREFIX_CACHE = "DL4J_TPU_PREFIX_CACHE"
     DL4J_TPU_QUANT = "DL4J_TPU_QUANT"
     DL4J_TPU_QUANT_MAX_DIVERGENCE = "DL4J_TPU_QUANT_MAX_DIVERGENCE"
     DL4J_TPU_QUANT_MIN_TOP1 = "DL4J_TPU_QUANT_MIN_TOP1"
@@ -126,6 +127,7 @@ class SystemProperties:
     DECODE_MAX_TOKENS = "decode_max_tokens"
     KV_BLOCK_SIZE = "kv_block_size"
     SPEC_DRAFT_K = "spec_draft_k"
+    PREFIX_CACHE = "prefix_cache"
     QUANT = "quant"
     QUANT_MAX_DIVERGENCE = "quant_max_divergence"
     QUANT_MIN_TOP1 = "quant_min_top1"
@@ -199,6 +201,7 @@ _ENV_FOR_PROP = {
         EnvironmentVars.DL4J_TPU_DECODE_MAX_TOKENS,
     SystemProperties.KV_BLOCK_SIZE: EnvironmentVars.DL4J_TPU_KV_BLOCK_SIZE,
     SystemProperties.SPEC_DRAFT_K: EnvironmentVars.DL4J_TPU_SPEC_DRAFT_K,
+    SystemProperties.PREFIX_CACHE: EnvironmentVars.DL4J_TPU_PREFIX_CACHE,
     SystemProperties.QUANT: EnvironmentVars.DL4J_TPU_QUANT,
     SystemProperties.QUANT_MAX_DIVERGENCE:
         EnvironmentVars.DL4J_TPU_QUANT_MAX_DIVERGENCE,
@@ -283,6 +286,7 @@ _DEFAULTS = {
     SystemProperties.DECODE_SLOTS: "8",
     SystemProperties.DECODE_MAX_CTX: "256",
     SystemProperties.DECODE_MAX_TOKENS: "128",
+    SystemProperties.PREFIX_CACHE: "1",
     SystemProperties.QUANT: "",            # "" = quantized deploys opt-in
     SystemProperties.QUANT_MAX_DIVERGENCE: "0.25",
     SystemProperties.QUANT_MIN_TOP1: "0.99",
@@ -604,6 +608,19 @@ class Environment:
 
     def set_spec_draft_k(self, n: int):
         return self.set_property(SystemProperties.SPEC_DRAFT_K, int(n))
+
+    def prefix_cache_enabled(self) -> bool:
+        """Whether DecodeEngine content-addresses KV blocks by token
+        prefix and reuses them across requests/turns
+        (``DL4J_TPU_PREFIX_CACHE``, on by default; greedy output is
+        token-identical either way — disable only to reproduce
+        cold-prefill timing)."""
+        return self.property(SystemProperties.PREFIX_CACHE) not in (
+            "0", "false", "off", None)
+
+    def set_prefix_cache(self, v: bool):
+        return self.set_property(SystemProperties.PREFIX_CACHE,
+                                 "1" if v else "0")
 
     # -- quantized-serving knobs (quant/, serving/registry.py) -------------
     def quant_mode(self) -> str:

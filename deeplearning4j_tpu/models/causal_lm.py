@@ -310,33 +310,75 @@ def _block_coords(tables, positions, block_size):
 
 
 def paged_prefill(params, cache, input_ids, tables, lengths,
-                  config: CausalLMConfig):
-    """Batched prefill into the paged cache: fill each row's blocks from
-    its padded prompt in ONE dispatch.
+                  config: CausalLMConfig, start_pos=None):
+    """Batched (optionally partial) prefill into the paged cache: fill
+    each row's uncached tail in ONE dispatch.
 
-    ``input_ids`` [B, T] are prompts zero-padded to the bucket, ``tables``
-    [B, MB] each row's block table (unallocated columns -> scratch 0),
-    ``lengths`` [B] the real prompt lengths. All B*T rows are written —
-    padding rows land in the rows' own blocks past ``lengths`` (masked
-    out of every later attention) or in the scratch block. Returns
-    ``(cache, logits[B, V])`` with each row's logits taken at position
-    ``lengths[b]-1``: the distribution of the row's first generated
-    token."""
+    ``input_ids`` [B, T] are the *tail* tokens zero-padded to the bucket
+    (for a cold prefill the tail is the whole prompt), ``tables`` [B, MB]
+    each row's block table (unallocated columns -> scratch 0), ``lengths``
+    [B] the real total prompt lengths, and ``start_pos`` [B] how many
+    leading rows are already committed in the row's blocks (0 = cold; a
+    prefix-cache hit attaches those blocks and prefills only positions
+    ``start_pos[b]..lengths[b]-1``). Tail row ``j`` sits at absolute
+    position ``start_pos[b]+j``; rows past the real tail
+    (``j >= lengths[b]-start_pos[b]``) are redirected to the scratch
+    block so fixed-shape padding writes can never clobber a committed —
+    possibly *shared* — block. Attention runs over the gathered block
+    view (cached prefix rows + the tail written this dispatch), masked
+    causally at each tail row's absolute position, so a warm tail is
+    numerically the same computation the cold prefill performs at those
+    positions. Returns ``(cache, logits[B, V])`` with each row's logits
+    taken at tail index ``lengths[b]-start_pos[b]-1``: the distribution
+    of the row's first generated token."""
+    from ..kernels import attention_dispatch
+
     c = config
     B, T = input_ids.shape
+    MB = tables.shape[1]
     Bs = cache["k"].shape[2]
-    h = _embed(params, input_ids, jnp.arange(T)[None, :], c)
-    tpos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
-    blk, off = _block_coords(tables, tpos, Bs)
+    C = MB * Bs
+    if start_pos is None:
+        start_pos = jnp.zeros((B,), jnp.int32)
+    pos = start_pos[:, None] + jnp.arange(T)[None, :]           # [B, T]
+    h = _embed(params, input_ids,
+               jnp.clip(pos, 0, c.max_position_embeddings - 1), c)
+    assert attention_dispatch(T, paged=True) == "paged"
+    valid = jnp.arange(T)[None, :] < (lengths - start_pos)[:, None]
+    blk, off = _block_coords(tables, pos, Bs)
+    blk = jnp.where(valid, blk, 0)          # padding rows -> scratch block
+    key_mask = jnp.arange(C)[None, None, :] <= pos[:, :, None]  # [B, T, C]
+    scale = c.head_dim ** -0.5
     cache_k, cache_v = cache["k"], cache["v"]
     for i, layer in enumerate(params["layers"]):
-        h, (k, v) = _causal_block(layer, h, c)
+        a = layer["attn"]
+        q = jnp.einsum("bte,ehd->bthd", h, dequantize(a["wq"], h.dtype)) \
+            + a["bq"]
+        k = jnp.einsum("bte,ehd->bthd", h, dequantize(a["wk"], h.dtype)) \
+            + a["bk"]
+        v = jnp.einsum("bte,ehd->bthd", h, dequantize(a["wv"], h.dtype)) \
+            + a["bv"]
         cache_k = cache_k.at[blk, i, off].set(
             k.astype(cache_k.dtype), mode="drop")
         cache_v = cache_v.at[blk, i, off].set(
             v.astype(cache_v.dtype), mode="drop")
+        # gather each row's blocks into its contiguous [C] key view: the
+        # cached prefix rows plus the tail rows written just above
+        ks = jnp.take(cache_k[:, i], tables, axis=0).reshape(
+            B, C, c.num_heads, c.head_dim)
+        vs = jnp.take(cache_v[:, i], tables, axis=0).reshape(
+            B, C, c.num_heads, c.head_dim)
+        att = jnp.einsum("bqhd,bchd->bhqc", q, ks,
+                         preferred_element_type=jnp.float32) * scale
+        att = jnp.where(key_mask[:, None], att, _BIG_NEG)
+        probs = jax.nn.softmax(att, axis=-1).astype(h.dtype)
+        ctx = jnp.einsum("bhqc,bchd->bqhd", probs, vs)
+        out = jnp.einsum("bqhd,hde->bqe", ctx,
+                         dequantize(a["wo"], h.dtype)) + a["bo"]
+        h = _mlp_ln(layer, h, out, c)
     last = jnp.take_along_axis(
-        h, jnp.clip(lengths - 1, 0, T - 1)[:, None, None], axis=1)[:, 0]
+        h, jnp.clip(lengths - start_pos - 1, 0, T - 1)[:, None, None],
+        axis=1)[:, 0]
     return {"k": cache_k, "v": cache_v}, _lm_logits(params, last)
 
 
@@ -428,9 +470,10 @@ class CausalLM:
     def init_paged_kv_cache(self, num_blocks: int, block_size: int) -> Dict:
         return init_paged_kv_cache(self.config, num_blocks, block_size)
 
-    def paged_prefill(self, params, cache, input_ids, tables, lengths):
+    def paged_prefill(self, params, cache, input_ids, tables, lengths,
+                      start_pos=None):
         return paged_prefill(params, cache, input_ids, tables, lengths,
-                             self.config)
+                             self.config, start_pos)
 
     def paged_decode(self, params, cache, tables, tokens, lengths):
         return paged_decode(params, cache, tables, tokens, lengths,

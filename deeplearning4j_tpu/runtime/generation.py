@@ -20,6 +20,22 @@ al. (2023) draft-model speculative decoding:
   sequence (LIFO), returns its blocks, and requeues it at the head of the
   queue with its generated prefix — recompute-style preemption that keeps
   greedy output token-identical.
+- **prefix-aware KV reuse** (RadixAttention, SGLang) — blocks are
+  content-addressed by token prefix: the refcounted allocator plus a
+  radix tree keyed on block-aligned token bytes let an admitted prompt
+  attach the longest cached block run (refcount++) and prefill only the
+  uncached tail (``paged_prefill``'s ``start_pos`` entry — same
+  executables, zero steady-state recompiles). Completed/preempted
+  requests *release* refs instead of freeing; their committed full blocks
+  stay cached, so a shared system prompt is prefilled once per fleet
+  replica and a multi-turn session's next turn re-attaches its whole
+  history. Cold cached leaves are reclaimed LRU as the primary reclaim
+  path (LIFO preemption stays the backstop); block-aligned sharing means
+  a shared block is never written by an attacher — the copy-on-write
+  fork is simply a fresh block at the divergence point. Greedy output is
+  token-identical to cold prefill by construction. Gate with
+  ``prefix_cache=`` / ``deploy(decode_prefix_cache=)`` /
+  ``DL4J_TPU_PREFIX_CACHE``.
 - **prefill/decode split with batched prefill** — queued prompts that pad
   to the same prompt bucket are coalesced into ONE fixed-shape jitted
   ``prefill`` dispatch (prompt padded up the bucket ladder, group padded
@@ -58,7 +74,9 @@ Observability: ``dl4j_decode_requests_total``, ``dl4j_decode_tokens_total``,
 ``dl4j_decode_steps_total``, ``dl4j_decode_active_slots``,
 ``dl4j_decode_queue_depth``, ``dl4j_kv_blocks_free{model}``,
 ``dl4j_decode_preempted_total``, ``dl4j_spec_proposed_tokens_total`` /
-``dl4j_spec_accepted_tokens_total``, ``dl4j_decode_ttft_seconds``
+``dl4j_spec_accepted_tokens_total``,
+``dl4j_kv_prefix_{hits,misses,evictions}_total``,
+``dl4j_kv_prefix_blocks{model}``, ``dl4j_decode_ttft_seconds``
 (exemplared with trace ids). Each request's trace gains a
 ``generation/prefill`` span (queue wait + prompt dispatch, TTFT) and a
 ``generation/decode`` span (first token → finish), so ``/debug/requests``
@@ -137,7 +155,8 @@ def sample_tokens(logits, temperature, top_k, key):
 class _GenRequest:
     __slots__ = ("prompt", "max_tokens", "temperature", "top_k", "eos",
                  "on_token", "future", "ctx", "deadline", "t_submit",
-                 "t_first", "tokens", "slot", "prefix", "admit_seq")
+                 "t_first", "tokens", "slot", "prefix", "admit_seq",
+                 "reuse_nodes", "start")
 
     def __init__(self, prompt, max_tokens, temperature, top_k, eos,
                  on_token, deadline, ctx):
@@ -158,20 +177,28 @@ class _GenRequest:
         # every generated token when the request is preempted/requeued
         self.prefix = prompt              # np.int32 [>=T]
         self.admit_seq = -1               # LIFO preemption order
+        # prefix-cache attachment planned at admission: the radix nodes
+        # whose blocks this request shares, covering rows [0, start)
+        self.reuse_nodes: List = []
+        self.start = 0
 
     def expired(self) -> bool:
         return self.deadline is not None and time.monotonic() >= self.deadline
 
 
 class _BlockAllocator:
-    """Free-list allocator over KV-pool block ids ``1..total`` (block 0 is
-    the scratch block and is never handed out). Callers hold the engine's
-    scheduler lock around every operation."""
+    """Refcounted free-list allocator over KV-pool block ids ``1..total``
+    (block 0 is the scratch block and is never handed out). A block is
+    freed only when its refcount reaches zero: a slot's block table holds
+    one ref per appearance, and the radix prefix cache holds one per
+    cached node — so a completed request *releases* shared blocks instead
+    of freeing them. Callers hold the engine's scheduler lock around
+    every operation."""
 
     def __init__(self, total: int):
         self.total = int(total)
         self._free = list(range(self.total, 0, -1))  # pop() yields 1 first
-        self._used: set = set()
+        self._refs: Dict[int, int] = {}
 
     @property
     def free_count(self) -> int:
@@ -179,33 +206,196 @@ class _BlockAllocator:
 
     @property
     def used_count(self) -> int:
-        return len(self._used)
+        return len(self._refs)
 
     def alloc(self, n: int) -> Optional[List[int]]:
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
-        self._used.update(out)
+        for b in out:
+            self._refs[b] = 1
         return out
 
-    def free(self, ids) -> int:
-        """Return blocks to the pool; double-frees and id 0 are ignored
-        (the reconcile pass repairs, it must never corrupt)."""
+    def ref(self, block: int) -> int:
+        return self._refs.get(int(block), 0)
+
+    def incref(self, ids) -> None:
+        """Add one ref per id (attaching a cached block to another owner).
+        Unknown ids are ignored — only live blocks can be shared."""
+        for b in ids:
+            b = int(b)
+            if b in self._refs:
+                self._refs[b] += 1
+
+    def decref(self, ids) -> int:
+        """Drop one ref per id; a block reaching zero returns to the
+        pool. Unknown ids and id 0 are ignored (the reconcile pass
+        repairs, it must never corrupt). Returns how many blocks were
+        actually freed."""
         n = 0
         for b in ids:
             b = int(b)
-            if b in self._used:
-                self._used.discard(b)
+            r = self._refs.get(b)
+            if r is None:
+                continue
+            if r <= 1:
+                del self._refs[b]
                 self._free.append(b)
                 n += 1
+            else:
+                self._refs[b] = r - 1
         return n
 
-    def reset_to(self, used_ids) -> None:
-        """Rebuild the free list so exactly ``used_ids`` are outstanding
-        (block-accounting repair)."""
-        self._used = {int(b) for b in used_ids if 0 < int(b) <= self.total}
+    # the historical name: releasing a plain (refcount-1) allocation is
+    # exactly a decref
+    free = decref
+
+    def refcounts(self) -> Dict[int, int]:
+        return dict(self._refs)
+
+    def reset_to(self, expected) -> None:
+        """Rebuild so exactly ``expected`` is outstanding
+        (block-accounting repair): a ``{block: refcount}`` mapping, or a
+        bare iterable of ids meaning refcount 1 each."""
+        if not isinstance(expected, dict):
+            expected = {int(b): 1 for b in expected}
+        self._refs = {int(b): int(r) for b, r in expected.items()
+                      if 0 < int(b) <= self.total and int(r) > 0}
         self._free = [b for b in range(self.total, 0, -1)
-                      if b not in self._used]
+                      if b not in self._refs]
+
+
+class _RadixNode:
+    """One cached block: ``key`` is the block's exact token bytes,
+    ``block`` the pool block id holding those rows' KV. ``refs`` counts
+    the slots currently attached through this node (0 = evictable once
+    it is a leaf); ``digest`` is the chained prefix hash shown by
+    ``/debug/decode``."""
+    __slots__ = ("key", "digest", "block", "parent", "children", "refs",
+                 "last_used")
+
+    def __init__(self, key: bytes, digest: str, block: int, parent):
+        self.key = key
+        self.digest = digest
+        self.block = int(block)
+        self.parent = parent
+        self.children: Dict[bytes, "_RadixNode"] = {}
+        self.refs = 0
+        self.last_used = 0
+
+
+class _RadixCache:
+    """Radix tree over block-aligned token prefixes (RadixAttention,
+    SGLang): depth ``d`` holds a sequence's ``d``-th full KV block, keyed
+    by that block's exact token bytes — content-addressing by value, so
+    two requests sharing a system prompt resolve to the same nodes and
+    hash collisions are impossible (the sha1 ``digest`` chain is debug
+    display only). The tree holds one allocator ref per cached block;
+    attached slots add theirs on top. All mutations happen under the
+    engine's scheduler lock."""
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        self.root = _RadixNode(b"", "", 0, None)
+        self._nodes: set = set()
+        self._clock = 0
+        self.evictions = 0          # lifetime LRU evictions
+
+    @property
+    def size(self) -> int:
+        return len(self._nodes)
+
+    def nodes(self) -> List[_RadixNode]:
+        return list(self._nodes)
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def match(self, tokens) -> List[_RadixNode]:
+        """Longest run of cached full blocks prefixing ``tokens`` (walked
+        from the root); bumps the run's LRU stamps."""
+        out: List[_RadixNode] = []
+        node = self.root
+        bs = self.block_size
+        n = int(len(tokens))
+        i = 0
+        while i + bs <= n:
+            child = node.children.get(tokens[i:i + bs].tobytes())
+            if child is None:
+                break
+            out.append(child)
+            node = child
+            i += bs
+        t = self._tick()
+        for nd in out:
+            nd.last_used = t
+        return out
+
+    def insert(self, tokens, blocks) -> List[_RadixNode]:
+        """Record ``blocks[j]`` as the cached KV for token rows
+        ``[j*bs, (j+1)*bs)``. Existing nodes win — a duplicate block
+        (two identical prompts prefilled cold in one group) stays owned
+        by its slot and is freed on release. Returns the newly created
+        nodes; the caller takes the tree's allocator ref on each."""
+        import hashlib
+
+        node = self.root
+        bs = self.block_size
+        created: List[_RadixNode] = []
+        t = self._tick()
+        for j, block in enumerate(blocks):
+            if (j + 1) * bs > len(tokens):
+                break
+            key = tokens[j * bs:(j + 1) * bs].tobytes()
+            child = node.children.get(key)
+            if child is None:
+                digest = hashlib.sha1(
+                    node.digest.encode() + key).hexdigest()[:12]
+                child = _RadixNode(key, digest, int(block), node)
+                node.children[key] = child
+                self._nodes.add(child)
+                created.append(child)
+            child.last_used = t
+            node = child
+        return created
+
+    def lru_leaf(self) -> Optional[_RadixNode]:
+        """Least-recently-used unattached leaf (the next LRU eviction
+        victim), or None when nothing is evictable."""
+        best = None
+        for nd in self._nodes:
+            if nd.children or nd.refs > 0:
+                continue
+            if best is None or nd.last_used < best.last_used:
+                best = nd
+        return best
+
+    def remove(self, node: _RadixNode) -> None:
+        node.parent.children.pop(node.key, None)
+        self._nodes.discard(node)
+
+    def reclaimable_count(self, exclude=(), ref_fn=None) -> int:
+        """Blocks reclaimable by cascading leaf eviction: nodes whose
+        entire subtree is unattached (and not in ``exclude`` — admission
+        excludes the nodes a forming prefill group is about to attach).
+        ``ref_fn(block)`` is the allocator refcount: a node whose block
+        is still owned elsewhere (an active slot inserted it) can be
+        *removed* but frees nothing, so it is not counted."""
+        ex = set(exclude)
+
+        def walk(nd):
+            n, all_ok = 0, True
+            for ch in nd.children.values():
+                cn, ok = walk(ch)
+                n += cn
+                all_ok = all_ok and ok
+            if all_ok and nd.refs == 0 and nd not in ex:
+                frees = ref_fn is None or ref_fn(nd.block) <= 1
+                return (n + 1 if frees else n), True
+            return n, False
+
+        return sum(walk(ch)[0] for ch in self.root.children.values())
 
 
 def _shard_kv_pool(mesh, cache_tree):
@@ -254,7 +444,9 @@ class DecodeEngine:
     (default: slab-equivalent, ``slots * ceil(max_ctx/block_size)``);
     ``prefill_batch`` caps how many same-bucket prompts share one prefill
     dispatch; ``draft_model`` + ``spec_k`` (``DL4J_TPU_SPEC_DRAFT_K``)
-    enable greedy speculative decoding.
+    enable greedy speculative decoding; ``prefix_cache``
+    (``DL4J_TPU_PREFIX_CACHE``, default on) enables content-addressed
+    KV-block reuse across requests and turns.
     """
 
     def __init__(self, model, *, slots: Optional[int] = None,
@@ -265,6 +457,7 @@ class DecodeEngine:
                  kv_blocks: Optional[int] = None,
                  prefill_batch: Optional[int] = None,
                  draft_model=None, spec_k: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
                  model_name: str = "default",
                  mesh=None, param_spec=None):
         if not is_generative_model(model):
@@ -348,6 +541,13 @@ class DecodeEngine:
         self._tables = np.zeros((S, self.max_blocks), np.int32)
         self._nblocks = np.zeros(S, np.int32)
         self._alloc = _BlockAllocator(self.kv_blocks)
+        # content-addressed prefix reuse over the block pool
+        # (DL4J_TPU_PREFIX_CACHE / deploy(decode_prefix_cache=))
+        pc = (prefix_cache if prefix_cache is not None
+              else env.prefix_cache_enabled())
+        self._prefix_cache = bool(pc)
+        self._radix = _RadixCache(self.block_size)
+        self._slot_nodes: List[List[_RadixNode]] = [[] for _ in range(S)]
         self._slot_req: List[Optional[_GenRequest]] = [None] * S
         self._active_n = 0
         self._admit_counter = 0
@@ -371,8 +571,10 @@ class DecodeEngine:
         self._stats_lock = ordered_lock("decode.stats")
         self._stats = {"requests": 0, "tokens": 0, "decode_steps": 0,
                        "prefills": 0, "prefill_dispatches": 0,
-                       "expired": 0, "preempted": 0, "spec_steps": 0,
-                       "spec_proposed": 0, "spec_accepted": 0}
+                       "prefill_rows": 0, "expired": 0, "preempted": 0,
+                       "spec_steps": 0, "spec_proposed": 0,
+                       "spec_accepted": 0, "prefix_hits": 0,
+                       "prefix_misses": 0, "prefix_reused_rows": 0}
         self._build_steps()
         reg = registry()
         self._reg = reg
@@ -430,6 +632,22 @@ class DecodeEngine:
             "dl4j_spec_accepted_tokens_total",
             "Draft tokens accepted (verified equal to the target model's "
             "greedy choice) by speculative decode steps")
+        self._m_prefix_hits = reg.counter(
+            "dl4j_kv_prefix_hits_total",
+            "Admitted prompts that attached at least one cached KV block "
+            "from the radix prefix cache (tail-only prefill)")
+        self._m_prefix_misses = reg.counter(
+            "dl4j_kv_prefix_misses_total",
+            "Admitted prompts that found no cached KV prefix and "
+            "prefilled cold")
+        self._m_prefix_evictions = reg.counter(
+            "dl4j_kv_prefix_evictions_total",
+            "Cached KV blocks reclaimed from the radix prefix cache "
+            "(LRU leaf eviction — the primary reclaim path)")
+        self._m_prefix_blocks = reg.gauge(
+            "dl4j_kv_prefix_blocks",
+            "KV-pool blocks currently held by the radix prefix cache",
+            labels=("model",)).labels(model=self.model_name)
 
     # -- jitted steps ------------------------------------------------------
     def _build_steps(self):
@@ -437,22 +655,26 @@ class DecodeEngine:
         draft = self.draft if self._spec_enabled else None
         k = self.spec_k
 
-        def prefill_fn(params, cache, ids, tables, lengths, temps,
+        def prefill_fn(params, cache, ids, tables, lengths, starts, temps,
                        top_ks, seed, step):
+            # starts [B]: rows already committed by attached prefix-cache
+            # blocks — the dispatch prefills only the tail (all-zero for
+            # a cold prefill; traced, so warm and cold tails share one
+            # executable per (bucket, batch) rung)
             cache, logits = model.paged_prefill(params, cache, ids,
-                                                tables, lengths)
+                                                tables, lengths, starts)
             key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
             toks = sample_tokens(logits, temps, top_ks, key)
             return cache, toks
 
         def prefill_draft_fn(params, dparams, cache, dcache, ids, tables,
-                             lengths, temps, top_ks, seed, step):
+                             lengths, starts, temps, top_ks, seed, step):
             # the draft cache must hold the same committed rows as the
             # target's, so the draft prefills inside the same dispatch
             cache, logits = model.paged_prefill(params, cache, ids,
-                                                tables, lengths)
+                                                tables, lengths, starts)
             dcache, _ = draft.paged_prefill(dparams, dcache, ids, tables,
-                                            lengths)
+                                            lengths, starts)
             key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
             toks = sample_tokens(logits, temps, top_ks, key)
             return cache, dcache, toks
@@ -526,10 +748,12 @@ class DecodeEngine:
         self._decode = counted_jit(decode_fn, "decode" + suffix,
                                    donate_argnums=(1,))
 
-    def _run_prefill(self, ids, tables, lengths, temps, top_ks):
-        """One batched prefill dispatch: ``ids`` [B, Tb] padded prompts,
-        ``tables`` [B, MB] the target slots' block tables, ``lengths``
-        [B] real prompt lengths. Returns the B first sampled tokens."""
+    def _run_prefill(self, ids, tables, lengths, starts, temps, top_ks):
+        """One batched prefill dispatch: ``ids`` [B, Tb] padded prompt
+        *tails*, ``tables`` [B, MB] the target slots' block tables,
+        ``lengths`` [B] real total prompt lengths, ``starts`` [B] rows
+        already committed by attached cached blocks (0 = cold). Returns
+        the B first sampled tokens."""
         if faults.active():
             faults.check("decode.prefill", batch=ids.shape[0],
                          bucket=ids.shape[1])
@@ -538,6 +762,7 @@ class DecodeEngine:
             try:
                 args = (jnp.asarray(ids), jnp.asarray(tables),
                         jnp.asarray(lengths),
+                        jnp.asarray(starts, jnp.int32),
                         jnp.asarray(temps, jnp.float32),
                         jnp.asarray(top_ks, jnp.int32),
                         jnp.asarray(self._seed, jnp.int32),
@@ -627,6 +852,7 @@ class DecodeEngine:
                                       np.zeros((bb, self.max_blocks),
                                                np.int32),
                                       np.ones(bb, np.int32),
+                                      np.zeros(bb, np.int32),
                                       np.zeros(bb, np.float32),
                                       np.zeros(bb, np.int32))
                     self._warmed.add(key)
@@ -821,24 +1047,52 @@ class DecodeEngine:
                         leaked[0][1])
         block_drift = 0
         with self._cv:
-            # a free slot must hold zero blocks; return any strays
+            # a free slot must hold zero blocks and zero cache
+            # attachments; a crashed/cancelled rider's blocks are
+            # *decref'd* (not freed): a block shared with the radix cache
+            # or another slot survives with its remaining refs
             for slot, req in enumerate(self._slot_req):
                 nb = int(self._nblocks[slot])
-                if req is None and nb > 0:
+                if req is None and (nb > 0 or self._slot_nodes[slot]):
                     block_drift += nb
-                    self._alloc.free(self._tables[slot, :nb])
+                    self._alloc.decref(self._tables[slot, :nb])
+                    for nd in self._slot_nodes[slot]:
+                        nd.refs = max(0, nd.refs - 1)
+                    self._slot_nodes[slot] = []
                     self._tables[slot, :] = 0
                     self._nblocks[slot] = 0
-            expected = {int(b)
-                        for slot, req in enumerate(self._slot_req)
-                        if req is not None
-                        for b in self._tables[slot,
-                                              :int(self._nblocks[slot])]}
-            if expected != self._alloc._used:
-                block_drift += len(expected ^ self._alloc._used)
+            # expected refcounts: one per appearance in an occupied
+            # slot's table + one per radix-cache node
+            expected: Dict[int, int] = {}
+            for slot, req in enumerate(self._slot_req):
+                if req is None:
+                    continue
+                for b in self._tables[slot, :int(self._nblocks[slot])]:
+                    expected[int(b)] = expected.get(int(b), 0) + 1
+            for nd in self._radix.nodes():
+                expected[nd.block] = expected.get(nd.block, 0) + 1
+            actual = self._alloc.refcounts()
+            if expected != actual:
+                block_drift += len(
+                    {b for b in set(expected) | set(actual)
+                     if expected.get(b, 0) != actual.get(b, 0)})
                 self._alloc.reset_to(expected)
+            # node attachment counts must mirror the slots' lists
+            want_refs: Dict[int, int] = {}
+            for slot, req in enumerate(self._slot_req):
+                if req is None:
+                    continue
+                for nd in self._slot_nodes[slot]:
+                    want_refs[id(nd)] = want_refs.get(id(nd), 0) + 1
+            for nd in self._radix.nodes():
+                want = want_refs.get(id(nd), 0)
+                if nd.refs != want:
+                    block_drift += 1
+                    nd.refs = want
             free = self._alloc.free_count
+            cached = self._radix.size
         self._m_blocks_free.set(free)
+        self._m_prefix_blocks.set(cached)
         if block_drift:
             self._m_block_leaks.inc(block_drift)
             log.warning("KV block accounting drifted by %d blocks; "
@@ -851,18 +1105,100 @@ class DecodeEngine:
         return _cdiv(min(int(rows), self.max_ctx), self.block_size)
 
     def _grow_slot(self, slot: int, rows: int) -> bool:
-        """Extend ``slot``'s block table to cover ``rows`` rows; returns
-        False when the pool cannot satisfy it. Caller holds ``_cv``."""
+        """Extend ``slot``'s block table to cover ``rows`` rows, evicting
+        LRU cached leaves when the free list alone cannot satisfy it;
+        returns False when the pool cannot satisfy it at all. Caller
+        holds ``_cv``."""
         need = self._blocks_for(rows)
         have = int(self._nblocks[slot])
         if need <= have:
             return True
-        got = self._alloc.alloc(need - have)
+        n = need - have
+        if n > self._alloc.free_count:
+            self._evict_for(n)
+        got = self._alloc.alloc(n)
         if got is None:
             return False
         self._tables[slot, have:need] = got
         self._nblocks[slot] = need
         return True
+
+    def _evict_for(self, n: int) -> int:
+        """LRU-evict unattached radix leaves until ``n`` blocks are free
+        (the primary reclaim path — LIFO preemption stays the backstop
+        when the cache has nothing left to give). Removing a leaf can
+        expose its parent as the next candidate, so whole cold chains
+        unwind oldest-first. Caller holds ``_cv``."""
+        evicted = 0
+        while self._alloc.free_count < n:
+            leaf = self._radix.lru_leaf()
+            if leaf is None:
+                break
+            self._radix.remove(leaf)
+            self._alloc.decref([leaf.block])
+            evicted += 1
+        if evicted:
+            self._radix.evictions += evicted
+            self._m_prefix_evictions.inc(evicted)
+            self._m_prefix_blocks.set(self._radix.size)
+        return evicted
+
+    def _available_blocks(self, exclude=()) -> int:
+        """Blocks obtainable without preempting anyone: the free list
+        plus everything LRU eviction could actually free. Caller holds
+        ``_cv``."""
+        return self._alloc.free_count + self._radix.reclaimable_count(
+            exclude, self._alloc.ref)
+
+    def _match_prefix(self, req: _GenRequest):
+        """Longest cached full-block run prefixing ``req.prefix``, capped
+        so at least one tail token remains to prefill (the logits of the
+        request's first generated token must come from a real dispatch).
+        Returns ``(nodes, rows)``. Caller holds ``_cv``."""
+        if not self._prefix_cache:
+            return [], 0
+        nodes = self._radix.match(req.prefix)
+        max_rows = len(req.prefix) - 1
+        while nodes and len(nodes) * self.block_size > max_rows:
+            nodes.pop()
+        return nodes, len(nodes) * self.block_size
+
+    def _attach_nodes(self, slot: int, req: _GenRequest) -> None:
+        """Share the matched cached blocks into ``slot``'s table:
+        refcount++ on each block, attachment++ on each node (pinning it
+        against eviction). The request then prefills only its tail — the
+        shared blocks are never written (tail and decode rows land in
+        blocks allocated at the divergence point: the copy-on-write
+        fork). Caller holds ``_cv``."""
+        k = len(req.reuse_nodes)
+        if k == 0:
+            return
+        blocks = [nd.block for nd in req.reuse_nodes]
+        self._tables[slot, :k] = blocks
+        self._nblocks[slot] = k
+        self._alloc.incref(blocks)
+        for nd in req.reuse_nodes:
+            nd.refs += 1
+        self._slot_nodes[slot] = list(req.reuse_nodes)
+
+    def _cache_slot_prefix(self, slot: int, req: _GenRequest) -> None:
+        """Insert the slot's committed full blocks into the radix tree
+        (tree takes one allocator ref per newly cached block) so a later
+        request — or this rider itself after a preemption — can
+        re-attach them instead of re-prefilling. Caller holds ``_cv``."""
+        if not self._prefix_cache:
+            return
+        committed = int(self._lengths[slot])
+        full = committed // self.block_size
+        if full <= 0:
+            return
+        seq = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)]
+        ).astype(np.int32)[:committed]
+        blocks = [int(b) for b in self._tables[slot, :full]]
+        for nd in self._radix.insert(seq, blocks):
+            self._alloc.incref([nd.block])
+        self._m_prefix_blocks.set(self._radix.size)
 
     def _blocks_deficit(self, horizon: int) -> int:
         """Additional pool blocks the active set needs so every rider can
@@ -883,7 +1219,7 @@ class DecodeEngine:
         while True:
             victim = failed = None
             with self._cv:
-                if self._blocks_deficit(horizon) <= self._alloc.free_count:
+                if self._blocks_deficit(horizon) <= self._available_blocks():
                     for slot, req in enumerate(self._slot_req):
                         if req is not None:
                             ok = self._grow_slot(
@@ -917,7 +1253,12 @@ class DecodeEngine:
         blocks, and requeue it at the queue head with prompt + generated
         tokens as the new prefill prefix (greedy output stays
         token-identical: a prefill over the full prefix yields the same
-        next-token argmax the decode path would have)."""
+        next-token argmax the decode path would have). The victim's
+        committed full blocks are first inserted into the radix cache, so
+        on re-admit the regrown prefix re-attaches them (refcount++) and
+        the re-prefill covers only the uncached tail — unless pool
+        pressure LRU-evicted them meanwhile, in which case it recomputes
+        from scratch exactly as before."""
         with self._cv:
             if self._slot_req[slot] is not req:
                 return
@@ -926,6 +1267,7 @@ class DecodeEngine:
                  np.asarray(req.tokens, np.int32)]).astype(np.int32)
             self._pending.insert(0, req)
             depth = len(self._pending)
+            self._cache_slot_prefix(slot, req)
         self._release_slot(slot)
         req.slot = None
         with self._stats_lock:
@@ -939,10 +1281,14 @@ class DecodeEngine:
     def _admit_pending(self):
         """Fill free slots from the queue (the per-iteration join half of
         continuous batching: this runs between every decode step).
-        Requests that pad to the same prompt bucket are coalesced into
-        one batched prefill dispatch, capped by free slots, free blocks,
-        and ``prefill_batch``; the queue head is always first in its
-        group, so admission order cannot starve."""
+        Each queued prompt first walks the radix prefix cache: the
+        longest cached block run is attached (refcount++) and only the
+        uncached *tail* is prefilled, so requests are coalesced by TAIL
+        bucket — a warm multi-turn prompt and a fresh short prompt can
+        share one dispatch. Admission is capped by free slots, available
+        blocks (free + LRU-evictable cached), and ``prefill_batch``; the
+        queue head is always first in its group, so admission order
+        cannot starve."""
         while True:
             expired: List[_GenRequest] = []
             group: List[_GenRequest] = []
@@ -956,12 +1302,22 @@ class DecodeEngine:
                 self._m_queue.set(len(self._pending))
                 if self._pending and free_slots:
                     head = self._pending[0]
-                    bucket = bucket_for(len(head.prefix), self.ladder)
-                    budget = self._alloc.free_count
-                    need = self._blocks_for(len(head.prefix) + 1)
-                    if bucket is not None and need <= budget:
+                    h_nodes, h_start = self._match_prefix(head)
+                    bucket = bucket_for(len(head.prefix) - h_start,
+                                        self.ladder)
+                    # blocks promised to the group so far; matched nodes
+                    # are pinned out of the evictable budget (attachment
+                    # below makes the pin real before any eviction runs)
+                    pinned: set = set()
+                    committed = 0
+                    need = (self._blocks_for(len(head.prefix) + 1)
+                            - len(h_nodes))
+                    if bucket is not None and need <= \
+                            self._available_blocks(set(h_nodes)):
+                        head.reuse_nodes, head.start = h_nodes, h_start
+                        pinned.update(h_nodes)
+                        committed += need
                         group.append(head)
-                        budget -= need
                         cap = min(len(free_slots), self.prefill_batch)
                         for req in self._pending[1:]:
                             if len(group) >= cap:
@@ -969,18 +1325,29 @@ class DecodeEngine:
                             if req.expired():
                                 expired.append(req)
                                 continue
-                            if bucket_for(len(req.prefix),
+                            r_nodes, r_start = self._match_prefix(req)
+                            if bucket_for(len(req.prefix) - r_start,
                                           self.ladder) != bucket:
                                 continue
-                            need = self._blocks_for(len(req.prefix) + 1)
-                            if need > budget:
+                            need = (self._blocks_for(len(req.prefix) + 1)
+                                    - len(r_nodes))
+                            if committed + need > self._available_blocks(
+                                    pinned | set(r_nodes)):
                                 continue
+                            req.reuse_nodes, req.start = r_nodes, r_start
+                            pinned.update(r_nodes)
+                            committed += need
                             group.append(req)
-                            budget -= need
                         for req in group + expired:
                             if req in self._pending:
                                 self._pending.remove(req)
                         slots_for = free_slots[:len(group)]
+                        # attach every member's cached run BEFORE any
+                        # grow: attachment pins the nodes, so one
+                        # member's eviction can never free a block
+                        # another member matched
+                        for req, slot in zip(group, slots_for):
+                            self._attach_nodes(slot, req)
                         for req, slot in zip(group, slots_for):
                             ok = self._grow_slot(slot,
                                                  len(req.prefix) + 1)
@@ -1000,7 +1367,10 @@ class DecodeEngine:
                     with self._cv:
                         blks = self._tables[slot,
                                             :int(self._nblocks[slot])]
-                        self._alloc.free(blks)
+                        self._alloc.decref(blks)
+                        for nd in self._slot_nodes[slot]:
+                            nd.refs = max(0, nd.refs - 1)
+                        self._slot_nodes[slot] = []
                         self._tables[slot, :] = 0
                         self._nblocks[slot] = 0
                         self._m_blocks_free.set(self._alloc.free_count)
@@ -1023,29 +1393,50 @@ class DecodeEngine:
 
     def _start_group(self, group: List[_GenRequest], slots: List[int],
                      bucket: int):
-        """Prefill a same-bucket group of prompts in ONE dispatch (padded
-        up the batch ladder; padding rows write the scratch block) and
-        sample each request's first token (the TTFT-defining dispatch)."""
+        """Prefill a same-TAIL-bucket group of prompts in ONE dispatch
+        (padded up the batch ladder; padding rows write the scratch
+        block) and sample each request's first token (the TTFT-defining
+        dispatch). A member with an attached cached prefix ships only its
+        uncached tail — ``starts[r]`` rows are already committed in its
+        shared blocks."""
         B = len(group)
         bb = bucket_for(B, self.batch_ladder)
         ids = np.zeros((bb, bucket), np.int32)
         tables = np.zeros((bb, self.max_blocks), np.int32)
         lengths = np.ones(bb, np.int32)
+        starts = np.zeros(bb, np.int32)
         temps = np.zeros(bb, np.float32)
         topks = np.zeros(bb, np.int32)
         for r, (req, slot) in enumerate(zip(group, slots)):
             p = req.prefix
-            ids[r, :p.size] = p
+            s = int(req.start)
+            tail = p[s:]
+            ids[r, :tail.size] = tail
             tables[r] = self._tables[slot]
             lengths[r] = p.size
+            starts[r] = s
             temps[r] = req.temperature
             topks[r] = req.top_k
         t0 = time.perf_counter()
-        toks = self._run_prefill(ids, tables, lengths, temps, topks)
+        toks = self._run_prefill(ids, tables, lengths, starts, temps,
+                                 topks)
         t_done = time.perf_counter()
+        hits = sum(1 for req in group if req.start > 0)
+        reused = int(sum(req.start for req in group))
         with self._stats_lock:
             self._stats["prefills"] += B
             self._stats["prefill_dispatches"] += 1
+            self._stats["prefill_rows"] += int(
+                sum(len(req.prefix) - req.start for req in group))
+            if self._prefix_cache:
+                self._stats["prefix_hits"] += hits
+                self._stats["prefix_misses"] += B - hits
+                self._stats["prefix_reused_rows"] += reused
+        if self._prefix_cache:
+            if hits:
+                self._m_prefix_hits.inc(hits)
+            if B - hits:
+                self._m_prefix_misses.inc(B - hits)
         for r, (req, slot) in enumerate(zip(group, slots)):
             tok = int(toks[r])
             first = req.t_first is None
@@ -1060,6 +1451,7 @@ class DecodeEngine:
                     tracer().record(
                         "generation/prefill", t0, t_done, context=req.ctx,
                         slot=slot, prompt_tokens=int(req.prefix.size),
+                        cached_tokens=int(req.start),
                         bucket=bucket, batch=B,
                         queue_s=round(t0 - req.t_submit, 6))
             req.slot = slot
@@ -1073,6 +1465,12 @@ class DecodeEngine:
             self._lengths[slot] = int(req.prefix.size)
             self._temps[slot] = req.temperature
             self._topks[slot] = req.top_k
+            with self._cv:
+                # publish the just-committed prompt blocks: a storm
+                # follower sharing this prompt attaches them while this
+                # rider is still decoding (decode writes land strictly
+                # past the prefix, never inside a published block)
+                self._cache_slot_prefix(slot, req)
             self._emit_token(req, tok)
             self._check_stop(req, slot, tok)
 
@@ -1096,7 +1494,7 @@ class DecodeEngine:
                     return False
                 if int(self._lengths[slot]) + k + 1 > self.max_ctx:
                     return False
-            return self._blocks_deficit(k + 1) <= self._alloc.free_count
+            return self._blocks_deficit(k + 1) <= self._available_blocks()
 
     def _decode_once(self):
         spec = self._spec_ready()
@@ -1174,6 +1572,11 @@ class DecodeEngine:
             tracer().record("generation/decode", req.t_first or t_done,
                             t_done, context=req.ctx, slot=slot,
                             tokens=len(req.tokens), finish_reason=reason)
+        with self._cv:
+            # cache prompt + generated full blocks for the session's next
+            # turn (the client re-sends its history: the warm turn
+            # attaches these and prefills only the new user tail)
+            self._cache_slot_prefix(slot, req)
         self._release_slot(slot)
         ttft = ((req.t_first - req.t_submit)
                 if req.t_first is not None else None)
@@ -1194,14 +1597,20 @@ class DecodeEngine:
             if self._slot_req[slot] is not None:
                 self._slot_req[slot] = None
                 self._active_n -= 1
-            # the slot's blocks return to the pool; stale KV rows stay in
-            # them but lengths=0 + a zeroed table masks them out of every
-            # future attention (poison-value test)
+            # the slot RELEASES its blocks (refcount--): a block cached
+            # in the radix tree or shared with another slot survives
+            # with its remaining refs, the rest return to the pool.
+            # Stale KV rows stay in freed blocks but lengths=0 + a
+            # zeroed table masks them out of every future attention
+            # (poison-value test)
             nb = int(self._nblocks[slot])
             if nb > 0:
-                self._alloc.free(self._tables[slot, :nb])
+                self._alloc.decref(self._tables[slot, :nb])
                 self._tables[slot, :] = 0
                 self._nblocks[slot] = 0
+            for nd in self._slot_nodes[slot]:
+                nd.refs = max(0, nd.refs - 1)
+            self._slot_nodes[slot] = []
             self._lengths[slot] = 0
             self._tokens[slot] = 0
             free = self._alloc.free_count
@@ -1305,6 +1714,19 @@ class DecodeEngine:
                          "free_blocks": self._alloc.free_count,
                          "max_blocks_per_slot": self.max_blocks,
                          "scratch_block": 0},
+                "prefix_cache": {
+                    "enabled": self._prefix_cache,
+                    "cached_blocks": self._radix.size,
+                    "evictions": self._radix.evictions,
+                    # most-recently-used first, bounded for the endpoint
+                    "nodes": [{"digest": nd.digest, "block": nd.block,
+                               "refs": nd.refs,
+                               "children": len(nd.children),
+                               "last_used": nd.last_used}
+                              for nd in sorted(
+                                  self._radix.nodes(),
+                                  key=lambda n: -n.last_used)[:64]],
+                },
                 "prefill": {"batch": self.prefill_batch,
                             "buckets": list(self.ladder),
                             "batch_ladder": list(self.batch_ladder)},
@@ -1334,6 +1756,9 @@ class DecodeEngine:
             s["active_slots"] = self._active_n
             s["queued"] = len(self._pending)
             s["kv_blocks_free"] = self._alloc.free_count
+            s["prefix_cached_blocks"] = self._radix.size
+            s["prefix_evictions"] = self._radix.evictions
+        s["prefix_cache"] = self._prefix_cache
         s["slots"] = self.slots
         s["max_ctx"] = self.max_ctx
         s["prompt_buckets"] = list(self.ladder)

@@ -18,8 +18,11 @@ with least-loaded dispatch (admission EWMA x backlog, polled from each
 replica's ``/metrics.json``), readyz-aware membership, and the
 tail-tolerance layer: budgeted failover + hedged requests drawing from
 one fleet-wide :class:`~.router.RetryBudget`, outlier ejection over
-actual dispatch outcomes with probe re-admission, and brownout
-shedding by ``X-Priority`` when ready capacity drops.
+actual dispatch outcomes with probe re-admission, brownout
+shedding by ``X-Priority`` when ready capacity drops, and
+consistent-hash session affinity (``X-Session-Id`` / prompt-prefix
+fingerprint) that pins a chat session's turns to the replica whose
+decode engine holds its KV blocks in the radix prefix cache.
 :class:`~.router.FleetServer` is the HTTP front door;
 ``python -m deeplearning4j_tpu.serving.fleet --replicas ...`` runs it
 standalone. A joining replica pre-bakes the fleet's bucket ladder from
@@ -58,4 +61,5 @@ without an ``X-Priority`` header). Telemetry:
 :mod:`.router`).
 """
 from .router import (FleetRouter, FleetServer, MidStreamError,  # noqa: F401
-                     NoReplicaError, Replica, RetryBudget)
+                     NoReplicaError, Replica, RetryBudget,
+                     prompt_fingerprint)

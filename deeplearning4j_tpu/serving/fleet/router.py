@@ -65,6 +65,22 @@ lowest-priority traffic first (``X-Priority`` header 0–9, default
 ``DL4J_TPU_FLEET_DEFAULT_PRIORITY``) with 503 + ``Retry-After``, and
 tightens forwarded deadlines in proportion to the capacity deficit.
 
+Session affinity (prefix-cache locality): a request carrying a session
+key — the ``X-Session-Id`` header, or for generates without one a
+fingerprint of the prompt's leading tokens — is pinned to the replica
+that owns the key on a consistent-hash ring (``affinity_vnodes``
+virtual nodes per replica, so membership churn only remaps ~1/N of
+sessions). Follow-up turns of a chat session therefore land on the
+replica whose decode engine already holds the session's KV blocks in
+its radix prefix cache (``runtime.generation``). Affinity is strictly
+an *optimization*: when the ring owner is ejected, not ready, no
+longer serving the model, or the fleet is browned out, the request
+degrades to the normal least-loaded pick
+(``dl4j_fleet_affinity_total{outcome="fallback"}``), and a failed
+affine attempt fails over to least-loaded exactly like any other.
+Generates are never hedged (they are non-idempotent), so an affine
+generate never races a cold replica against the warm one.
+
 Fault sites for drills (``common.faults``): ``fleet.dispatch`` (ctx
 ``url``/``model``/``phase``: ``connect`` = connection failure or slow
 replica, ``body`` = truncated response / mid-stream reset) and
@@ -93,7 +109,8 @@ Telemetry: ``dl4j_fleet_replicas{model}``,
 ``dl4j_fleet_readmissions_total{replica}``, ``dl4j_fleet_ejected``,
 ``dl4j_fleet_poll_errors_total{replica,reason}``,
 ``dl4j_fleet_shed_total{model,priority}``, ``dl4j_fleet_brownout``,
-``dl4j_fleet_ready_fraction``.
+``dl4j_fleet_ready_fraction``,
+``dl4j_fleet_affinity_total{outcome}`` (``hit|fallback``).
 """
 from __future__ import annotations
 
@@ -104,6 +121,8 @@ import queue
 import re
 import threading
 import time
+import bisect
+import hashlib
 import urllib.error
 import urllib.request
 import zlib
@@ -283,8 +302,10 @@ class FleetRouter:
     ``replicas`` are base URLs (``http://host:port``). Poll cadence,
     failover retries, per-attempt timeout, retry-budget ratio, hedge
     percentile, and brownout fraction default to the
-    ``DL4J_TPU_FLEET_*`` env knobs; the ejection thresholds are
-    constructor-only (they are operator tuning, not deployment config).
+    ``DL4J_TPU_FLEET_*`` env knobs; the ejection thresholds and
+    ``affinity_vnodes`` (virtual nodes per replica on the session ring)
+    are constructor-only (they are operator tuning, not deployment
+    config).
     ``start_polling()`` runs the background refresh; tests can drive
     ``poll_once()`` directly."""
 
@@ -303,7 +324,8 @@ class FleetRouter:
                  eject_latency_z: float = 3.0,
                  eject_backoff_s: float = 5.0,
                  eject_max_backoff_s: float = 60.0,
-                 eject_max_frac: float = 0.5):
+                 eject_max_frac: float = 0.5,
+                 affinity_vnodes: int = 64):
         env = environment()
         self.poll_s = env.fleet_poll_s() if poll_s is None else float(poll_s)
         self.retries = env.fleet_retries() if retries is None \
@@ -330,6 +352,10 @@ class FleetRouter:
             else retry_budget, retry_burst)
         self._lock = ordered_lock("fleet.router")
         self._replicas: Dict[str, Replica] = {}
+        self.affinity_vnodes = max(int(affinity_vnodes), 1)
+        #: consistent-hash ring for session affinity: sorted
+        #: ``(hash, url)`` vnode entries, rebuilt on membership change
+        self._ring: List[Tuple[int, str]] = []
         #: per-model recent winner latencies (the hedge-delay basis)
         self._latencies: Dict[str, "list[float]"] = {}
         self._poll_thread: Optional[threading.Thread] = None
@@ -385,6 +411,12 @@ class FleetRouter:
         self._m_ready_frac = reg.gauge(
             "dl4j_fleet_ready_fraction",
             "Fraction of known replicas ready and not ejected")
+        self._m_affinity = reg.counter(
+            "dl4j_fleet_affinity_total",
+            "Session-affine routing decisions: hit = dispatched to the "
+            "ring owner, fallback = owner unusable, degraded to "
+            "least-loaded",
+            labels=("outcome",))
         self._m_tokens.set(self._budget.tokens)
         for url in replicas:
             self.add_replica(url, poll=False)
@@ -400,6 +432,7 @@ class FleetRouter:
             if existing is not None:
                 return existing
             self._replicas[rep.url] = rep
+            self._rebuild_ring_locked()
         if poll:
             self._poll_replica(rep)
             self._update_fleet_gauge()
@@ -408,9 +441,39 @@ class FleetRouter:
     def remove_replica(self, url: str) -> bool:
         with self._lock:
             gone = self._replicas.pop(url.rstrip("/"), None) is not None
+            if gone:
+                self._rebuild_ring_locked()
         if gone:
             self._update_fleet_gauge()
         return gone
+
+    def _rebuild_ring_locked(self):
+        """Recompute the consistent-hash ring from current membership.
+        Caller holds the lock. ``affinity_vnodes`` virtual nodes per
+        replica keep the key space evenly spread and bound remap churn
+        on membership change to ~1/N of sessions."""
+        ring: List[Tuple[int, str]] = []
+        for url in self._replicas:
+            for v in range(self.affinity_vnodes):
+                ring.append((zlib.crc32(f"{url}#{v}".encode()), url))
+        ring.sort()
+        self._ring = ring
+
+    @staticmethod
+    def session_hash(session_key: str) -> int:
+        return zlib.crc32(session_key.encode())
+
+    def affine_url(self, session_key: str) -> Optional[str]:
+        """The ring owner for ``session_key`` — health-blind; routing
+        applies the usability checks on top. Exposed for tests and the
+        ``/fleet`` debug view."""
+        h = self.session_hash(session_key)
+        with self._lock:
+            ring = self._ring
+            if not ring:
+                return None
+            idx = bisect.bisect_left(ring, (h, ""))
+            return ring[idx % len(ring)][1]
 
     def replicas(self) -> List[Replica]:
         with self._lock:
@@ -424,6 +487,8 @@ class FleetRouter:
         return {"poll_s": self.poll_s, "retries": self.retries,
                 "budget": budget,
                 "brownout": self.brownout_state(),
+                "affinity": {"vnodes": self.affinity_vnodes,
+                             "ring_size": len(self._ring)},
                 "replicas": [r.snapshot() for r in self.replicas()]}
 
     # -- polling ----------------------------------------------------------
@@ -678,6 +743,26 @@ class FleetRouter:
             reps.sort(key=lambda r: (r.score(model), r.dispatched, r.url))
         return reps
 
+    def _affine_replica(self, model: Optional[str],
+                        session_key: str) -> Optional[Replica]:
+        """The ring owner for ``session_key`` iff it is usable right
+        now: ready, not ejected, serving ``model`` (an unknown model
+        list still counts, mirroring ``_candidates``), and the fleet
+        not browned out — a browned-out fleet routes for capacity, not
+        cache locality. None means: degrade to least-loaded."""
+        if self.brownout_state()["active"]:
+            return None
+        url = self.affine_url(session_key)
+        if url is None:
+            return None
+        with self._lock:
+            rep = self._replicas.get(url)
+            if rep is None or not rep.ready or rep.ejected:
+                return None
+            if model is not None and rep.models and model not in rep.models:
+                return None
+            return rep
+
     def _pick(self, model: Optional[str], exclude: Sequence[str],
               strict: bool = False) -> Tuple[Optional[Replica], bool]:
         """Next replica for an attempt, ``(replica, is_probe)``. An
@@ -789,7 +874,8 @@ class FleetRouter:
               headers: Sequence[Tuple[str, str]] = (),
               model: Optional[str] = None,
               timeout_s: Optional[float] = None,
-              idempotent: Optional[bool] = None
+              idempotent: Optional[bool] = None,
+              session_key: Optional[str] = None
               ) -> Tuple[int, Dict[str, str], bytes, str]:
         """Route one HTTP request to the best replica with budgeted
         failover and (for idempotent requests) a budgeted hedge.
@@ -799,7 +885,12 @@ class FleetRouter:
         replica produced an HTTP answer at all; a mid-stream failure on
         a non-idempotent request raises :class:`MidStreamError` instead
         of retrying. ``idempotent`` defaults from the path: generate is
-        not, everything else is."""
+        not, everything else is. ``session_key`` requests prefix-cache
+        affinity: the first attempt goes to the key's consistent-hash
+        ring owner when that replica is usable
+        (``dl4j_fleet_affinity_total{outcome="hit"}``), else — or on
+        failover after the affine attempt fails — the normal
+        least-loaded pick applies (``outcome="fallback"``)."""
         timeout = self.timeout_s if timeout_s is None else float(timeout_s)
         if idempotent is None:
             idempotent = not path.split("?", 1)[0].endswith("/generate")
@@ -839,7 +930,13 @@ class FleetRouter:
                     return
                 self._account_abandoned(orep, okind, ores, ometa)
 
-        rep, probe = self._pick(model, tried)
+        rep, probe = None, False
+        if session_key is not None:
+            rep = self._affine_replica(model, session_key)
+            self._m_affinity.labels(
+                outcome="hit" if rep is not None else "fallback").inc()
+        if rep is None:
+            rep, probe = self._pick(model, tried)
         if rep is None:
             self._m_dispatch.labels(replica="", outcome="no_replica").inc()
             raise NoReplicaError(
@@ -1008,7 +1105,8 @@ class FleetRouter:
 
     # -- convenience client API -------------------------------------------
     def predict(self, model: str, inputs, *,
-                timeout_s: Optional[float] = None) -> dict:
+                timeout_s: Optional[float] = None,
+                session_key: Optional[str] = None) -> dict:
         """JSON predict against the least-loaded replica; returns the
         parsed response body. Non-2xx answers raise RuntimeError with
         the replica's error payload."""
@@ -1017,7 +1115,8 @@ class FleetRouter:
         status, _, payload, url = self.route(
             "POST", f"/v1/models/{model}/predict", body,
             headers=[("Content-Type", "application/json")],
-            model=model, timeout_s=timeout_s, idempotent=True)
+            model=model, timeout_s=timeout_s, idempotent=True,
+            session_key=session_key)
         doc = json.loads(payload or b"{}")
         if status != 200:
             raise RuntimeError(
@@ -1025,12 +1124,22 @@ class FleetRouter:
         return doc
 
     def generate(self, model: str, prompt: Sequence[int], *,
-                 timeout_s: Optional[float] = None, **opts) -> dict:
+                 timeout_s: Optional[float] = None,
+                 session_key: Optional[str] = None, **opts) -> dict:
+        """Generate with optional session affinity: pass the same
+        ``session_key`` on every turn of a chat session and follow-up
+        turns land on the replica whose prefix cache holds the
+        session's KV blocks. Omitted, the key defaults to a
+        fingerprint of the prompt's leading tokens, which pins shared
+        system-prompt storms the same way."""
+        if session_key is None:
+            session_key = prompt_fingerprint(model, prompt)
         body = json.dumps({"prompt": list(prompt), **opts}).encode()
         status, _, payload, url = self.route(
             "POST", f"/v1/models/{model}/generate", body,
             headers=[("Content-Type", "application/json")],
-            model=model, timeout_s=timeout_s, idempotent=False)
+            model=model, timeout_s=timeout_s, idempotent=False,
+            session_key=session_key)
         doc = json.loads(payload or b"{}")
         if status != 200:
             raise RuntimeError(
@@ -1040,11 +1149,26 @@ class FleetRouter:
 
 _MODEL_PATH_RE = re.compile(r"^/v1/models/([^/:]+)(?::[^/]+)?/")
 
+#: how many leading prompt tokens the fallback session fingerprint
+#: covers — enough to separate distinct system prompts, short enough
+#: that every turn of a growing session keeps hashing the same head
+_FINGERPRINT_TOKENS = 32
+
 #: request headers the front door forwards to the replica (trace context,
-#: deadlines, and priority must survive the hop; hop-by-hop headers must
-#: not)
+#: deadlines, priority, and the session key must survive the hop;
+#: hop-by-hop headers must not)
 _FORWARDED_HEADERS = ("content-type", "traceparent", "x-request-timeout-s",
-                      "x-priority")
+                      "x-priority", "x-session-id")
+
+
+def prompt_fingerprint(model: Optional[str],
+                       prompt: Sequence[int]) -> str:
+    """Session key derived from a prompt's leading tokens: requests
+    sharing a system prompt (or earlier turns of the same session)
+    hash identically and therefore pin to the same replica."""
+    head = ",".join(str(int(t)) for t in list(prompt)[:_FINGERPRINT_TOKENS])
+    digest = hashlib.sha1(f"{model or ''}|{head}".encode()).hexdigest()
+    return f"pfx:{digest}"
 
 
 def _parse_priority(raw: Optional[str], default: int) -> int:
@@ -1071,7 +1195,13 @@ class FleetServer:
     capacity-scaled cutoff — 503 with ``Retry-After`` and
     ``X-Fleet-Brownout: 1`` — and tightens the forwarded
     ``X-Request-Timeout-S`` so queued work inside the degraded fleet
-    gives up sooner."""
+    gives up sooner.
+
+    Clients that want prefix-cache locality send ``X-Session-Id`` (any
+    stable opaque string per chat session); generates without one are
+    keyed by a fingerprint of the prompt's leading tokens. Either way
+    the request pins to the session's ring owner when that replica is
+    healthy — see :class:`FleetRouter` session affinity."""
 
     def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
                  port: int = 0):
@@ -1180,10 +1310,20 @@ class FleetServer:
                                 f"{tightened:.3f}"))
                 path = self.path.split("?", 1)[0]
                 idempotent = not path.endswith("/generate")
+                session_key = self.headers.get("X-Session-Id")
+                if session_key is None and not idempotent and body:
+                    # no explicit session: fingerprint the prompt head
+                    # so shared-prefix storms still pin to one replica
+                    try:
+                        doc = json.loads(body)
+                        session_key = prompt_fingerprint(
+                            model, doc.get("prompt") or ())
+                    except (ValueError, TypeError):
+                        session_key = None
                 try:
                     status, hdrs, payload, url = router.route(
                         method, self.path, body, headers=fwd, model=model,
-                        idempotent=idempotent)
+                        idempotent=idempotent, session_key=session_key)
                 except MidStreamError as e:
                     hh = [("X-Trace-Id", e.trace_id)] if e.trace_id else []
                     self.send_json(

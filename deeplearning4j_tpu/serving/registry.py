@@ -201,6 +201,7 @@ class ModelRegistry:
                decode_prefill_batch: Optional[int] = None,
                decode_draft_model=None,
                decode_spec_k: Optional[int] = None,
+               decode_prefix_cache: Optional[bool] = None,
                quantize=None,
                calibration_batch=None,
                quant_max_divergence: Optional[float] = None,
@@ -228,8 +229,11 @@ class ModelRegistry:
         EOS (env defaults otherwise). ``decode_kv_block_size`` /
         ``decode_kv_blocks`` size the paged KV pool,
         ``decode_prefill_batch`` caps how many same-bucket prompts share
-        one prefill dispatch, and ``decode_draft_model`` +
-        ``decode_spec_k`` enable greedy speculative decoding. Warmup
+        one prefill dispatch, ``decode_draft_model`` +
+        ``decode_spec_k`` enable greedy speculative decoding, and
+        ``decode_prefix_cache`` gates content-addressed KV-prefix reuse
+        across requests/turns (``DL4J_TPU_PREFIX_CACHE``, on by
+        default). Warmup
         compiles one prefill executable per (prompt bucket, batch rung)
         pair plus the decode-step executable (plus the speculative step
         when a draft is configured).
@@ -299,6 +303,7 @@ class ModelRegistry:
                                   prefill_batch=decode_prefill_batch,
                                   draft_model=decode_draft_model,
                                   spec_k=decode_spec_k,
+                                  prefix_cache=decode_prefix_cache,
                                   model_name=name,
                                   mesh=mesh, param_spec=param_spec)
         else:

@@ -1174,3 +1174,98 @@ class TestCheckFleetColdStart:
             rec["cold_join"]["ttr_s"] / rec["warm_restart"]["ttr_s"],
             rel=1e-2)
         assert "gate_ok" in rec and "gate_reason" in rec
+
+
+def _pr_record(match=True, reused=1120, expected=1120, cold_rows=1416,
+               warm_rows=296, hits=5, requests=6, sess_match=True,
+               ratio=10.2):
+    return {
+        "storm": {"decode_match": match, "requests": requests,
+                  "reused_rows": reused, "expected_reused_rows": expected,
+                  "prefill_rows": warm_rows,
+                  "prefill_rows_cold": cold_rows,
+                  "prefix_hits": hits},
+        "session": {"decode_match": sess_match, "ttft_ratio": ratio,
+                    "warm_ttft_s": 0.01, "cold_ttft_s": 0.01 * ratio},
+    }
+
+
+class TestCheckPrefixReuse:
+    """Gate logic for the prefix_reuse metric: the radix cache must be
+    invisible to the decoded function (token identity both phases), the
+    storm must reuse EXACTLY the block-aligned common prefix per
+    follower with the computed-row gap to prove single prefill, every
+    follower must hit, and warm turn-2 TTFT must beat the cold
+    full-history prefill by >= 5x."""
+
+    def test_accepts_good_record(self):
+        ok, reason = bench.check_prefix_reuse(_pr_record())
+        assert ok, reason
+
+    def test_rejects_storm_token_mismatch(self):
+        ok, reason = bench.check_prefix_reuse(_pr_record(match=False))
+        assert not ok
+        assert "changed the decoded function" in reason
+
+    def test_rejects_wrong_reused_rows(self):
+        # a follower that re-prefilled its prefix (reused < expected) or
+        # attached beyond the block-aligned run (reused > expected)
+        ok, reason = bench.check_prefix_reuse(_pr_record(reused=1100))
+        assert not ok
+        assert "block-aligned common prefix" in reason
+        ok, _ = bench.check_prefix_reuse(_pr_record(reused=1140))
+        assert not ok
+
+    def test_rejects_computed_row_gap_mismatch(self):
+        # reused counter says 1120 but the engine actually computed the
+        # same rows as the cold run: the "reuse" never skipped work
+        ok, reason = bench.check_prefix_reuse(
+            _pr_record(warm_rows=1416))
+        assert not ok
+        assert "prefilled exactly once" in reason
+
+    def test_rejects_missed_followers(self):
+        ok, reason = bench.check_prefix_reuse(_pr_record(hits=4))
+        assert not ok
+        assert "hit the cache" in reason
+
+    def test_rejects_session_token_mismatch(self):
+        ok, reason = bench.check_prefix_reuse(
+            _pr_record(sess_match=False))
+        assert not ok
+        assert "decodes differently" in reason
+
+    def test_rejects_insufficient_ttft_ratio_and_boundary(self):
+        ok, reason = bench.check_prefix_reuse(_pr_record(ratio=4.9))
+        assert not ok
+        assert "5.0" in reason or "5x" in reason
+        ok, _ = bench.check_prefix_reuse(_pr_record(ratio=5.01))
+        assert ok
+
+    def test_custom_min_ratio(self):
+        ok, _ = bench.check_prefix_reuse(_pr_record(ratio=3.0),
+                                         min_ratio=2.5)
+        assert ok
+
+    def test_tiny_live_measurement_passes_gate(self):
+        """The full metric end-to-end on CPU. The deterministic legs ARE
+        asserted in CI: token identity in both phases, exact reused-row
+        accounting (the storm prefills the common prefix once — the
+        cold/warm computed-row gap equals the reused rows), and every
+        follower hitting. The 5x TTFT gate has wide margin at the tiny
+        sizing (measured ~10x: turn-2 prefills a 2-block tail instead of
+        a 45-block history)."""
+        import jax
+        import jax.numpy as jnp
+
+        rec = bench.bench_prefix_reuse(jax, jnp, tiny=True)
+        assert rec["storm"]["decode_match"]
+        assert rec["storm"]["reused_rows"] == \
+            rec["storm"]["expected_reused_rows"]
+        assert (rec["storm"]["prefill_rows_cold"]
+                - rec["storm"]["prefill_rows"]) == \
+            rec["storm"]["reused_rows"]
+        assert rec["storm"]["prefix_hits"] == rec["storm"]["requests"] - 1
+        assert rec["session"]["decode_match"]
+        assert rec["session"]["ttft_ratio"] > 1.0
+        assert rec["gate_ok"], rec["gate_reason"]

@@ -1226,3 +1226,229 @@ class TestFleetChaos:
             # the X-Priority header survived front door -> replica ring
             assert r["disposition"] is None
             assert r["priority"] == 9
+
+
+# ---------------------------------------------------------------------------
+# session affinity: consistent-hash ring + degrade-to-least-loaded
+# ---------------------------------------------------------------------------
+
+class TestSessionAffinity:
+    def _router(self, n=3, **kw):
+        kw.setdefault("poll_s", 3600)
+        router = FleetRouter(**kw)
+        urls = [f"http://r{i}:1" for i in range(n)]
+        for u in urls:
+            router.add_replica(u, poll=False)
+        return router, urls
+
+    def test_ring_deterministic_balanced_and_stable_under_churn(self):
+        router, urls = self._router(3)
+        assert router.snapshot()["affinity"]["ring_size"] \
+            == 3 * router.affinity_vnodes
+        owners = {u: 0 for u in urls}
+        keys = [f"sess-{i}" for i in range(300)]
+        first = {k: router.affine_url(k) for k in keys}
+        for k in keys:
+            assert router.affine_url(k) == first[k]  # deterministic
+            owners[first[k]] += 1
+        # ~64 vnodes/replica spread the key space: nobody starves
+        assert all(c > 30 for c in owners.values()), owners
+        # removing one replica remaps ONLY the keys it owned
+        victim = urls[0]
+        router.remove_replica(victim)
+        moved = sum(1 for k in keys if router.affine_url(k) != first[k])
+        assert moved == owners[victim]
+        # re-adding restores the original ownership exactly
+        router.add_replica(victim, poll=False)
+        assert all(router.affine_url(k) == first[k] for k in keys)
+
+    def test_owner_usable_iff_ready_not_ejected_serving_model(self):
+        router = FleetRouter(poll_s=3600)
+        reps = [_stub_replica(router, f"http://r{i}:1") for i in range(2)]
+        router._rebuild_ring_locked()
+        key = "chat-7"
+        owner = next(r for r in reps if r.url == router.affine_url(key))
+        assert router._affine_replica("toy", key) is owner
+        owner.ready = False
+        assert router._affine_replica("toy", key) is None
+        owner.ready = True
+        owner.ejected = True
+        assert router._affine_replica("toy", key) is None
+        owner.ejected = False
+        owner.models = ["other"]
+        assert router._affine_replica("toy", key) is None
+        owner.models = []          # unknown model list still counts
+        assert router._affine_replica("toy", key) is owner
+
+    def test_brownout_disables_affinity(self):
+        # 1 ready of 2 known < 0.9 threshold: capacity beats locality
+        router = FleetRouter(poll_s=3600, brownout_frac=0.9)
+        _stub_replica(router, "http://up:1")
+        _stub_replica(router, "http://down:1", ready=False)
+        router._rebuild_ring_locked()
+        key = next(f"k{i}" for i in range(64)
+                   if router.affine_url(f"k{i}") == "http://up:1")
+        assert router.brownout_state()["active"]
+        assert router._affine_replica("toy", key) is None
+
+    def test_session_header_pins_requests_to_one_replica(self):
+        """Live fleet: every predict carrying the same X-Session-Id
+        answers from the ring owner (outcome=hit); dropping the owner
+        mid-session degrades to least-loaded (outcome=fallback) with
+        zero lost requests."""
+        fleet = _Fleet(2, retries=2)
+        front = FleetServer(fleet.router)
+        port = front.start()
+        body = json.dumps({"inputs": _x().tolist()}).encode()
+        key = "chat-affinity-1"
+        owner = fleet.router.affine_url(key)
+
+        def ask():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/toy/predict",
+                data=body,
+                headers={"Content-Type": "application/json",
+                         "X-Session-Id": key})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200
+                return r.headers["X-Fleet-Replica"]
+
+        hits0 = _counter_value("dl4j_fleet_affinity_total", outcome="hit")
+        fb0 = _counter_value("dl4j_fleet_affinity_total",
+                             outcome="fallback")
+        try:
+            for _ in range(6):
+                assert ask() == owner
+            assert _counter_value("dl4j_fleet_affinity_total",
+                                  outcome="hit") == hits0 + 6
+            # kill the owner: the session degrades, nothing is lost
+            idx = next(i for i, (_, s) in enumerate(fleet.members)
+                       if f":{s.port}" in owner)
+            fleet.members[idx][1].stop()
+            fleet.router.poll_once()
+            survivors = {ask() for _ in range(4)}
+            assert survivors and owner not in survivors
+            assert _counter_value("dl4j_fleet_affinity_total",
+                                  outcome="fallback") == fb0 + 4
+        finally:
+            front.stop()
+            fleet.close()
+
+
+class _GenFleet:
+    """Two live generative replicas (same weights) + router + front."""
+
+    def __init__(self, **router_kw):
+        from deeplearning4j_tpu.models import causal_lm
+
+        cfg = causal_lm.CausalLMConfig.tiny()
+        self.model = causal_lm.CausalLM(cfg, seed=0)
+        self.cfg = cfg
+        self.members = []
+        urls = []
+        for _ in range(2):
+            reg = ModelRegistry(manifest_dir=None, retain=1)
+            reg.deploy("lm", "v1", self.model, decode_slots=3,
+                       decode_max_ctx=64, decode_prompt_buckets=[32, 48],
+                       decode_kv_block_size=8)
+            srv = ModelServer(reg)
+            port = srv.start()
+            self.members.append((reg, srv))
+            urls.append(f"http://127.0.0.1:{port}")
+        router_kw.setdefault("poll_s", 0.2)
+        router_kw.setdefault("timeout_s", 60)
+        router_kw.setdefault("retries", 2)
+        self.router = FleetRouter(urls, **router_kw)
+        self.router.poll_once()
+        self.front = FleetServer(self.router)
+        self.port = self.front.start()
+
+    def close(self):
+        self.router.stop_polling()
+        self.front.stop()
+        for reg, srv in self.members:
+            try:
+                srv.stop()
+            except Exception:
+                pass
+            try:
+                reg.drain_all(save_manifests=False)
+            except Exception:
+                pass
+
+
+class TestGenerateAffinity:
+    def _gen(self, port, prompt, headers=()):
+        body = json.dumps({"prompt": [int(t) for t in prompt],
+                           "max_tokens": 4}).encode()
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/lm/generate",
+            data=body, headers=hdrs)
+        with urllib.request.urlopen(req, timeout=60) as r:
+            doc = json.loads(r.read())
+            return doc["tokens"], r.headers["X-Fleet-Replica"]
+
+    def test_fingerprint_pins_shared_prefix_storm(self):
+        """Generates WITHOUT a session header still pin: the front door
+        fingerprints the prompt head, so a storm sharing a system
+        prompt lands on one replica and reuses its radix cache."""
+        fleet = _GenFleet()
+        rng = np.random.RandomState(3)
+        # the fingerprint hashes the first 32 tokens: the shared system
+        # prompt must fill that whole window for the storm to pin
+        common = rng.randint(0, fleet.cfg.vocab_size, 32).astype(np.int32)
+        prompts = [np.concatenate(
+            [common, rng.randint(0, fleet.cfg.vocab_size,
+                                 4).astype(np.int32)])
+            for _ in range(4)]
+        try:
+            served = {self._gen(fleet.port, p)[1] for p in prompts}
+            assert len(served) == 1
+        finally:
+            fleet.close()
+
+    @pytest.mark.slow
+    def test_midstorm_ejection_degrades_zero_lost_no_leaks(self):
+        """The acceptance drill: a multi-turn session storm pinned by
+        X-Session-Id loses its affine replica mid-storm; every request
+        must still answer (failover to least-loaded), and the decode
+        engines' refcount/leak counters must read 0 afterwards."""
+        block_leaks = registry().counter("dl4j_kv_block_leaks_total")
+        slot_leaks = registry().counter("dl4j_decode_slot_leaks_total")
+        b0, s0 = block_leaks.value(), slot_leaks.value()
+        fleet = _GenFleet()
+        fleet.router.start_polling()
+        rng = np.random.RandomState(5)
+        base = rng.randint(0, fleet.cfg.vocab_size, 20).astype(np.int32)
+        key = "storm-session"
+        owner = fleet.router.affine_url(key)
+        hdr = {"X-Session-Id": key}
+        try:
+            history = list(base)
+            toks, url = self._gen(fleet.port, history, hdr)
+            assert url == owner
+            history += toks
+            # drop the affine owner mid-session
+            idx = next(i for i, (_, s) in enumerate(fleet.members)
+                       if f":{s.port}" in owner)
+            fleet.members[idx][1].stop()
+            served = []
+            for turn in range(4):
+                toks, url = self._gen(fleet.port, history, hdr)
+                history += toks
+                served.append(url)
+            # zero lost: every turn answered, all from the survivor
+            assert all(u != owner for u in served)
+            # and the replay decodes exactly what one engine would:
+            # the survivor's cache rebuilt the session from turn 2 on
+            eng_ref = fleet.members[1 - idx][0].generate(
+                "lm", np.asarray(history[:len(base) + 4], np.int32),
+                max_tokens=4)
+            assert eng_ref["tokens"] == history[
+                len(base) + 4:len(base) + 8]
+            assert block_leaks.value() == b0
+            assert slot_leaks.value() == s0
+        finally:
+            fleet.close()

@@ -729,7 +729,10 @@ class TestPagedKVBlocks:
         """The reservation the paging PR exists for: a sequence holds
         ceil((rows written + 1) / block_size) blocks at every step —
         never the slab layout's full max_ctx worth."""
-        eng = _engine(model, slots=2, prompt_buckets=[16], kv_block_size=8)
+        # prefix cache off: this test pins the raw paging accounting,
+        # where completion returns every block to the pool
+        eng = _engine(model, slots=2, prompt_buckets=[16], kv_block_size=8,
+                      prefix_cache=False)
         samples = []
 
         def cb(_tok):
@@ -760,7 +763,8 @@ class TestPagedKVBlocks:
             "dl4j_kv_blocks_free",
             "Free KV-cache blocks in the paged decode pool",
             labels=("model",))
-        eng = _engine(model, kv_block_size=8, model_name="kvgauge")
+        eng = _engine(model, kv_block_size=8, model_name="kvgauge",
+                      prefix_cache=False)
         child = fam.labels(model="kvgauge")
         dips = []
         try:
@@ -853,7 +857,10 @@ class TestPreemption:
             s = eng.stats()
             assert s["preempted"] >= 1
             assert fam.value() >= before + 1
-            assert s["kv_blocks_free"] == 5  # nothing leaked
+            # nothing leaked: completed prefixes legitimately stay in
+            # the radix cache; free + cached must cover the whole pool
+            assert (s["kv_blocks_free"]
+                    + s["prefix_cached_blocks"]) == 5
         finally:
             eng.close(10)
 
@@ -1021,3 +1028,238 @@ class TestPagedEnvKnobs:
         finally:
             env.clear_property(SystemProperties.KV_BLOCK_SIZE)
             env.clear_property(SystemProperties.SPEC_DRAFT_K)
+
+
+# ---------------------------------------------------------------------------
+# tentpole: prefix-aware KV reuse (radix cache over the paged pool)
+# ---------------------------------------------------------------------------
+
+class TestPrefixCache:
+    def test_warm_repeat_reuses_and_stays_token_identical(self, model):
+        """The headline: a repeated prompt attaches its block-aligned
+        cached prefix (all but the final block run — one tail token must
+        still prefill to produce logits) and decodes the exact tokens of
+        the cold run."""
+        eng = _engine(model, kv_block_size=8, kv_blocks=16)
+        prompt = _prompt(23, seed=120)
+        ref = _ref_greedy(model, prompt, 6)
+        try:
+            cold = eng.generate(prompt, max_tokens=6).result(timeout=60)
+            s0 = eng.stats()
+            assert cold["tokens"] == ref
+            assert s0["prefix_hits"] == 0 and s0["prefix_misses"] == 1
+            assert s0["prefix_cached_blocks"] > 0
+            warm = eng.generate(prompt, max_tokens=6).result(timeout=60)
+            s1 = eng.stats()
+            assert warm["tokens"] == ref
+            assert s1["prefix_hits"] == 1
+            # 23-token prompt, block 8: blocks [0:8) and [8:16) cached;
+            # the 22-row cap never binds here (16 <= 22)
+            assert s1["prefix_reused_rows"] == 16
+            # warm prefill computed only the 7-row tail
+            assert s1["prefill_rows"] - s0["prefill_rows"] == 7
+        finally:
+            eng.close(10)
+
+    def test_multi_turn_history_reattaches(self, model):
+        """Turn 2 re-sends turn 1's prompt + generated reply + new user
+        tokens: the cached run covers the whole committed history
+        (prompt AND generated tokens), so only the new tail prefills."""
+        eng = _engine(model, kv_block_size=8, kv_blocks=16,
+                      prompt_buckets=[32, 64], max_ctx=64)
+        p1 = _prompt(12, seed=121)
+        try:
+            t1 = eng.generate(p1, max_tokens=8).result(timeout=60)
+            turn2 = np.concatenate(
+                [p1, np.asarray(t1["tokens"], np.int32),
+                 _prompt(6, seed=122)])
+            ref = _ref_greedy(model, turn2, 5)
+            s0 = eng.stats()
+            t2 = eng.generate(turn2, max_tokens=5).result(timeout=60)
+            s1 = eng.stats()
+            assert t2["tokens"] == ref
+            assert s1["prefix_hits"] - s0["prefix_hits"] == 1
+            # committed history = 12 + 8 = 20 rows -> 2 full blocks
+            assert s1["prefix_reused_rows"] - s0["prefix_reused_rows"] == 16
+        finally:
+            eng.close(10)
+
+    def test_divergent_suffix_forks_not_corrupts(self, model):
+        """Two prompts sharing 16 tokens then diverging: the second
+        attaches the shared run and prefills its own suffix into fresh
+        blocks — the first request's cached blocks must stay intact
+        (verified by decoding both against the recompute reference)."""
+        eng = _engine(model, kv_block_size=8, kv_blocks=16)
+        common = _prompt(16, seed=123)
+        a = np.concatenate([common, _prompt(7, seed=124)])
+        b = np.concatenate([common, _prompt(7, seed=125)])
+        ra, rb = _ref_greedy(model, a, 6), _ref_greedy(model, b, 6)
+        try:
+            assert eng.generate(a, max_tokens=6).result(60)["tokens"] == ra
+            s0 = eng.stats()
+            assert eng.generate(b, max_tokens=6).result(60)["tokens"] == rb
+            s1 = eng.stats()
+            assert s1["prefix_reused_rows"] - s0["prefix_reused_rows"] == 16
+            # replaying A after B's fork must still see A's blocks
+            assert eng.generate(a, max_tokens=6).result(60)["tokens"] == ra
+        finally:
+            eng.close(10)
+
+    def test_lru_eviction_reclaims_unattached_leaves(self, model):
+        """A pool sized for ~2 cached prompts: filling it with distinct
+        prompts forces leaf eviction (counted on the engine and the
+        dl4j_kv_prefix_evictions_total counter) and decode stays
+        correct throughout."""
+        fam = registry().counter(
+            "dl4j_kv_prefix_evictions_total",
+            "KV prefix-cache blocks reclaimed by LRU leaf eviction")
+        before = fam.value()
+        eng = _engine(model, kv_block_size=8, kv_blocks=8)
+        prompts = [_prompt(14, seed=130 + i) for i in range(4)]
+        refs = [_ref_greedy(model, p, 4) for p in prompts]
+        try:
+            for p, ref in zip(prompts, refs):
+                assert eng.generate(p, max_tokens=4
+                                    ).result(60)["tokens"] == ref
+            s = eng.stats()
+            assert s["prefix_evictions"] > 0
+            assert fam.value() - before == s["prefix_evictions"]
+            # the pool never leaked: all blocks free or cached
+            assert (s["kv_blocks_free"] + s["prefix_cached_blocks"]
+                    == eng.kv_blocks)
+        finally:
+            eng.close(10)
+
+    def test_disabled_engine_never_caches(self, model):
+        eng = _engine(model, kv_block_size=8, prefix_cache=False)
+        prompt = _prompt(23, seed=126)
+        ref = _ref_greedy(model, prompt, 6)
+        try:
+            for _ in range(2):
+                assert eng.generate(prompt, max_tokens=6
+                                    ).result(60)["tokens"] == ref
+            s = eng.stats()
+            assert s["prefix_cache"] is False
+            assert s["prefix_hits"] == 0 and s["prefix_misses"] == 0
+            assert s["prefix_cached_blocks"] == 0
+            assert eng.debug_snapshot()["prefix_cache"]["enabled"] is False
+        finally:
+            eng.close(10)
+
+    def test_debug_snapshot_exposes_radix(self, model):
+        eng = _engine(model, kv_block_size=8, model_name="radix-snap")
+        try:
+            eng.generate(_prompt(20, seed=127), max_tokens=4).result(60)
+            snap = eng.debug_snapshot()["prefix_cache"]
+            assert snap["enabled"] is True
+            assert snap["cached_blocks"] == len(snap["nodes"]) > 0
+            for nd in snap["nodes"]:
+                assert nd["block"] > 0          # never the scratch block
+                assert len(nd["digest"]) == 12  # chained sha1, truncated
+                assert nd["refs"] == 0          # nothing attached now
+        finally:
+            eng.close(10)
+
+    def test_prefix_blocks_gauge_tracks_cache(self, model):
+        fam = registry().gauge(
+            "dl4j_kv_prefix_blocks",
+            "KV blocks currently held by the prefix cache's radix tree",
+            labels=("model",))
+        eng = _engine(model, kv_block_size=8, model_name="pfxgauge")
+        child = fam.labels(model="pfxgauge")
+        try:
+            eng.generate(_prompt(17, seed=128), max_tokens=3).result(60)
+            assert child.value() == eng.stats()["prefix_cached_blocks"] > 0
+        finally:
+            eng.close(10)
+
+
+class TestPrefixCacheEnvKnobs:
+    def test_default_and_override(self):
+        from deeplearning4j_tpu.common.environment import SystemProperties
+        env = environment()
+        assert env.prefix_cache_enabled() is True
+        try:
+            env.set_prefix_cache(False)
+            assert env.prefix_cache_enabled() is False
+        finally:
+            env.clear_property(SystemProperties.PREFIX_CACHE)
+
+    def test_engine_reads_env_knob(self, model):
+        from deeplearning4j_tpu.common.environment import SystemProperties
+        env = environment()
+        try:
+            env.set_prefix_cache(False)
+            eng = _engine(model)
+            assert eng.stats()["prefix_cache"] is False
+            eng.close(5)
+            # the constructor kwarg wins over the env default
+            eng = _engine(model, prefix_cache=True)
+            assert eng.stats()["prefix_cache"] is True
+            eng.close(5)
+        finally:
+            env.clear_property(SystemProperties.PREFIX_CACHE)
+
+
+class TestPrefixCachePreemption:
+    def test_preempted_request_reattaches_cached_prefix(self, model):
+        """Satellite regression (preemption/fork interplay): a LIFO-
+        preempted request publishes its regrown prefix (prompt +
+        committed tokens) into the radix cache before releasing its
+        blocks, so the re-admit attaches that run and prefills ONLY the
+        uncached tail instead of recomputing from scratch."""
+        # pool of 6 blocks = 48 rows; both requests' worst case is 4
+        # blocks, so the later one is preempted mid-decode (empirically
+        # stable: the re-admit re-attaches 2 full cached blocks)
+        eng = _engine(model, slots=2, prompt_buckets=[16, 32],
+                      kv_block_size=8, kv_blocks=6)
+        pa, pb = _prompt(8, seed=84), _prompt(8, seed=85)
+        ra, rb = _ref_greedy(model, pa, 24), _ref_greedy(model, pb, 24)
+        try:
+            fa = eng.generate(pa, max_tokens=24)
+            fb = eng.generate(pb, max_tokens=24)
+            assert fa.result(timeout=120)["tokens"] == ra
+            assert fb.result(timeout=120)["tokens"] == rb
+            s = eng.stats()
+            assert s["preempted"] >= 1
+            # the re-admit was a cache hit on its own regrown prefix:
+            # at least its full prompt block came back from the tree
+            assert s["prefix_hits"] >= 1
+            assert s["prefix_reused_rows"] >= 8
+            # and the re-prefill computed fewer rows than a cold
+            # recompute of both requests' full prefixes would have
+            cold_rows = 2 * 8 + 8 + s["prefix_reused_rows"]
+            assert s["prefill_rows"] < cold_rows
+            # nothing leaked: every block is free or cached
+            assert (s["kv_blocks_free"] + s["prefix_cached_blocks"]
+                    == eng.kv_blocks)
+        finally:
+            eng.close(10)
+
+
+class TestPrefixCacheSpeculative:
+    def test_spec_with_prefix_sharing_token_identical(self, model):
+        """Satellite regression (spec compat): draft+target decode with
+        prefix sharing enabled — including a warm request attached to
+        cached blocks the draft cache knows nothing about — must stay
+        token-identical to the plain greedy reference. The target's
+        verify pass is authoritative, so stale draft KV for reused rows
+        can cost acceptance but never change tokens."""
+        dcfg = dataclasses.replace(CFG, num_layers=1)
+        draft = causal_lm.CausalLM(dcfg, params={
+            "embeddings": model.params["embeddings"],
+            "layers": model.params["layers"][:1]})
+        eng = _engine(model, kv_block_size=8, kv_blocks=16,
+                      draft_model=draft, spec_k=3)
+        prompt = _prompt(19, seed=140)
+        ref = _ref_greedy(model, prompt, 10)
+        try:
+            cold = eng.generate(prompt, max_tokens=10).result(timeout=60)
+            warm = eng.generate(prompt, max_tokens=10).result(timeout=60)
+            assert cold["tokens"] == ref
+            assert warm["tokens"] == ref
+            s = eng.stats()
+            assert s["prefix_hits"] == 1      # the warm run reused blocks
+            assert s["spec_steps"] > 0        # and speculation really ran
+        finally:
+            eng.close(10)

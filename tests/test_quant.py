@@ -303,7 +303,10 @@ class TestQuantizedCausalLM:
             res = eng.generate(prompt, max_tokens=8,
                                eos_token=None).result(timeout=60)
             assert res["tokens"] == ref
-            assert eng.stats()["kv_blocks_free"] == eng.kv_blocks
+            # completed prefixes legitimately stay in the radix cache
+            s = eng.stats()
+            assert s["kv_blocks_free"] + s["prefix_cached_blocks"] \
+                == eng.kv_blocks
         finally:
             eng.close(5.0)
 

@@ -655,6 +655,77 @@ class TestDecodeResilience:
         assert child.value() >= before + 1
         assert not eng.worker_dead
 
+    def test_prefix_shared_blocks_survive_rider_crashes(self):
+        """Refcounted-pool chaos drill: riders ATTACHED to cached
+        prefix blocks are killed mid-decode (prefill fault, then step
+        fault); a crashed rider must decref — never free — the shared
+        blocks, so the cache stays valid, dl4j_kv_block_leaks_total
+        stays flat (released, not repaired), and once the dust settles
+        every outstanding block is held by exactly the radix tree."""
+        from deeplearning4j_tpu.models import causal_lm
+        from deeplearning4j_tpu.runtime.generation import DecodeEngine
+
+        cfg = causal_lm.CausalLMConfig.tiny()
+        model = causal_lm.CausalLM(cfg, seed=0)
+        eng = DecodeEngine(model, slots=2, max_ctx=64,
+                           prompt_buckets=[32], kv_block_size=8,
+                           kv_blocks=16)
+        block_leaks = metrics_registry().counter(
+            "dl4j_kv_block_leaks_total")
+        slot_leaks = metrics_registry().counter(
+            "dl4j_decode_slot_leaks_total")
+        b0, s0 = block_leaks.value(), slot_leaks.value()
+        common = np.random.RandomState(55).randint(
+            0, cfg.vocab_size, 16).astype(np.int32)
+
+        def mk(seed):
+            tail = np.random.RandomState(100 + seed).randint(
+                0, cfg.vocab_size, 5).astype(np.int32)
+            return np.concatenate([common, tail])
+        try:
+            # seed the cache: a clean request publishes the shared run
+            ref = eng.generate(mk(0), max_tokens=6,
+                               eos_token=None).result(30)
+            assert eng.stats()["prefix_cached_blocks"] >= 2
+            # drill 1: kill a warm rider during its tail prefill
+            with faults.injected("decode.prefill", times=1):
+                bad = eng.generate(mk(1), max_tokens=6, eos_token=None)
+                with pytest.raises(faults.InjectedFault):
+                    bad.result(timeout=30)
+            # drill 2: kill a warm rider mid-decode
+            with faults.injected("decode.step", times=1):
+                bad = eng.generate(mk(2), max_tokens=6, eos_token=None)
+                with pytest.raises(faults.InjectedFault):
+                    bad.result(timeout=30)
+            deadline = time.monotonic() + 10
+            while eng.stats()["active_slots"] \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            s = eng.stats()
+            assert s["active_slots"] == 0
+            # shared blocks were decref'd, not freed: the cache survived
+            # both crashes and a replay still decodes identically
+            again = eng.generate(mk(0), max_tokens=6,
+                                 eos_token=None).result(30)
+            assert again["tokens"] == ref["tokens"]
+            assert eng.stats()["prefix_hits"] >= 1
+            # steady state: every pool block is free or cached, and
+            # each outstanding block is held by exactly one tree ref
+            s = eng.stats()
+            assert (s["kv_blocks_free"] + s["prefix_cached_blocks"]
+                    == eng.kv_blocks)
+            with eng._cv:
+                refs = eng._alloc.refcounts()
+            assert all(v == 1 for v in refs.values())
+            assert len(refs) == s["prefix_cached_blocks"]
+            # everything above happened through release paths — the
+            # reconcile repair counters never had to fire
+            assert block_leaks.value() == b0
+            assert slot_leaks.value() == s0
+        finally:
+            faults.clear()
+            eng.close(10)
+
 
 # ---------------------------------------------------------------------------
 # compile-cache fault sites: recovery, never a request failure
