@@ -617,9 +617,14 @@ def bench_telemetry_overhead(jax, jnp, tiny):
     plus a third pass with a per-request trace context bound — the
     serving front end's request-scoped tracing (traceparent in,
     span-tree out) — to price the contextvar/span-id machinery.
-    The instrumentation contract is near-zero cost, so `overhead_frac`
-    must stay under the `check_telemetry_overhead` gate's 3%;
-    `tracing_overhead_frac` is reported alongside it."""
+    A fourth, fleet-level pass routes the same predict through a live
+    2-replica FleetRouter (background polling + aggregator scraping on)
+    with the whole observability plane armed vs off: attempt spans,
+    traceparent forwarding, metrics aggregation and the replica-side
+    decomposition must all ride inside the same near-zero-cost
+    contract. `overhead_frac` and `fleet_overhead_frac` must both stay
+    under the `check_telemetry_overhead` gate's 3%;
+    `tracing_overhead_frac` is reported alongside them."""
     from deeplearning4j_tpu.common.environment import environment
     from deeplearning4j_tpu.common.tracing import (TraceContext,
                                                    new_trace_id, tracer,
@@ -677,6 +682,64 @@ def bench_telemetry_overhead(jax, jnp, tiny):
     out["tracing_overhead_frac"] = round(
         1.0 - out["metrics_trace_sps"] / max(out["metrics_off_sps"], 1e-9),
         4)
+
+    # -- fleet pass: the observability plane armed vs off ----------------
+    # two in-process replicas behind one router with background polling;
+    # toggling the shared registry arms/disarms attempt spans, the
+    # aggregator's scrape targets and the replicas' own instrumentation
+    # at once — the routed request rate must not notice.
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+    from deeplearning4j_tpu.serving.fleet import FleetRouter
+
+    n_fleet_reqs = 40 if tiny else 120
+    body = json.dumps(
+        {"inputs": np.asarray(reqs[0]).tolist()}).encode()
+    hdrs = [("Content-Type", "application/json")]
+    members, router = [], None
+    try:
+        for _ in range(2):
+            sreg = ModelRegistry(manifest_dir=None)
+            sreg.deploy("bench", "v1", net, example=reqs[0],
+                        max_batch=max_batch)
+            srv = ModelServer(sreg, max_concurrent=4)
+            members.append((sreg, srv, f"http://127.0.0.1:{srv.start()}"))
+        router = FleetRouter([m[2] for m in members], poll_s=0.2,
+                             timeout_s=30)
+        router.poll_once()
+        router.start_polling()
+
+        def drive():
+            for _ in range(n_fleet_reqs):
+                router.route("POST", "/v1/models/bench/predict", body,
+                             headers=hdrs, model="bench", timeout_s=30)
+
+        drive()  # warm: ladder compiled, hedge samples, one poll cycle
+        for mode in ("off", "on"):
+            reg.set_enabled(mode == "on")
+            runs = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                drive()
+                runs.append(time.perf_counter() - t0)
+            runs.sort()
+            out[f"fleet_{mode}_rps"] = round(
+                n_fleet_reqs / runs[len(runs) // 2], 2)
+    finally:
+        reg.set_enabled(prev_enabled)
+        tracer().clear()
+        if router is not None:
+            router.stop_polling()
+        for sreg, srv, _ in members:
+            try:
+                srv.stop()
+            except Exception:
+                pass
+            try:
+                sreg.drain_all(save_manifests=False)
+            except Exception:
+                pass
+    out["fleet_overhead_frac"] = round(
+        1.0 - out["fleet_on_rps"] / max(out["fleet_off_rps"], 1e-9), 4)
     ok, reason = check_telemetry_overhead(out)
     out["gate_ok"], out["gate_reason"] = ok, reason
     return out
@@ -1905,7 +1968,10 @@ def check_telemetry_overhead(rec, max_overhead=0.03):
     """(ok, reason): metrics-on serving throughput may cost at most
     `max_overhead` (3%) vs metrics-off — the near-zero-cost contract of
     the telemetry subsystem. A bigger gap means instrumentation leaked
-    onto the per-dispatch path (allocation, locking, or a host sync)."""
+    onto the per-dispatch path (allocation, locking, or a host sync).
+    When the record carries the fleet pass (`fleet_on_rps`), the same
+    gate applies to the whole observability plane armed vs off: attempt
+    spans + aggregator scraping + decomposition on the routed path."""
     on, off = rec["metrics_on_sps"], rec["metrics_off_sps"]
     floor = (1.0 - max_overhead) * off
     if on < floor:
@@ -1913,6 +1979,16 @@ def check_telemetry_overhead(rec, max_overhead=0.03):
             f"metrics-on throughput {on:.2f} < {floor:.2f} "
             f"({(1 - max_overhead) * 100:.0f}% of metrics-off {off:.2f}): "
             "telemetry is not near-zero-cost on the serving path")
+    f_on = rec.get("fleet_on_rps")
+    if f_on is not None:
+        f_off = rec["fleet_off_rps"]
+        f_floor = (1.0 - max_overhead) * f_off
+        if f_on < f_floor:
+            return False, (
+                f"observability-armed fleet throughput {f_on:.2f} < "
+                f"{f_floor:.2f} ({(1 - max_overhead) * 100:.0f}% of "
+                f"disarmed {f_off:.2f}): the fleet observability plane "
+                "is taxing the routed serving path")
     return True, "ok"
 
 
@@ -2566,6 +2642,325 @@ def check_fleet_resilience(rec, max_p99_ratio=3.0):
     return True, "ok"
 
 
+def bench_observability_plane(jax, jnp, tiny):
+    """The fleet observability plane's three contracts, proven live on
+    a 3-replica fleet through the real HTTP front door:
+
+    1. **stitched hedge trace** — after a storm warms the router's
+       per-model latency samples, a connect-delay fault on every
+       replica forces one traced predict to hedge; the fleet's
+       ``/debug/trace/<id>`` must render ONE cross-process tree holding
+       BOTH ``fleet/attempt`` spans (primary + hedge — the abandoned
+       loser included) and, under the winning attempt, the replica's
+       server-side ``serving/request`` → ``serving/admission`` →
+       ``inference/dispatch`` subtree; the response's ``X-Trace-Id``
+       must echo the trace id the client minted in ``traceparent``.
+    2. **percentile parity** — the fleet's merged histogram series must
+       carry bucket counts equal to the client-side pooling of every
+       replica's ``/metrics.json`` buckets, with p50/p90/p99 EXACTLY
+       the percentiles of that pooled distribution (bucket-wise sums,
+       never an average of averages).
+    3. **signals rollup** — ``/fleet/signals`` must list every replica,
+       and the fleet rollup's summed capacity fields (waiters,
+       queue_depth, active) must equal the sum over its own per-replica
+       rows."""
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.common import faults
+    from deeplearning4j_tpu.common.environment import environment
+    from deeplearning4j_tpu.common.tracing import (TraceContext,
+                                                   format_traceparent,
+                                                   new_span_id,
+                                                   new_trace_id)
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+    from deeplearning4j_tpu.serving.fleet import (FleetRouter, FleetServer,
+                                                  histogram_quantile)
+
+    n_in, hidden, n_out, B = 16, 32, 4, 4
+    n_threads = 4
+    per_thread = 10 if tiny else 25
+    # the connect fault must dwarf the storm's p90 (the armed hedge
+    # delay) so the hedge reliably launches while the primary sleeps
+    hedge_fault_delay_s = 0.75
+    fam_name = "dl4j_inference_latency_seconds"
+
+    def _mlp(seed=0):
+        b = NeuralNetConfiguration.builder().seed(seed).list()
+        b.layer(DenseLayer(n_in=n_in, n_out=hidden, activation="tanh"))
+        conf = b.layer(OutputLayer(n_in=hidden, n_out=n_out)).build()
+        return MultiLayerNetwork(conf).init()
+
+    x = np.random.RandomState(0).randn(B, n_in).astype(np.float32)
+    body = json.dumps({"inputs": x.tolist()}).encode()
+    rec = {"replicas": 3, "storm_requests": n_threads * per_thread,
+           "histogram_family": fam_name}
+
+    def _http(method, url, data=None, headers=None, timeout=30):
+        req = urllib.request.Request(url, data=data,
+                                     headers=dict(headers or {}),
+                                     method=method)
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+
+    reg = environment().metrics()
+    prev_enabled = reg.enabled
+    reg.set_enabled(True)
+    members, urls = [], []
+    router, front = None, None
+    try:
+        for i in range(3):
+            sreg = ModelRegistry(manifest_dir=None)
+            sreg.deploy("bench", "v1", _mlp(), example=x, max_batch=8)
+            srv = ModelServer(sreg, max_concurrent=4)
+            port = srv.start()
+            members.append((sreg, srv))
+            urls.append(f"http://127.0.0.1:{port}")
+        router = FleetRouter(urls, poll_s=0.25, retries=3, timeout_s=30,
+                             retry_budget=0.5, retry_burst=10.0,
+                             hedge_pctl=90, hedge_min_samples=8)
+        router.poll_once()
+        router.start_polling()
+        front = FleetServer(router)
+        base = f"http://127.0.0.1:{front.start()}"
+
+        # -- phase 1: storm through the front door ------------------------
+        # fills every replica's histograms and warms the router's latency
+        # samples so the hedge delay is armed for phase 2
+        ok_count = [0]
+        lock = threading.Lock()
+
+        def client():
+            for _ in range(per_thread):
+                status, _, _ = _http(
+                    "POST", base + "/v1/models/bench/predict", body,
+                    {"Content-Type": "application/json"})
+                if status == 200:
+                    with lock:
+                        ok_count[0] += 1
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rec["storm_ok"] = ok_count[0]
+
+        # -- phase 2: percentile parity -----------------------------------
+        # quiesced fleet: force one synchronous scrape so the aggregator
+        # holds exactly what the replicas will answer next
+        router.poll_once()
+        pooled = {}
+        for url in urls:
+            _, _, payload = _http("GET", url + "/metrics.json")
+            fam = json.loads(payload).get(fam_name, {})
+            for entry in fam.get("series", ()):
+                labels = entry.get("labels", {})
+                key = tuple(sorted(labels.items()))
+                bounds = tuple(entry["bounds"])
+                agg = pooled.setdefault(
+                    key, [bounds, [0.0] * len(entry["bucket_counts"])])
+                if agg[0] == bounds:
+                    for j, c in enumerate(entry["bucket_counts"]):
+                        agg[1][j] += c
+        _, _, payload = _http("GET", base + "/metrics.json")
+        fleet_series = json.loads(payload).get(fam_name, {}).get(
+            "series", ())
+        checked, max_diff, missing = 0, 0.0, 0
+        for key, (bounds, counts) in pooled.items():
+            if not sum(counts):
+                continue
+            merged = next(
+                (e for e in fleet_series
+                 if "replica" not in e.get("labels", {})
+                 and tuple(sorted(e["labels"].items())) == key
+                 and e.get("bucket_counts") == counts), None)
+            if merged is None:
+                missing += 1
+                continue
+            checked += 1
+            for q, k in ((0.50, "p50"), (0.90, "p90"), (0.99, "p99")):
+                want = histogram_quantile(bounds, counts, q)
+                got = merged.get(k)
+                if want is None or got is None:
+                    max_diff = max(max_diff, float("inf")
+                                   if want != got else 0.0)
+                else:
+                    max_diff = max(max_diff, abs(want - got))
+        rec["percentile_parity"] = {"series_checked": checked,
+                                    "series_missing": missing,
+                                    "max_abs_diff": max_diff}
+
+        # -- phase 3: /fleet/signals rollup consistency -------------------
+        _, _, payload = _http("GET", base + "/fleet/signals")
+        sig = json.loads(payload)
+        rows = sig.get("replicas", {})
+        fleet = sig.get("fleet", {})
+        sums_ok = True
+        for field in ("waiters", "queue_depth", "active"):
+            for model, roll in (fleet.get("admission") or {}).items():
+                want = sum(
+                    (row.get("admission") or {}).get(model, {})
+                    .get(field) or 0.0 for row in rows.values())
+                got = roll.get(field)
+                if got is None or abs(got - want) > 1e-9:
+                    sums_ok = False
+        rec["signals"] = {"replica_rows": len(rows),
+                          "fleet_ready": fleet.get("ready"),
+                          "rollup_consistent": sums_ok}
+
+        # -- phase 4: forced hedge, stitched over real HTTP ---------------
+        trace_id = new_trace_id()
+        faults.inject("fleet.dispatch", kind="delay", rate=1.0, seed=5,
+                      delay_s=hedge_fault_delay_s,
+                      predicate=lambda ctx: ctx.get("phase") == "connect")
+        try:
+            status, hdrs, _ = _http(
+                "POST", base + "/v1/models/bench/predict", body,
+                {"Content-Type": "application/json",
+                 # a real client span id: an all-zero parent-id is
+                 # invalid per W3C and would be discarded downstream
+                 "traceparent": format_traceparent(
+                     TraceContext(trace_id, new_span_id()))})
+        finally:
+            faults.clear("fleet.dispatch")
+        stitched = {"status": status,
+                    "echoed_trace_id": hdrs.get("X-Trace-Id"),
+                    "trace_id": trace_id}
+        # the abandoned loser's span lands from ITS attempt thread once
+        # the faulted connect wakes up — poll until the tree is whole
+        deadline = time.perf_counter() + (10 if tiny else 20)
+        kinds, doc = [], {}
+        while time.perf_counter() < deadline:
+            _, _, payload = _http("GET",
+                                  base + "/debug/trace/" + trace_id)
+            doc = json.loads(payload)
+            kinds = [e["args"].get("kind")
+                     for e in doc.get("events", ())
+                     if e.get("name") == "fleet/attempt"]
+            if len(kinds) >= 2 and _subtree_names(
+                    doc.get("tree", ()), "fleet/attempt") \
+                    >= {"serving/request", "serving/admission",
+                        "inference/dispatch"}:
+                break
+            time.sleep(0.1)
+        stitched["attempt_kinds"] = sorted(kinds)
+        stitched["outcomes"] = sorted(
+            e["args"].get("outcome") for e in doc.get("events", ())
+            if e.get("name") == "fleet/attempt")
+        stitched["replicas_stitched"] = doc.get("replicas", [])
+        stitched["winner_subtree"] = sorted(_subtree_names(
+            doc.get("tree", ()), "fleet/attempt"))
+        rec["stitched"] = stitched
+    finally:
+        reg.set_enabled(prev_enabled)
+        if front is not None:
+            try:
+                front.stop()
+            except Exception:
+                pass
+        if router is not None:
+            router.stop_polling()
+        for sreg, srv in members:
+            try:
+                srv.stop()
+            except Exception:
+                pass
+            try:
+                sreg.drain_all(save_manifests=False)
+            except Exception:
+                pass
+    ok, reason = check_observability_plane(rec)
+    rec["gate_ok"], rec["gate_reason"] = ok, reason
+    return rec
+
+
+def _subtree_names(tree, root_name):
+    """Every span name that appears under a node named `root_name`
+    anywhere in a span_tree — the 'what hangs under the attempts'
+    probe for the stitched-trace gate."""
+    names = set()
+
+    def walk(nodes, inside):
+        for n in nodes:
+            hit = inside or n.get("name") == root_name
+            if inside:
+                names.add(n.get("name"))
+            walk(n.get("children", ()), hit)
+
+    walk(tree, False)
+    return names
+
+
+def check_observability_plane(rec):
+    """(ok, reason): gates an observability_plane record must pass.
+
+    - the storm lost nothing (a broken fleet invalidates the rest);
+    - the hedged predict answered 200 and echoed the client's minted
+      trace id in ``X-Trace-Id`` — trace context survived front door →
+      router → replica and back;
+    - the stitched tree holds BOTH attempt spans (a ``primary`` and a
+      ``hedge``) and the winner's server-side subtree
+      (``serving/request`` → ``serving/admission`` →
+      ``inference/dispatch``) — one trace for one logical request,
+      however many processes served it;
+    - fleet-merged percentiles are EXACT: at least one histogram series
+      checked, none missing from the fleet exposition, zero difference
+      vs percentiles over the pooled per-replica buckets;
+    - ``/fleet/signals`` lists all 3 replicas and its fleet rollup sums
+      match its own per-replica rows."""
+    if rec["storm_ok"] < rec["storm_requests"]:
+        return False, (
+            f"only {rec['storm_ok']}/{rec['storm_requests']} storm "
+            "requests answered 200: the fleet under test is unhealthy")
+    st = rec["stitched"]
+    if st["status"] != 200:
+        return False, (
+            f"the hedged predict answered {st['status']}, not 200")
+    if st["echoed_trace_id"] != st["trace_id"]:
+        return False, (
+            f"X-Trace-Id {st['echoed_trace_id']} != minted trace id "
+            f"{st['trace_id']}: trace context was dropped on the "
+            "front-door path")
+    kinds = st["attempt_kinds"]
+    if "hedge" not in kinds or "primary" not in kinds:
+        return False, (
+            f"stitched trace holds attempt kinds {kinds}: need both the "
+            "primary and the hedge span in ONE trace")
+    want = {"serving/request", "serving/admission", "inference/dispatch"}
+    if not want <= set(st["winner_subtree"]):
+        return False, (
+            f"winner subtree {st['winner_subtree']} is missing "
+            f"{sorted(want - set(st['winner_subtree']))}: the replica's "
+            "server-side spans did not stitch under the fleet attempt")
+    par = rec["percentile_parity"]
+    if par["series_checked"] < 1:
+        return False, "no histogram series had observations to check"
+    if par["series_missing"] > 0:
+        return False, (
+            f"{par['series_missing']} pooled series missing from the "
+            "fleet /metrics.json merged exposition")
+    if par["max_abs_diff"] > 0.0:
+        return False, (
+            f"fleet-merged percentiles differ from pooled-bucket "
+            f"percentiles by {par['max_abs_diff']}: the merge is not "
+            "exact")
+    sig = rec["signals"]
+    if sig["replica_rows"] != rec["replicas"]:
+        return False, (
+            f"/fleet/signals lists {sig['replica_rows']} replicas, "
+            f"expected {rec['replicas']}")
+    if not sig["rollup_consistent"]:
+        return False, (
+            "/fleet/signals fleet rollup does not equal the sum of its "
+            "own per-replica rows")
+    return True, "ok"
+
+
 def bench_fleet_cold_start(jax, jnp, tiny):
     """Fleet-scale cold start over the shared artifact store (the
     ArtifactStore tentpole's headline): with DL4J_TPU_REMOTE_CACHE
@@ -2955,6 +3350,12 @@ def main():
                                                              tiny)
         except Exception as e:
             out["fleet_resilience"] = f"error: {type(e).__name__}"
+        _release()
+        try:
+            out["observability_plane"] = bench_observability_plane(
+                jax, jnp, tiny)
+        except Exception as e:
+            out["observability_plane"] = f"error: {type(e).__name__}"
         _release()
         try:
             out["fleet_cold_start"] = bench_fleet_cold_start(jax, jnp,

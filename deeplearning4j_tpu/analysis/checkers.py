@@ -16,20 +16,21 @@ from . import Finding, Module, PACKAGE_ROOT
 
 #: label keys metric families may use — the bounded-cardinality contract
 #: (DL104). Every key here is either a closed enum (kind/cache/outcome/
-#: reason/state/good/window/path/site/engine/mode/tier/priority — mode is
-#: the quantization storage format, int8|fp8; tier is the artifact-store
-#: layer, local|remote; priority is the X-Priority request class, the
-#: ten values "0".."9"; outcome enums are per-family, e.g. the router
-#: dispatch set and the session-affinity pair hit|fallback on
-#: ``dl4j_fleet_affinity_total``), a deploy-bounded identity
+#: reason/state/good/window/path/site/engine/mode/tier/priority/slo —
+#: mode is the quantization storage format, int8|fp8; tier is the
+#: artifact-store layer, local|remote; priority is the X-Priority
+#: request class, the ten values "0".."9"; slo is the goodput split on
+#: ``dl4j_tokens_total``, ok|violated; outcome enums are per-family,
+#: e.g. the router dispatch set and the session-affinity pair
+#: hit|fallback on ``dl4j_fleet_affinity_total``), a deploy-bounded identity
 #: (model/version/bucket/worker/name/replica — replica is a fleet
 #: member's URL, bounded by the router's configured replica set), or
 #: process identity (the build-info trio). A request-scoped value (trace id, user id, prompt)
 #: must ride on exemplars or spans, never on labels.
 REGISTERED_LABELS: Set[str] = {
     "bucket", "cache", "engine", "good", "kind", "mode", "model", "name",
-    "outcome", "path", "priority", "reason", "replica", "site", "state",
-    "tier", "version", "window", "worker", "jax_version",
+    "outcome", "path", "priority", "reason", "replica", "site", "slo",
+    "state", "tier", "version", "window", "worker", "jax_version",
     "jaxlib_version", "platform",
 }
 

@@ -95,6 +95,8 @@ class EnvironmentVars:
     DL4J_TPU_FLEET_HEDGE_PCTL = "DL4J_TPU_FLEET_HEDGE_PCTL"
     DL4J_TPU_FLEET_BROWNOUT_FRAC = "DL4J_TPU_FLEET_BROWNOUT_FRAC"
     DL4J_TPU_FLEET_DEFAULT_PRIORITY = "DL4J_TPU_FLEET_DEFAULT_PRIORITY"
+    DL4J_TPU_FLEET_AGG_RETENTION_S = "DL4J_TPU_FLEET_AGG_RETENTION_S"
+    DL4J_TPU_FLEET_AGG_MAX_SAMPLES = "DL4J_TPU_FLEET_AGG_MAX_SAMPLES"
     XLA_FLAGS = "XLA_FLAGS"
 
 
@@ -165,6 +167,8 @@ class SystemProperties:
     FLEET_HEDGE_PCTL = "fleet_hedge_pctl"
     FLEET_BROWNOUT_FRAC = "fleet_brownout_frac"
     FLEET_DEFAULT_PRIORITY = "fleet_default_priority"
+    FLEET_AGG_RETENTION_S = "fleet_agg_retention_s"
+    FLEET_AGG_MAX_SAMPLES = "fleet_agg_max_samples"
 
 
 _ENV_FOR_PROP = {
@@ -260,6 +264,10 @@ _ENV_FOR_PROP = {
         EnvironmentVars.DL4J_TPU_FLEET_BROWNOUT_FRAC,
     SystemProperties.FLEET_DEFAULT_PRIORITY:
         EnvironmentVars.DL4J_TPU_FLEET_DEFAULT_PRIORITY,
+    SystemProperties.FLEET_AGG_RETENTION_S:
+        EnvironmentVars.DL4J_TPU_FLEET_AGG_RETENTION_S,
+    SystemProperties.FLEET_AGG_MAX_SAMPLES:
+        EnvironmentVars.DL4J_TPU_FLEET_AGG_MAX_SAMPLES,
 }
 
 _DEFAULTS = {
@@ -324,6 +332,8 @@ _DEFAULTS = {
     SystemProperties.FLEET_HEDGE_PCTL: "95",
     SystemProperties.FLEET_BROWNOUT_FRAC: "0.5",
     SystemProperties.FLEET_DEFAULT_PRIORITY: "5",
+    SystemProperties.FLEET_AGG_RETENTION_S: "600",
+    SystemProperties.FLEET_AGG_MAX_SAMPLES: "512",
 }
 
 
@@ -1000,6 +1010,26 @@ class Environment:
             return min(max(int(v), 0), 9)
         except (TypeError, ValueError):
             return 5
+
+    def fleet_agg_retention_s(self) -> float:
+        """How long the fleet metrics aggregator's in-memory signal
+        ring retains scraped autoscaler samples, in seconds
+        (``DL4J_TPU_FLEET_AGG_RETENTION_S``)."""
+        v = self.property(SystemProperties.FLEET_AGG_RETENTION_S)
+        try:
+            return max(float(v), 1.0)
+        except (TypeError, ValueError):
+            return 600.0
+
+    def fleet_agg_max_samples(self) -> int:
+        """Hard cap on samples in the aggregator's signal ring
+        (``DL4J_TPU_FLEET_AGG_MAX_SAMPLES``) — the bound that holds
+        even when a short poll interval outruns the retention window."""
+        v = self.property(SystemProperties.FLEET_AGG_MAX_SAMPLES)
+        try:
+            return max(int(v), 1)
+        except (TypeError, ValueError):
+            return 512
 
     # -- telemetry (common/metrics.py, common/tracing.py) ------------------
     def metrics(self):

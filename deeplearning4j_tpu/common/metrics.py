@@ -357,13 +357,20 @@ class MetricsRegistry:
             for key, child in fam.children():
                 labels = dict(zip(fam.label_names, key))
                 if fam.kind == "histogram":
-                    n = child.count()
+                    with child._lock:
+                        n = child._count
+                        s = child._sum
+                        counts = list(child._counts)
                     # None (not NaN) for empty histograms: the snapshot
                     # must stay strict-JSON for /metrics.json consumers
                     pct = child.percentiles() if n else {
                         "p50": None, "p90": None, "p99": None}
-                    entry = {"labels": labels, "count": n,
-                             "sum": child.sum(), **pct}
+                    # raw per-bucket counts (last slot = +Inf overflow):
+                    # the fleet aggregator merges replicas bucket-wise, so
+                    # merged percentiles are exact, not re-estimated
+                    entry = {"labels": labels, "count": n, "sum": s,
+                             "bounds": list(child._bounds),
+                             "bucket_counts": counts, **pct}
                     ex = child.exemplars()
                     if ex:
                         entry["exemplars"] = ex

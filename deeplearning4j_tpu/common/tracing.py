@@ -261,12 +261,18 @@ class Tracer:
 
     def record(self, name: str, t0: float, t1: float,
                context: Optional[TraceContext] = None,
+               span_id: Optional[str] = None,
                **attrs) -> Optional[dict]:
         """Append one completed span on behalf of a request whose context
         lives on another thread (``t0``/``t1`` in ``time.perf_counter``
         seconds). With ``context``, the span enters that request's tree
-        as a child of ``context.span_id``. An ``error=...`` attr counts
-        ``dl4j_span_errors_total`` exactly like a failing ``span()``."""
+        as a child of ``context.span_id``. ``span_id`` pins the recorded
+        span's own id instead of minting one — a caller that already
+        *announced* an id (the fleet router forwards each attempt's span
+        id downstream in ``traceparent``, so the replica's server-side
+        spans parent under it) records the matching span here. An
+        ``error=...`` attr counts ``dl4j_span_errors_total`` exactly
+        like a failing ``span()``."""
         if not registry().enabled:
             return None
         ev = {"name": name, "ph": "X", "ts": t0 * 1e6,
@@ -275,7 +281,7 @@ class Tracer:
         args = dict(attrs)
         if context is not None:
             args["trace_id"] = context.trace_id
-            args["span_id"] = new_span_id()
+            args["span_id"] = span_id or new_span_id()
             if context.span_id:
                 args["parent_span_id"] = context.span_id
         if args.get("error"):

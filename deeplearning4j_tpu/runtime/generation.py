@@ -76,12 +76,18 @@ Observability: ``dl4j_decode_requests_total``, ``dl4j_decode_tokens_total``,
 ``dl4j_decode_preempted_total``, ``dl4j_spec_proposed_tokens_total`` /
 ``dl4j_spec_accepted_tokens_total``,
 ``dl4j_kv_prefix_{hits,misses,evictions}_total``,
-``dl4j_kv_prefix_blocks{model}``, ``dl4j_decode_ttft_seconds``
-(exemplared with trace ids). Each request's trace gains a
+``dl4j_kv_prefix_blocks{model}``, ``dl4j_decode_ttft_seconds{model}``
+(exemplared with trace ids), ``dl4j_decode_itl_seconds{model}``
+(inter-token latency), and the goodput split
+``dl4j_tokens_total{model,slo=ok|violated}`` — a token is "good" when
+its request's TTFT met the per-model latency objective
+(``DL4J_TPU_SLO_LATENCY_MS``; with no objective set every token is ok). Each request's trace gains a
 ``generation/prefill`` span (queue wait + prompt dispatch, TTFT) and a
-``generation/decode`` span (first token → finish), so ``/debug/requests``
-reconstructs a generation's timeline end to end; ``/debug/decode`` dumps
-the live slot map and block tables.
+``generation/decode`` span (first token → finish), and its result
+carries a ``phases`` dict (``queue_s``/``prefill_s``/``decode_s``) so
+``/debug/requests`` reconstructs — and attributes — a generation's
+timeline end to end; ``/debug/decode`` dumps the live slot map and
+block tables.
 """
 from __future__ import annotations
 
@@ -155,8 +161,8 @@ def sample_tokens(logits, temperature, top_k, key):
 class _GenRequest:
     __slots__ = ("prompt", "max_tokens", "temperature", "top_k", "eos",
                  "on_token", "future", "ctx", "deadline", "t_submit",
-                 "t_first", "tokens", "slot", "prefix", "admit_seq",
-                 "reuse_nodes", "start")
+                 "t_first", "t_prefill0", "t_last", "tokens", "slot",
+                 "prefix", "admit_seq", "reuse_nodes", "start")
 
     def __init__(self, prompt, max_tokens, temperature, top_k, eos,
                  on_token, deadline, ctx):
@@ -171,6 +177,12 @@ class _GenRequest:
         self.deadline = deadline          # monotonic instant or None
         self.t_submit = time.perf_counter()
         self.t_first: Optional[float] = None
+        # phase boundaries for per-request latency decomposition:
+        # queue = [t_submit, t_prefill0), prefill = [t_prefill0,
+        # t_first), decode = [t_first, finish). t_last is the previous
+        # token's emit instant (the inter-token-latency basis).
+        self.t_prefill0: Optional[float] = None
+        self.t_last: Optional[float] = None
         self.tokens: List[int] = []
         self.slot: Optional[int] = None
         # the rows a prefill must (re)compute: the prompt, extended with
@@ -601,7 +613,27 @@ class DecodeEngine:
         self._m_ttft = reg.histogram(
             "dl4j_decode_ttft_seconds",
             "Time from generate() to the first sampled token",
-            buckets=exponential_buckets(1e-3, 2.0, 18))
+            labels=("model",),
+            buckets=exponential_buckets(1e-3, 2.0, 18)).labels(
+                model=self.model_name)
+        self._m_itl = reg.histogram(
+            "dl4j_decode_itl_seconds",
+            "Inter-token latency: gap between consecutive sampled "
+            "tokens of one request (the decode-phase tail a reader "
+            "actually feels)",
+            labels=("model",),
+            buckets=exponential_buckets(1e-4, 2.0, 18)).labels(
+                model=self.model_name)
+        goodput = reg.counter(
+            "dl4j_tokens_total",
+            "Goodput: tokens emitted, split by whether the owning "
+            "request's TTFT met the per-model latency objective "
+            "(DL4J_TPU_SLO_LATENCY_MS; no objective -> every token ok)",
+            labels=("model", "slo"))
+        self._m_tok_ok = goodput.labels(model=self.model_name, slo="ok")
+        self._m_tok_violated = goodput.labels(model=self.model_name,
+                                              slo="violated")
+        self._slo_latency_s = env.slo_latency_s()
         self._m_expired = reg.counter(
             "dl4j_decode_expired_total",
             "Generation requests whose deadline expired before a slot")
@@ -872,7 +904,9 @@ class DecodeEngine:
                  timeout_s: Optional[float] = None) -> Future:
         """Enqueue one generation request; returns a Future resolving to
         ``{"tokens", "finish_reason", "ttft_s", "prompt_tokens",
-        "completion_tokens"}``.
+        "completion_tokens", "tokens_per_sec", "phases"}`` — ``phases``
+        decomposes the request's latency into
+        ``queue_s``/``prefill_s``/``decode_s``.
 
         ``timeout_s`` bounds the wait for a decode *slot* (admission into
         the running batch), not the generation itself; an expired request
@@ -1440,6 +1474,11 @@ class DecodeEngine:
         for r, (req, slot) in enumerate(zip(group, slots)):
             tok = int(toks[r])
             first = req.t_first is None
+            if req.t_prefill0 is None:
+                # first prefill dispatch closes the queue phase; a
+                # preempted rider keeps its original boundary so queue
+                # attribution stays honest across requeues
+                req.t_prefill0 = t0
             if first:
                 req.t_first = t_done
             if self._reg.enabled:
@@ -1548,6 +1587,22 @@ class DecodeEngine:
         with self._stats_lock:
             self._stats["tokens"] += 1
         self._m_tokens.inc()
+        if self._reg.enabled:
+            now = time.perf_counter()
+            if req.t_last is not None:
+                self._m_itl.observe(now - req.t_last)
+            req.t_last = now
+            # goodput: every token of a request whose TTFT met the
+            # latency objective counts as slo=ok; a late first token
+            # taints the whole request's tokens. No configured
+            # objective (slo_latency_s() -> None) means nothing can
+            # violate — mirrors SLOTracker.
+            obj = self._slo_latency_s
+            ttft = (req.t_first - req.t_submit) \
+                if req.t_first is not None else None
+            (self._m_tok_ok if obj is None
+             or (ttft is not None and ttft <= obj)
+             else self._m_tok_violated).inc()
         if req.on_token is not None:
             try:
                 req.on_token(tok)
@@ -1581,6 +1636,15 @@ class DecodeEngine:
         ttft = ((req.t_first - req.t_submit)
                 if req.t_first is not None else None)
         gen_s = t_done - (req.t_first or req.t_submit)
+        phases = {
+            "queue_s": round(req.t_prefill0 - req.t_submit, 6)
+            if req.t_prefill0 is not None else None,
+            "prefill_s": round(req.t_first - req.t_prefill0, 6)
+            if req.t_first is not None and req.t_prefill0 is not None
+            else None,
+            "decode_s": round(t_done - req.t_first, 6)
+            if req.t_first is not None else None,
+        }
         if not req.future.done():
             req.future.set_result({
                 "tokens": list(req.tokens),
@@ -1590,6 +1654,7 @@ class DecodeEngine:
                 "ttft_s": round(ttft, 6) if ttft is not None else None,
                 "tokens_per_sec": round(len(req.tokens) / gen_s, 3)
                 if gen_s > 0 else None,
+                "phases": phases,
             })
 
     def _release_slot(self, slot: int):

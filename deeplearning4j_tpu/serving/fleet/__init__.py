@@ -45,6 +45,22 @@ Minimal flow::
     front = FleetServer(router)
     front.start()                      # clients talk to this one URL
 
+**Observability plane**: the front door is also the fleet's one pane of
+glass. Every dispatch attempt (primary / retry / hedge /
+affinity_fallback) records a ``fleet/attempt`` span under the inbound
+trace context and forwards ``traceparent`` with the attempt's span id as
+parent, so the fleet's ``GET /debug/trace/<id>`` stitches the front-door
+attempts with each involved replica's server-side tree into one
+cross-process trace — a hedged request renders as a single trace with
+both attempts and the winner's full admission/dispatch subtree.
+:class:`~.aggregator.FleetAggregator` rides the existing poll loop,
+folding each replica's ``/metrics.json`` into a bounded time-series ring
+with per-type merge semantics (counters summed with restart-reset
+detection, gauges last-value-per-replica, histograms bucket-wise summed
+so merged percentiles are exact); the fleet serves ``GET /metrics`` +
+``/metrics.json`` (per-replica series labeled ``replica`` plus merged
+series) and ``GET /fleet/signals``, the documented autoscaler feed.
+
 Env knobs: ``DL4J_TPU_FLEET_POLL_S`` (replica poll cadence),
 ``DL4J_TPU_FLEET_RETRIES`` (failover attempts),
 ``DL4J_TPU_FLEET_TIMEOUT_S`` (per-attempt timeout),
@@ -52,7 +68,8 @@ Env knobs: ``DL4J_TPU_FLEET_POLL_S`` (replica poll cadence),
 ``DL4J_TPU_FLEET_HEDGE_PCTL`` (hedge-delay latency percentile),
 ``DL4J_TPU_FLEET_BROWNOUT_FRAC`` (ready fraction below which the front
 door sheds), ``DL4J_TPU_FLEET_DEFAULT_PRIORITY`` (priority assumed
-without an ``X-Priority`` header). Telemetry:
+without an ``X-Priority`` header), ``DL4J_TPU_FLEET_AGG_RETENTION_S`` /
+``DL4J_TPU_FLEET_AGG_MAX_SAMPLES`` (signal-ring retention). Telemetry:
 ``dl4j_fleet_replicas{model}``,
 ``dl4j_router_dispatch_total{replica,outcome}``,
 ``dl4j_fleet_hedges_total{model,outcome}``,
@@ -60,6 +77,8 @@ without an ``X-Priority`` header). Telemetry:
 ``dl4j_fleet_shed_total{model,priority}`` and friends (see
 :mod:`.router`).
 """
+from .aggregator import (FleetAggregator, histogram_quantile,  # noqa: F401
+                         render_prometheus_text)
 from .router import (FleetRouter, FleetServer, MidStreamError,  # noqa: F401
                      NoReplicaError, Replica, RetryBudget,
                      prompt_fingerprint)

@@ -132,7 +132,11 @@ from ...common import faults
 from ...common.environment import environment
 from ...common.locks import ordered_lock
 from ...common.metrics import registry as metrics_registry
+from ...common.tracing import (TraceContext, context_from_traceparent,
+                               format_traceparent, new_span_id, span_tree,
+                               tracer)
 from ..resilience import DispatchStats, latency_zscore
+from .aggregator import FleetAggregator
 
 log = logging.getLogger(__name__)
 
@@ -350,6 +354,9 @@ class FleetRouter:
         self._budget = RetryBudget(
             env.fleet_retry_budget() if retry_budget is None
             else retry_budget, retry_burst)
+        #: fleet metrics aggregation rides the poll loop: every
+        #: /metrics.json the poller fetches is folded into this
+        self.aggregator = FleetAggregator()
         self._lock = ordered_lock("fleet.router")
         self._replicas: Dict[str, Replica] = {}
         self.affinity_vnodes = max(int(affinity_vnodes), 1)
@@ -444,6 +451,7 @@ class FleetRouter:
             if gone:
                 self._rebuild_ring_locked()
         if gone:
+            self.aggregator.forget(url)
             self._update_fleet_gauge()
         return gone
 
@@ -530,6 +538,7 @@ class FleetRouter:
         except ValueError as e:
             load, malformed = {}, 1
             log.debug("junk /metrics.json from %s: %r", rep.url, e)
+        self.aggregator.ingest(rep.url, metrics_doc)
         if malformed:
             self._m_poll_errors.labels(replica=rep.url,
                                        reason="malformed").inc()
@@ -851,6 +860,25 @@ class FleetRouter:
         # the loser accounts for itself
         self._account_abandoned(rep, kind, res, meta)
 
+    def _record_attempt(self, rep: Replica, meta: Dict[str, Any],
+                        outcome: str):
+        """Record this attempt's ``fleet/attempt`` span cross-thread
+        into the front door's trace ring, under the request's
+        :class:`TraceContext` — with the SAME span id the attempt
+        announced downstream in ``traceparent``, so the replica's
+        server-side subtree parents under the exact attempt that
+        reached it when :meth:`stitched_trace` joins the two rings.
+        Runs on whatever thread settles the attempt (the route loop for
+        the winner, the attempt worker itself for an abandoned hedge
+        loser) — ``record(context=)`` is the cross-thread-safe path."""
+        ctx = meta.get("ctx")
+        if ctx is None:
+            return
+        tracer().record("fleet/attempt", meta.get("pt0", 0.0),
+                        time.perf_counter(), context=ctx,
+                        span_id=meta.get("span_id"), replica=rep.url,
+                        kind=meta.get("kind", ""), outcome=outcome)
+
     def _account_abandoned(self, rep: Replica, kind: str, res,
                            meta: Dict[str, Any]):
         latency = time.monotonic() - meta["t0"]
@@ -861,6 +889,7 @@ class FleetRouter:
         if not ok:
             why = "503" if kind == "response" else kind
             self._note_replica_failure(rep, why)
+        self._record_attempt(rep, meta, "abandoned")
         self._m_dispatch.labels(replica=rep.url, outcome="abandoned").inc()
 
     def _note_replica_failure(self, rep: Replica, why: str):
@@ -894,6 +923,17 @@ class FleetRouter:
         timeout = self.timeout_s if timeout_s is None else float(timeout_s)
         if idempotent is None:
             idempotent = not path.split("?", 1)[0].endswith("/generate")
+        # the request's trace context: the client's traceparent when one
+        # arrived, else a fresh root minted here — either way every
+        # attempt records a fleet/attempt span under it, and forwards
+        # its OWN span id downstream so the replica's subtree nests
+        ctx = context_from_traceparent(
+            next((v for k, v in headers
+                  if str(k).lower() == "traceparent"), None))
+        base_headers = [(k, v) for k, v in headers
+                        if str(k).lower() not in ("traceparent",
+                                                  "x-fleet-replica",
+                                                  "x-fleet-attempt")]
         resq: "queue.Queue" = queue.Queue()
         race = {"done": False}
         race_lock = threading.Lock()
@@ -902,19 +942,29 @@ class FleetRouter:
         failovers = 0
         hedged = False
         hedge_blocked = not idempotent
+        first_kind = "primary"
         last_503: Optional[Tuple[int, Dict[str, str], bytes, str]] = None
         last_err: Optional[BaseException] = None
 
         def start(rep: Replica, probe: bool, hedge: bool):
             nonlocal inflight
+            kind = "hedge" if hedge else ("retry" if tried else first_kind)
             tried.append(rep.url)
             with self._lock:
                 rep.inflight += 1
                 rep.dispatched += 1
-            meta = {"probe": probe, "hedge": hedge, "t0": time.monotonic()}
+            sid = new_span_id()
+            hdrs = list(base_headers)
+            hdrs.append(("traceparent", format_traceparent(
+                TraceContext(ctx.trace_id, sid))))
+            hdrs.append(("X-Fleet-Replica", rep.url))
+            hdrs.append(("X-Fleet-Attempt", kind))
+            meta = {"probe": probe, "hedge": hedge, "t0": time.monotonic(),
+                    "pt0": time.perf_counter(), "ctx": ctx,
+                    "span_id": sid, "kind": kind}
             threading.Thread(
                 target=self._attempt,
-                args=(rep, method, path, body, headers, timeout, model,
+                args=(rep, method, path, body, hdrs, timeout, model,
                       meta, resq, race, race_lock),
                 name="dl4j-tpu-fleet-attempt", daemon=True).start()
             inflight += 1
@@ -935,6 +985,8 @@ class FleetRouter:
             rep = self._affine_replica(model, session_key)
             self._m_affinity.labels(
                 outcome="hit" if rep is not None else "fallback").inc()
+            if rep is None:
+                first_kind = "affinity_fallback"
         if rep is None:
             rep, probe = self._pick(model, tried)
         if rep is None:
@@ -994,6 +1046,7 @@ class FleetRouter:
                         probe=meta["probe"])
                     if status < 300 and model is not None:
                         self._note_latency(model, latency)
+                    self._record_attempt(rep, meta, "ok")
                     finish()
                     self._m_dispatch.labels(replica=rep.url,
                                             outcome="ok").inc()
@@ -1008,11 +1061,13 @@ class FleetRouter:
                 self._settle_attempt(rep, ok=False, latency_s=None,
                                      probe=meta["probe"])
                 self._note_replica_failure(rep, "503")
+                self._record_attempt(rep, meta, "503")
             elif kind == "mid_stream":
                 hdrs, err = res
                 self._settle_attempt(rep, ok=False, latency_s=None,
                                      probe=meta["probe"])
                 self._note_replica_failure(rep, "mid_stream")
+                self._record_attempt(rep, meta, "mid_stream")
                 if not idempotent:
                     # the response body started; a retry could run the
                     # generation twice — surface instead
@@ -1030,6 +1085,7 @@ class FleetRouter:
                 self._settle_attempt(rep, ok=False, latency_s=None,
                                      probe=meta["probe"])
                 self._note_replica_failure(rep, "connect")
+                self._record_attempt(rep, meta, "conn_error")
 
             # a sibling attempt may still win the race
             if inflight:
@@ -1102,6 +1158,74 @@ class FleetRouter:
     def count_shed(self, model: Optional[str], priority: int):
         self._m_shed.labels(model=model or "",
                             priority=str(priority)).inc()
+
+    # -- cross-replica trace stitching ------------------------------------
+    def stitched_trace(self, trace_id: str) -> Dict[str, Any]:
+        """One cross-process span tree for ``trace_id``: the front
+        door's own ``fleet/attempt`` spans plus every involved
+        replica's ``/debug/trace/<id>`` events, nested by span ids.
+        Each attempt forwarded its OWN span id downstream in
+        ``traceparent``, so a replica's server-side
+        ``serving/request`` → admission → dispatch subtree hangs under
+        the exact attempt that reached it — a hedged request renders as
+        ONE trace with both attempts and the winner's full subtree.
+        Replicas named by local attempt spans are asked first; with no
+        local evidence (ring rolled over, or another front door served
+        the request) every known replica is asked. An unreachable
+        replica just contributes nothing — stitching is best-effort."""
+        trc = tracer()
+        local = [e for e in trc.events_for(trace_id)
+                 if isinstance(e.get("args"), dict)]
+        urls = sorted({e["args"].get("replica") for e in local
+                       if e.get("name") == "fleet/attempt"
+                       and e["args"].get("replica")})
+        if not urls:
+            urls = sorted(r.url for r in self.replicas())
+        events = list(local)
+        stitched_from: List[str] = []
+        timeout = min(self.timeout_s, max(self.poll_s * 2, 1.0))
+        for url in urls:
+            try:
+                _, doc = self._fetch_json(
+                    url + "/debug/trace/" + trace_id, timeout)
+            except (OSError, ValueError):
+                continue
+            remote = doc.get("events") if isinstance(doc, dict) else None
+            if isinstance(remote, list) and remote:
+                stitched_from.append(url)
+                events.extend(e for e in remote if isinstance(e, dict))
+        # dedup by span id: an in-process fleet (tests, benches) shares
+        # one tracer ring, so the "remote" fetch returns spans the local
+        # scan already collected — one node per span keeps the tree sane
+        seen: set = set()
+        deduped = []
+        for e in events:
+            sid = e.get("args", {}).get("span_id") \
+                if isinstance(e.get("args"), dict) else None
+            if sid is not None:
+                if sid in seen:
+                    continue
+                seen.add(sid)
+            deduped.append(e)
+        events = deduped
+        return {"trace_id": trace_id, "count": len(events),
+                "replicas": stitched_from, "tree": span_tree(events),
+                "events": events}
+
+    # -- autoscaler signal feed -------------------------------------------
+    def fleet_signals(self) -> Dict[str, Any]:
+        """``GET /fleet/signals``: the aggregator's latest per-replica
+        autoscaling signals joined with the router's own membership
+        view (ready/ejected/inflight) and brownout posture, plus the
+        fleet rollup — the documented feed for ROADMAP item 3's
+        SLO-driven autoscaler."""
+        with self._lock:
+            state = {r.url: {"ready": r.ready, "ejected": r.ejected,
+                             "inflight": r.inflight,
+                             "models": list(r.models)}
+                     for r in self._replicas.values()}
+        return self.aggregator.signals(replica_state=state,
+                                       brownout=self.brownout_state())
 
     # -- convenience client API -------------------------------------------
     def predict(self, model: str, inputs, *,
@@ -1186,9 +1310,13 @@ class FleetServer:
     replica (with budgeted failover + hedging); ``GET /v1/models``
     answers from the best replica; ``/readyz`` is the *fleet's*
     readiness (any replica ready) plus its brownout posture; ``/fleet``
-    is the router's polled membership + budget view; ``/metrics`` is
-    the router process's own registry (dispatch counters + fleet
-    gauges).
+    is the router's polled membership + budget view; ``/metrics`` +
+    ``/metrics.json`` serve the router process's own registry (dispatch
+    counters + fleet gauges) COMBINED with the aggregated replica
+    registries — per-replica series carry a ``replica`` label, merged
+    series none (see :mod:`.aggregator`); ``/fleet/signals`` is the
+    distilled autoscaler feed; ``/debug/trace/<id>`` (debug-gated like
+    every ``/debug/*``) answers the cross-replica stitched span tree.
 
     During brownout the front door sheds POSTs whose ``X-Priority``
     (0–9, default ``DL4J_TPU_FLEET_DEFAULT_PRIORITY``) falls below the
@@ -1234,10 +1362,18 @@ class FleetServer:
         return self
 
     def _handler(self):
-        from ...common.httpserver import JsonRequestHandler, metrics_payload
+        from ...common.httpserver import JsonRequestHandler, debug_enabled
+        from ...common.metrics import touch_runtime_info
+        from .aggregator import render_prometheus_text
         router = self.router
 
         class Handler(JsonRequestHandler):
+            def _fleet_exposition(self):
+                """Front-door registry + aggregated replica registries
+                in one /metrics.json-shaped document."""
+                return router.aggregator.merged_with(
+                    touch_runtime_info().snapshot())
+
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
                 if path == "/healthz":
@@ -1254,10 +1390,20 @@ class FleetServer:
                         200 if ready else 503)
                 elif path == "/fleet":
                     self.send_json(router.snapshot())
+                elif path == "/fleet/signals":
+                    self.send_json(router.fleet_signals())
                 elif path == "/metrics":
-                    self.send_payload(*metrics_payload())
+                    self.send_payload(
+                        render_prometheus_text(
+                            self._fleet_exposition()).encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
                 elif path == "/metrics.json":
-                    self.send_payload(*metrics_payload("json"))
+                    self.send_payload(
+                        json.dumps(self._fleet_exposition()).encode(),
+                        "application/json")
+                elif path.startswith("/debug/trace/") and debug_enabled():
+                    self.send_json(router.stitched_trace(
+                        path[len("/debug/trace/"):].strip("/")))
                 elif path == "/v1/models":
                     self._proxy("GET", None)
                 else:

@@ -236,7 +236,10 @@ class ModelServer:
                         latency_s: Optional[float] = None,
                         disposition: Optional[str] = None,
                         precision: Optional[str] = None,
-                        priority: Optional[int] = None):
+                        priority: Optional[int] = None,
+                        fleet_replica: Optional[str] = None,
+                        fleet_attempt: Optional[str] = None,
+                        phases: Optional[dict] = None):
         """Ring + SLO bookkeeping for one completed request, whatever its
         outcome (the ring is the /debug/requests + flight-recorder
         source). ``latency_s`` overrides the SLO-fed latency — generate
@@ -246,7 +249,14 @@ class ModelServer:
         request (``quarantined|retried|breaker_open|engine_restart``);
         when the handler did not set one, the engine-recorded
         disposition for this trace id is consumed — so a post-mortem can
-        tell shed load from faulted load by trace id."""
+        tell shed load from faulted load by trace id.
+        ``fleet_replica``/``fleet_attempt`` echo the front-door attempt
+        that carried the request (the ``X-Fleet-Replica`` /
+        ``X-Fleet-Attempt`` headers the fleet router stamps per
+        attempt), so ``/debug/requests`` — and the flight recorder,
+        which dumps these same ring records — shows which hedge/retry a
+        replica actually served; ``phases`` is the engine's per-request
+        latency decomposition (queue/prefill/decode seconds)."""
         if disposition is None:
             disposition = pop_disposition(trace_id)
         else:
@@ -259,7 +269,10 @@ class ModelServer:
             "precision": precision,
             "priority": priority,
             "ts": time.time(), "duration_s": round(duration_s, 6),
-            "timeout_s": timeout_s})
+            "timeout_s": timeout_s,
+            "fleet_replica": fleet_replica,
+            "fleet_attempt": fleet_attempt,
+            "phases": phases})
         if status in _SLO_STATUSES:
             try:
                 self.slo_for(name).record(
@@ -438,6 +451,14 @@ class ModelServer:
                                                  0), 9)
                     except ValueError:
                         pass
+                # the fleet router stamps which attempt this is
+                # (primary|retry|hedge|affinity_fallback) and its own
+                # view of this replica's URL; echoing them into the
+                # ring joins a replica's /debug/requests (and flight
+                # recorder) back to the front-door attempt it served
+                self._fleet_replica = self.headers.get("X-Fleet-Replica")
+                self._fleet_attempt = self.headers.get("X-Fleet-Attempt")
+                self._phases = None
                 if server.draining:
                     self.send_json(
                         {"error": "server is draining"}, 503,
@@ -457,7 +478,10 @@ class ModelServer:
                         latency_s=self._latency_s,
                         disposition=self._disposition,
                         precision=self._precision,
-                        priority=self._priority)
+                        priority=self._priority,
+                        fleet_replica=self._fleet_replica,
+                        fleet_attempt=self._fleet_attempt,
+                        phases=self._phases)
 
             def _dispatch_request(self, kind: str, name: str,
                                   version: Optional[str]):
@@ -620,6 +644,7 @@ class ModelServer:
                 self._served_version = mv.version
                 self._precision = mv.precision
                 self._latency_s = res.get("ttft_s")
+                self._phases = res.get("phases")
                 self.send_json({"model": name, "version": mv.version,
                                 **res})
 
@@ -657,6 +682,7 @@ class ModelServer:
                         tail = {"done": True, "model": name,
                                 "version": mv.version, **res}
                         self._latency_s = res.get("ttft_s")
+                        self._phases = res.get("phases")
                     except Exception as e:  # headers are out: in-band error
                         self._last_status = 500
                         tail = {"done": True,

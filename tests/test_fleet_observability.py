@@ -1,0 +1,669 @@
+"""Fleet observability plane (serving/fleet aggregator + trace stitching
++ per-token latency decomposition).
+
+Covers the acceptance contract of the observability PR: every
+FleetRouter dispatch attempt records a ``fleet/attempt`` span under the
+inbound trace context (primary / retry / hedge / affinity_fallback, with
+outcome) and forwards its OWN span id downstream, so the fleet's
+``/debug/trace/<id>`` stitches front-door attempts with each replica's
+server-side subtree into ONE cross-process tree — including the
+abandoned hedge loser, whose span lands from the loser's attempt thread.
+Replicas echo ``X-Fleet-Replica`` / ``X-Fleet-Attempt`` into their
+request ring so ``/debug/requests`` and the flight recorder join back
+to the front-door attempt. The FleetAggregator's merge semantics are
+pinned property-style: bucket-wise-summed histograms give percentiles
+EXACTLY equal to a single histogram holding the pooled raw
+observations; counters survive replica restarts (reset detection) and
+removals (retired totals) without the fleet sum ever decreasing; gauges
+are last-value-per-replica. ``/fleet/signals`` is the documented
+autoscaler feed. DecodeEngine's decomposition (TTFT/ITL histograms,
+goodput split by SLO, per-request phase timings) is pinned at the
+engine level.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import faults
+from deeplearning4j_tpu.common.environment import (SystemProperties,
+                                                   environment)
+from deeplearning4j_tpu.common.metrics import MetricsRegistry, registry
+from deeplearning4j_tpu.common.tracing import (TraceContext,
+                                               format_traceparent,
+                                               new_span_id, new_trace_id,
+                                               tracer)
+from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+from deeplearning4j_tpu.serving.fleet import (FleetAggregator, FleetRouter,
+                                              FleetServer,
+                                              histogram_quantile)
+
+N_IN, N_OUT = 6, 3
+
+
+def _mlp(seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=N_IN, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=N_OUT))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _x(n=4, seed=0):
+    return np.random.RandomState(seed).randn(n, N_IN).astype(np.float32)
+
+
+_BODY = None
+
+
+def _body():
+    global _BODY
+    if _BODY is None:
+        _BODY = json.dumps({"inputs": _x().tolist()}).encode()
+    return _BODY
+
+
+def _get(url, timeout=10):
+    try:
+        r = urllib.request.urlopen(url, timeout=timeout)
+        return r.status, dict(r.headers), json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read() or b"{}")
+
+
+def _post(url, body, headers=(), timeout=30):
+    req = urllib.request.Request(url, data=body,
+                                 headers=dict(headers), method="POST")
+    try:
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _wait_until(fn, timeout=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.05)
+    return fn()
+
+
+def _attempt_events(trace_id):
+    return [e for e in tracer().events_for(trace_id)
+            if e.get("name") == "fleet/attempt"]
+
+
+@pytest.fixture(autouse=True)
+def _no_armed_faults():
+    yield
+    faults.clear()
+
+
+class _Fleet:
+    """N live single-model replicas + a router, torn down in reverse."""
+
+    def __init__(self, n, front=False, **router_kw):
+        self.members = []
+        urls = []
+        for i in range(n):
+            reg = ModelRegistry(manifest_dir=None)
+            reg.deploy("toy", "v1", _mlp(), example=_x(), warm=True)
+            srv = ModelServer(reg)
+            port = srv.start()
+            self.members.append((reg, srv))
+            urls.append(f"http://127.0.0.1:{port}")
+        self.urls = urls
+        router_kw.setdefault("poll_s", 0.2)
+        router_kw.setdefault("timeout_s", 30)
+        self.router = FleetRouter(urls, **router_kw)
+        self.router.poll_once()
+        self.front = None
+        if front:
+            self.front = FleetServer(self.router)
+            self.base = f"http://127.0.0.1:{self.front.start()}"
+
+    def predict(self, headers=()):
+        hdrs = [("Content-Type", "application/json"), *headers]
+        return self.router.route("POST", "/v1/models/toy/predict",
+                                 _body(), headers=hdrs, model="toy",
+                                 timeout_s=30)
+
+    def close(self):
+        if self.front is not None:
+            try:
+                self.front.stop()
+            except Exception:
+                pass
+        self.router.stop_polling()
+        for reg, srv in self.members:
+            try:
+                srv.stop()
+            except Exception:
+                pass
+            try:
+                reg.drain_all(save_manifests=False)
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# fleet/attempt spans under the inbound trace context
+# ---------------------------------------------------------------------------
+
+class TestAttemptSpans:
+    def test_primary_attempt_span_parents_replica_subtree(self):
+        """One routed predict: the front door records fleet/attempt
+        (kind=primary, outcome=ok) under the CLIENT's trace context,
+        and the replica's serving/request nests under the attempt's
+        span id — the cross-thread/cross-process parent chain that the
+        stitched tree relies on."""
+        fleet = _Fleet(1)
+        try:
+            tid, client_span = new_trace_id(), new_span_id()
+            tp = format_traceparent(TraceContext(tid, client_span))
+            status, _, _, url = fleet.predict([("traceparent", tp)])
+            assert status == 200
+            attempts = _wait_until(lambda: _attempt_events(tid))
+            assert len(attempts) == 1
+            args = attempts[0]["args"]
+            assert args["kind"] == "primary"
+            assert args["outcome"] == "ok"
+            assert args["replica"] == url == fleet.urls[0]
+            # the attempt parents under the client's span...
+            assert args["parent_span_id"] == client_span
+            # ...and the replica's root span under the attempt
+            req = _wait_until(lambda: [
+                e for e in tracer().events_for(tid)
+                if e.get("name") == "serving/request"])
+            assert req[0]["args"]["parent_span_id"] == args["span_id"]
+        finally:
+            fleet.close()
+
+    def test_hedge_loser_span_lands_in_winners_trace(self):
+        """The satellite regression: attempt worker threads must record
+        under the request's context even for the ABANDONED hedge loser
+        — one trace ends up holding primary(ok) + hedge(abandoned)."""
+        fleet = _Fleet(2, hedge_pctl=50, hedge_min_samples=2,
+                       retry_budget=1.0, retry_burst=10.0)
+        try:
+            for _ in range(4):  # warm the hedge-delay latency samples
+                assert fleet.predict()[0] == 200
+            tid = new_trace_id()
+            tp = format_traceparent(TraceContext(tid, new_span_id()))
+            faults.inject("fleet.dispatch", kind="delay", rate=1.0,
+                          seed=3, delay_s=0.4,
+                          predicate=lambda ctx:
+                          ctx.get("phase") == "connect")
+            try:
+                status, _, _, _ = fleet.predict([("traceparent", tp)])
+            finally:
+                faults.clear("fleet.dispatch")
+            assert status == 200
+            # the loser settles asynchronously on its own attempt thread
+            attempts = _wait_until(
+                lambda: (lambda a: a if len(a) >= 2 else None)(
+                    _attempt_events(tid)))
+            kinds = sorted(e["args"]["kind"] for e in attempts)
+            outcomes = sorted(e["args"]["outcome"] for e in attempts)
+            assert kinds == ["hedge", "primary"]
+            assert outcomes == ["abandoned", "ok"]
+            # both attempts hit distinct replicas of ONE trace
+            assert len({e["args"]["replica"] for e in attempts}) == 2
+        finally:
+            fleet.close()
+
+    def test_failover_records_retry_kind(self):
+        fleet = _Fleet(2, retries=2)
+        try:
+            tid = new_trace_id()
+            tp = format_traceparent(TraceContext(tid, new_span_id()))
+            faults.inject("fleet.dispatch", kind="error", rate=1.0,
+                          seed=5, predicate=lambda ctx:
+                          ctx.get("phase") == "connect")
+
+            def disarm_after_first(ctx):
+                # only the FIRST attempt faults: clear after one hit
+                faults.clear("fleet.dispatch")
+                return True
+
+            faults.clear("fleet.dispatch")
+            first_url = []
+
+            def once(ctx):
+                if first_url:
+                    return False
+                first_url.append(ctx.get("url"))
+                return ctx.get("phase") == "connect"
+
+            faults.inject("fleet.dispatch", kind="error", rate=1.0,
+                          seed=5, predicate=once)
+            status, _, _, _ = fleet.predict([("traceparent", tp)])
+            assert status == 200
+            attempts = _wait_until(
+                lambda: (lambda a: a if len(a) >= 2 else None)(
+                    _attempt_events(tid)))
+            by_kind = {e["args"]["kind"]: e["args"] for e in attempts}
+            assert by_kind["primary"]["outcome"] == "conn_error"
+            assert by_kind["retry"]["outcome"] == "ok"
+        finally:
+            fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-replica trace stitching
+# ---------------------------------------------------------------------------
+
+class TestStitchedTrace:
+    def test_front_door_stitches_one_tree_over_http(self):
+        """E2E over real HTTP: client-minted traceparent → front door →
+        replica and back (X-Trace-Id echo), then the fleet's
+        /debug/trace/<id> answers ONE tree with the attempt span and
+        the replica's admission/dispatch subtree under it."""
+        fleet = _Fleet(1, front=True)
+        try:
+            tid = new_trace_id()
+            tp = format_traceparent(TraceContext(tid, new_span_id()))
+            status, hdrs, _ = _post(
+                fleet.base + "/v1/models/toy/predict", _body(),
+                [("Content-Type", "application/json"),
+                 ("traceparent", tp)])
+            assert status == 200
+            assert hdrs["X-Trace-Id"] == tid
+
+            def stitched():
+                _, _, doc = _get(fleet.base + "/debug/trace/" + tid)
+                names = _subtree_names(doc.get("tree", ()),
+                                       "fleet/attempt")
+                want = {"serving/request", "serving/admission",
+                        "inference/dispatch"}
+                return doc if want <= names else None
+
+            doc = _wait_until(stitched)
+            assert doc, "replica subtree never stitched under attempt"
+            # dedup: one node per span id even when the front door and
+            # the replica share a tracer ring (in-process fleets)
+            sids = [e["args"]["span_id"] for e in doc["events"]
+                    if e.get("args", {}).get("span_id")]
+            assert len(sids) == len(set(sids))
+        finally:
+            fleet.close()
+
+    def test_stitched_trace_falls_back_to_all_replicas(self):
+        """With no local fleet/attempt evidence (another front door
+        served the request), stitching asks every known replica."""
+        fleet = _Fleet(1)
+        try:
+            tid = "ab" * 16
+            status, hdrs, _ = _post(
+                fleet.urls[0] + "/v1/models/toy/predict", _body(),
+                [("Content-Type", "application/json"),
+                 ("traceparent", f"00-{tid}-{'cd' * 8}-01")])
+            assert status == 200
+            tracer_events = _wait_until(
+                lambda: [e for e in tracer().events_for(tid)
+                         if e.get("name") == "serving/request"])
+            assert tracer_events
+            doc = fleet.router.stitched_trace(tid)
+            assert doc["trace_id"] == tid
+            names = {e.get("name") for e in doc["events"]}
+            assert "serving/request" in names
+        finally:
+            fleet.close()
+
+
+def _subtree_names(tree, root_name):
+    names = set()
+
+    def walk(nodes, inside):
+        for n in nodes:
+            hit = inside or n.get("name") == root_name
+            if inside:
+                names.add(n.get("name"))
+            walk(n.get("children", ()), hit)
+
+    walk(tree, False)
+    return names
+
+
+# ---------------------------------------------------------------------------
+# replica-side echo: /debug/requests + flight recorder join the attempt
+# ---------------------------------------------------------------------------
+
+class TestFleetAttemptEcho:
+    def test_ring_echoes_fleet_headers_and_flight_recorder_joins(
+            self, tmp_path):
+        from deeplearning4j_tpu.serving.lifecycle import GracefulLifecycle
+
+        reg = ModelRegistry(manifest_dir=None)
+        reg.deploy("toy", "v1", _mlp(), example=_x(), warm=True)
+        srv = ModelServer(reg)
+        base = f"http://127.0.0.1:{srv.start()}"
+        try:
+            tid = "ef" * 16
+            status, _, _ = _post(
+                base + "/v1/models/toy/predict", _body(),
+                [("Content-Type", "application/json"),
+                 ("traceparent", f"00-{tid}-{'ab' * 8}-01"),
+                 ("X-Fleet-Replica", base),
+                 ("X-Fleet-Attempt", "hedge")])
+            assert status == 200
+            _, _, doc = _get(base + "/debug/requests?trace_id=" + tid)
+            assert doc["count"] == 1
+            rec = doc["requests"][0]
+            assert rec["fleet_replica"] == base
+            assert rec["fleet_attempt"] == "hedge"
+            # the flight recorder dumps these same ring records, so a
+            # dead replica's post-mortem still names its attempt
+            lc = GracefulLifecycle(reg, srv)
+            path = lc.dump_flight_recorder(
+                str(tmp_path / "flight.json"))
+            dump = json.loads(open(path).read())
+            recs = [r for r in dump["requests"]
+                    if r.get("trace_id") == tid]
+            assert recs and recs[0]["fleet_attempt"] == "hedge"
+        finally:
+            srv.stop()
+            reg.drain_all(save_manifests=False)
+
+    def test_router_stamps_attempt_headers(self):
+        fleet = _Fleet(1)
+        try:
+            tid = new_trace_id()
+            tp = format_traceparent(TraceContext(tid, new_span_id()))
+            assert fleet.predict([("traceparent", tp)])[0] == 200
+            _, _, doc = _get(
+                fleet.urls[0] + "/debug/requests?trace_id=" + tid)
+            rec = doc["requests"][0]
+            assert rec["fleet_replica"] == fleet.urls[0]
+            assert rec["fleet_attempt"] == "primary"
+        finally:
+            fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# FleetAggregator merge semantics
+# ---------------------------------------------------------------------------
+
+def _hist_doc(values, name="t_lat", model="m"):
+    """A /metrics.json-shaped doc holding one histogram fed `values`."""
+    r = MetricsRegistry(enabled=True)
+    h = r.histogram(name, "t", labels=("model",)).labels(model=model)
+    for v in values:
+        h.observe(v)
+    return r.snapshot()
+
+
+def _counter_doc(value, name="c_total"):
+    r = MetricsRegistry(enabled=True)
+    r.counter(name, "c").inc(value)
+    return r.snapshot()
+
+
+def _merged_series(agg, name):
+    return [e for e in agg.snapshot()[name]["series"]
+            if "replica" not in e["labels"]]
+
+
+class TestAggregatorMerge:
+    def test_merged_percentiles_equal_pooled_raw_observations(self):
+        """The headline property: fleet-merged p50/p90/p99 from
+        bucket-wise-summed counts EXACTLY equal the percentiles a
+        single histogram reports when fed every replica's raw
+        observations pooled — never an average of averages."""
+        rng = np.random.RandomState(7)
+        shards = [np.exp(rng.uniform(-12, 2, size=n)).tolist()
+                  for n in (37, 11, 83)]
+        agg = FleetAggregator(retention_s=60, max_samples=64)
+        for i, values in enumerate(shards):
+            agg.ingest(f"http://r{i}", _hist_doc(values))
+
+        pooled_reg = MetricsRegistry(enabled=True)
+        pooled = pooled_reg.histogram(
+            "t_lat", "t", labels=("model",)).labels(model="m")
+        for values in shards:
+            for v in values:
+                pooled.observe(v)
+
+        merged = _merged_series(agg, "t_lat")
+        assert len(merged) == 1
+        m = merged[0]
+        assert m["count"] == sum(len(s) for s in shards)
+        for q, key in ((0.50, "p50"), (0.90, "p90"), (0.99, "p99")):
+            assert m[key] == pooled.quantile(q)  # exact, no tolerance
+        # and the generic helper agrees with the merged entry
+        assert histogram_quantile(
+            tuple(m["bounds"]), m["bucket_counts"], 0.99) == m["p99"]
+
+    def test_counter_reset_detection_never_decreases_fleet_sum(self):
+        agg = FleetAggregator(retention_s=60, max_samples=64)
+        agg.ingest("http://a", _counter_doc(10))
+        agg.ingest("http://b", _counter_doc(10))
+        assert _merged_series(agg, "c_total")[0]["value"] == 20
+        # replica a restarts: raw drops 10 -> 2; the fleet sum must
+        # treat the 2 as fresh traffic, never go backwards
+        agg.ingest("http://a", _counter_doc(2))
+        assert _merged_series(agg, "c_total")[0]["value"] == 22
+        agg.ingest("http://a", _counter_doc(5))
+        assert _merged_series(agg, "c_total")[0]["value"] == 25
+
+    def test_histogram_reset_detection(self):
+        agg = FleetAggregator(retention_s=60, max_samples=64)
+        agg.ingest("http://a", _hist_doc([0.1] * 8))
+        # restart: the replica comes back with fewer observations
+        agg.ingest("http://a", _hist_doc([0.1] * 3))
+        m = _merged_series(agg, "t_lat")[0]
+        assert m["count"] == 11  # 8 from the first epoch + 3 fresh
+
+    def test_gauge_is_last_value_per_replica(self):
+        def gauge_doc(v):
+            r = MetricsRegistry(enabled=True)
+            r.gauge("g", "g").set(v)
+            return r.snapshot()
+
+        agg = FleetAggregator(retention_s=60, max_samples=64)
+        agg.ingest("http://a", gauge_doc(5.0))
+        agg.ingest("http://a", gauge_doc(2.0))  # overwrite, not sum
+        agg.ingest("http://b", gauge_doc(3.0))
+        snap = agg.snapshot()["g"]["series"]
+        per_rep = {e["labels"].get("replica"): e["value"] for e in snap}
+        assert per_rep["http://a"] == 2.0
+        assert per_rep["http://b"] == 3.0
+        assert per_rep[None] == 5.0  # merged = last values summed
+
+    def test_forgotten_replica_keeps_counter_history(self):
+        agg = FleetAggregator(retention_s=60, max_samples=64)
+        agg.ingest("http://a", _counter_doc(10))
+        agg.ingest("http://b", _counter_doc(7))
+        agg.forget("http://a")
+        # a's traffic really happened: the merged sum stays monotone,
+        # but a no longer appears as a per-replica series
+        snap = agg.snapshot()["c_total"]["series"]
+        assert all(e["labels"].get("replica") != "http://a"
+                   for e in snap)
+        merged = [e for e in snap if "replica" not in e["labels"]]
+        assert merged[0]["value"] == 17
+        agg.ingest("http://b", _counter_doc(9))
+        assert _merged_series(agg, "c_total")[0]["value"] == 19
+
+    def test_junk_documents_are_ignored(self):
+        agg = FleetAggregator(retention_s=60, max_samples=64)
+        for junk in (None, [], "x", {"f": "nope"},
+                     {"f": {"type": "histogram", "series": [
+                         {"labels": {"m": "x"}, "bounds": [1.0],
+                          "bucket_counts": [1]}]}},  # wrong arity
+                     {"f": {"type": "counter",
+                            "series": [{"labels": {}, "value": "NaN"}]}}):
+            agg.ingest("http://a", junk)
+        snap = agg.snapshot()
+        assert all(not fam["series"] for fam in snap.values())
+
+
+class TestFleetSignals:
+    def _doc(self, waiters, ewma, healthy=1.0):
+        r = MetricsRegistry(enabled=True)
+        r.gauge("dl4j_serving_waiters", "w",
+                labels=("model",)).labels(model="toy").set(waiters)
+        r.gauge("dl4j_serving_ewma_service_seconds", "e",
+                labels=("model",)).labels(model="toy").set(ewma)
+        r.gauge("dl4j_slo_healthy", "h",
+                labels=("model",)).labels(model="toy").set(healthy)
+        r.gauge("dl4j_slo_burn_rate", "b",
+                labels=("model", "window")).labels(
+                    model="toy", window="300").set(0.5 * (waiters + 1))
+        return r.snapshot()
+
+    def test_rollup_sums_means_and_worst_burn(self):
+        agg = FleetAggregator(retention_s=60, max_samples=64)
+        agg.ingest("http://a", self._doc(2, 0.010))
+        agg.ingest("http://b", self._doc(4, 0.030, healthy=0.0))
+        sig = agg.signals(replica_state={
+            "http://a": {"ready": True, "ejected": False, "inflight": 0},
+            "http://b": {"ready": True, "ejected": False, "inflight": 1},
+        })
+        assert set(sig["replicas"]) == {"http://a", "http://b"}
+        roll = sig["fleet"]
+        assert roll["replicas"] == 2 and roll["ready"] == 2
+        adm = roll["admission"]["toy"]
+        assert adm["waiters"] == 6                       # summed
+        assert adm["ewma_s"] == pytest.approx(0.020)     # mean
+        slo = roll["slo"]["toy"]
+        assert slo["healthy"] is False                   # AND
+        assert slo["burn"]["300"] == pytest.approx(2.5)  # max
+        assert sig["ring"]["samples"] == 2
+
+    def test_ring_bounded_by_max_samples(self):
+        agg = FleetAggregator(retention_s=60, max_samples=3)
+        for i in range(10):
+            agg.ingest("http://a", self._doc(i, 0.01))
+        sig = agg.signals()
+        assert sig["ring"]["samples"] <= 3
+        assert sig["ring"]["scrapes"] == 10
+        # the latest view wins
+        assert sig["replicas"]["http://a"]["admission"]["toy"][
+            "waiters"] == 9
+
+    def test_http_fleet_signals_and_merged_metrics(self):
+        """The live endpoints: /fleet/signals rows match membership and
+        fleet /metrics.json carries replica-labeled + merged series."""
+        fleet = _Fleet(2, front=True, poll_s=0.2)
+        try:
+            for _ in range(4):
+                assert fleet.predict()[0] == 200
+            fleet.router.poll_once()
+            _, _, sig = _get(fleet.base + "/fleet/signals")
+            assert set(sig["replicas"]) == set(fleet.urls)
+            assert sig["fleet"]["replicas"] == 2
+            for url in fleet.urls:
+                assert sig["replicas"][url]["ready"] is True
+            _, _, doc = _get(fleet.base + "/metrics.json")
+            fam = doc.get("dl4j_serving_requests_total") or {}
+            labels = [e["labels"] for e in fam.get("series", ())]
+            assert any("replica" in l for l in labels)
+            # prometheus text renders too (cumulative buckets et al)
+            r = urllib.request.urlopen(fleet.base + "/metrics",
+                                       timeout=10)
+            text = r.read().decode()
+            assert r.status == 200
+            assert 'replica="' in text and "_bucket" in text
+        finally:
+            fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# per-token latency decomposition (DecodeEngine)
+# ---------------------------------------------------------------------------
+
+class TestLatencyDecomposition:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        from deeplearning4j_tpu.models import causal_lm
+        from deeplearning4j_tpu.runtime.generation import DecodeEngine
+
+        model = causal_lm.CausalLM(causal_lm.CausalLMConfig.tiny(),
+                                   seed=0)
+        eng = DecodeEngine(model, slots=2, max_ctx=64,
+                           prompt_buckets=[32])
+        yield eng
+        eng.close(10)
+
+    def _prompt(self, n=5, seed=0):
+        from deeplearning4j_tpu.models import causal_lm
+        cfg = causal_lm.CausalLMConfig.tiny()
+        return np.random.RandomState(seed).randint(
+            0, cfg.vocab_size, n).astype(np.int32)
+
+    def test_result_carries_phase_decomposition(self, engine):
+        res = engine.generate(self._prompt(), max_tokens=6).result(60)
+        phases = res["phases"]
+        assert set(phases) == {"queue_s", "prefill_s", "decode_s"}
+        assert all(v is None or v >= 0 for v in phases.values())
+        assert phases["prefill_s"] is not None
+        assert phases["decode_s"] is not None
+
+    def test_ttft_itl_and_goodput_metrics(self, engine):
+        def counter(name, **labels):
+            fam = registry().get(name)
+            if fam is None:
+                return 0.0
+            want = tuple(labels[k] for k in fam.label_names)
+            return sum(c.value() for key, c in fam.children()
+                       if key == want)
+
+        model_name = engine.model_name
+        pre_ok = counter("dl4j_tokens_total", model=model_name, slo="ok")
+        res = engine.generate(self._prompt(seed=1),
+                              max_tokens=5).result(60)
+        n_tok = len(res["tokens"])
+        assert n_tok > 0
+        # no latency objective configured -> every token counts ok
+        assert counter("dl4j_tokens_total", model=model_name,
+                       slo="ok") == pre_ok + n_tok
+        fam = registry().get("dl4j_decode_itl_seconds")
+        assert fam is not None and "model" in fam.label_names
+        fam = registry().get("dl4j_decode_ttft_seconds")
+        assert fam is not None and "model" in fam.label_names
+
+    def test_slo_objective_splits_goodput(self):
+        """An absurdly tight latency objective marks every token
+        violated — the goodput split the autoscaler feed keys on."""
+        from deeplearning4j_tpu.models import causal_lm
+        from deeplearning4j_tpu.runtime.generation import DecodeEngine
+
+        env = environment()
+        saved = env.property_override(SystemProperties.SLO_LATENCY_MS)
+        env.set_property(SystemProperties.SLO_LATENCY_MS, "0.0001")
+        eng = None
+        try:
+            model = causal_lm.CausalLM(causal_lm.CausalLMConfig.tiny(),
+                                       seed=1)
+            eng = DecodeEngine(model, slots=2, max_ctx=64,
+                               prompt_buckets=[32])
+
+            def violated():
+                fam = registry().get("dl4j_tokens_total")
+                i = fam.label_names.index("slo")
+                j = fam.label_names.index("model")
+                return sum(c.value() for key, c in fam.children()
+                           if key[i] == "violated"
+                           and key[j] == eng.model_name)
+
+            pre = violated()
+            res = eng.generate(self._prompt(seed=2),
+                               max_tokens=4).result(60)
+            assert violated() == pre + len(res["tokens"])
+        finally:
+            if eng is not None:
+                eng.close(10)
+            if saved is None:
+                env.clear_property(SystemProperties.SLO_LATENCY_MS)
+            else:
+                env.set_property(SystemProperties.SLO_LATENCY_MS, saved)
