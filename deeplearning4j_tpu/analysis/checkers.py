@@ -22,15 +22,17 @@ from . import Finding, Module, PACKAGE_ROOT
 #: request class, the ten values "0".."9"; slo is the goodput split on
 #: ``dl4j_tokens_total``, ok|violated; outcome enums are per-family,
 #: e.g. the router dispatch set and the session-affinity pair
-#: hit|fallback on ``dl4j_fleet_affinity_total``), a deploy-bounded identity
+#: hit|fallback on ``dl4j_fleet_affinity_total``; kernel is the
+#: hand-written-kernel family on ``dl4j_kernel_dispatch_total`` —
+#: attention|paged_decode|dequant_matmul), a deploy-bounded identity
 #: (model/version/bucket/worker/name/replica — replica is a fleet
 #: member's URL, bounded by the router's configured replica set), or
 #: process identity (the build-info trio). A request-scoped value (trace id, user id, prompt)
 #: must ride on exemplars or spans, never on labels.
 REGISTERED_LABELS: Set[str] = {
-    "bucket", "cache", "engine", "good", "kind", "mode", "model", "name",
-    "outcome", "path", "priority", "reason", "replica", "site", "slo",
-    "state", "tier", "version", "window", "worker", "jax_version",
+    "bucket", "cache", "engine", "good", "kernel", "kind", "mode", "model",
+    "name", "outcome", "path", "priority", "reason", "replica", "site",
+    "slo", "state", "tier", "version", "window", "worker", "jax_version",
     "jaxlib_version", "platform",
 }
 

@@ -50,6 +50,8 @@ class EnvironmentVars:
     DL4J_TPU_XLA_CACHE = "DL4J_TPU_XLA_CACHE"
     DL4J_TPU_WARMUP_THREADS = "DL4J_TPU_WARMUP_THREADS"
     DL4J_TPU_FLASH_MIN_SEQ = "DL4J_TPU_FLASH_MIN_SEQ"
+    DL4J_TPU_PAGED_KERNEL = "DL4J_TPU_PAGED_KERNEL"
+    DL4J_TPU_FUSED_DEQUANT = "DL4J_TPU_FUSED_DEQUANT"
     DL4J_TPU_INFERENCE_BUCKETING = "DL4J_TPU_INFERENCE_BUCKETING"
     DL4J_TPU_INFERENCE_MAX_BATCH = "DL4J_TPU_INFERENCE_MAX_BATCH"
     DL4J_TPU_DECODE_SLOTS = "DL4J_TPU_DECODE_SLOTS"
@@ -122,6 +124,8 @@ class SystemProperties:
     XLA_CACHE = "xla_cache"
     WARMUP_THREADS = "warmup_threads"
     FLASH_MIN_SEQ = "flash_min_seq"
+    PAGED_KERNEL = "paged_kernel"
+    FUSED_DEQUANT = "fused_dequant"
     INFERENCE_BUCKETING = "inference_bucketing"
     INFERENCE_MAX_BATCH = "inference_max_batch"
     DECODE_SLOTS = "decode_slots"
@@ -195,6 +199,8 @@ _ENV_FOR_PROP = {
     SystemProperties.XLA_CACHE: EnvironmentVars.DL4J_TPU_XLA_CACHE,
     SystemProperties.WARMUP_THREADS: EnvironmentVars.DL4J_TPU_WARMUP_THREADS,
     SystemProperties.FLASH_MIN_SEQ: EnvironmentVars.DL4J_TPU_FLASH_MIN_SEQ,
+    SystemProperties.PAGED_KERNEL: EnvironmentVars.DL4J_TPU_PAGED_KERNEL,
+    SystemProperties.FUSED_DEQUANT: EnvironmentVars.DL4J_TPU_FUSED_DEQUANT,
     SystemProperties.INFERENCE_BUCKETING:
         EnvironmentVars.DL4J_TPU_INFERENCE_BUCKETING,
     SystemProperties.INFERENCE_MAX_BATCH:
@@ -289,6 +295,8 @@ _DEFAULTS = {
     SystemProperties.XLA_CACHE: "auto",
     SystemProperties.WARMUP_THREADS: "0",  # 0 = auto
     SystemProperties.FLASH_MIN_SEQ: "1024",
+    SystemProperties.PAGED_KERNEL: "auto",
+    SystemProperties.FUSED_DEQUANT: "auto",
     SystemProperties.INFERENCE_BUCKETING: "1",
     SystemProperties.INFERENCE_MAX_BATCH: "128",
     SystemProperties.DECODE_SLOTS: "8",
@@ -531,6 +539,42 @@ class Environment:
 
     def set_flash_min_seq(self, n: int):
         return self.set_property(SystemProperties.FLASH_MIN_SEQ, int(n))
+
+    def paged_kernel(self) -> str:
+        """Policy for the Pallas paged-flash decode kernel
+        (``DL4J_TPU_PAGED_KERNEL``): "auto" (default) runs it on
+        accelerator backends when the paged KV layout tiles natively
+        (``kernels.paged_flash_decode.tileable``) and keeps the XLA
+        block-table gather path otherwise; "on" forces the kernel
+        everywhere (interpret mode off-accelerator — the token-identity
+        test/debug hook); "off" pins the gather path. Evaluated at trace
+        time by ``kernels.attention_dispatch``, so flipping it only
+        affects executables compiled afterwards."""
+        v = str(self.property(SystemProperties.PAGED_KERNEL)
+                or "auto").lower()
+        return v if v in ("auto", "on", "off") else "auto"
+
+    def set_paged_kernel(self, mode: Optional[str]):
+        """Programmatic override; None restores "auto"."""
+        return self.set_property(SystemProperties.PAGED_KERNEL,
+                                 mode or "auto")
+
+    def fused_dequant(self) -> str:
+        """Policy for the Pallas fused int8 dequant-matmul
+        (``DL4J_TPU_FUSED_DEQUANT``): "auto" (default) fuses on
+        accelerator backends when the weight tiles natively (K and N
+        multiples of 128) and falls back to the XLA
+        cast-then-``dot`` contraction otherwise; "on" forces the kernel
+        everywhere (interpret mode off-accelerator); "off" pins the XLA
+        path. Trace-time, like ``paged_kernel``."""
+        v = str(self.property(SystemProperties.FUSED_DEQUANT)
+                or "auto").lower()
+        return v if v in ("auto", "on", "off") else "auto"
+
+    def set_fused_dequant(self, mode: Optional[str]):
+        """Programmatic override; None restores "auto"."""
+        return self.set_property(SystemProperties.FUSED_DEQUANT,
+                                 mode or "auto")
 
     # -- inference-serving knobs (runtime/inference.py) --------------------
     def inference_bucketing(self) -> bool:

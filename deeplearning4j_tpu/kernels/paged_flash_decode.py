@@ -1,0 +1,145 @@
+"""Paged-flash decode — Pallas TPU kernel over the paged KV block pool.
+
+The XLA fallback in ``models.causal_lm.paged_decode`` reads the cache by
+gathering every slot's blocks into a contiguous ``[S, C, H, D]`` view
+(``jnp.take`` over the block table) and running dense einsum attention
+against it — one full round-trip of the slot's KV through HBM per layer
+per step, plus the materialized gather copy. This kernel closes that gap
+the way PagedAttention (vLLM, SOSP'23) and Flash-Decoding do: the block
+table itself rides into the kernel as a *scalar-prefetch* operand, the
+grid walks ``(slot, table_column)``, and each KV block is DMA'd HBM→VMEM
+exactly once, straight from its pool position — no gathered copy ever
+exists. Scores accumulate through the standard online-softmax recurrence
+(f32 m/l/acc VMEM scratch persisting across the sequential block walk),
+with per-slot length masking so scratch blocks (table padding points at
+block 0) and uncommitted tail rows contribute nothing.
+
+``Q`` is the per-slot query count: 1 for the classic decode step, k+1
+for the speculative verify pass — one kernel serves both, and the
+dispatch decision (``kernels.attention_dispatch``) deliberately ignores
+``Q`` so spec-k configs can never flap between paths mid-stream.
+
+Layouts match the pool exactly (no transposes at the call site):
+
+  q                [S, Q, H, D]   queries at positions lengths[s]+0..Q-1
+  k_pages/v_pages  [N, Bs, H, D]  one layer's slice of the block pool
+  tables           [S, MB] int32  per-slot block table (0 = scratch)
+  lengths          [S]     int32  committed rows per slot
+
+Heads are walked inside the kernel body (H is static and small for the
+decode shapes this serves), so one block fetch feeds all heads. Tests
+run interpret mode on CPU; the real chip runs compiled.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _interpret, _params
+
+_NEG_INF = -1e30
+
+
+def tileable(head_dim: int, block_size: int) -> bool:
+    """Whether the paged KV layout hits Mosaic's native f32/bf16 tiling
+    on hardware: the lane dim of every streamed block is ``head_dim``
+    and the key sublane dim is ``block_size``. Shapes that fail this run
+    the XLA gather fallback under ``DL4J_TPU_PAGED_KERNEL=auto`` (the
+    compiled kernel would pad each tiny block up to a full tile and lose
+    to the gather); interpret mode accepts any shape."""
+    return int(head_dim) % 128 == 0 and int(block_size) % 8 == 0
+
+
+def _kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
+            m_sc, l_sc, acc_sc, *, scale, n_blocks, heads):
+    s, b = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(b == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    n_q, bs = q_ref.shape[1], k_ref.shape[1]
+    # logical row each key of this table column occupies in the slot's
+    # sequence vs the row each query writes at: key row r is visible to
+    # query qi iff r <= lengths[s]+qi — identical to the gather path's
+    # key_mask, and it zeroes scratch-block padding (columns past the
+    # slot's allocation point at block 0 but their logical rows exceed
+    # every query position)
+    row = b * bs + jax.lax.broadcasted_iota(jnp.int32, (n_q, bs), 1)
+    qpos = lengths_ref[s] + jax.lax.broadcasted_iota(
+        jnp.int32, (n_q, bs), 0)
+    mask = row <= qpos
+
+    for h in range(heads):
+        q, k, v = q_ref[0, :, h, :], k_ref[0, :, h, :], v_ref[0, :, h, :]
+        sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        sc = jnp.where(mask, sc, _NEG_INF)
+        m_prev = m_sc[h][:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1))
+        p = jnp.exp(sc - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        m_sc[h] = m_new[:, None]
+        l_sc[h] = l_sc[h] * alpha[:, None] + jnp.sum(p, axis=-1)[:, None]
+        acc_sc[h] = acc_sc[h] * alpha[:, None] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+
+    @pl.when(b == n_blocks - 1)
+    def _done():
+        for h in range(heads):
+            l = jnp.maximum(l_sc[h][:, 0], 1e-30)
+            o_ref[0, :, h, :] = (acc_sc[h] / l[:, None]).astype(o_ref.dtype)
+
+
+def paged_flash_decode(q, k_pages, v_pages, tables, lengths,
+                       scale: float = None, interpret: bool = None):
+    """Attention context for the paged decode step, read straight from
+    the block pool. Returns ``ctx [S, Q, H, D]`` in ``q.dtype`` — the
+    drop-in replacement for the gather path's softmax(QKᵀ)·V (the caller
+    keeps its own QKV projections, cache scatter and output projection).
+
+    The K/V pages must already hold the current step's rows: callers
+    scatter the fresh K/V through the block table first (exactly as the
+    gather path does) and pass the updated pool slice in.
+    """
+    S, Q, H, D = q.shape
+    Bs = k_pages.shape[1]
+    MB = tables.shape[1]
+    scale = D ** -0.5 if scale is None else scale
+    if interpret is None:
+        interpret = _interpret()
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, MB),
+        in_specs=[
+            pl.BlockSpec((1, Q, H, D), lambda s, b, t, ln: (s, 0, 0, 0)),
+            # the in-kernel block-table walk: the KV index maps read the
+            # prefetched table, so each grid step DMAs its pool block
+            # directly — the gather copy never exists
+            pl.BlockSpec((1, Bs, H, D),
+                         lambda s, b, t, ln: (t[s, b], 0, 0, 0)),
+            pl.BlockSpec((1, Bs, H, D),
+                         lambda s, b, t, ln: (t[s, b], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Q, H, D),
+                               lambda s, b, t, ln: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, Q, 1), jnp.float32),
+            pltpu.VMEM((H, Q, 1), jnp.float32),
+            pltpu.VMEM((H, Q, D), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_kernel, scale=scale, n_blocks=MB, heads=H),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, Q, H, D), q.dtype),
+        compiler_params=_params(1),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pages, v_pages)

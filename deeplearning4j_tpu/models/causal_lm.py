@@ -391,15 +391,22 @@ def paged_decode(params, cache, tables, tokens, lengths,
     ``tokens`` [S, Q] are each slot's next Q tokens (position
     ``lengths[s]+q``), ``lengths`` [S] how many committed rows each
     slot's blocks hold. Writes each token's K/V through the block table,
-    then attends over the gathered block view — the block-table gather
-    happens inside the jitted step, so the executable set stays fixed
+    then attends over the block pool — either through the Pallas
+    paged-flash kernel (``kernels.paged_flash_decode``: the block table
+    rides into the kernel as a scalar-prefetch operand and KV blocks
+    stream HBM→VMEM with online-softmax accumulation) or the XLA
+    block-table gather fallback; both live inside the jitted step, and
+    the path is decided at trace time, so the executable set stays fixed
     (zero steady-state recompiles). Returns ``(cache, logits[S, Q, V])``.
 
-    ``kernels.attention_dispatch`` labels this path ``paged`` on the
-    dispatch counter; like the slab decode it always computes via XLA
-    einsums (a gathered-block query can never amortize the Pallas
-    kernel's blocking)."""
-    from ..kernels import attention_dispatch
+    ``kernels.attention_dispatch(Q, paged=True, head_dim=, block_size=)``
+    picks the path (``DL4J_TPU_PAGED_KERNEL``: auto routes to the kernel
+    on accelerator backends when the pool layout tiles, on/off force);
+    the decision ignores ``Q`` by contract so the decode step and the
+    ``Q=k+1`` speculative verify always share a path. Both compute the
+    same masked softmax over the same rows — greedy decode is
+    token-identical across them (regression-gated)."""
+    from ..kernels import attention_dispatch, paged_flash_decode
 
     c = config
     S, Q = tokens.shape
@@ -409,7 +416,9 @@ def paged_decode(params, cache, tables, tokens, lengths,
     pos = lengths[:, None] + jnp.arange(Q)[None, :]            # [S, Q]
     h = _embed(params, tokens,
                jnp.clip(pos, 0, c.max_position_embeddings - 1), c)
-    assert attention_dispatch(Q, paged=True) == "paged"
+    path = attention_dispatch(Q, paged=True, head_dim=c.head_dim,
+                              block_size=Bs)
+    assert path in ("paged", "paged_flash")
     blk, off = _block_coords(tables, pos, Bs)
     key_mask = jnp.arange(C)[None, None, :] <= pos[:, :, None]  # [S, Q, C]
     scale = c.head_dim ** -0.5
@@ -426,16 +435,22 @@ def paged_decode(params, cache, tables, tokens, lengths,
             k.astype(cache_k.dtype), mode="drop")
         cache_v = cache_v.at[blk, i, off].set(
             v.astype(cache_v.dtype), mode="drop")
-        # gather each slot's blocks into its contiguous [C] key view
-        ks = jnp.take(cache_k[:, i], tables, axis=0).reshape(
-            S, C, c.num_heads, c.head_dim)
-        vs = jnp.take(cache_v[:, i], tables, axis=0).reshape(
-            S, C, c.num_heads, c.head_dim)
-        att = jnp.einsum("sqhd,schd->shqc", q, ks,
-                         preferred_element_type=jnp.float32) * scale
-        att = jnp.where(key_mask[:, None], att, _BIG_NEG)
-        probs = jax.nn.softmax(att, axis=-1).astype(h.dtype)
-        ctx = jnp.einsum("shqc,schd->sqhd", probs, vs)
+        if path == "paged_flash":
+            # walk the block table in-kernel: each pool block is DMA'd
+            # once, straight from its pool position — no gathered copy
+            ctx = paged_flash_decode(q, cache_k[:, i], cache_v[:, i],
+                                     tables, lengths, scale=scale)
+        else:
+            # gather each slot's blocks into its contiguous [C] key view
+            ks = jnp.take(cache_k[:, i], tables, axis=0).reshape(
+                S, C, c.num_heads, c.head_dim)
+            vs = jnp.take(cache_v[:, i], tables, axis=0).reshape(
+                S, C, c.num_heads, c.head_dim)
+            att = jnp.einsum("sqhd,schd->shqc", q, ks,
+                             preferred_element_type=jnp.float32) * scale
+            att = jnp.where(key_mask[:, None], att, _BIG_NEG)
+            probs = jax.nn.softmax(att, axis=-1).astype(h.dtype)
+            ctx = jnp.einsum("shqc,schd->sqhd", probs, vs)
         out = jnp.einsum("sqhd,hde->sqe", ctx,
                          dequantize(a["wo"], h.dtype)) + a["bo"]
         h = _mlp_ln(layer, h, out, c)

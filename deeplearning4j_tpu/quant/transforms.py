@@ -23,6 +23,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 #: saturation range of the two storage formats
 _INT8_QMAX = 127.0
@@ -163,14 +165,105 @@ def dequantize(w, dtype=None):
     return out.astype(dtype if dtype is not None else w.orig_dtype)
 
 
+def _mm_fit_tile(dim: int, want: int, base: int) -> int:
+    """Largest multiple of ``base`` ≤ ``want`` that divides ``dim``;
+    ``dim`` itself when nothing divides (interpret mode takes any
+    shape, hardware eligibility is gated before we get here)."""
+    if dim % base:
+        return dim
+    t = min(want, dim)
+    t -= t % base
+    while t > 0 and dim % t:
+        t -= base
+    return t if t > 0 else dim
+
+
+def _fused_mm_kernel(x_ref, w_ref, s_ref, o_ref, acc_sc, *, n_k):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    # the dequant lives inside the contraction loop: the int8 tile is
+    # widened to the activation dtype in VMEM registers on its way into
+    # the MXU — a full-precision weight copy never exists, in HBM or out
+    acc_sc[...] += jax.lax.dot_general(
+        x_ref[...], w_ref[...].astype(x_ref.dtype),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_k - 1)
+    def _done():
+        o_ref[...] = (acc_sc[...] * s_ref[...]).astype(o_ref.dtype)
+
+
+def _fused_dequant_matmul(x2, q, scale_row, interpret):
+    """int8 weight-stationary ``[M,K] @ [K,N]`` with the per-channel
+    scale applied to the f32 accumulator at the final K step —
+    numerically ``(x @ q) * scale``, the exact XLA-path contraction."""
+    import functools
+    from ..kernels.flash_attention import _params
+
+    M, K = x2.shape
+    N = q.shape[1]
+    tk = _mm_fit_tile(K, 512, 128)
+    tn = _mm_fit_tile(N, 256, 128)
+    M_pad = -(-M // 8) * 8
+    if M_pad != M:
+        x2 = jnp.pad(x2, [(0, M_pad - M), (0, 0)])
+    tm = _mm_fit_tile(M_pad, 256, 8)
+    grid = (M_pad // tm, N // tn, K // tk)
+    out = pl.pallas_call(
+        functools.partial(_fused_mm_kernel, n_k=grid[2]),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tm, tk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((tk, tn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((1, tn), lambda i, j, k: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((tm, tn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M_pad, N), x2.dtype),
+        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        compiler_params=_params(2),
+        interpret=interpret,
+    )(x2, q, scale_row.reshape(1, N).astype(jnp.float32))
+    return out[:M] if M_pad != M else out
+
+
+def _fused_path(x, w):
+    """(path, reason, interpret) for the int8 per-channel branch of
+    :func:`dequant_matmul` — "fused" (Pallas) or "xla" (cast-then-dot).
+    Trace-time, mirroring ``kernels.attention_dispatch``'s contract."""
+    from ..common.environment import environment
+    mode = environment().fused_dequant()
+    if mode == "off":
+        return "xla", "DL4J_TPU_FUSED_DEQUANT=off", False
+    M = int(np.prod(x.shape[:-1])) if x.ndim > 1 else 1
+    if M == 0:
+        return "xla", "empty activation batch", False
+    K, N = w.q.shape
+    if mode == "on":
+        return "fused", "", jax.default_backend() == "cpu"
+    if jax.default_backend() == "cpu":
+        return "xla", "cpu backend (auto gates the kernel to accelerators)", \
+            False
+    if K % 128 or N % 128:
+        return "xla", f"untileable weight: K={K} N={N}", False
+    return "fused", "", False
+
+
 def dequant_matmul(x, w):
     """``x @ W`` with int8/fp8-at-rest ``W`` (last-dim contraction, any
-    leading ``x`` dims). int8: the matmul runs in ``x.dtype`` against the
-    casted payload and the per-output-channel scale multiplies the
-    *result* — the dequant never materializes a full-precision weight
-    copy. fp8: the activation is dynamically scaled per tensor and the
-    contraction is a real fp8 ``dot_general`` accumulated in f32 via
-    ``preferred_element_type``."""
+    leading ``x`` dims). int8: per ``DL4J_TPU_FUSED_DEQUANT`` either the
+    Pallas fused kernel (int8 weight tiles + f32 scales stay in VMEM and
+    dequantize inside the MXU contraction loop — a full-precision weight
+    copy never exists in HBM) or the XLA fallback where the matmul runs
+    in ``x.dtype`` against the casted payload; either way the
+    per-output-channel scale multiplies the *result*. fp8: the
+    activation is dynamically scaled per tensor and the contraction is a
+    real fp8 ``dot_general`` accumulated in f32 via
+    ``preferred_element_type``. Plain arrays pass straight through to
+    ``jnp.matmul`` so one code path serves both precisions."""
     if not isinstance(w, QuantizedTensor):
         return jnp.matmul(x, w)
     if w.ndim != 2 or w.scale.shape[0] != 1:
@@ -184,6 +277,16 @@ def dequant_matmul(x, w):
             xq, w.q, (((x.ndim - 1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return (out * (sx * out_scale)).astype(x.dtype)
+    path, reason, interpret = _fused_path(x, w)
+    try:
+        from ..kernels import kernel_dispatch
+        kernel_dispatch("dequant_matmul", path, reason)
+    except Exception:
+        pass  # observability must never break a trace
+    if path == "fused":
+        x2 = jnp.asarray(x).reshape(-1, x.shape[-1])
+        out = _fused_dequant_matmul(x2, w.q, out_scale, interpret)
+        return out.reshape(tuple(x.shape[:-1]) + (w.q.shape[1],))
     out = jnp.matmul(x, w.q.astype(x.dtype))
     return (out * out_scale.astype(x.dtype)).astype(x.dtype)
 

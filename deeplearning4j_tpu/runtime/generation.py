@@ -1753,7 +1753,12 @@ class DecodeEngine:
     def debug_snapshot(self) -> Dict[str, Any]:
         """Live slot map + block tables for ``GET /debug/decode`` and the
         flight recorder: which sequence owns which slot, how many rows it
-        committed, and which pool blocks back it."""
+        committed, and which pool blocks back it — plus the ``kernels``
+        section: which attention/dequant path served the last dispatch
+        (kernel name, chosen path, fallback reason), straight from
+        ``kernels.dispatch_snapshot()``. Dispatch happens at trace time,
+        so that section describes the executables this process compiled,
+        not per-request routing."""
         with self._cv:
             slots = []
             for slot, req in enumerate(self._slot_req):
@@ -1801,6 +1806,11 @@ class DecodeEngine:
                 "draining": self._draining,
                 "closed": self._closed,
             }
+            try:
+                from ..kernels import dispatch_snapshot
+                snap["kernels"] = dispatch_snapshot()
+            except Exception:
+                snap["kernels"] = {}
             if self.mesh is not None:
                 from ..common.mesh import mesh_shape, spec_desc
                 snap["mesh_shape"] = mesh_shape(self.mesh)

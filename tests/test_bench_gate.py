@@ -1423,3 +1423,126 @@ class TestCheckPrefixReuse:
         assert rec["session"]["decode_match"]
         assert rec["session"]["ttft_ratio"] > 1.0
         assert rec["gate_ok"], rec["gate_reason"]
+
+
+def _pd_record(identical=True, g_paged=1, g_flash=0, k_paged=0, k_flash=1,
+               g_compiles=0, k_compiles=0, fused_dispatch=1, fused_err=2e-6,
+               top1=1.0, platform="cpu", interpret=True, speedup=1.4):
+    return {
+        "platform": platform,
+        "interpret": interpret,
+        "gather": {"path": "paged", "tokens_per_sec": 100.0,
+                   "steady_state_compiles": g_compiles,
+                   "dispatch_paged": g_paged,
+                   "dispatch_paged_flash": g_flash},
+        "kernel": {"path": "paged_flash",
+                   "tokens_per_sec": 100.0 * speedup,
+                   "steady_state_compiles": k_compiles,
+                   "dispatch_paged": k_paged,
+                   "dispatch_paged_flash": k_flash},
+        "token_identical": identical,
+        "speedup_vs_gather": speedup,
+        "fused_dequant": {"k": 512, "n": 512, "max_abs_err": fused_err,
+                          "top1_agreement": top1,
+                          "dispatch_fused": fused_dispatch},
+    }
+
+
+class TestCheckPallasDecode:
+    """Gate logic for the pallas_decode metric: token-identical greedy
+    streams between the gather and paged-flash phases, dispatch counters
+    proving which path compiled each phase, zero steady-state recompiles,
+    the fused dequant-matmul within the quant deploy-gate thresholds, and
+    (accelerators only) the kernel actually beating the gather."""
+
+    def test_accepts_good_cpu_record(self):
+        ok, reason = bench.check_pallas_decode(_pd_record())
+        assert ok, reason
+
+    def test_rejects_token_divergence(self):
+        ok, reason = bench.check_pallas_decode(_pd_record(identical=False))
+        assert not ok
+        assert "drop-in" in reason
+
+    def test_rejects_gather_phase_served_by_kernel(self):
+        # the "gather baseline" that secretly compiled the kernel
+        ok, reason = bench.check_pallas_decode(
+            _pd_record(g_paged=1, g_flash=1))
+        assert not ok
+        assert "gather" in reason
+        ok, _ = bench.check_pallas_decode(_pd_record(g_paged=0))
+        assert not ok
+
+    def test_rejects_kernel_phase_served_by_gather(self):
+        # a kernel phase that silently fell back measures nothing
+        ok, reason = bench.check_pallas_decode(
+            _pd_record(k_flash=0, k_paged=1))
+        assert not ok
+        assert "paged-flash" in reason
+
+    def test_rejects_steady_state_recompiles(self):
+        ok, reason = bench.check_pallas_decode(_pd_record(k_compiles=2))
+        assert not ok
+        assert "recompiled" in reason
+        ok, _ = bench.check_pallas_decode(_pd_record(g_compiles=1))
+        assert not ok
+
+    def test_rejects_fused_leg_that_never_fused(self):
+        ok, reason = bench.check_pallas_decode(
+            _pd_record(fused_dispatch=0))
+        assert not ok
+        assert "fallback against itself" in reason
+
+    def test_rejects_fused_divergence_and_top1(self):
+        ok, reason = bench.check_pallas_decode(_pd_record(fused_err=0.3))
+        assert not ok
+        assert "diverges" in reason
+        ok, reason = bench.check_pallas_decode(_pd_record(top1=0.9))
+        assert not ok
+        assert "top-1" in reason
+
+    def test_accelerator_speed_gate_and_boundary(self):
+        # on hardware the kernel must pay for itself; CPU (interpret
+        # mode) skips the speed leg but must say so
+        ok, reason = bench.check_pallas_decode(
+            _pd_record(platform="tpu", interpret=False, speedup=1.01))
+        assert not ok
+        assert "paying for itself" in reason
+        ok, _ = bench.check_pallas_decode(
+            _pd_record(platform="tpu", interpret=False, speedup=1.06))
+        assert ok
+        ok, _ = bench.check_pallas_decode(
+            _pd_record(speedup=0.5))  # cpu: speed leg skipped
+        assert ok
+        ok, reason = bench.check_pallas_decode(_pd_record(interpret=False))
+        assert not ok
+        assert "interpret" in reason
+
+    def test_custom_thresholds(self):
+        rec = _pd_record(platform="tpu", interpret=False, speedup=1.02)
+        ok, _ = bench.check_pallas_decode(rec, min_speedup=1.01)
+        assert ok
+
+    def test_tiny_live_measurement_passes_gate(self):
+        """The full metric end-to-end on CPU: the gather phase runs the
+        XLA block-table gather, the kernel phase the same greedy loop
+        through the interpret-mode Pallas kernel. The deterministic legs
+        ARE asserted in CI (token identity, dispatch-counter proof of
+        which path compiled each phase, zero steady-state recompiles,
+        fused-dequant parity); the throughput leg is informational on
+        CPU."""
+        import jax
+        import jax.numpy as jnp
+
+        rec = bench.bench_pallas_decode(jax, jnp, tiny=True)
+        assert rec["token_identical"]
+        assert rec["interpret"]
+        assert rec["gather"]["dispatch_paged"] >= 1
+        assert rec["gather"]["dispatch_paged_flash"] == 0
+        assert rec["kernel"]["dispatch_paged_flash"] >= 1
+        assert rec["kernel"]["dispatch_paged"] == 0
+        assert rec["gather"]["steady_state_compiles"] == 0
+        assert rec["kernel"]["steady_state_compiles"] == 0
+        assert rec["fused_dequant"]["max_abs_err"] <= 0.25
+        assert rec["fused_dequant"]["dispatch_fused"] >= 1
+        assert rec["gate_ok"], rec["gate_reason"]
